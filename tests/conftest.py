@@ -1,0 +1,32 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is unavailable in CI; sharding correctness is validated
+on 8 virtual CPU devices (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip). Must run before any
+jax import, hence the env mutation at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def fake_kube():
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    return FakeKube()
+
+
+@pytest.fixture()
+def fake_tpu():
+    from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+
+    return FakeTpuBackend()

@@ -1,0 +1,118 @@
+"""Drain / re-admit against the fake apiserver (drain/evict.py)."""
+
+import pytest
+
+from tpu_cc_manager.drain import evict
+from tpu_cc_manager.drain.pause import is_paused
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS, PAUSED_VALUE
+
+NODE = "tpu-node-0"
+NS = "tpu-operator"
+DP_LABEL = "google.com/tpu.deploy.device-plugin"
+DP_APP = DRAIN_COMPONENT_LABELS[DP_LABEL]
+
+
+def operator_controller(fake_kube):
+    """Emulate the operator: when a component label is paused, delete its pods
+    (the external behavior the reference relies on, SURVEY.md §5)."""
+
+    def reactor(name, node):
+        for key, app in DRAIN_COMPONENT_LABELS.items():
+            if is_paused(node_labels(node).get(key)):
+                fake_kube.delete_pods_matching(NS, f"app={app}")
+
+    fake_kube.add_patch_reactor(reactor)
+
+
+def test_evict_pauses_and_waits(fake_kube):
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "dp-pod", NODE, labels={"app": DP_APP})
+    operator_controller(fake_kube)
+
+    original = evict.evict_components(fake_kube, NODE, NS, timeout_s=5, poll_interval_s=0.01)
+    assert original == {DP_LABEL: "true"}
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[DP_LABEL] == PAUSED_VALUE
+    assert fake_kube.list_pods(NS, label_selector=f"app={DP_APP}") == []
+
+
+def test_readmit_restores_labels(fake_kube):
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    operator_controller(fake_kube)
+    original = evict.evict_components(fake_kube, NODE, NS, timeout_s=1, poll_interval_s=0.01)
+    evict.readmit_components(fake_kube, NODE, original)
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "true"
+
+
+def test_custom_value_roundtrip(fake_kube):
+    fake_kube.add_node(NODE, {DP_LABEL: "custom-flavor"})
+    operator_controller(fake_kube)
+    original = evict.evict_components(fake_kube, NODE, NS, timeout_s=1, poll_interval_s=0.01)
+    assert is_paused(node_labels(fake_kube.get_node(NODE))[DP_LABEL])
+    evict.readmit_components(fake_kube, NODE, original)
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "custom-flavor"
+
+
+def test_disabled_component_untouched(fake_kube):
+    fake_kube.add_node(NODE, {DP_LABEL: "false"})
+    original = evict.evict_components(fake_kube, NODE, NS, timeout_s=1, poll_interval_s=0.01)
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "false"
+    evict.readmit_components(fake_kube, NODE, original)
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "false"
+
+
+def test_timeout_proceeds_by_default(fake_kube):
+    # No controller: the pod never goes away.
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "stuck", NODE, labels={"app": DP_APP})
+    # Reference behavior: warn and continue (gpu_operator_eviction.py:205-207).
+    evict.evict_components(fake_kube, NODE, NS, timeout_s=0.05, poll_interval_s=0.01)
+
+
+def test_timeout_strict_raises(fake_kube):
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "stuck", NODE, labels={"app": DP_APP})
+    with pytest.raises(evict.EvictionTimeout):
+        evict.evict_components(
+            fake_kube, NODE, NS, timeout_s=0.05, poll_interval_s=0.01,
+            proceed_on_timeout=False,
+        )
+
+
+def test_already_paused_labels_still_waited_on(fake_kube):
+    """Crash recovery: a previous run paused the component and died; the
+    retry must still wait for the component's pods to finish terminating
+    even though there is nothing new to patch."""
+    from tpu_cc_manager.labels import PAUSED_VALUE as PV
+
+    fake_kube.add_node(NODE, {DP_LABEL: PV})
+    fake_kube.add_pod(NS, "terminating", NODE, labels={"app": DP_APP})
+    calls_before = fake_kube.list_pod_calls
+    evict.evict_components(fake_kube, NODE, NS, timeout_s=0.05, poll_interval_s=0.01)
+    # It polled (and timed out per the proceed-on-timeout default).
+    assert fake_kube.list_pod_calls > calls_before
+
+
+def test_readmit_after_crash_recovery_does_not_strand_paused(fake_kube):
+    """If the remembered 'original' snapshot is itself a paused value (taken
+    by a crash-recovery run), readmit must not write it back."""
+    from tpu_cc_manager.labels import PAUSED_VALUE as PV
+
+    fake_kube.add_node(NODE, {DP_LABEL: PV})
+    original = evict.evict_components(
+        fake_kube, NODE, NS, timeout_s=0.05, poll_interval_s=0.01
+    )
+    assert original == {DP_LABEL: PV}
+    evict.readmit_components(fake_kube, NODE, original)
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "true"
+
+
+def test_readmit_respects_concurrent_user_disable(fake_kube):
+    """A user disabling a component mid-drain wins over the unpause."""
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    operator_controller(fake_kube)
+    original = evict.evict_components(fake_kube, NODE, NS, timeout_s=1, poll_interval_s=0.01)
+    fake_kube.set_node_label(NODE, DP_LABEL, "false")  # concurrent user edit
+    evict.readmit_components(fake_kube, NODE, original)
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "false"

@@ -1,0 +1,73 @@
+"""The in-memory apiserver fake itself (kubeclient/fake.py)."""
+
+import pytest
+
+from tpu_cc_manager.kubeclient.api import KubeApiError, node_labels
+
+
+def test_node_crud_and_labels(fake_kube):
+    fake_kube.add_node("n1", {"a": "1"})
+    node = fake_kube.get_node("n1")
+    assert node_labels(node) == {"a": "1"}
+    fake_kube.patch_node_labels("n1", {"b": "2", "a": None})
+    assert node_labels(fake_kube.get_node("n1")) == {"b": "2"}
+    with pytest.raises(KubeApiError) as exc:
+        fake_kube.get_node("missing")
+    assert exc.value.status == 404
+
+
+def test_pod_selectors(fake_kube):
+    fake_kube.add_pod("ns", "p1", "n1", labels={"app": "x"})
+    fake_kube.add_pod("ns", "p2", "n2", labels={"app": "x"})
+    fake_kube.add_pod("ns", "p3", "n1", labels={"app": "y"})
+    pods = fake_kube.list_pods("ns", label_selector="app=x", field_selector="spec.nodeName=n1")
+    assert [p["metadata"]["name"] for p in pods] == ["p1"]
+    assert len(fake_kube.list_pods("ns", label_selector="app=x")) == 2
+    assert fake_kube.list_pods("other") == []
+
+
+def test_node_label_selector(fake_kube):
+    fake_kube.add_node("n1", {"pool": "tpu"})
+    fake_kube.add_node("n2", {"pool": "cpu"})
+    assert len(fake_kube.list_nodes("pool=tpu")) == 1
+    assert len(fake_kube.list_nodes("pool")) == 2
+    assert len(fake_kube.list_nodes()) == 2
+
+
+def test_watch_sees_patches(fake_kube):
+    fake_kube.add_node("n1")
+    rv = fake_kube.get_node("n1")["metadata"]["resourceVersion"]
+    fake_kube.patch_node_labels("n1", {"k": "v"})
+    events = list(fake_kube.watch_nodes("n1", rv, timeout_seconds=1))
+    assert len(events) == 1
+    assert events[0].type == "MODIFIED"
+    assert node_labels(events[0].object) == {"k": "v"}
+
+
+def test_watch_410_after_compaction(fake_kube):
+    fake_kube.add_node("n1")
+    rv = fake_kube.get_node("n1")["metadata"]["resourceVersion"]
+    fake_kube.patch_node_labels("n1", {"k": "v"})
+    fake_kube.patch_node_labels("n1", {"k": "v2"})
+    fake_kube.compact()
+    with pytest.raises(KubeApiError) as exc:
+        list(fake_kube.watch_nodes("n1", rv, timeout_seconds=1))
+    assert exc.value.status == 410
+
+
+def test_watch_fault_injection(fake_kube):
+    fake_kube.add_node("n1")
+    fake_kube.inject_watch_fault(KubeApiError(None, "boom"))
+    with pytest.raises(KubeApiError):
+        list(fake_kube.watch_nodes("n1", None, timeout_seconds=1))
+    # Next watch works again (rv=None replays from the beginning: ADDED).
+    events = list(fake_kube.watch_nodes("n1", None, timeout_seconds=0))
+    assert [e.type for e in events] == ["ADDED"]
+
+
+def test_patch_reactor_fires(fake_kube):
+    fake_kube.add_node("n1")
+    seen = []
+    fake_kube.add_patch_reactor(lambda name, node: seen.append(name))
+    fake_kube.patch_node_labels("n1", {"x": "1"})
+    assert seen == ["n1"]

@@ -1,0 +1,25 @@
+"""Host CC capability probing (ccmanager/hostcaps.py vs reference
+main.py:80-103)."""
+
+from tpu_cc_manager.ccmanager.hostcaps import is_host_cc_enabled
+
+
+def test_no_probes_match(tmp_path):
+    probes = (("missing", str(tmp_path / "nope"), None),)
+    assert is_host_cc_enabled(probes) is False
+
+
+def test_device_node_presence(tmp_path):
+    dev = tmp_path / "tdx_guest"
+    dev.touch()
+    probes = (("TDX guest", str(dev), None),)
+    assert is_host_cc_enabled(probes) is True
+
+
+def test_sysfs_param_content(tmp_path):
+    param = tmp_path / "tdx"
+    param.write_text("Y\n")
+    probes = (("KVM TDX", str(param), "Y"),)
+    assert is_host_cc_enabled(probes) is True
+    param.write_text("N\n")
+    assert is_host_cc_enabled(probes) is False

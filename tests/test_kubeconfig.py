@@ -1,0 +1,59 @@
+"""Kubeconfig parsing (kubeclient/rest.py ClusterConfig)."""
+
+import base64
+
+import pytest
+
+from tpu_cc_manager.kubeclient.api import KubeApiError
+from tpu_cc_manager.kubeclient.rest import ClusterConfig
+
+
+def write_kubeconfig(tmp_path, user: dict):
+    cfg = {
+        "current-context": "test",
+        "contexts": [{"name": "test", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [
+            {
+                "name": "c",
+                "cluster": {
+                    "server": "https://example:6443",
+                    "insecure-skip-tls-verify": True,
+                },
+            }
+        ],
+        "users": [{"name": "u", "user": user}],
+    }
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_token_auth(tmp_path):
+    path = write_kubeconfig(tmp_path, {"token": "sekret"})
+    cfg = ClusterConfig.from_kubeconfig(path)
+    assert cfg.server == "https://example:6443"
+    assert cfg.token == "sekret"
+    assert cfg.insecure_skip_tls_verify is True
+
+
+def test_client_cert_data_materialized(tmp_path):
+    cert = base64.b64encode(b"CERTDATA").decode()
+    key = base64.b64encode(b"KEYDATA").decode()
+    path = write_kubeconfig(
+        tmp_path, {"client-certificate-data": cert, "client-key-data": key}
+    )
+    cfg = ClusterConfig.from_kubeconfig(path)
+    assert cfg.client_cert_file and cfg.client_key_file
+    with open(cfg.client_cert_file, "rb") as f:
+        assert f.read() == b"CERTDATA"
+
+
+def test_missing_context_raises(tmp_path):
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump({"clusters": []}))
+    with pytest.raises(KubeApiError):
+        ClusterConfig.from_kubeconfig(str(path))

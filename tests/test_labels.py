@@ -1,0 +1,32 @@
+"""Mode canonicalization and ready-state derivation (labels.py)."""
+
+from tpu_cc_manager.labels import (
+    MODE_DEVTOOLS,
+    MODE_OFF,
+    MODE_ON,
+    MODE_SLICE,
+    STATE_FAILED,
+    canonical_mode,
+    ready_state_for,
+)
+
+
+def test_canonical_mode_passthrough():
+    for m in (MODE_ON, MODE_OFF, MODE_DEVTOOLS, MODE_SLICE):
+        assert canonical_mode(m) == m
+
+
+def test_ppcie_alias_maps_to_slice():
+    assert canonical_mode("ppcie") == MODE_SLICE
+
+
+def test_ready_state():
+    # Reference semantics (gpu_operator_eviction.py:275-288): on/fabric-wide
+    # modes are ready, off is not, failed/unknown are indeterminate.
+    assert ready_state_for(MODE_ON) == "true"
+    assert ready_state_for(MODE_SLICE) == "true"
+    assert ready_state_for(MODE_OFF) == "false"
+    assert ready_state_for(STATE_FAILED) == ""
+    assert ready_state_for("unknown") == ""
+    # Deliberate divergence (SURVEY.md §8.4): devtools is explicit.
+    assert ready_state_for(MODE_DEVTOOLS) == "debug"

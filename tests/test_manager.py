@@ -1,0 +1,263 @@
+"""CCManager reconciler state machine (ccmanager/manager.py vs reference
+call stacks SURVEY.md §3.2/§3.3)."""
+
+import pytest
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.drain.pause import is_paused
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    CC_READY_STATE_LABEL,
+    DRAIN_COMPONENT_LABELS,
+    MODE_DEVTOOLS,
+    MODE_OFF,
+    MODE_ON,
+    MODE_SLICE,
+    STATE_FAILED,
+)
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "tpu-node-0"
+NS = "tpu-operator"
+DP_LABEL = "google.com/tpu.deploy.device-plugin"
+DP_APP = DRAIN_COMPONENT_LABELS[DP_LABEL]
+
+
+def make_manager(fake_kube, backend, **kw):
+    kw.setdefault("evict_components", False)
+    kw.setdefault("smoke_workload", "none")
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("eviction_timeout_s", 1)
+    kw.setdefault("eviction_poll_interval_s", 0.01)
+    return CCManager(
+        api=fake_kube,
+        backend=backend,
+        node_name=NODE,
+        operator_namespace=NS,
+        **kw,
+    )
+
+
+def state_of(fake_kube):
+    labels = node_labels(fake_kube.get_node(NODE))
+    return labels.get(CC_MODE_STATE_LABEL), labels.get(CC_READY_STATE_LABEL)
+
+
+def test_mode_on_happy_path(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert state_of(fake_kube) == (MODE_ON, "true")
+    ops = [op for op, _ in fake_tpu.op_log]
+    # stage-all before reset-all before wait (reference main.py:502-529).
+    assert ops.index("stage") < ops.index("reset") < ops.index("wait_ready")
+    assert "attest" in ops
+
+
+def test_mode_off_skips_attestation(fake_kube):
+    backend = FakeTpuBackend(initial_mode=MODE_ON)
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_OFF) is True
+    assert state_of(fake_kube) == (MODE_OFF, "false")
+    assert "attest" not in [op for op, _ in backend.op_log]
+
+
+def test_idempotent_apply_skips_reset(fake_kube):
+    backend = FakeTpuBackend(initial_mode=MODE_ON)
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert "reset" not in [op for op, _ in backend.op_log]
+    # State is still reported (reference main.py:255-258).
+    assert state_of(fake_kube) == (MODE_ON, "true")
+
+
+def test_mixed_capability_exits(fake_kube):
+    backend = FakeTpuBackend(num_chips=4, cc_supported=[True, True, False, False])
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    # Crash-as-retry (reference main.py:237-240).
+    with pytest.raises(SystemExit):
+        mgr.set_cc_mode(MODE_ON)
+
+
+def test_no_cc_capable_chips_reports_off(fake_kube):
+    backend = FakeTpuBackend(cc_supported=False)
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert state_of(fake_kube) == (MODE_OFF, "false")
+
+
+def test_slice_mode_requires_all_chips(fake_kube):
+    backend = FakeTpuBackend(slice_cc_supported=[True, True, True, False])
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    # Reference PPCIe all-must-support rule (main.py:279-282).
+    with pytest.raises(SystemExit):
+        mgr.set_cc_mode(MODE_SLICE)
+
+
+def test_slice_mode_happy_path(fake_kube):
+    backend = FakeTpuBackend(num_hosts=2, accelerator_type="v5p-32")
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_SLICE) is True
+    assert state_of(fake_kube) == (MODE_SLICE, "true")
+
+
+def test_ppcie_alias(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode("ppcie") is True
+    assert state_of(fake_kube) == (MODE_SLICE, "true")
+
+
+def test_invalid_mode_rejected(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode("bogus") is False
+    assert state_of(fake_kube) == (None, None)  # state untouched
+
+
+def test_reset_failure_labels_failed(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    fake_tpu.fail_next("reset")
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert state_of(fake_kube) == (STATE_FAILED, "")
+
+
+def test_verification_mismatch_labels_failed(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    orig_reset = fake_tpu.reset
+
+    def sabotaged_reset(chips):
+        fake_tpu.staged.clear()  # staged mode never lands
+        orig_reset(chips)
+
+    fake_tpu.reset = sabotaged_reset
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert state_of(fake_kube) == (STATE_FAILED, "")
+
+
+def test_attestation_failure_labels_failed(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    fake_tpu.fail_next("attest")
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert state_of(fake_kube) == (STATE_FAILED, "")
+
+
+def test_devtools_mode_applies(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode(MODE_DEVTOOLS) is True
+    assert state_of(fake_kube) == (MODE_DEVTOOLS, "debug")
+
+
+def test_eviction_wraps_reconfigure(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "dp", NODE, labels={"app": DP_APP})
+
+    observed = {}
+
+    def reactor(name, node):
+        value = node_labels(node).get(DP_LABEL)
+        if is_paused(value):
+            observed.setdefault(
+                "paused_before_reset",
+                "reset" not in [op for op, _ in fake_tpu.op_log],
+            )
+            fake_kube.delete_pods_matching(NS, f"app={DP_APP}")
+
+    fake_kube.add_patch_reactor(reactor)
+    mgr = make_manager(fake_kube, fake_tpu, evict_components=True)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    # Drain happened before the hardware reset (reference main.py:544-578).
+    assert observed.get("paused_before_reset") is True
+    # Component label restored afterward.
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "true"
+
+
+def test_readmit_even_on_failure(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_tpu.fail_next("reset")
+    mgr = make_manager(fake_kube, fake_tpu, evict_components=True)
+    assert mgr.set_cc_mode(MODE_ON) is False
+    # Never left paused by a failed toggle.
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "true"
+    assert state_of(fake_kube)[0] == STATE_FAILED
+
+
+def test_smoke_failure_labels_failed(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+
+    def failing_smoke(workload):
+        raise RuntimeError("numerics mismatch")
+
+    mgr = make_manager(
+        fake_kube, fake_tpu, smoke_workload="matmul", smoke_runner=failing_smoke
+    )
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert state_of(fake_kube) == (STATE_FAILED, "")
+
+
+def test_smoke_runner_invoked_with_workload(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    calls = []
+
+    def smoke(workload):
+        calls.append(workload)
+        return {"ok": True}
+
+    mgr = make_manager(fake_kube, fake_tpu, smoke_workload="matmul", smoke_runner=smoke)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert calls == ["matmul"]
+
+
+def test_with_default(fake_kube, fake_tpu):
+    mgr = make_manager(fake_kube, fake_tpu, default_mode=MODE_ON)
+    assert mgr.with_default(None) == MODE_ON
+    assert mgr.with_default("") == MODE_ON
+    assert mgr.with_default(MODE_OFF) == MODE_OFF
+    assert mgr.with_default("ppcie") == MODE_SLICE
+
+
+def test_escaping_exception_not_recorded_ok(fake_kube, fake_tpu):
+    """A KubeApiError escaping mid-drain must not count as a successful
+    reconcile in the metrics."""
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    registry = MetricsRegistry()
+
+    real_list_pods = fake_kube.list_pods
+
+    def exploding_list_pods(*a, **kw):
+        raise KubeApiError(500, "apiserver down")
+
+    fake_kube.list_pods = exploding_list_pods
+    mgr = make_manager(fake_kube, fake_tpu, evict_components=True, metrics=registry)
+    with pytest.raises(KubeApiError):
+        mgr.set_cc_mode(MODE_ON)
+    fake_kube.list_pods = real_list_pods
+    assert registry.last().result == "failed"
+
+
+def test_phase_metrics_recorded(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE)
+    registry = MetricsRegistry()
+    mgr = make_manager(fake_kube, fake_tpu, metrics=registry)
+    mgr.set_cc_mode(MODE_ON)
+    m = registry.last()
+    assert m is not None and m.result == "ok"
+    names = [p.name for p in m.phases]
+    assert names == ["stage", "reset", "wait_ready", "attest"]
+    text = registry.render_prometheus()
+    assert "tpu_cc_reconcile_seconds" in text
+    assert 'phase="reset"' in text

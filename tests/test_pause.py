@@ -1,0 +1,51 @@
+"""Pause/unpause label algebra (reference gpu_operator_eviction.py:43-95)."""
+
+import pytest
+
+from tpu_cc_manager.drain.pause import is_paused, pause_value, unpause_value
+from tpu_cc_manager.labels import PAUSED_SUFFIX, PAUSED_VALUE
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        ("true", PAUSED_VALUE),            # enabled -> paused
+        ("custom", "custom" + PAUSED_SUFFIX),  # custom value preserved
+        ("false", None),                   # user-disabled: untouched
+        ("", None),                        # empty: untouched
+        (None, None),                      # absent: untouched
+        (PAUSED_VALUE, None),              # already paused: idempotent
+        ("custom" + PAUSED_SUFFIX, None),  # already paused custom: idempotent
+    ],
+)
+def test_pause_value(value, expected):
+    assert pause_value(value) == expected
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        (PAUSED_VALUE, "true"),
+        ("custom" + PAUSED_SUFFIX, "custom"),
+        ("true", None),
+        ("false", None),
+        ("", None),
+        (None, None),
+    ],
+)
+def test_unpause_value(value, expected):
+    assert unpause_value(value) == expected
+
+
+def test_pause_unpause_roundtrip():
+    for original in ("true", "vfio", "some-custom-value"):
+        paused = pause_value(original)
+        assert paused is not None and is_paused(paused)
+        assert unpause_value(paused) == original
+
+
+def test_is_paused():
+    assert is_paused(PAUSED_VALUE)
+    assert is_paused("x" + PAUSED_SUFFIX)
+    assert not is_paused("true")
+    assert not is_paused(None)

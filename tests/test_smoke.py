@@ -1,0 +1,32 @@
+"""Smoke workloads on the virtual CPU mesh (smoke/)."""
+
+import pytest
+
+from tpu_cc_manager.smoke import runner
+
+
+def test_matmul_smoke_passes():
+    result = runner.run_workload("matmul", size=256, iters=1)
+    assert result["ok"] is True
+    assert result["workload"] == "matmul"
+    assert result["devices"] >= 1
+    assert result["tflops"] > 0
+
+
+def test_matmul_uses_all_virtual_devices():
+    import jax
+
+    result = runner.run_workload("matmul", size=256, iters=1)
+    assert result["devices"] == len(jax.devices())
+
+
+def test_unknown_workload_rejected():
+    with pytest.raises(runner.SmokeError):
+        runner.run_workload("does-not-exist")
+
+
+def test_subprocess_runner_matmul():
+    # The manager's production path: workload in a child process so the agent
+    # never holds the TPU.
+    result = runner.run_workload_subprocess("matmul", timeout_s=300)
+    assert result["ok"] is True

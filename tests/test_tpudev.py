@@ -1,0 +1,156 @@
+"""Device layer: fake backend semantics, tpuvm backend with injected
+environment, attestation verification."""
+
+import os
+
+import pytest
+
+from tpu_cc_manager.labels import MODE_OFF, MODE_ON
+from tpu_cc_manager.tpudev import load_backend
+from tpu_cc_manager.tpudev.attestation import (
+    AttestationError,
+    fresh_nonce,
+    verify_quote,
+)
+from tpu_cc_manager.tpudev.contract import TpuError
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.tpudev.tpuvm import TpuVmBackend, parse_accelerator_type
+
+
+class TestFakeBackend:
+    def test_stage_then_reset_commits(self, fake_tpu):
+        topo = fake_tpu.discover()
+        chips = topo.chips
+        fake_tpu.stage_cc_mode(chips, MODE_ON)
+        # Staged but not committed yet.
+        assert all(fake_tpu.query_cc_mode(c) == MODE_OFF for c in chips)
+        fake_tpu.reset(chips)
+        fake_tpu.wait_ready(chips, timeout_s=1)
+        assert all(fake_tpu.query_cc_mode(c) == MODE_ON for c in chips)
+
+    def test_fault_injection(self, fake_tpu):
+        fake_tpu.fail_next("reset")
+        with pytest.raises(TpuError):
+            fake_tpu.reset(fake_tpu.discover().chips)
+        fake_tpu.reset(fake_tpu.discover().chips)  # next call succeeds
+
+    def test_attestation_roundtrip(self, fake_tpu):
+        topo = fake_tpu.discover()
+        fake_tpu.stage_cc_mode(topo.chips, MODE_ON)
+        fake_tpu.reset(topo.chips)
+        nonce = fresh_nonce()
+        quote = fake_tpu.fetch_attestation(nonce)
+        assert verify_quote(quote, nonce, MODE_ON, topo.slice_id) == []
+
+    def test_attestation_rejects_tampering(self, fake_tpu):
+        import dataclasses
+
+        nonce = fresh_nonce()
+        quote = fake_tpu.fetch_attestation(nonce)
+        bad = dataclasses.replace(quote, signature="0" * 64)
+        with pytest.raises(AttestationError):
+            verify_quote(bad, nonce, MODE_OFF)
+
+    def test_attestation_rejects_stale_nonce(self, fake_tpu):
+        quote = fake_tpu.fetch_attestation("nonce-a")
+        with pytest.raises(AttestationError):
+            verify_quote(quote, "nonce-b", MODE_OFF)
+
+    def test_devtools_policy_logs_instead_of_raising(self, fake_tpu):
+        quote = fake_tpu.fetch_attestation("nonce-a")
+        problems = verify_quote(quote, "nonce-b", MODE_OFF, debug_policy=True)
+        assert problems  # reported, not raised
+
+
+class TestTpuVmBackend:
+    @pytest.fixture()
+    def backend(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        monkeypatch.delenv("TPU_SLICE_ID", raising=False)
+        # Fabricate device nodes.
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        for i in range(4):
+            (devdir / f"accel{i}").touch()
+        return TpuVmBackend(
+            state_dir=str(tmp_path / "state"),
+            reset_cmd=["true"],
+            metadata_url="http://127.0.0.1:1",  # unreachable -> env fallbacks
+            device_glob=str(devdir / "accel*"),
+        )
+
+    def test_discover(self, backend):
+        topo = backend.discover()
+        assert topo.accelerator_type == "v5p-8"
+        assert len(topo.chips) == 4
+        assert topo.num_hosts == 1
+        assert topo.host_index == 0
+
+    def test_stage_reset_query_roundtrip(self, backend):
+        topo = backend.discover()
+        assert backend.query_cc_mode(topo.chips[0]) == MODE_OFF
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        assert backend.query_cc_mode(topo.chips[0]) == MODE_OFF  # not committed
+        backend.reset(topo.chips)
+        assert all(backend.query_cc_mode(c) == MODE_ON for c in topo.chips)
+        backend.wait_ready(topo.chips, timeout_s=1)
+
+    def test_reset_command_failure(self, backend):
+        backend.reset_cmd = ["false"]
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        with pytest.raises(TpuError):
+            backend.reset(topo.chips)
+        # Crash-safety: the failed reset must NOT look committed — the chip
+        # reports an in-between state so idempotency checks re-apply.
+        assert backend.query_cc_mode(topo.chips[0]) == "resetting"
+        # Retry succeeds and commits.
+        backend.reset_cmd = ["true"]
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        backend.reset(topo.chips)
+        assert backend.query_cc_mode(topo.chips[0]) == MODE_ON
+
+    def test_state_survives_restart(self, backend, tmp_path):
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        backend.reset(topo.chips)
+        reborn = TpuVmBackend(
+            state_dir=backend.state_dir,
+            reset_cmd=["true"],
+            metadata_url="http://127.0.0.1:1",
+            device_glob=backend.device_glob,
+        )
+        assert all(reborn.query_cc_mode(c) == MODE_ON for c in topo.chips)
+
+    def test_attestation_needs_metadata_server(self, backend):
+        with pytest.raises(TpuError):
+            backend.fetch_attestation("n")
+
+
+@pytest.mark.parametrize(
+    "accel,gen,chips,hosts",
+    [
+        ("v5e-1", "v5e", 1, 1),
+        ("v5e-8", "v5e", 8, 1),
+        ("v5p-8", "v5p", 4, 1),
+        ("v5p-32", "v5p", 16, 4),
+        ("v5p-64", "v5p", 32, 8),
+        ("v4-16", "v4", 8, 2),
+        ("v6e-16", "v6e", 16, 2),
+    ],
+)
+def test_parse_accelerator_type(accel, gen, chips, hosts):
+    assert parse_accelerator_type(accel) == (gen, chips, hosts)
+
+
+def test_parse_accelerator_type_garbage():
+    with pytest.raises(TpuError):
+        parse_accelerator_type("not-a-number-x")
+
+
+def test_load_backend_factory(tmp_path):
+    assert isinstance(load_backend("fake"), FakeTpuBackend)
+    assert isinstance(load_backend("tpuvm", state_dir=str(tmp_path)), TpuVmBackend)
+    with pytest.raises(ValueError):
+        load_backend("gpu")

@@ -1,0 +1,163 @@
+"""Watch loop semantics (ccmanager/manager.py watch_and_apply vs reference
+main.py:600-684): initial apply, change detection, 410 resync, error cap,
+readiness file."""
+
+import threading
+
+import pytest
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.kubeclient.api import KubeApiError, WatchEvent, node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    MODE_OFF,
+    MODE_ON,
+)
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "tpu-node-0"
+
+
+class ScriptedKube(FakeKube):
+    """FakeKube whose watch stream is a script: each segment is either a list
+    of WatchEvents or an exception to raise. When the script runs out the
+    stop event fires, ending watch_and_apply deterministically."""
+
+    def __init__(self):
+        super().__init__()
+        self.segments = []
+        self.stop = threading.Event()
+
+    def watch_nodes(self, name, resource_version=None, timeout_seconds=300):
+        if not self.segments:
+            self.stop.set()
+            return iter(())
+        seg = self.segments.pop(0)
+        if callable(seg):
+            seg = seg()  # side-effects mid-script (may raise)
+        if isinstance(seg, Exception):
+            raise seg
+        return iter(seg)
+
+
+def modified_event(labels, rv="100"):
+    return WatchEvent(
+        "MODIFIED",
+        {"metadata": {"name": NODE, "labels": labels, "resourceVersion": rv}},
+    )
+
+
+def make_manager(kube, backend, **kw):
+    kw.setdefault("evict_components", False)
+    kw.setdefault("smoke_workload", "none")
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("reconnect_delay_s", 0.0)
+    return CCManager(api=kube, backend=backend, node_name=NODE, **kw)
+
+
+@pytest.fixture()
+def kube(tmp_path):
+    k = ScriptedKube()
+    k.add_node(NODE)
+    return k
+
+
+def run_to_completion(mgr, kube):
+    mgr.watch_and_apply(stop=kube.stop)
+
+
+def test_initial_apply_uses_default(kube, fake_tpu, tmp_path):
+    mgr = make_manager(
+        kube, fake_tpu, default_mode=MODE_ON,
+        readiness_file=str(tmp_path / "ready"),
+    )
+    run_to_completion(mgr, kube)
+    assert node_labels(kube.get_node(NODE))[CC_MODE_STATE_LABEL] == MODE_ON
+    assert (tmp_path / "ready").exists()  # reference main.py:612
+
+
+def test_label_change_triggers_apply(kube, fake_tpu, tmp_path):
+    kube.set_node_label(NODE, CC_MODE_LABEL, MODE_OFF)
+    kube.segments = [[modified_event({CC_MODE_LABEL: MODE_ON})]]
+    mgr = make_manager(kube, fake_tpu, readiness_file=str(tmp_path / "r"))
+    run_to_completion(mgr, kube)
+    assert node_labels(kube.get_node(NODE))[CC_MODE_STATE_LABEL] == MODE_ON
+
+
+def test_unchanged_label_does_not_reapply(kube, tmp_path):
+    backend = FakeTpuBackend(initial_mode=MODE_ON)
+    kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+    kube.segments = [
+        [modified_event({CC_MODE_LABEL: MODE_ON, "unrelated": "edit"})],
+    ]
+    mgr = make_manager(kube, backend, readiness_file=str(tmp_path / "r"))
+    run_to_completion(mgr, kube)
+    # Exactly one discover from the initial apply; the unrelated label edit
+    # must not trigger a second reconcile (reference main.py:646-657).
+    assert [op for op, _ in backend.op_log].count("discover") == 1
+
+
+def test_410_resyncs_via_get(kube, fake_tpu, tmp_path):
+    kube.set_node_label(NODE, CC_MODE_LABEL, MODE_OFF)
+
+    def break_watch():
+        # The desired mode changes "while the watch was broken" — only the
+        # resync re-GET (reference main.py:670-682) can observe it.
+        kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+        return KubeApiError(410, "gone")
+
+    kube.segments = [break_watch]
+    mgr = make_manager(kube, fake_tpu, readiness_file=str(tmp_path / "r"))
+    run_to_completion(mgr, kube)
+    assert node_labels(kube.get_node(NODE))[CC_MODE_STATE_LABEL] == MODE_ON
+
+
+def test_error_event_410_resyncs(kube, fake_tpu, tmp_path):
+    kube.set_node_label(NODE, CC_MODE_LABEL, MODE_OFF)
+
+    def label_change_then_error_event():
+        kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+        return [WatchEvent("ERROR", {"code": 410, "message": "too old"})]
+
+    kube.segments = [label_change_then_error_event]
+    mgr = make_manager(kube, fake_tpu, readiness_file=str(tmp_path / "r"))
+    run_to_completion(mgr, kube)
+    assert node_labels(kube.get_node(NODE))[CC_MODE_STATE_LABEL] == MODE_ON
+
+
+def test_consecutive_error_cap_is_fatal(kube, fake_tpu, tmp_path):
+    kube.segments = [KubeApiError(None, "boom")] * 3
+    mgr = make_manager(
+        kube, fake_tpu, max_watch_errors=3, readiness_file=str(tmp_path / "r")
+    )
+    # Reference main.py:661-668: cap exhaustion raises; pod restart recovers.
+    with pytest.raises(RuntimeError):
+        run_to_completion(mgr, kube)
+
+
+def test_error_counter_resets_on_success(kube, fake_tpu, tmp_path):
+    # Two errors, a good event, two more errors: never hits cap=3
+    # (documented reference quirk, SURVEY.md §8.6).
+    kube.segments = [
+        KubeApiError(None, "e1"),
+        KubeApiError(None, "e2"),
+        [modified_event({CC_MODE_LABEL: MODE_OFF})],
+        KubeApiError(None, "e3"),
+        KubeApiError(None, "e4"),
+    ]
+    mgr = make_manager(
+        kube, fake_tpu, max_watch_errors=3, readiness_file=str(tmp_path / "r")
+    )
+    run_to_completion(mgr, kube)  # completes without RuntimeError
+
+
+def test_error_event_cap_is_fatal(kube, fake_tpu, tmp_path):
+    kube.segments = [[WatchEvent("ERROR", {"code": 500})] for _ in range(3)]
+    mgr = make_manager(
+        kube, fake_tpu, max_watch_errors=3, readiness_file=str(tmp_path / "r")
+    )
+    with pytest.raises(RuntimeError):
+        run_to_completion(mgr, kube)

@@ -1,0 +1,36 @@
+"""tpu-cc-manager: a TPU-native confidential-computing control plane for GKE.
+
+Built from scratch with the capabilities of NVIDIA's k8s-cc-manager
+(reference: /root/reference, see SURVEY.md): a per-node DaemonSet agent that
+
+1. watches the desired-state node label ``cloud.google.com/tpu-cc.mode``
+   (reference analogue: ``nvidia.com/cc.mode``, main.py:62),
+2. drains TPU device-plugin / workload pods via a label pause protocol
+   (reference: gpu_operator_eviction.py:131-214),
+3. flips the whole ICI-connected TPU slice into/out of confidential-computing
+   mode with stage-all/reset-all/verify-all atomicity (the TPU analogue of the
+   reference's fabric-atomic PPCIe flow, main.py:317-391),
+4. fetches and verifies a slice attestation quote (new; no reference
+   counterpart),
+5. validates the reconfigured slice end-to-end with an in-tree JAX/XLA smoke
+   workload (new; no reference counterpart),
+6. re-admits the drained components (reference:
+   gpu_operator_eviction.py:217-259) and reports actual state through node
+   labels (reference: gpu_operator_eviction.py:262-295).
+
+Package layout:
+
+- ``kubeclient/``  minimal Kubernetes REST client (stdlib only) + fake server
+- ``tpudev/``      TPU device layer: CC backend contract, fake + TPU VM impls
+- ``drain/``       pause/unpause label algebra, eviction, state reporting
+- ``ccmanager/``   the reconciler, watch loop, rolling orchestrator, CLI
+- ``smoke/``       JAX validation workloads (matmul, Llama, ResNet-50)
+- ``models/``      flax model definitions used by the smoke workloads
+- ``parallel/``    mesh / sharding / checkpoint / multi-slice DP over DCN
+- ``ops/``         pallas TPU kernels for the smoke-model hot paths
+- ``utils/``       logging, phase metrics, config
+"""
+
+from tpu_cc_manager.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,9 @@
+"""``python -m tpu_cc_manager`` runs the node agent (the container
+entrypoint; reference analogue: ``python3 /app/main.py``,
+Dockerfile.distroless:70)."""
+
+import sys
+
+from tpu_cc_manager.ccmanager.cli import main
+
+sys.exit(main())

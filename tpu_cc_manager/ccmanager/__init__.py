@@ -1,0 +1,9 @@
+"""The control loop: reconciler, watch loop, rolling orchestrator, CLI.
+
+Reference analogue: main.py (CCManager, watch_and_apply, main(); SURVEY.md §2
+#1-#4, §3).
+"""
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+
+__all__ = ["CCManager"]

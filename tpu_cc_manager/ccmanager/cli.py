@@ -1,0 +1,124 @@
+"""CLI entry / bootstrap.
+
+Reference analogue: main() (main.py:698-763; SURVEY.md §2 #1). Flags carry
+the same env-var defaulting scheme (--kubeconfig/KUBECONFIG,
+--default-cc-mode/DEFAULT_CC_MODE default "on", --node-name/NODE_NAME
+required, --debug), plus TPU-specific additions: backend selection, smoke
+workload selection, a Prometheus metrics port, and JSON logging.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from tpu_cc_manager.ccmanager.hostcaps import is_host_cc_enabled
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.metrics_server import start_metrics_server
+from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+from tpu_cc_manager.labels import MODE_OFF, VALID_MODES
+from tpu_cc_manager.tpudev import load_backend
+from tpu_cc_manager.utils.logging import setup_logging
+from tpu_cc_manager.version import __version__
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-cc-manager",
+        description="TPU confidential-computing node agent for GKE",
+    )
+    p.add_argument(
+        "--kubeconfig",
+        default=os.environ.get("KUBECONFIG"),
+        help="kubeconfig path (default: in-cluster config, then $KUBECONFIG)",
+    )
+    p.add_argument(
+        "-m", "--default-cc-mode",
+        default=os.environ.get("DEFAULT_CC_MODE", "on"),
+        help="mode applied when the desired-mode label is absent (default: on; "
+        "forced to 'off' when the host lacks CC capability)",
+    )
+    p.add_argument(
+        "--node-name",
+        default=os.environ.get("NODE_NAME"),
+        help="this node's name (default: $NODE_NAME; required)",
+    )
+    p.add_argument(
+        "--tpu-backend",
+        default=os.environ.get("TPU_CC_BACKEND", "tpuvm"),
+        choices=("tpuvm", "fake"),
+        help="device layer: 'tpuvm' on real TPU VMs, 'fake' for dry-runs",
+    )
+    p.add_argument(
+        "--smoke-workload",
+        default=os.environ.get("CC_SMOKE_WORKLOAD", "none"),
+        help="JAX workload run as the final verify phase after each "
+        "reconfigure (default: none; see tpu_cc_manager.smoke.runner.WORKLOADS)",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=int(os.environ.get("CC_METRICS_PORT", "0")),
+        help="serve Prometheus metrics on this port (0 = disabled)",
+    )
+    p.add_argument("--json-logs", action="store_true",
+                   default=os.environ.get("CC_JSON_LOGS", "").lower() in ("1", "true"))
+    p.add_argument("-d", "--debug", action="store_true")
+    p.add_argument("--version", action="version", version=__version__)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(debug=args.debug, json_lines=args.json_logs)
+
+    if not args.node_name:
+        # Fatal misconfiguration (reference main.py:731-734).
+        log.error("--node-name / NODE_NAME is required")
+        return 1
+    default_mode = args.default_cc_mode
+    if default_mode not in VALID_MODES and default_mode not in ("ppcie",):
+        log.error("invalid --default-cc-mode %r (valid: %s)", default_mode, VALID_MODES)
+        return 1
+
+    host_cc = is_host_cc_enabled()
+    if not host_cc and default_mode != MODE_OFF:
+        # Secure-by-default without bricking non-CC hosts
+        # (reference main.py:736-742).
+        log.warning(
+            "host lacks CC capability; overriding default mode %r -> 'off'",
+            default_mode,
+        )
+        default_mode = MODE_OFF
+
+    try:
+        api = RestKube(ClusterConfig.load(args.kubeconfig))
+    except Exception as e:  # noqa: BLE001 - any config failure is fatal here
+        log.error("could not configure kubernetes client: %s", e)
+        return 1
+
+    backend = load_backend(args.tpu_backend)
+    manager = CCManager(
+        api=api,
+        backend=backend,
+        node_name=args.node_name,
+        default_mode=default_mode,
+        host_cc_capable=host_cc,
+        smoke_workload=args.smoke_workload,
+    )
+    if args.metrics_port:
+        start_metrics_server(args.metrics_port, manager.metrics)
+    try:
+        manager.run()
+    except Exception as e:  # noqa: BLE001 - crash-as-retry (reference main.py:757-759)
+        log.error("manager terminated: %s", e, exc_info=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Host confidential-computing capability detection.
+
+Reference: is_host_cc_enabled() (main.py:80-103) probes
+/sys/module/kvm_intel/parameters/tdx and /sys/module/kvm_amd/parameters/sev_snp
+— i.e. "can this host run CC guests". A TPU VM is itself the guest, so the
+equivalent question is "is this VM confidential": probed via the TDX/SEV
+guest device nodes, with the reference's KVM-host probes kept for the case
+where the agent runs on a bare-metal host managing CC guest VMs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+# (description, path, expected-content prefix or None for existence-only)
+_DEFAULT_PROBES: tuple[tuple[str, str, str | None], ...] = (
+    ("TDX guest device", "/dev/tdx_guest", None),
+    ("SEV guest device", "/dev/sev-guest", None),
+    ("KVM Intel TDX host support", "/sys/module/kvm_intel/parameters/tdx", "Y"),
+    ("KVM AMD SEV-SNP host support", "/sys/module/kvm_amd/parameters/sev_snp", "Y"),
+)
+
+
+def is_host_cc_enabled(
+    probes: tuple[tuple[str, str, str | None], ...] = _DEFAULT_PROBES,
+) -> bool:
+    """True if any probe indicates confidential-computing capability."""
+    for desc, path, expect in probes:
+        if not os.path.exists(path):
+            continue
+        if expect is None:
+            log.info("host CC capability: %s present (%s)", desc, path)
+            return True
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                content = f.read().strip()
+        except OSError as e:
+            log.debug("probe %s unreadable: %s", path, e)
+            continue
+        if content.upper().startswith(expect.upper()):
+            log.info("host CC capability: %s enabled (%s=%s)", desc, path, content)
+            return True
+    log.warning("no host CC capability detected (probed %d locations)", len(probes))
+    return False

@@ -1,0 +1,424 @@
+"""CCManager: the per-node reconciler.
+
+Reference analogue: the CCManager class (main.py:105-695; call stacks in
+SURVEY.md §3). The protocol is preserved — desired mode read from a node
+label, idempotency check, drain-before-reconfigure, phased
+stage/reset/verify, crash-as-retry on unrecoverable misconfiguration,
+``failed`` state label on errors, watch with resourceVersion tracking /
+410 resync / consecutive-error cap — with the TPU-structural changes:
+
+- the device unit is the ICI slice, so stage/reset/wait act on the whole
+  chip set (tpudev/contract.py);
+- verification is upgraded from "query equals desired" to query + slice
+  attestation + an optional end-to-end JAX smoke workload (SURVEY.md §3.4);
+- every phase is timed (utils/metrics.py) because the north-star metric is
+  the drain→CC-on→ready latency (BASELINE.md).
+
+Reference bugs deliberately fixed (SURVEY.md §8): ``time`` is imported (§8.1),
+there is no dead ``last_label`` state (§8.2), label writes are merge-patches
+(§8.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Callable
+
+from tpu_cc_manager.drain import evict, state
+from tpu_cc_manager.kubeclient.api import (
+    KubeApi,
+    KubeApiError,
+    node_labels,
+    resource_version,
+)
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    MODE_DEVTOOLS,
+    MODE_OFF,
+    MODE_SLICE,
+    STATE_FAILED,
+    VALID_MODES,
+    canonical_mode,
+)
+from tpu_cc_manager.tpudev import attestation
+from tpu_cc_manager.tpudev.contract import SliceTopology, TpuCcBackend, TpuChip, TpuError
+from tpu_cc_manager.utils import metrics as metrics_mod
+
+log = logging.getLogger(__name__)
+
+DEFAULT_READINESS_FILE = "/run/tpu/validations/.tpu-cc-manager-ctr-ready"
+# Reference operational constants (SURVEY.md §6).
+WATCH_TIMEOUT_S = 300
+WATCH_RECONNECT_DELAY_S = 5.0
+MAX_CONSECUTIVE_WATCH_ERRORS = 10
+DEFAULT_READY_TIMEOUT_S = 300.0
+
+
+class CCManager:
+    def __init__(
+        self,
+        api: KubeApi,
+        backend: TpuCcBackend,
+        node_name: str,
+        default_mode: str = "on",
+        host_cc_capable: bool = True,
+        operator_namespace: str | None = None,
+        evict_components: bool | None = None,
+        smoke_workload: str | None = None,
+        smoke_runner: Callable[[str], dict] | None = None,
+        eviction_timeout_s: float = evict.DEFAULT_EVICTION_TIMEOUT_S,
+        eviction_poll_interval_s: float = evict.DEFAULT_POLL_INTERVAL_S,
+        ready_timeout_s: float = DEFAULT_READY_TIMEOUT_S,
+        readiness_file: str | None = None,
+        watch_timeout_s: int = WATCH_TIMEOUT_S,
+        reconnect_delay_s: float = WATCH_RECONNECT_DELAY_S,
+        max_watch_errors: int = MAX_CONSECUTIVE_WATCH_ERRORS,
+        metrics: metrics_mod.MetricsRegistry | None = None,
+    ) -> None:
+        self.api = api
+        self.backend = backend
+        self.node_name = node_name
+        self.default_mode = canonical_mode(default_mode)
+        self.host_cc_capable = host_cc_capable
+        # Env-var configuration, same names modulo prefix as the reference
+        # (main.py:116-119: OPERATOR_NAMESPACE, EVICT_OPERATOR_COMPONENTS).
+        self.operator_namespace = operator_namespace or os.environ.get(
+            "OPERATOR_NAMESPACE", "tpu-operator"
+        )
+        if evict_components is None:
+            evict_components = os.environ.get(
+                "EVICT_OPERATOR_COMPONENTS", "true"
+            ).lower() in ("true", "1", "yes")
+        self.evict_components = evict_components
+        self.smoke_workload = (
+            smoke_workload
+            if smoke_workload is not None
+            else os.environ.get("CC_SMOKE_WORKLOAD", "none")
+        )
+        self.smoke_runner = smoke_runner
+        self.eviction_timeout_s = eviction_timeout_s
+        self.eviction_poll_interval_s = eviction_poll_interval_s
+        self.ready_timeout_s = ready_timeout_s
+        self.readiness_file = readiness_file or os.environ.get(
+            "CC_READINESS_FILE", DEFAULT_READINESS_FILE
+        )
+        self.watch_timeout_s = watch_timeout_s
+        self.reconnect_delay_s = reconnect_delay_s
+        self.max_watch_errors = max_watch_errors
+        self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
+
+    # ------------------------------------------------------------------
+    # Label plumbing
+    # ------------------------------------------------------------------
+
+    def with_default(self, label_value: str | None) -> str:
+        """Absent/empty desired label means the configured default
+        (reference main.py:686-691)."""
+        if not label_value:
+            log.info("no %s label; defaulting to %s", CC_MODE_LABEL, self.default_mode)
+            return self.default_mode
+        return canonical_mode(label_value)
+
+    def get_node_cc_mode_label(self) -> tuple[str | None, str]:
+        """Read the desired-mode label and the node's resourceVersion.
+
+        Apiserver errors propagate — at startup that is fatal by design
+        (reference main.py:596-598, crash-as-retry)."""
+        node = self.api.get_node(self.node_name)
+        return node_labels(node).get(CC_MODE_LABEL), resource_version(node)
+
+    def create_readiness_file(self) -> None:
+        """Touch the readiness file after the first successful apply; failures
+        are non-fatal (reference main.py:66-78)."""
+        try:
+            os.makedirs(os.path.dirname(self.readiness_file), exist_ok=True)
+            with open(self.readiness_file, "w", encoding="utf-8"):
+                pass
+            log.info("created readiness file %s", self.readiness_file)
+        except OSError as e:
+            log.warning("could not create readiness file %s: %s", self.readiness_file, e)
+
+    # ------------------------------------------------------------------
+    # Mode application (reference call stack 3.2/3.3)
+    # ------------------------------------------------------------------
+
+    def set_cc_mode(self, mode: str) -> bool:
+        mode = canonical_mode(mode)
+        if mode not in VALID_MODES:
+            log.error(
+                "invalid CC mode %r (valid: %s) — refusing to act", mode, VALID_MODES
+            )
+            return False
+        if not self.host_cc_capable and mode != MODE_OFF:
+            # Warning only; the backend/attestation will produce the hard
+            # failure (reference main.py:224-225).
+            log.warning(
+                "host/VM is not CC-capable but mode %s requested; "
+                "attestation will likely fail", mode,
+            )
+
+        try:
+            topo = self.backend.discover()
+        except TpuError as e:
+            log.error("TPU discovery failed: %s", e)
+            state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
+            return False
+
+        if not topo.chips:
+            log.info("no TPU chips on this node; nothing to do")
+            return True
+
+        if mode == MODE_SLICE:
+            chips = self._slice_mode_chips(topo)
+        else:
+            chips = self._cc_mode_chips(topo, mode)
+        if chips is None:  # nothing to reconfigure; state already reported
+            return True
+
+        if self._mode_is_set(chips, mode):
+            log.info("CC mode %s already set on all %d chip(s)", mode, len(chips))
+            state.set_cc_state_label(self.api, self.node_name, mode)
+            return True
+
+        m = self.metrics.start(mode)
+        try:
+            if self.evict_components:
+                ok = self._apply_with_eviction(topo, chips, mode, m)
+            else:
+                ok = self._apply_direct(topo, chips, mode, m)
+        except BaseException:
+            # An escaping exception (e.g. KubeApiError mid-drain) must not be
+            # recorded as a successful reconcile.
+            if m.result == "pending":
+                m.result = "failed"
+            raise
+        finally:
+            m.finish(m.result if m.result != "pending" else "noop")
+        return ok
+
+    def _cc_mode_chips(
+        self, topo: SliceTopology, mode: str
+    ) -> tuple[TpuChip, ...] | None:
+        """Select chips for a non-slice mode change, with the reference's
+        mixed-capability policy (main.py:232-253)."""
+        cc_capable = topo.cc_capable_chips()
+        if 0 < len(cc_capable) < len(topo.chips) and mode != MODE_OFF:
+            # Mixed capability is unrecoverable misconfiguration: crash so the
+            # DaemonSet restart surfaces it loudly (reference main.py:237-240).
+            log.error(
+                "node has %d CC-capable of %d chips — mixed capability cannot "
+                "host mode %s; exiting (DaemonSet restart acts as retry)",
+                len(cc_capable), len(topo.chips), mode,
+            )
+            sys.exit(1)
+        if not cc_capable:
+            log.info("no CC-capable chips; reporting state off")
+            state.set_cc_state_label(self.api, self.node_name, MODE_OFF)
+            return None
+        return topo.chips if mode == MODE_OFF else cc_capable
+
+    def _slice_mode_chips(self, topo: SliceTopology) -> tuple[TpuChip, ...]:
+        """Slice-wide CC requires every chip in the ICI domain to support it
+        (the reference's all-devices-must-support-PPCIe rule, main.py:279-282)."""
+        lacking = [c for c in topo.chips if not c.slice_cc_supported]
+        if lacking:
+            log.error(
+                "%d of %d chips lack slice-wide CC support (%s) — cannot form "
+                "a slice CC domain; exiting (DaemonSet restart acts as retry)",
+                len(lacking), len(topo.chips), ", ".join(c.name for c in lacking[:4]),
+            )
+            sys.exit(1)
+        return topo.chips
+
+    def _mode_is_set(self, chips: tuple[TpuChip, ...], mode: str) -> bool:
+        """Idempotency check (reference mode_is_set, main.py:428-447)."""
+        try:
+            return all(self.backend.query_cc_mode(c) == mode for c in chips)
+        except TpuError as e:
+            log.warning("query during idempotency check failed (%s); proceeding", e)
+            return False
+
+    def _apply_with_eviction(
+        self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
+        m: metrics_mod.ReconcileMetrics,
+    ) -> bool:
+        """Drain, reconfigure, re-admit (reference main.py:544-578).
+
+        Re-admission runs even when the reconfigure fails, so components are
+        never left paused by a failed toggle."""
+        with m.phase(metrics_mod.PHASE_DRAIN):
+            original = evict.evict_components(
+                self.api,
+                self.node_name,
+                self.operator_namespace,
+                timeout_s=self.eviction_timeout_s,
+                poll_interval_s=self.eviction_poll_interval_s,
+            )
+        try:
+            return self._apply_direct(topo, chips, mode, m)
+        finally:
+            with m.phase(metrics_mod.PHASE_READMIT):
+                evict.readmit_components(self.api, self.node_name, original)
+
+    def _apply_direct(
+        self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
+        m: metrics_mod.ReconcileMetrics,
+    ) -> bool:
+        """The phased hardware transition (reference main.py:449-542,
+        restructured: slice atomicity is structural in the backend contract,
+        and verify is upgraded with attestation + smoke)."""
+        if topo.is_multi_host and mode != MODE_SLICE:
+            log.warning(
+                "host %d/%d of multi-host slice %s: a per-host mode change "
+                "disrupts the whole ICI domain; the rolling orchestrator "
+                "should drive all hosts of this slice together",
+                topo.host_index, topo.num_hosts, topo.slice_id,
+            )
+        try:
+            with m.phase(metrics_mod.PHASE_STAGE):
+                self.backend.stage_cc_mode(chips, mode)
+            with m.phase(metrics_mod.PHASE_RESET):
+                self.backend.reset(chips)
+            with m.phase(metrics_mod.PHASE_WAIT_READY):
+                self.backend.wait_ready(chips, self.ready_timeout_s)
+            # Verify 1: committed mode matches (reference main.py:524-528).
+            for chip in chips:
+                got = self.backend.query_cc_mode(chip)
+                if got != mode:
+                    raise TpuError(
+                        f"verification failed on {chip.name}: "
+                        f"wanted {mode}, device reports {got}"
+                    )
+            # Verify 2: attestation (new; skipped for plain 'off').
+            if mode != MODE_OFF:
+                with m.phase(metrics_mod.PHASE_ATTEST):
+                    nonce = attestation.fresh_nonce()
+                    quote = self.backend.fetch_attestation(nonce)
+                    attestation.verify_quote(
+                        quote,
+                        nonce,
+                        expected_mode=mode,
+                        expected_slice_id=topo.slice_id,
+                        debug_policy=(mode == MODE_DEVTOOLS),
+                    )
+            # Verify 3: end-to-end JAX smoke workload (new).
+            if self.smoke_workload and self.smoke_workload != "none":
+                with m.phase(metrics_mod.PHASE_SMOKE):
+                    self._run_smoke(self.smoke_workload)
+        except Exception as e:  # noqa: BLE001 - reference parity:
+            # any failure labels the node 'failed' and keeps the loop alive
+            # (main.py:531-538).
+            log.error("CC mode change to %s failed: %s", mode, e, exc_info=True)
+            state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
+            m.result = "failed"
+            return False
+        state.set_cc_state_label(self.api, self.node_name, mode)
+        m.result = "ok"
+        log.info("CC mode %s applied and verified on %d chip(s)", mode, len(chips))
+        return True
+
+    def _run_smoke(self, workload: str) -> dict:
+        if self.smoke_runner is not None:
+            return self.smoke_runner(workload)
+        from tpu_cc_manager.smoke.runner import run_workload_subprocess
+
+        return run_workload_subprocess(workload)
+
+    # ------------------------------------------------------------------
+    # Watch loop (reference call stack 3.4)
+    # ------------------------------------------------------------------
+
+    def watch_and_apply(self, stop: threading.Event | None = None) -> None:
+        """Initial apply, then watch the node label forever.
+
+        Semantics preserved from the reference (main.py:600-684): rv
+        tracking, 300 s server-side watch timeout, ERROR-event handling,
+        410-Gone resync via re-GET + conditional re-apply, consecutive-error
+        cap of 10 (reset on any successful event — documented quirk,
+        SURVEY.md §8.6), 5 s reconnect delay (with ``time`` imported; the
+        reference's missing import made this path fatal, SURVEY.md §8.1).
+        ``stop`` makes the loop exitable for tests and graceful shutdown.
+        """
+        label, rv = self.get_node_cc_mode_label()
+        self.set_cc_mode(self.with_default(label))
+        self.create_readiness_file()
+        last_label_value = label
+        consecutive_errors = 0
+
+        while not (stop and stop.is_set()):
+            try:
+                for event in self.api.watch_nodes(
+                    self.node_name, rv or None, self.watch_timeout_s
+                ):
+                    if stop and stop.is_set():
+                        return
+                    if event.type == "ERROR":
+                        code = (event.object or {}).get("code")
+                        if code == 410:
+                            raise KubeApiError(410, "watch ERROR event: Gone")
+                        consecutive_errors += 1
+                        log.warning(
+                            "watch ERROR event (%s/%s): %s",
+                            consecutive_errors, self.max_watch_errors, event.object,
+                        )
+                        if consecutive_errors >= self.max_watch_errors:
+                            # Divergence from the reference, which only caps
+                            # ApiExceptions (main.py:659-668): a stream of
+                            # ERROR events is equally hopeless.
+                            raise RuntimeError(
+                                f"{consecutive_errors} consecutive watch ERROR "
+                                f"events; giving up (pod restart acts as recovery)"
+                            )
+                        break
+                    consecutive_errors = 0
+                    rv = resource_version(event.object) or rv
+                    value = node_labels(event.object).get(CC_MODE_LABEL)
+                    if value != last_label_value:
+                        log.info(
+                            "%s changed: %r -> %r",
+                            CC_MODE_LABEL, last_label_value, value,
+                        )
+                        self.set_cc_mode(self.with_default(value))
+                        last_label_value = value
+                else:
+                    # Stream ended normally (server-side timeout): reconnect
+                    # immediately with the tracked rv.
+                    continue
+            except KubeApiError as e:
+                consecutive_errors += 1
+                if consecutive_errors >= self.max_watch_errors:
+                    raise RuntimeError(
+                        f"{consecutive_errors} consecutive watch errors; giving "
+                        f"up (pod restart acts as recovery)"
+                    ) from e
+                if e.status == 410:
+                    log.info("watch resourceVersion expired; resyncing")
+                    try:
+                        value, rv = self.get_node_cc_mode_label()
+                    except KubeApiError as e2:
+                        log.warning("resync GET failed: %s", e2)
+                        time.sleep(self.reconnect_delay_s)
+                        continue
+                    if value != last_label_value:
+                        self.set_cc_mode(self.with_default(value))
+                        last_label_value = value
+                    continue
+                log.warning(
+                    "watch error (%s/%s): %s — reconnecting in %.0fs",
+                    consecutive_errors, self.max_watch_errors, e,
+                    self.reconnect_delay_s,
+                )
+                time.sleep(self.reconnect_delay_s)
+
+    def run(self, stop: threading.Event | None = None) -> None:
+        """Entry point (reference main.py:693-695)."""
+        log.info(
+            "starting tpu-cc-manager on node %s (default=%s evict=%s smoke=%s ns=%s)",
+            self.node_name, self.default_mode, self.evict_components,
+            self.smoke_workload, self.operator_namespace,
+        )
+        self.watch_and_apply(stop)

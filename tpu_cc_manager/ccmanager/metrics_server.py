@@ -1,0 +1,46 @@
+"""Tiny Prometheus text endpoint (stdlib http.server, daemon thread).
+
+The reference has no metrics endpoint (SURVEY.md §5 — its only outward state
+is node labels and a readiness file). Since this build's north-star is a
+latency, the phase timings in utils/metrics.py are exported at
+``/metrics``; ``/healthz`` returns 200 for liveness probes.
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import threading
+
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+def start_metrics_server(port: int, registry: MetricsRegistry) -> http.server.ThreadingHTTPServer:
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.rstrip("/") in ("", "/metrics"):
+                body = registry.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+            elif self.path == "/healthz":
+                body = b"ok\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+            else:
+                body = b"not found\n"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *fmtargs):  # quiet access logs
+            log.debug("metrics http: " + fmt, *fmtargs)
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, name="metrics", daemon=True)
+    thread.start()
+    log.info("metrics server listening on :%d", port)
+    return server

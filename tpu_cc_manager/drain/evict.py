@@ -1,0 +1,135 @@
+"""Drain and re-admit TPU operator components via the pause protocol.
+
+Reference analogue: gpu_operator_eviction.py:98-259 (SURVEY.md §2 #8). The
+shape is the same — read the component deploy labels, rewrite them to their
+paused values in one node patch, poll until each component's pods are gone
+from this node, and invert afterwards — with two deliberate changes:
+
+- label writes are a merge-patch of metadata.labels only (not the reference's
+  racy full-object read-modify-write, SURVEY.md §8.3);
+- the timeout policy is explicit: ``proceed_on_timeout=True`` preserves the
+  reference's "don't fail — continue anyway" behavior
+  (gpu_operator_eviction.py:205-207) but callers can demand strictness.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from tpu_cc_manager.drain.pause import is_paused, pause_value, unpause_value
+from tpu_cc_manager.kubeclient.api import KubeApi, node_labels
+from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS
+
+log = logging.getLogger(__name__)
+
+# Reference values: 300 s wait, 2 s poll (gpu_operator_eviction.py:136, :200).
+DEFAULT_EVICTION_TIMEOUT_S = 300.0
+DEFAULT_POLL_INTERVAL_S = 2.0
+
+
+class EvictionTimeout(Exception):
+    """Raised (only when proceed_on_timeout=False) if pods outlive the wait."""
+
+
+def fetch_component_labels(api: KubeApi, node_name: str) -> dict[str, str]:
+    """Current values of the drain-component labels on the node.
+
+    Reference: fetch_current_component_labels (gpu_operator_eviction.py:98).
+    Only labels actually present on the node are returned.
+    """
+    labels = node_labels(api.get_node(node_name))
+    return {k: labels[k] for k in DRAIN_COMPONENT_LABELS if k in labels}
+
+
+def evict_components(
+    api: KubeApi,
+    node_name: str,
+    namespace: str,
+    timeout_s: float = DEFAULT_EVICTION_TIMEOUT_S,
+    poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
+    proceed_on_timeout: bool = True,
+) -> dict[str, str]:
+    """Pause every drainable component and wait for its pods to leave the node.
+
+    Returns the original label values (pass them to ``readmit_components``).
+    Reference: evict_gpu_operator_components (gpu_operator_eviction.py:131-214).
+    """
+    original = fetch_component_labels(api, node_name)
+    patch = {}
+    for key, value in original.items():
+        paused = pause_value(value)
+        if paused is not None:
+            patch[key] = paused
+    if patch:
+        log.info("pausing components on %s: %s", node_name, sorted(patch))
+        api.patch_node_labels(node_name, patch)
+    else:
+        log.info("no components to pause on %s", node_name)
+
+    # Wait for the operator controller to delete each paused component's
+    # pods. Components already paused by a previous (crashed) run must be
+    # waited on too — their pods may still be terminating — hence "paused
+    # now", not "paused by us".
+    paused_now = sorted(
+        key
+        for key, value in {**original, **patch}.items()
+        if is_paused(value)
+    )
+    if not paused_now:
+        return original
+    deadline = time.monotonic() + timeout_s
+    for key in paused_now:
+        app = DRAIN_COMPONENT_LABELS[key]
+        while True:
+            pods = api.list_pods(
+                namespace,
+                label_selector=f"app={app}",
+                field_selector=f"spec.nodeName={node_name}",
+            )
+            if not pods:
+                log.info("component %s drained from %s", app, node_name)
+                break
+            if time.monotonic() >= deadline:
+                msg = (
+                    f"timed out waiting for {len(pods)} pod(s) of component "
+                    f"{app} to leave node {node_name}"
+                )
+                if proceed_on_timeout:
+                    # Reference behavior: warn and continue to the hardware
+                    # phase anyway (gpu_operator_eviction.py:205-207).
+                    log.warning("%s — continuing anyway", msg)
+                    break
+                raise EvictionTimeout(msg)
+            time.sleep(poll_interval_s)
+    return original
+
+
+def readmit_components(api: KubeApi, node_name: str, original: dict[str, str]) -> None:
+    """Restore the pre-drain label values, unpausing what we paused.
+
+    Reference: reschedule_gpu_operator_components
+    (gpu_operator_eviction.py:217-259). Reads the node again and only
+    unpauses labels that are still in a paused state, so a concurrent
+    user edit (e.g. disabling a component mid-drain) wins.
+    """
+    current = fetch_component_labels(api, node_name)
+    patch = {}
+    for key in DRAIN_COMPONENT_LABELS:
+        restored = unpause_value(current.get(key))
+        if restored is not None:
+            # The unpaused current value is the truth. The remembered
+            # original is only consulted when it is itself unpaused (it can
+            # legitimately be a paused value after a crash-recovery run, and
+            # writing that back would strand the component).
+            remembered = original.get(key)
+            patch[key] = (
+                remembered
+                if remembered is not None and not is_paused(remembered)
+                else restored
+            )
+    if patch:
+        log.info("unpausing components on %s: %s", node_name, sorted(patch))
+        api.patch_node_labels(node_name, patch)
+    else:
+        log.info("no components to unpause on %s", node_name)

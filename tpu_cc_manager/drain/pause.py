@@ -1,0 +1,44 @@
+"""Pause/unpause label algebra — pure functions, no I/O.
+
+Ported exactly from the reference's protocol (gpu_operator_eviction.py:43-95,
+SURVEY.md §5 "label state machine"), because the external controller that
+reacts to these labels (the TPU operator, analogue of the GPU operator)
+defines them as its API:
+
+    'true'      -> PAUSED_VALUE                  (pause)
+    custom 'v'  -> 'v' + PAUSED_SUFFIX           (pause, preserving the value)
+    'false'/''  -> unchanged                     (component user-disabled)
+    paused      -> unchanged                     (idempotent)
+
+and unpausing inverts exactly.
+"""
+
+from __future__ import annotations
+
+from tpu_cc_manager.labels import PAUSED_SUFFIX, PAUSED_VALUE
+
+
+def is_paused(value: str | None) -> bool:
+    return value is not None and (
+        value == PAUSED_VALUE or value.endswith(PAUSED_SUFFIX)
+    )
+
+
+def pause_value(value: str | None) -> str | None:
+    """New label value when pausing, or None if the label must not change."""
+    if value is None or value in ("", "false"):
+        return None
+    if is_paused(value):
+        return None
+    if value == "true":
+        return PAUSED_VALUE
+    return value + PAUSED_SUFFIX
+
+
+def unpause_value(value: str | None) -> str | None:
+    """New label value when unpausing, or None if the label must not change."""
+    if value is None or not is_paused(value):
+        return None
+    if value == PAUSED_VALUE:
+        return "true"
+    return value[: -len(PAUSED_SUFFIX)]

@@ -1,0 +1,32 @@
+"""Actual-state reporting through node labels.
+
+Reference: set_cc_state_label (gpu_operator_eviction.py:262-295) — writes
+``cc.mode.state`` and the derived ``cc.ready.state`` in one call. Here both
+labels land in a single merge-patch (the reference does a full-object RMW
+patch per label write; SURVEY.md §8.3).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpu_cc_manager.kubeclient.api import KubeApi
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    CC_READY_STATE_LABEL,
+    ready_state_for,
+)
+
+log = logging.getLogger(__name__)
+
+
+def set_cc_state_label(api: KubeApi, node_name: str, state: str) -> None:
+    ready = ready_state_for(state)
+    log.info(
+        "reporting state on %s: %s=%s %s=%s",
+        node_name, CC_MODE_STATE_LABEL, state, CC_READY_STATE_LABEL, ready,
+    )
+    api.patch_node_labels(
+        node_name,
+        {CC_MODE_STATE_LABEL: state, CC_READY_STATE_LABEL: ready},
+    )

@@ -1,0 +1,89 @@
+"""KubeApi: the exact apiserver surface the manager needs, as an interface.
+
+Reference analogue: the subset of kubernetes.client.CoreV1Api used by
+main.py:129-140/580-684 and gpu_operator_eviction.py (read_node, patch_node,
+list_namespaced_pod, watch.Watch). Defining it as an interface lets tests and
+bench.py swap in the in-memory fake (SURVEY.md §4 test plan, step 2).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Iterator, Mapping
+
+
+class KubeApiError(Exception):
+    """Apiserver error with an HTTP status, mirroring ApiException.status
+    (the reference branches on 410 Gone at main.py:670)."""
+
+    def __init__(self, status: int | None, reason: str = ""):
+        super().__init__(f"kube api error status={status} reason={reason}")
+        self.status = status
+        self.reason = reason
+
+
+@dataclass
+class WatchEvent:
+    """One event from a watch stream: type ∈ ADDED|MODIFIED|DELETED|BOOKMARK|ERROR,
+    object is the raw (JSON-decoded) Kubernetes object."""
+
+    type: str
+    object: dict
+
+
+def node_labels(node: dict) -> dict:
+    """Labels of a node dict ({} if unset)."""
+    return (node.get("metadata") or {}).get("labels") or {}
+
+
+def resource_version(obj: dict) -> str:
+    return str((obj.get("metadata") or {}).get("resourceVersion") or "")
+
+
+class KubeApi(abc.ABC):
+    """Typed facade over the apiserver operations the control plane performs."""
+
+    @abc.abstractmethod
+    def get_node(self, name: str) -> dict:
+        """GET /api/v1/nodes/{name}. Raises KubeApiError (404 if absent)."""
+
+    @abc.abstractmethod
+    def patch_node_labels(self, name: str, labels: Mapping[str, str | None]) -> dict:
+        """JSON merge-patch {"metadata": {"labels": labels}} onto the node.
+
+        A ``None`` value deletes the label (merge-patch semantics). Returns
+        the patched node. This deliberately never writes anything but labels
+        (SURVEY.md §8.3)."""
+
+    @abc.abstractmethod
+    def list_nodes(self, label_selector: str | None = None) -> list[dict]:
+        """GET /api/v1/nodes, optionally filtered by an equality label
+        selector ("k=v" or "k" presence, comma-separated)."""
+
+    @abc.abstractmethod
+    def list_pods(
+        self,
+        namespace: str,
+        label_selector: str | None = None,
+        field_selector: str | None = None,
+    ) -> list[dict]:
+        """GET /api/v1/namespaces/{ns}/pods with optional selectors.
+
+        The manager uses label_selector="app=<component>" plus
+        field_selector="spec.nodeName=<node>" while polling the drain
+        (reference gpu_operator_eviction.py:185-207)."""
+
+    @abc.abstractmethod
+    def watch_nodes(
+        self,
+        name: str,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        """Watch a single node (field selector metadata.name=<name>).
+
+        Yields WatchEvents until the server-side timeout elapses, then
+        returns. Transport errors raise KubeApiError; a stale
+        resourceVersion raises KubeApiError(410) either immediately or as an
+        ERROR event translated by the caller (reference main.py:622-638)."""

@@ -1,0 +1,258 @@
+"""In-memory fake apiserver implementing KubeApi.
+
+Used by unit/integration tests and by bench.py's no-cluster dry-run
+(BASELINE.json configs[0]). The reference project has no fake backend at all
+(SURVEY.md §4) — this is the deliberate fix.
+
+Features beyond a dumb store, each needed by a specific test scenario:
+
+- monotonically increasing resourceVersions with a watch event log,
+- configurable "compaction" so old resourceVersions raise 410 Gone
+  (exercises the resync path, reference main.py:670-682),
+- injectable transport errors / ERROR events on the watch stream
+  (exercises the consecutive-error cap, reference main.py:659-668),
+- reactors: callbacks fired after each node label patch, used to emulate the
+  operator controller that deletes component pods when it sees the paused
+  label (the reference relies on the external GPU operator for this,
+  gpu_operator_eviction.py:185-207).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Callable, Iterator, Mapping
+
+from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, WatchEvent
+
+
+def _match_label_selector(labels: Mapping[str, str], selector: str | None) -> bool:
+    if not selector:
+        return True
+    for term in selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "=" in term:
+            k, _, v = term.partition("=")
+            if labels.get(k.strip()) != v.strip():
+                return False
+        elif labels.get(term) is None:
+            return False
+    return True
+
+
+def _match_pod_field_selector(pod: dict, selector: str | None) -> bool:
+    if not selector:
+        return True
+    for term in selector.split(","):
+        k, _, v = term.partition("=")
+        k, v = k.strip(), v.strip()
+        if k == "spec.nodeName":
+            if (pod.get("spec") or {}).get("nodeName") != v:
+                return False
+        elif k == "metadata.name":
+            if (pod.get("metadata") or {}).get("name") != v:
+                return False
+        elif k == "status.phase":
+            if (pod.get("status") or {}).get("phase") != v:
+                return False
+        else:
+            raise KubeApiError(400, f"unsupported field selector {k!r}")
+    return True
+
+
+class FakeKube(KubeApi):
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._rv = 0
+        self._compacted_before = 0  # rvs strictly below this are 410-Gone
+        self._nodes: dict[str, dict] = {}
+        self._pods: dict[tuple[str, str], dict] = {}  # (namespace, name) -> pod
+        self._node_events: list[tuple[int, WatchEvent]] = []
+        self._watch_faults: list[Exception | WatchEvent] = []
+        self._patch_reactors: list[Callable[[str, dict], None]] = []
+        # Counters some tests assert on.
+        self.patch_calls = 0
+        self.list_pod_calls = 0
+
+    # ---- test harness helpers -------------------------------------------
+
+    def add_node(self, name: str, labels: dict | None = None) -> dict:
+        with self._lock:
+            self._rv += 1
+            node = {
+                "kind": "Node",
+                "metadata": {
+                    "name": name,
+                    "labels": dict(labels or {}),
+                    "resourceVersion": str(self._rv),
+                },
+            }
+            self._nodes[name] = node
+            self._record_event("ADDED", node)
+            return copy.deepcopy(node)
+
+    def add_pod(
+        self,
+        namespace: str,
+        name: str,
+        node_name: str,
+        labels: dict | None = None,
+        phase: str = "Running",
+    ) -> dict:
+        with self._lock:
+            self._rv += 1
+            pod = {
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": namespace,
+                    "labels": dict(labels or {}),
+                    "resourceVersion": str(self._rv),
+                },
+                "spec": {"nodeName": node_name},
+                "status": {"phase": phase},
+            }
+            self._pods[(namespace, name)] = pod
+            return copy.deepcopy(pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._pods.pop((namespace, name), None)
+
+    def delete_pods_matching(self, namespace: str, label_selector: str) -> int:
+        """Emulates the operator controller reacting to a paused label."""
+        with self._lock:
+            doomed = [
+                key
+                for key, pod in self._pods.items()
+                if key[0] == namespace
+                and _match_label_selector((pod["metadata"].get("labels") or {}), label_selector)
+            ]
+            for key in doomed:
+                del self._pods[key]
+            return len(doomed)
+
+    def add_patch_reactor(self, fn: Callable[[str, dict], None]) -> None:
+        """fn(node_name, patched_node) runs (outside the lock) after each
+        patch_node_labels call."""
+        self._patch_reactors.append(fn)
+
+    def inject_watch_fault(self, fault: Exception | WatchEvent) -> None:
+        """Next watch_nodes call raises/yields this before streaming events."""
+        self._watch_faults.append(fault)
+
+    def compact(self) -> None:
+        """Forget watch history: watches from older rvs now get 410 Gone."""
+        with self._lock:
+            self._compacted_before = self._rv + 1
+            self._node_events.clear()
+
+    def set_node_label(self, name: str, key: str, value: str | None) -> dict:
+        """Out-of-band label write (e.g. 'the user edits the desired mode')."""
+        return self.patch_node_labels(name, {key: value}, _count=False)
+
+    # ---- KubeApi ---------------------------------------------------------
+
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise KubeApiError(404, f"node {name} not found")
+            return copy.deepcopy(node)
+
+    def patch_node_labels(
+        self, name: str, labels: Mapping[str, str | None], _count: bool = True
+    ) -> dict:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise KubeApiError(404, f"node {name} not found")
+            if _count:
+                self.patch_calls += 1
+            current = node["metadata"].setdefault("labels", {})
+            for k, v in labels.items():
+                if v is None:
+                    current.pop(k, None)
+                else:
+                    current[k] = str(v)
+            self._rv += 1
+            node["metadata"]["resourceVersion"] = str(self._rv)
+            self._record_event("MODIFIED", node)
+            snapshot = copy.deepcopy(node)
+        for reactor in self._patch_reactors:
+            reactor(name, snapshot)
+        return snapshot
+
+    def list_nodes(self, label_selector: str | None = None) -> list[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(n)
+                for n in self._nodes.values()
+                if _match_label_selector(n["metadata"].get("labels") or {}, label_selector)
+            ]
+
+    def list_pods(
+        self,
+        namespace: str,
+        label_selector: str | None = None,
+        field_selector: str | None = None,
+    ) -> list[dict]:
+        with self._lock:
+            self.list_pod_calls += 1
+            return [
+                copy.deepcopy(p)
+                for (ns, _), p in self._pods.items()
+                if ns == namespace
+                and _match_label_selector(p["metadata"].get("labels") or {}, label_selector)
+                and _match_pod_field_selector(p, field_selector)
+            ]
+
+    def watch_nodes(
+        self,
+        name: str,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        if self._watch_faults:
+            fault = self._watch_faults.pop(0)
+            if isinstance(fault, Exception):
+                raise fault
+            yield fault
+            return
+        start_rv = int(resource_version) if resource_version else 0
+        with self._lock:
+            if start_rv and start_rv < self._compacted_before - 1:
+                raise KubeApiError(410, "resourceVersion too old")
+        deadline = time.monotonic() + timeout_seconds
+        cursor = start_rv
+        while True:
+            with self._lock:
+                pending = [
+                    ev
+                    for rv, ev in self._node_events
+                    if rv > cursor and ev.object["metadata"]["name"] == name
+                ]
+                if pending:
+                    cursor = max(
+                        int(ev.object["metadata"]["resourceVersion"]) for ev in pending
+                    )
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._lock.wait(timeout=min(remaining, 0.05))
+                    continue
+            for ev in pending:
+                yield copy.deepcopy(ev)
+
+    # ---- internals -------------------------------------------------------
+
+    def _record_event(self, etype: str, node: dict) -> None:
+        # Caller holds the lock.
+        self._node_events.append((self._rv, WatchEvent(etype, copy.deepcopy(node))))
+        if len(self._node_events) > 4096:
+            del self._node_events[:2048]
+        self._lock.notify_all()

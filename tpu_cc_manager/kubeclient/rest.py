@@ -1,0 +1,233 @@
+"""Real apiserver client over stdlib http.client + ssl.
+
+Replaces the reference's external ``kubernetes`` package dependency
+(requirements.txt, main.py:129-140). Supports the same two auth paths, in the
+same order of preference: in-cluster service-account config, then kubeconfig
+fallback (reference main.py:131-140).
+
+Only the four verbs the control plane needs are implemented (see
+:mod:`tpu_cc_manager.kubeclient.api`); the watch uses the apiserver's
+streaming JSON-lines protocol with server-side timeoutSeconds, matching the
+reference's ``watch.Watch().stream(..., timeout_seconds=300)`` behavior
+(main.py:622-632).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import ssl
+import tempfile
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Iterator, Mapping
+
+from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, WatchEvent
+
+log = logging.getLogger(__name__)
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+@dataclass
+class ClusterConfig:
+    """Where the apiserver is and how to authenticate to it."""
+
+    server: str  # e.g. https://10.0.0.1:443
+    token: str | None = None
+    ca_file: str | None = None
+    client_cert_file: str | None = None
+    client_key_file: str | None = None
+    insecure_skip_tls_verify: bool = False
+
+    @classmethod
+    def in_cluster(cls) -> "ClusterConfig":
+        """Service-account config, present in every pod with a mounted SA."""
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token_path = os.path.join(SERVICEACCOUNT_DIR, "token")
+        ca_path = os.path.join(SERVICEACCOUNT_DIR, "ca.crt")
+        if not host or not os.path.exists(token_path):
+            raise KubeApiError(None, "not running in-cluster")
+        with open(token_path, "r", encoding="utf-8") as f:
+            token = f.read().strip()
+        return cls(
+            server=f"https://{host}:{port}",
+            token=token,
+            ca_file=ca_path if os.path.exists(ca_path) else None,
+        )
+
+    @classmethod
+    def from_kubeconfig(cls, path: str | None = None) -> "ClusterConfig":
+        """Parse the current-context of a kubeconfig file.
+
+        Supports token, client-certificate(-data)/client-key(-data), and
+        insecure-skip-tls-verify — the auth shapes kind and GKE emit.
+        """
+        import yaml  # baked into the image; only needed on this path
+
+        path = path or os.environ.get("KUBECONFIG") or os.path.expanduser("~/.kube/config")
+        with open(path, "r", encoding="utf-8") as f:
+            cfg = yaml.safe_load(f) or {}
+
+        def by_name(section: str, name: str) -> dict:
+            for item in cfg.get(section) or []:
+                if item.get("name") == name:
+                    return item.get(section.rstrip("s")) or {}
+            raise KubeApiError(None, f"kubeconfig: {section} entry {name!r} not found")
+
+        ctx_name = cfg.get("current-context")
+        if not ctx_name:
+            raise KubeApiError(None, "kubeconfig: no current-context")
+        ctx = by_name("contexts", ctx_name)
+        cluster = by_name("clusters", ctx.get("cluster", ""))
+        user = by_name("users", ctx.get("user", ""))
+
+        def materialize(data_key: str, file_key: str, src: dict) -> str | None:
+            if src.get(file_key):
+                return src[file_key]
+            data = src.get(data_key)
+            if not data:
+                return None
+            f = tempfile.NamedTemporaryFile(
+                prefix="tpucc-kubeconfig-", suffix=".pem", delete=False
+            )
+            f.write(base64.b64decode(data))
+            f.close()
+            return f.name
+
+        return cls(
+            server=cluster.get("server", ""),
+            token=user.get("token"),
+            ca_file=materialize("certificate-authority-data", "certificate-authority", cluster),
+            client_cert_file=materialize("client-certificate-data", "client-certificate", user),
+            client_key_file=materialize("client-key-data", "client-key", user),
+            insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
+        )
+
+    @classmethod
+    def load(cls, kubeconfig: str | None = None) -> "ClusterConfig":
+        """In-cluster first, kubeconfig fallback (reference main.py:129-140)."""
+        try:
+            cfg = cls.in_cluster()
+            log.info("using in-cluster kubernetes configuration")
+            return cfg
+        except KubeApiError:
+            cfg = cls.from_kubeconfig(kubeconfig)
+            log.info("using kubeconfig at %s", kubeconfig or "<default>")
+            return cfg
+
+
+class RestKube(KubeApi):
+    def __init__(self, config: ClusterConfig):
+        self.config = config
+        self._ssl_ctx = self._build_ssl_context(config)
+
+    @staticmethod
+    def _build_ssl_context(config: ClusterConfig) -> ssl.SSLContext | None:
+        if not config.server.startswith("https"):
+            return None
+        ctx = ssl.create_default_context(cafile=config.ca_file)
+        if config.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if config.client_cert_file:
+            ctx.load_cert_chain(config.client_cert_file, config.client_key_file)
+        return ctx
+
+    # ---- low-level HTTP --------------------------------------------------
+
+    def _open(self, method: str, path: str, query: dict | None = None,
+              body: bytes | None = None, content_type: str | None = None,
+              read_timeout: float = 30.0):
+        url = self.config.server.rstrip("/") + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        req = urllib.request.Request(url, data=body, method=method)
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        req.add_header("Accept", "application/json")
+        try:
+            return urllib.request.urlopen(req, timeout=read_timeout, context=self._ssl_ctx)
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode("utf-8", "replace")[:512]
+            except Exception:
+                pass
+            raise KubeApiError(e.code, f"{method} {path}: {detail or e.reason}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise KubeApiError(None, f"{method} {path}: {e}") from e
+
+    def _request_json(self, method: str, path: str, query: dict | None = None,
+                      body: dict | None = None, content_type: str | None = None) -> dict:
+        raw = json.dumps(body).encode() if body is not None else None
+        with self._open(method, path, query, raw, content_type) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    # ---- KubeApi ---------------------------------------------------------
+
+    def get_node(self, name: str) -> dict:
+        return self._request_json("GET", f"/api/v1/nodes/{name}")
+
+    def patch_node_labels(self, name: str, labels: Mapping[str, str | None]) -> dict:
+        return self._request_json(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            body={"metadata": {"labels": dict(labels)}},
+            content_type="application/merge-patch+json",
+        )
+
+    def list_nodes(self, label_selector: str | None = None) -> list[dict]:
+        query: dict = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        return self._request_json("GET", "/api/v1/nodes", query).get("items", [])
+
+    def list_pods(self, namespace: str, label_selector: str | None = None,
+                  field_selector: str | None = None) -> list[dict]:
+        query: dict = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
+        return self._request_json(
+            "GET", f"/api/v1/namespaces/{namespace}/pods", query
+        ).get("items", [])
+
+    def watch_nodes(self, name: str, resource_version: str | None = None,
+                    timeout_seconds: int = 300) -> Iterator[WatchEvent]:
+        query = {
+            "watch": "true",
+            "fieldSelector": f"metadata.name={name}",
+            "timeoutSeconds": str(timeout_seconds),
+            "allowWatchBookmarks": "false",
+        }
+        if resource_version:
+            query["resourceVersion"] = resource_version
+        # Client-side read timeout a bit above the server-side one so the
+        # server closes first in the normal case.
+        resp = self._open("GET", "/api/v1/nodes", query, read_timeout=timeout_seconds + 15)
+        try:
+            while True:
+                try:
+                    line = resp.readline()
+                except (OSError, TimeoutError) as e:
+                    raise KubeApiError(None, f"watch stream: {e}") from e
+                if not line:
+                    return  # server closed (timeout elapsed)
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise KubeApiError(None, f"watch stream: bad JSON frame: {e}") from e
+                yield WatchEvent(payload.get("type", "ERROR"), payload.get("object") or {})
+        finally:
+            resp.close()

@@ -1,0 +1,91 @@
+"""The node-label contract: desired state, actual state, readiness.
+
+This is the TPU mapping of the reference's label state machine (SURVEY.md §5;
+reference main.py:62, gpu_operator_eviction.py:23-38). The protocol is kept
+intact — desired/actual state carried on node labels, drain via a label pause
+protocol — with TPU-native names and one TPU-specific mode value.
+
+Mode semantics (reference modes at main.py:214-296):
+
+=========  =====================================================================
+``on``     CC enabled for the node's TPU chips (reference ``on``).
+``off``    CC disabled (reference ``off``).
+``devtools``  CC enabled with a debug attestation policy: quotes are fetched
+           and logged but verification failures do not fail the reconcile.
+           (Reference ``devtools`` is a GPU debug mode; the TPU analogue is an
+           attestation-policy relaxation.)
+``slice``  Slice-wide CC across every host of a multi-host ICI domain, staged
+           and committed with fabric atomicity. This is the TPU analogue of
+           the reference's ``ppcie`` multi-GPU Protected-PCIe mode
+           (main.py:265-426): a TPU slice connected by ICI is the analogue of
+           the NVLink/NVSwitch fabric, so CC state must be toggled per-slice,
+           not per-chip. ``ppcie`` is accepted as a deprecated input alias.
+=========  =====================================================================
+"""
+
+from __future__ import annotations
+
+# --- Desired / actual / readiness labels (reference: nvidia.com/cc.mode,
+# nvidia.com/cc.mode.state, nvidia.com/cc.ready.state).
+CC_MODE_LABEL = "cloud.google.com/tpu-cc.mode"
+CC_MODE_STATE_LABEL = "cloud.google.com/tpu-cc.mode.state"
+CC_READY_STATE_LABEL = "cloud.google.com/tpu-cc.ready.state"
+
+# Valid desired modes. Absent/empty label means "use the default".
+MODE_ON = "on"
+MODE_OFF = "off"
+MODE_DEVTOOLS = "devtools"
+MODE_SLICE = "slice"
+VALID_MODES = (MODE_ON, MODE_OFF, MODE_DEVTOOLS, MODE_SLICE)
+
+# Deprecated input aliases (accepted on the desired label, never written back).
+MODE_ALIASES = {"ppcie": MODE_SLICE}
+
+# Actual-state values: every valid mode plus "failed"
+# (reference gpu_operator_eviction.py:268).
+STATE_FAILED = "failed"
+
+# Drained components: label key on the node -> pod app label selector value.
+# Reference analogue: the five nvidia.com/gpu.deploy.* components and their
+# app-label map (gpu_operator_eviction.py:23-38). The TPU set covers the GKE
+# TPU stack: the device plugin that advertises google.com/tpu resources, the
+# DRA driver, node metrics, the CC/workload validators.
+DRAIN_COMPONENT_LABELS = {
+    "google.com/tpu.deploy.device-plugin": "tpu-device-plugin",
+    "google.com/tpu.deploy.dra-driver": "tpu-dra-driver",
+    "google.com/tpu.deploy.metrics-agent": "tpu-metrics-agent",
+    "google.com/tpu.deploy.sandbox-validator": "tpu-sandbox-validator",
+    "google.com/tpu.deploy.workload-validator": "tpu-workload-validator",
+}
+
+# Pause protocol (reference gpu_operator_eviction.py:43-95):
+#   'true'        -> PAUSED_VALUE
+#   custom 'v'    -> 'v' + PAUSED_SUFFIX
+#   'false' / ''  -> unchanged (user-disabled component)
+#   already paused-> unchanged
+# Unpausing inverts exactly.
+PAUSED_VALUE = "paused-for-tpu-cc-mode-change"
+PAUSED_SUFFIX = "_paused-for-tpu-cc-mode-change"
+
+
+def canonical_mode(mode: str) -> str:
+    """Map deprecated aliases onto canonical mode names (``ppcie``→``slice``)."""
+    return MODE_ALIASES.get(mode, mode)
+
+
+def ready_state_for(state: str) -> str:
+    """Derive the readiness label value from the actual-state value.
+
+    Reference (gpu_operator_eviction.py:275-288): on/ppcie -> "true",
+    off -> "false", anything else -> "". Divergence, decided explicitly per
+    SURVEY.md §8.4: the reference leaves ``devtools`` with an empty ready
+    state; we report ``"debug"`` so schedulers can distinguish "CC up but in
+    debug-attestation mode" from "unknown/failed".
+    """
+    if state in (MODE_ON, MODE_SLICE):
+        return "true"
+    if state == MODE_OFF:
+        return "false"
+    if state == MODE_DEVTOOLS:
+        return "debug"
+    return ""

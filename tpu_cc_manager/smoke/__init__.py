@@ -1,0 +1,14 @@
+"""JAX/XLA smoke workloads: end-to-end validation of a reconfigured slice.
+
+New subsystem with no reference counterpart (SURVEY.md §0(d), §3.4): the
+reference's verify phase stops at ``query_cc_mode() == mode``; here each
+reconfigure can additionally prove the slice does real, numerically correct
+work by running one of these workloads (selected via --smoke-workload):
+
+- ``matmul``  bf16 MXU matmul + numerics check (BASELINE.json configs[1]),
+- ``llama``   Llama decode microbenchmark, tokens/sec (configs[2], [4]),
+- ``resnet``  ResNet-50 train step, MFU (configs[3]).
+
+Workloads run in a subprocess (``python -m tpu_cc_manager.smoke``) so the
+manager process never holds the TPU.
+"""

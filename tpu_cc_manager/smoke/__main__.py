@@ -1,0 +1,32 @@
+"""Subprocess entry for smoke workloads: prints one JSON result line last."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu_cc_manager.smoke")
+    p.add_argument("--workload", required=True)
+    p.add_argument("--size", type=int, default=None,
+                   help="problem-size override (workload-specific)")
+    args = p.parse_args(argv)
+
+    from tpu_cc_manager.smoke.runner import SmokeError, run_workload
+
+    kwargs = {}
+    if args.size is not None:
+        kwargs["size"] = args.size
+    try:
+        result = run_workload(args.workload, **kwargs)
+    except SmokeError as e:
+        print(json.dumps({"ok": False, "workload": args.workload, "error": str(e)}))
+        return 1
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

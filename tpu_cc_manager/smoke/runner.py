@@ -1,0 +1,67 @@
+"""Smoke-workload registry and runners.
+
+Each workload module exposes ``run(**kwargs) -> dict`` returning at least
+``{"ok": bool, "workload": str}`` plus workload-specific measurements
+(tflops, tokens_per_sec, mfu…). The manager invokes workloads through
+``run_workload_subprocess`` so the TPU is acquired and released by a child
+process, never by the long-lived agent.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import subprocess
+import sys
+
+log = logging.getLogger(__name__)
+
+WORKLOADS = {
+    "matmul": "tpu_cc_manager.smoke.matmul",
+}
+
+
+class SmokeError(Exception):
+    """Workload failed — treated like a device verification failure."""
+
+
+def run_workload(name: str, **kwargs) -> dict:
+    """Run a workload in-process (tests, bench)."""
+    if name not in WORKLOADS:
+        raise SmokeError(f"unknown smoke workload {name!r} (have {sorted(WORKLOADS)})")
+    mod = importlib.import_module(WORKLOADS[name])
+    result = mod.run(**kwargs)
+    if not result.get("ok"):
+        raise SmokeError(f"workload {name} reported failure: {result}")
+    return result
+
+
+def run_workload_subprocess(name: str, timeout_s: float = 900.0) -> dict:
+    """Run a workload as ``python -m tpu_cc_manager.smoke`` and parse the
+    final JSON line from its stdout."""
+    if name not in WORKLOADS:
+        raise SmokeError(f"unknown smoke workload {name!r} (have {sorted(WORKLOADS)})")
+    cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", name]
+    log.info("running smoke workload: %s", " ".join(cmd))
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired as e:
+        raise SmokeError(f"workload {name} timed out after {timeout_s:.0f}s") from e
+    last_json = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                last_json = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if proc.returncode != 0:
+        raise SmokeError(
+            f"workload {name} exited rc={proc.returncode}: "
+            f"{(proc.stderr or '')[-512:]}"
+        )
+    if not last_json or not last_json.get("ok"):
+        raise SmokeError(f"workload {name} produced no passing result: {last_json}")
+    log.info("smoke workload %s passed: %s", name, last_json)
+    return last_json

@@ -1,0 +1,39 @@
+"""TPU device layer: the CC/attestation contract and its backends.
+
+This layer replaces the reference's external gpu-admin-tools dependency
+(SURVEY.md §1 L1) — the contract the control loop consumes is re-created for
+TPU slices in :mod:`contract`, with a fully featured fake in :mod:`fake`
+(SURVEY.md §4 calls the reference's missing fake backend its biggest gap) and
+a real TPU VM backend in :mod:`tpuvm`.
+"""
+
+from tpu_cc_manager.tpudev.contract import (
+    AttestationQuote,
+    SliceTopology,
+    TpuCcBackend,
+    TpuChip,
+    TpuError,
+)
+
+__all__ = [
+    "AttestationQuote",
+    "SliceTopology",
+    "TpuCcBackend",
+    "TpuChip",
+    "TpuError",
+]
+
+
+def load_backend(name: str, **kwargs) -> TpuCcBackend:
+    """Backend factory: ``fake`` or ``tpuvm`` (reference picks its device
+    library at image build time, Dockerfile.distroless:22; we pick at runtime
+    via --tpu-backend / TPU_CC_BACKEND so the kind dry-run needs no hardware)."""
+    if name == "fake":
+        from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+
+        return FakeTpuBackend(**kwargs)
+    if name == "tpuvm":
+        from tpu_cc_manager.tpudev.tpuvm import TpuVmBackend
+
+        return TpuVmBackend(**kwargs)
+    raise ValueError(f"unknown TPU backend {name!r} (expected 'fake' or 'tpuvm')")

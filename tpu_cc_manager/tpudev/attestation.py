@@ -1,0 +1,145 @@
+"""Attestation verification for reconfigured slices.
+
+New subsystem — no reference counterpart (SURVEY.md §0(b): "libtpu / TPU VM
+runtime based CC+attestation toggle"). After a CC transition commits, the
+reconciler asks the backend for a quote bound to a fresh nonce and verifies
+it here before declaring the node ready. In ``devtools`` mode the policy is
+relaxed: problems are logged, not fatal (the reference's devtools is a GPU
+debug mode; the TPU analogue is a debug attestation policy, labels.py).
+
+Verifier dispatch is by quote ``platform``:
+- ``fake``  — HMAC with the shared test key (tpudev/fake.py),
+- ``tpuvm`` — GCE instance-identity JWT checks (tpudev/tpuvm.py); offline
+  parts only (issuer/audience/expiry structure), signature verification
+  against Google's JWKS requires egress and is delegated to the caller's
+  environment.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import logging
+import secrets
+import time
+
+from tpu_cc_manager.tpudev.contract import AttestationQuote, TpuError
+
+log = logging.getLogger(__name__)
+
+REQUIRED_MEASUREMENTS = ("accelerator_type", "runtime_digest", "cc_mode")
+
+
+class AttestationError(TpuError):
+    """Quote failed verification (fatal outside devtools policy)."""
+
+
+def fresh_nonce() -> str:
+    return secrets.token_hex(16)
+
+
+def _check_fake_signature(quote: AttestationQuote) -> list[str]:
+    from tpu_cc_manager.tpudev.fake import sign_fake_quote
+
+    expected = sign_fake_quote(quote.slice_id, quote.nonce, quote.mode, quote.measurements)
+    if not hmac.compare_digest(expected, quote.signature):
+        return ["fake quote HMAC mismatch"]
+    return []
+
+
+def _decode_jwt_segment(seg: str) -> dict:
+    pad = "=" * (-len(seg) % 4)
+    return json.loads(base64.urlsafe_b64decode(seg + pad))
+
+
+def _check_tpuvm_signature(quote: AttestationQuote) -> list[str]:
+    """Structural checks on a GCE instance-identity JWT carried in
+    ``signature``. Full RS256 verification against Google's JWKS needs
+    network egress; environments with egress can layer it on top."""
+    problems = []
+    parts = quote.signature.split(".")
+    if len(parts) != 3:
+        return ["tpuvm quote is not a JWT"]
+    try:
+        header = _decode_jwt_segment(parts[0])
+        claims = _decode_jwt_segment(parts[1])
+    except Exception as e:  # noqa: BLE001 - any decode failure is the finding
+        return [f"tpuvm quote JWT undecodable: {e}"]
+    if header.get("alg") not in ("RS256", "ES256"):
+        problems.append(f"unexpected JWT alg {header.get('alg')!r}")
+    aud = claims.get("aud")
+    if not aud:
+        # No audience means no nonce binding at all — a replayed token would
+        # sail through; treat as a failure, not a skip.
+        problems.append("JWT has no audience claim (nonce unbound)")
+    elif quote.nonce not in str(aud):
+        problems.append("JWT audience does not carry the nonce")
+    exp = claims.get("exp")
+    if isinstance(exp, (int, float)) and exp < time.time():
+        problems.append("JWT expired")
+    return problems
+
+
+_SIGNATURE_CHECKS = {
+    "fake": _check_fake_signature,
+    "tpuvm": _check_tpuvm_signature,
+}
+
+
+def verify_quote(
+    quote: AttestationQuote,
+    nonce: str,
+    expected_mode: str,
+    expected_slice_id: str | None = None,
+    debug_policy: bool = False,
+) -> list[str]:
+    """Verify a quote; returns the (possibly empty) problem list.
+
+    Raises AttestationError on any problem unless ``debug_policy`` is set
+    (devtools mode), in which case problems are logged and returned.
+    """
+    problems: list[str] = []
+    if quote.nonce != nonce:
+        problems.append(f"nonce mismatch: sent {nonce}, quote has {quote.nonce}")
+    if quote.mode != expected_mode:
+        problems.append(f"mode mismatch: expected {expected_mode}, quote says {quote.mode}")
+    if expected_slice_id is not None and quote.slice_id != expected_slice_id:
+        problems.append(
+            f"slice mismatch: expected {expected_slice_id}, quote says {quote.slice_id}"
+        )
+    for key in REQUIRED_MEASUREMENTS:
+        if key not in quote.measurements:
+            problems.append(f"missing measurement {key!r}")
+    checker = _SIGNATURE_CHECKS.get(quote.platform)
+    if checker is None:
+        problems.append(f"unknown quote platform {quote.platform!r}")
+    else:
+        problems.extend(checker(quote))
+
+    if problems:
+        if debug_policy:
+            for p in problems:
+                log.warning("attestation (devtools policy, non-fatal): %s", p)
+        else:
+            raise AttestationError("; ".join(problems))
+    else:
+        log.info(
+            "attestation verified: slice=%s mode=%s digest=%s",
+            quote.slice_id,
+            quote.mode,
+            quote.measurements.get("runtime_digest", "")[:12],
+        )
+    return problems
+
+
+def quote_digest(quote: AttestationQuote) -> str:
+    """Short stable digest of a quote, for logs and cross-slice comparison
+    (multi-slice DP verifies every slice attests the same runtime digest
+    before re-forming the DCN mesh, parallel/multislice.py)."""
+    msg = json.dumps(
+        {"slice": quote.slice_id, "mode": quote.mode, "m": quote.measurements},
+        sort_keys=True,
+    ).encode()
+    return hashlib.sha256(msg).hexdigest()[:16]

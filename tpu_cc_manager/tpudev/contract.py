@@ -1,0 +1,129 @@
+"""The device-layer contract the control loop consumes.
+
+This is the TPU re-creation of the interface the reference consumes from
+gpu-admin-tools (SURVEY.md §1 L1: find_gpus, query/set cc & ppcie mode,
+reset_with_os, wait_for_boot, GpuError), redesigned around the one structural
+difference between the two fabrics: **a TPU slice is the unit of CC state,
+not a chip**. GPUs are staged per-device and reset per-device (with PPCIe as
+a special fabric-atomic mode, reference main.py:317-391); an ICI-connected
+TPU slice must always be staged together and reset together, so fabric
+atomicity is structural here — ``reset`` takes the whole chip set and there
+is no per-chip reset at all.
+
+Second addition with no reference counterpart: attestation. A CC transition
+on TPU is only trustworthy if the post-reset slice produces a verifiable
+quote, so ``fetch_attestation`` is part of the contract and the verify phase
+checks it (SURVEY.md §3.4 "TPU mapping").
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+
+class TpuError(Exception):
+    """Device-layer failure (reference analogue: GpuError, main.py:40).
+
+    The control loop catches this, labels the node ``failed``, and keeps
+    watching (reference main.py:531-538)."""
+
+
+@dataclass(frozen=True)
+class TpuChip:
+    """One TPU chip as seen from this host."""
+
+    index: int                 # host-local chip index
+    device_path: str           # e.g. /dev/accel0 or /dev/vfio/…
+    chip_type: str             # "v5e" | "v5p" | "v6e" | …
+    cc_supported: bool         # chip+platform can run confidential workloads
+    slice_cc_supported: bool   # chip can join a multi-host slice-wide CC domain
+
+    @property
+    def name(self) -> str:
+        return f"{self.chip_type}:{self.device_path}"
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """The ICI domain this host belongs to (the NVLink-fabric analogue)."""
+
+    slice_id: str              # stable id of the ICI domain
+    accelerator_type: str      # e.g. "v5p-32"
+    num_hosts: int             # hosts in the slice (1 for single-host types)
+    host_index: int            # this host's position in the slice
+    chips: tuple[TpuChip, ...] = field(default_factory=tuple)
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    def cc_capable_chips(self) -> tuple[TpuChip, ...]:
+        return tuple(c for c in self.chips if c.cc_supported)
+
+    def slice_cc_capable_chips(self) -> tuple[TpuChip, ...]:
+        return tuple(c for c in self.chips if c.slice_cc_supported)
+
+
+@dataclass(frozen=True)
+class AttestationQuote:
+    """Evidence that the slice booted into the reported CC mode.
+
+    ``measurements`` carries the platform's claims (mode, slice id, runtime
+    digest…); ``signature`` binds them plus the caller's nonce.
+    """
+
+    slice_id: str
+    nonce: str
+    mode: str
+    measurements: dict[str, str]
+    signature: str
+    platform: str  # "fake" | "tpuvm"
+
+
+class TpuCcBackend(abc.ABC):
+    """What the reconciler calls. All methods may raise TpuError.
+
+    Call sequence for a mode change (reference phases at main.py:449-542,
+    restructured for slice atomicity):
+
+        topo = discover()
+        stage_cc_mode(chips, mode)    # write desired mode, no disruption yet
+        reset(chips)                  # commit: whole-chip-set reset
+        wait_ready(chips, timeout)    # runtime back up
+        query_cc_mode(chip) == mode   # verify, per chip
+        fetch_attestation(nonce)      # verify the platform agrees
+    """
+
+    @abc.abstractmethod
+    def discover(self) -> SliceTopology:
+        """Enumerate this host's chips and slice membership
+        (reference analogue: find_gpus(), main.py:144-155)."""
+
+    @abc.abstractmethod
+    def query_cc_mode(self, chip: TpuChip) -> str:
+        """Current committed CC mode of a chip: on|off|devtools|slice
+        (reference analogue: query_cc_mode, main.py:441)."""
+
+    @abc.abstractmethod
+    def stage_cc_mode(self, chips: tuple[TpuChip, ...], mode: str) -> None:
+        """Stage a mode on a set of chips without committing it. Staging is
+        batched (all chips in one call) because TPU CC config is a slice
+        property (reference analogue: per-gpu set_cc_mode, main.py:511,
+        batched by the caller)."""
+
+    @abc.abstractmethod
+    def reset(self, chips: tuple[TpuChip, ...]) -> None:
+        """Commit staged modes by resetting the chip set together. The whole
+        set goes down at once — fabric atomicity is structural (reference
+        analogue: the reset-all loop, main.py:514-519 / :362-368)."""
+
+    @abc.abstractmethod
+    def wait_ready(self, chips: tuple[TpuChip, ...], timeout_s: float) -> None:
+        """Block until the runtime is healthy on every chip, or raise
+        TpuError (reference analogue: wait_for_boot, main.py:523)."""
+
+    @abc.abstractmethod
+    def fetch_attestation(self, nonce: str) -> AttestationQuote:
+        """Produce a quote for the slice's current state bound to ``nonce``.
+        New capability — no reference counterpart (SURVEY.md §0(b))."""

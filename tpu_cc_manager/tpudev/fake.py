@@ -1,0 +1,173 @@
+"""Fake TPU backend: in-memory chips with configurable latency and faults.
+
+This is the test double the reference never had (SURVEY.md §4). It implements
+the full contract with:
+
+- configurable chip count / capability flags (mixed-capability test cases,
+  reference main.py:237-240),
+- staged-vs-committed mode tracking so tests can assert the
+  stage-all/reset-all ordering (reference main.py:502-519),
+- attestation quotes HMAC-signed with a shared test key, verified by
+  :mod:`tpu_cc_manager.tpudev.attestation`,
+- fault injection: fail on stage/reset/wait/attest once or always,
+- latency knobs so bench.py can model realistic reset/boot times.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import threading
+import time
+
+from tpu_cc_manager.labels import MODE_OFF
+from tpu_cc_manager.tpudev.contract import (
+    AttestationQuote,
+    SliceTopology,
+    TpuCcBackend,
+    TpuChip,
+    TpuError,
+)
+
+# Shared secret for fake quotes; the verifier uses the same constant.
+FAKE_ATTESTATION_KEY = b"tpu-cc-manager-fake-attestation-key"
+
+
+def sign_fake_quote(slice_id: str, nonce: str, mode: str, measurements: dict) -> str:
+    msg = json.dumps(
+        {"slice_id": slice_id, "nonce": nonce, "mode": mode, "m": measurements},
+        sort_keys=True,
+    ).encode()
+    return hmac.new(FAKE_ATTESTATION_KEY, msg, hashlib.sha256).hexdigest()
+
+
+class FakeTpuBackend(TpuCcBackend):
+    def __init__(
+        self,
+        num_chips: int = 4,
+        chip_type: str = "v5p",
+        accelerator_type: str = "v5p-8",
+        num_hosts: int = 1,
+        host_index: int = 0,
+        slice_id: str = "fake-slice-0",
+        cc_supported: bool | list[bool] = True,
+        slice_cc_supported: bool | list[bool] = True,
+        initial_mode: str = MODE_OFF,
+        reset_latency_s: float = 0.0,
+        boot_latency_s: float = 0.0,
+    ) -> None:
+        def flags(spec, n):
+            return list(spec) if isinstance(spec, list) else [spec] * n
+
+        cc_flags = flags(cc_supported, num_chips)
+        slice_flags = flags(slice_cc_supported, num_chips)
+        self._chips = tuple(
+            TpuChip(
+                index=i,
+                device_path=f"/dev/accel{i}",
+                chip_type=chip_type,
+                cc_supported=cc_flags[i],
+                slice_cc_supported=slice_flags[i],
+            )
+            for i in range(num_chips)
+        )
+        self._topology = SliceTopology(
+            slice_id=slice_id,
+            accelerator_type=accelerator_type,
+            num_hosts=num_hosts,
+            host_index=host_index,
+            chips=self._chips,
+        )
+        self._lock = threading.Lock()
+        self.committed: dict[int, str] = {c.index: initial_mode for c in self._chips}
+        self.staged: dict[int, str] = {}
+        self.booted: dict[int, bool] = {c.index: True for c in self._chips}
+        self.reset_latency_s = reset_latency_s
+        self.boot_latency_s = boot_latency_s
+        self._boot_done_at: dict[int, float] = {}
+        # Fault injection: map op name -> remaining failure count (-1 = always).
+        self.fail: dict[str, int] = {}
+        # Ordered op log for ordering assertions: (op, payload).
+        self.op_log: list[tuple[str, object]] = []
+
+    # ---- fault injection helpers ----------------------------------------
+
+    def fail_next(self, op: str, times: int = 1) -> None:
+        self.fail[op] = times
+
+    def _maybe_fail(self, op: str) -> None:
+        n = self.fail.get(op, 0)
+        if n:
+            if n > 0:
+                self.fail[op] = n - 1
+            raise TpuError(f"injected fault in {op}")
+
+    # ---- contract --------------------------------------------------------
+
+    def discover(self) -> SliceTopology:
+        self._maybe_fail("discover")
+        self.op_log.append(("discover", None))
+        return self._topology
+
+    def query_cc_mode(self, chip: TpuChip) -> str:
+        self._maybe_fail("query")
+        with self._lock:
+            return self.committed[chip.index]
+
+    def stage_cc_mode(self, chips: tuple[TpuChip, ...], mode: str) -> None:
+        self._maybe_fail("stage")
+        with self._lock:
+            for chip in chips:
+                self.staged[chip.index] = mode
+            self.op_log.append(("stage", (tuple(c.index for c in chips), mode)))
+
+    def reset(self, chips: tuple[TpuChip, ...]) -> None:
+        self._maybe_fail("reset")
+        if self.reset_latency_s:
+            time.sleep(self.reset_latency_s)
+        with self._lock:
+            now = time.monotonic()
+            for chip in chips:
+                if chip.index in self.staged:
+                    self.committed[chip.index] = self.staged.pop(chip.index)
+                self.booted[chip.index] = False
+                self._boot_done_at[chip.index] = now + self.boot_latency_s
+            self.op_log.append(("reset", tuple(c.index for c in chips)))
+
+    def wait_ready(self, chips: tuple[TpuChip, ...], timeout_s: float) -> None:
+        self._maybe_fail("wait_ready")
+        deadline = time.monotonic() + timeout_s
+        for chip in chips:
+            while True:
+                with self._lock:
+                    ready_at = self._boot_done_at.get(chip.index, 0.0)
+                    if time.monotonic() >= ready_at:
+                        self.booted[chip.index] = True
+                        break
+                if time.monotonic() >= deadline:
+                    raise TpuError(f"chip {chip.index} did not become ready")
+                time.sleep(0.01)
+        self.op_log.append(("wait_ready", tuple(c.index for c in chips)))
+
+    def fetch_attestation(self, nonce: str) -> AttestationQuote:
+        self._maybe_fail("attest")
+        with self._lock:
+            modes = sorted(set(self.committed.values()))
+            mode = modes[0] if len(modes) == 1 else "mixed"
+            measurements = {
+                "accelerator_type": self._topology.accelerator_type,
+                "num_chips": str(len(self._chips)),
+                "runtime_digest": hashlib.sha256(b"fake-tpu-runtime").hexdigest(),
+                "cc_mode": mode,
+            }
+        sig = sign_fake_quote(self._topology.slice_id, nonce, mode, measurements)
+        self.op_log.append(("attest", nonce))
+        return AttestationQuote(
+            slice_id=self._topology.slice_id,
+            nonce=nonce,
+            mode=mode,
+            measurements=measurements,
+            signature=sig,
+            platform="fake",
+        )

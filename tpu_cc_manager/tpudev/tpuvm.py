@@ -1,0 +1,295 @@
+"""Real TPU VM backend.
+
+The reference's device layer flips PCI config bits and resets the GPU
+(gpu-admin-tools; SURVEY.md §1 L1). TPUs expose no user-visible equivalent,
+so this backend follows the design SURVEY.md §7.2 prescribes: the CC mode is
+carried as *runtime configuration* (persisted in a state dir), committed by
+**restarting the TPU runtime** for the whole host at once, verified by
+runtime health + a platform attestation (GCE instance-identity JWT from the
+metadata server; on SEV-SNP/TDX hosts the VM-level evidence is implicit in
+the platform's confidential-VM identity claims).
+
+Everything environment-touching is injectable (commands, paths, metadata
+URL) so the backend is unit-testable on any machine; on a non-TPU host
+``discover`` raises TpuError and the CLI tells the operator to use
+``--tpu-backend=fake``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import subprocess
+import time
+import urllib.error
+import urllib.request
+
+from tpu_cc_manager.labels import MODE_OFF, VALID_MODES
+from tpu_cc_manager.tpudev.contract import (
+    AttestationQuote,
+    SliceTopology,
+    TpuCcBackend,
+    TpuChip,
+    TpuError,
+)
+
+log = logging.getLogger(__name__)
+
+METADATA_URL = "http://metadata.google.internal/computeMetadata/v1"
+DEFAULT_STATE_DIR = "/var/lib/tpu-cc-manager"
+# Restarting the runtime is the commit point (the reset_with_os analogue,
+# reference main.py:519). Overridable for non-systemd hosts.
+DEFAULT_RESET_CMD = ["systemctl", "restart", "tpu-runtime"]
+# libtpu's default gRPC/health port on TPU VMs.
+DEFAULT_HEALTH_PROBE_CMD = None  # None -> device-node + state-file probe
+
+# chips per host by generation (v4/v5p: 4 chips/host; v5e/v6e: up to 8).
+_CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
+# cores per chip: megacore generations report 1 core/chip to accelerator-type
+# counts on v5e/v6e; v4/v5p accelerator-type counts are TensorCores (2/chip).
+_CORES_PER_CHIP = {"v4": 2, "v5p": 2, "v5e": 1, "v6e": 1}
+
+
+def parse_accelerator_type(accel: str) -> tuple[str, int, int]:
+    """``v5p-32`` -> (generation, total_chips, num_hosts)."""
+    try:
+        gen, _, count = accel.partition("-")
+        cores = int(count)
+    except ValueError as e:
+        raise TpuError(f"unparseable accelerator type {accel!r}") from e
+    gen = gen.lower()
+    if gen.startswith("v5lite"):
+        gen = "v5e"
+    cores_per_chip = _CORES_PER_CHIP.get(gen, 2)
+    chips = max(1, cores // cores_per_chip)
+    per_host = _CHIPS_PER_HOST.get(gen, 4)
+    hosts = max(1, (chips + per_host - 1) // per_host)
+    return gen, chips, hosts
+
+
+class TpuVmBackend(TpuCcBackend):
+    def __init__(
+        self,
+        state_dir: str = DEFAULT_STATE_DIR,
+        reset_cmd: list[str] | None = None,
+        health_probe_cmd: list[str] | None = DEFAULT_HEALTH_PROBE_CMD,
+        metadata_url: str = METADATA_URL,
+        device_glob: str = "/dev/accel*",
+        vfio_glob: str = "/dev/vfio/[0-9]*",
+    ) -> None:
+        self.state_dir = state_dir
+        self.reset_cmd = reset_cmd or list(DEFAULT_RESET_CMD)
+        self.health_probe_cmd = health_probe_cmd
+        self.metadata_url = metadata_url
+        self.device_glob = device_glob
+        self.vfio_glob = vfio_glob
+
+    # ---- metadata / persistence helpers ---------------------------------
+
+    def _metadata(self, path: str, default: str | None = None) -> str | None:
+        req = urllib.request.Request(
+            f"{self.metadata_url}/{path}", headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                return resp.read().decode("utf-8").strip()
+        except (urllib.error.URLError, OSError, TimeoutError):
+            return default
+
+    def _state_path(self, name: str) -> str:
+        return os.path.join(self.state_dir, name)
+
+    def _read_state(self, name: str) -> dict:
+        try:
+            with open(self._state_path(name), "r", encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+        except (OSError, json.JSONDecodeError) as e:
+            raise TpuError(f"corrupt device state file {name}: {e}") from e
+
+    def _write_state(self, name: str, payload: dict) -> None:
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = self._state_path(name) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._state_path(name))
+
+    # ---- contract --------------------------------------------------------
+
+    def discover(self) -> SliceTopology:
+        device_paths = sorted(glob.glob(self.device_glob)) or sorted(
+            glob.glob(self.vfio_glob)
+        )
+        accel = (
+            os.environ.get("TPU_ACCELERATOR_TYPE")
+            or self._metadata("instance/attributes/accelerator-type")
+        )
+        if not device_paths and not accel:
+            raise TpuError(
+                "no TPU devices found (no /dev/accel*, no accelerator-type "
+                "metadata) — not a TPU VM? use --tpu-backend=fake for dry-runs"
+            )
+        accel = accel or f"v5e-{len(device_paths)}"
+        gen, total_chips, num_hosts = parse_accelerator_type(accel)
+        worker_id = int(
+            os.environ.get("TPU_WORKER_ID")
+            or self._metadata("instance/attributes/agent-worker-number", "0")
+            or 0
+        )
+        slice_id = (
+            os.environ.get("TPU_SLICE_ID")
+            or self._metadata("instance/attributes/tpu-env-slice-id")
+            or f"{accel}-{self._metadata('instance/id', 'local')}"
+        )
+        # Confidential support: the VM itself must be confidential. Probe the
+        # same host signals the reference probes for TDX/SEV-SNP
+        # (main.py:80-103), which surface inside a CC VM as /dev/tdx_guest or
+        # /dev/sev-guest.
+        host_cc = os.path.exists("/dev/tdx_guest") or os.path.exists("/dev/sev-guest")
+        if not device_paths:
+            # Multi-host slices schedule one worker per host; synthesize this
+            # host's chip share when the device nodes are containerized away.
+            per_host = max(1, total_chips // num_hosts)
+            device_paths = [f"/dev/accel{i}" for i in range(per_host)]
+        chips = tuple(
+            TpuChip(
+                index=i,
+                device_path=p,
+                chip_type=gen,
+                cc_supported=host_cc,
+                slice_cc_supported=host_cc and num_hosts > 1,
+            )
+            for i, p in enumerate(device_paths)
+        )
+        return SliceTopology(
+            slice_id=str(slice_id),
+            accelerator_type=accel,
+            num_hosts=num_hosts,
+            host_index=worker_id,
+            chips=chips,
+        )
+
+    def query_cc_mode(self, chip: TpuChip) -> str:
+        pending = self._read_state("pending.json")
+        if str(chip.index) in pending:
+            # A reset started but never finished (crash / failed restart):
+            # the true hardware mode is unknown, so report a value that can
+            # never satisfy an idempotency check.
+            return "resetting"
+        committed = self._read_state("committed.json")
+        mode = committed.get(str(chip.index), committed.get("*", MODE_OFF))
+        return mode if mode in VALID_MODES else MODE_OFF
+
+    def stage_cc_mode(self, chips: tuple[TpuChip, ...], mode: str) -> None:
+        staged = self._read_state("staged.json")
+        for chip in chips:
+            staged[str(chip.index)] = mode
+        self._write_state("staged.json", staged)
+        log.info("staged mode=%s on %d chip(s)", mode, len(chips))
+
+    def reset(self, chips: tuple[TpuChip, ...]) -> None:
+        staged = self._read_state("staged.json")
+        pending = {}
+        for chip in chips:
+            key = str(chip.index)
+            if key in staged:
+                pending[key] = staged.pop(key)
+        # Crash-safety ordering: mark the transition *pending* before the
+        # disruptive restart, and only promote to committed after the restart
+        # succeeds. A crash or restart failure leaves pending.json behind, and
+        # query_cc_mode reports "resetting" for those chips — which can never
+        # equal a desired mode, so the retrying reconcile re-runs the full
+        # apply instead of trusting a commit that never happened
+        # (crash-as-retry safety, SURVEY.md §7(c)).
+        self._write_state("pending.json", pending)
+        self._write_state("staged.json", staged)
+        log.info("restarting TPU runtime: %s", " ".join(self.reset_cmd))
+        try:
+            subprocess.run(
+                self.reset_cmd, check=True, capture_output=True, timeout=120
+            )
+        except FileNotFoundError as e:
+            raise TpuError(f"reset command not found: {e}") from e
+        except subprocess.TimeoutExpired as e:
+            raise TpuError(f"reset command timed out: {e}") from e
+        except subprocess.CalledProcessError as e:
+            raise TpuError(
+                f"reset command failed rc={e.returncode}: "
+                f"{(e.stderr or b'').decode('utf-8', 'replace')[:256]}"
+            ) from e
+        committed = self._read_state("committed.json")
+        committed.update(pending)
+        self._write_state("committed.json", committed)
+        self._write_state("pending.json", {})
+
+    def wait_ready(self, chips: tuple[TpuChip, ...], timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self._probe_healthy(chips):
+                return
+            if time.monotonic() >= deadline:
+                raise TpuError(
+                    f"TPU runtime not healthy after {timeout_s:.0f}s"
+                )
+            time.sleep(1.0)
+
+    def _probe_healthy(self, chips: tuple[TpuChip, ...]) -> bool:
+        if self.health_probe_cmd is not None:
+            try:
+                rc = subprocess.run(
+                    self.health_probe_cmd, capture_output=True, timeout=10
+                ).returncode
+                return rc == 0
+            except (OSError, subprocess.TimeoutExpired):
+                return False
+        # Default probe: every chip's device node is back.
+        return all(os.path.exists(c.device_path) for c in chips)
+
+    def fetch_attestation(self, nonce: str) -> AttestationQuote:
+        committed = self._read_state("committed.json")
+        modes = sorted(set(committed.values())) or [MODE_OFF]
+        mode = modes[0] if len(modes) == 1 else "mixed"
+        topo = self.discover()
+        # GCE instance-identity JWT bound to the nonce via the audience.
+        jwt = self._metadata(
+            f"instance/service-accounts/default/identity"
+            f"?audience=tpu-cc-manager/{nonce}&format=full"
+        )
+        if jwt is None:
+            raise TpuError(
+                "metadata server unreachable: cannot fetch instance identity "
+                "for attestation"
+            )
+        measurements = {
+            "accelerator_type": topo.accelerator_type,
+            "num_chips": str(len(topo.chips)),
+            "runtime_digest": self._runtime_digest(),
+            "cc_mode": mode,
+            "confidential_vm": str(
+                os.path.exists("/dev/tdx_guest") or os.path.exists("/dev/sev-guest")
+            ).lower(),
+        }
+        return AttestationQuote(
+            slice_id=topo.slice_id,
+            nonce=nonce,
+            mode=mode,
+            measurements=measurements,
+            signature=jwt,
+            platform="tpuvm",
+        )
+
+    def _runtime_digest(self) -> str:
+        """Digest of the runtime config that CC mode is carried in."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for name in ("committed.json",):
+            try:
+                with open(self._state_path(name), "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                pass
+        return h.hexdigest()

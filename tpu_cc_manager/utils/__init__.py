@@ -1,0 +1,1 @@
+"""Shared utilities: structured logging and phase-latency metrics."""

@@ -1,0 +1,155 @@
+"""Phase-latency metrics.
+
+The reference has no instrumentation beyond log lines (SURVEY.md §5), but the
+north-star metric for this build is a latency — per-node drain→CC-on→ready
+< 90 s (BASELINE.md) — so every reconcile phase is timed here and the timings
+are exported both as structured log lines and programmatically (bench.py and
+the Prometheus text endpoint read them).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+# Canonical phase names, in pipeline order.
+PHASE_DRAIN = "drain"
+PHASE_STAGE = "stage"
+PHASE_RESET = "reset"
+PHASE_WAIT_READY = "wait_ready"
+PHASE_ATTEST = "attest"
+PHASE_SMOKE = "smoke"
+PHASE_READMIT = "readmit"
+
+
+@dataclass
+class PhaseRecord:
+    name: str
+    start: float
+    end: float = 0.0
+    ok: bool = True
+
+    @property
+    def seconds(self) -> float:
+        return max(0.0, self.end - self.start)
+
+
+@dataclass
+class ReconcileMetrics:
+    """Timings for one reconcile (one desired-mode application)."""
+
+    mode: str
+    start: float = field(default_factory=time.monotonic)
+    end: float = 0.0
+    phases: list[PhaseRecord] = field(default_factory=list)
+    result: str = "pending"  # pending | ok | failed | noop
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        rec = PhaseRecord(name=name, start=time.monotonic())
+        try:
+            yield rec
+        except BaseException:
+            rec.ok = False
+            raise
+        finally:
+            rec.end = time.monotonic()
+            self.phases.append(rec)
+            log.info(
+                "phase %s finished in %.2fs (ok=%s)",
+                name,
+                rec.seconds,
+                rec.ok,
+                extra={"fields": {"phase": name, "seconds": round(rec.seconds, 3), "ok": rec.ok}},
+            )
+
+    def finish(self, result: str) -> None:
+        self.end = time.monotonic()
+        self.result = result
+        log.info(
+            "reconcile mode=%s result=%s total=%.2fs phases=%s",
+            self.mode,
+            result,
+            self.total_seconds,
+            {p.name: round(p.seconds, 2) for p in self.phases},
+            extra={
+                "fields": {
+                    "mode": self.mode,
+                    "result": result,
+                    "total_seconds": round(self.total_seconds, 3),
+                }
+            },
+        )
+
+    @property
+    def total_seconds(self) -> float:
+        end = self.end if self.end else time.monotonic()
+        return max(0.0, end - self.start)
+
+    def phase_seconds(self, name: str) -> float:
+        return sum(p.seconds for p in self.phases if p.name == name)
+
+
+class MetricsRegistry:
+    """Process-wide registry of reconcile metrics (thread-safe).
+
+    Backs the Prometheus text endpoint and bench.py. The reference exposes no
+    metrics endpoint (SURVEY.md §5) — this is a deliberate addition.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._history: list[ReconcileMetrics] = []
+
+    def start(self, mode: str) -> ReconcileMetrics:
+        m = ReconcileMetrics(mode=mode)
+        with self._lock:
+            self._history.append(m)
+            # Bound memory: keep the last 256 reconciles.
+            if len(self._history) > 256:
+                del self._history[: len(self._history) - 256]
+        return m
+
+    @property
+    def history(self) -> list[ReconcileMetrics]:
+        with self._lock:
+            return list(self._history)
+
+    def last(self) -> ReconcileMetrics | None:
+        with self._lock:
+            return self._history[-1] if self._history else None
+
+    def render_prometheus(self) -> str:
+        """Render the registry in Prometheus text exposition format."""
+        lines = [
+            "# HELP tpu_cc_reconcile_seconds Total seconds for the most recent reconcile.",
+            "# TYPE tpu_cc_reconcile_seconds gauge",
+        ]
+        last = self.last()
+        if last is not None:
+            lines.append(
+                'tpu_cc_reconcile_seconds{mode="%s",result="%s"} %.3f'
+                % (last.mode, last.result, last.total_seconds)
+            )
+            lines.append("# HELP tpu_cc_phase_seconds Seconds per phase of the most recent reconcile.")
+            lines.append("# TYPE tpu_cc_phase_seconds gauge")
+            for p in last.phases:
+                lines.append(
+                    'tpu_cc_phase_seconds{mode="%s",phase="%s",ok="%s"} %.3f'
+                    % (last.mode, p.name, str(p.ok).lower(), p.seconds)
+                )
+        lines.append("# HELP tpu_cc_reconciles_total Reconciles since process start.")
+        lines.append("# TYPE tpu_cc_reconciles_total counter")
+        hist = self.history
+        for result in ("ok", "failed", "noop"):
+            n = sum(1 for m in hist if m.result == result)
+            lines.append('tpu_cc_reconciles_total{result="%s"} %d' % (result, n))
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
