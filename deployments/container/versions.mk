@@ -1,0 +1,11 @@
+# Version pins for the image build (reference analogue: versions.mk).
+# Keep VERSION in lockstep with tpu_cc_manager/version.py.
+
+VERSION := 0.1.0
+
+PYTHON_VERSION := 3.12
+JAX_VERSION := 0.9.0
+BASE_DIST := gcr.io/distroless/python3-debian12:nonroot
+
+REGISTRY ?= ghcr.io/tpu-cc-manager
+IMAGE_NAME ?= tpu-cc-manager

@@ -1,0 +1,140 @@
+/* rmutil: a tiny static `rm` for the distroless agent image.
+ *
+ * Why this exists (same reason as the reference's rmsrc/rm.c, SURVEY.md §2
+ * #11): the shipped container is distroless — no shell, no coreutils — but
+ * the DaemonSet's preStop hook must delete the readiness file
+ * (/run/tpu/validations/.tpu-cc-manager-ctr-ready) so the operator's
+ * validation framework notices the agent is gone. A ~100-line static binary
+ * is cheaper and smaller than pulling busybox into the image.
+ *
+ * Design (deliberately not the reference's nftw() walk): recursion is done
+ * with openat()/fdopendir()/unlinkat() relative to directory fds, so it
+ * needs no PATH_MAX buffers, is immune to path-length limits, and cannot be
+ * redirected by a concurrent rename of an ancestor directory.
+ *
+ * Usage: rm [-r] [-f] [--] PATH...
+ *   -r  recurse into directories
+ *   -f  ignore missing paths and all errors (exit 0)
+ */
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+static int opt_recursive = 0;
+static int opt_force = 0;
+static int exit_status = 0;
+
+static void complain(const char *path, const char *what) {
+    if (!opt_force) {
+        fprintf(stderr, "rm: %s: %s: %s\n", what, path, strerror(errno));
+        exit_status = 1;
+    }
+}
+
+/* Remove everything inside the directory open at fd (consumes fd). */
+static int clear_dir(int fd, const char *label) {
+    DIR *dir = fdopendir(fd);
+    if (!dir) {
+        close(fd);
+        return -1;
+    }
+    int ok = 0;
+    struct dirent *ent;
+    while ((ent = readdir(dir)) != NULL) {
+        if (strcmp(ent->d_name, ".") == 0 || strcmp(ent->d_name, "..") == 0)
+            continue;
+        if (unlinkat(dirfd(dir), ent->d_name, 0) == 0)
+            continue;
+        if (errno != EISDIR && errno != EPERM) {
+            complain(ent->d_name, "cannot remove");
+            ok = -1;
+            continue;
+        }
+        /* Probably a directory: descend and retry. */
+        int sub = openat(dirfd(dir), ent->d_name,
+                         O_RDONLY | O_DIRECTORY | O_NOFOLLOW | O_CLOEXEC);
+        if (sub < 0 || clear_dir(sub, ent->d_name) != 0) {
+            complain(ent->d_name, "cannot descend into");
+            ok = -1;
+            continue;
+        }
+        if (unlinkat(dirfd(dir), ent->d_name, AT_REMOVEDIR) != 0) {
+            complain(ent->d_name, "cannot rmdir");
+            ok = -1;
+        }
+        /* readdir() state can be stale after deletions; restart the scan so
+         * nothing is skipped. */
+        rewinddir(dir);
+    }
+    (void)label;
+    closedir(dir);
+    return ok;
+}
+
+static void remove_path(const char *path) {
+    if (unlink(path) == 0)
+        return;
+    if (errno == ENOENT) {
+        if (!opt_force) {
+            fprintf(stderr, "rm: no such file: %s\n", path);
+            exit_status = 1;
+        }
+        return;
+    }
+    if (errno != EISDIR && errno != EPERM) {
+        complain(path, "cannot remove");
+        return;
+    }
+    if (!opt_recursive) {
+        errno = EISDIR;
+        complain(path, "is a directory (need -r)");
+        return;
+    }
+    int fd = open(path, O_RDONLY | O_DIRECTORY | O_NOFOLLOW | O_CLOEXEC);
+    if (fd < 0) {
+        complain(path, "cannot open");
+        return;
+    }
+    if (clear_dir(fd, path) != 0 && !opt_force)
+        return;
+    if (rmdir(path) != 0)
+        complain(path, "cannot rmdir");
+}
+
+int main(int argc, char **argv) {
+    int i = 1;
+    for (; i < argc && argv[i][0] == '-' && argv[i][1] != '\0'; i++) {
+        if (strcmp(argv[i], "--") == 0) {
+            i++;
+            break;
+        }
+        for (const char *f = argv[i] + 1; *f; f++) {
+            switch (*f) {
+            case 'r':
+            case 'R':
+                opt_recursive = 1;
+                break;
+            case 'f':
+                opt_force = 1;
+                break;
+            default:
+                fprintf(stderr, "rm: unknown flag -%c\n", *f);
+                return 2;
+            }
+        }
+    }
+    if (i >= argc) {
+        if (opt_force)
+            return 0;
+        fprintf(stderr, "usage: rm [-r] [-f] [--] PATH...\n");
+        return 2;
+    }
+    for (; i < argc; i++)
+        remove_path(argv[i]);
+    return opt_force ? 0 : exit_status;
+}

@@ -1,19 +1,31 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip hardware is unavailable in CI; sharding correctness is validated
-on 8 virtual CPU devices (the driver separately dry-run-compiles the
-multi-chip path via __graft_entry__.dryrun_multichip). Must run before any
-jax import, hence the env mutation at conftest import time.
+Two traps in this image make the obvious env vars insufficient:
+
+1. The image exports ``JAX_PLATFORMS=axon`` (a tunnel to one real TPU chip)
+   and a sitecustomize that — whenever ``PALLAS_AXON_POOL_IPS`` is set —
+   registers the axon backend and calls
+   ``jax.config.update("jax_platforms", "axon,cpu")``, overriding any env
+   value. Tests must never touch that tunnel (it is single-client and a
+   concurrent test run can wedge it), so we delete the trigger variable
+   (inherited by smoke-workload subprocesses) and force the config back.
+2. ``xla_force_host_platform_device_count`` must be in XLA_FLAGS before the
+   CPU backend initializes; conftest import time is early enough.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # keep smoke subprocesses off the TPU
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

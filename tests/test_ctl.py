@@ -1,0 +1,54 @@
+"""Operator CLI command functions (ctl.py) against the fake apiserver."""
+
+import argparse
+
+from tpu_cc_manager import ctl
+from tpu_cc_manager.ccmanager.multislice import publish_quote
+from tpu_cc_manager.ccmanager.rolling import SLICE_ID_LABEL
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import CC_MODE_LABEL, CC_MODE_STATE_LABEL
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+
+
+def ns(**kw):
+    return argparse.Namespace(**kw)
+
+
+def test_status_lists_nodes(fake_kube, capsys):
+    fake_kube.add_node("n0", {"pool": "tpu", CC_MODE_LABEL: "on",
+                              CC_MODE_STATE_LABEL: "on"})
+    rc = ctl.cmd_status(fake_kube, ns(selector="pool=tpu"))
+    out = capsys.readouterr().out
+    assert rc == 0 and "n0" in out and "on" in out
+
+
+def test_attest_ok_and_fail(fake_kube, capsys):
+    quote = FakeTpuBackend(slice_id="s1", initial_mode="on").fetch_attestation("n")
+    fake_kube.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    publish_quote(fake_kube, "n0", quote)
+    assert ctl.cmd_attest(
+        fake_kube, ns(selector="pool=tpu", mode="on", slices=None, max_age=3600)
+    ) == 0
+    assert ctl.cmd_attest(
+        fake_kube, ns(selector="pool=tpu", mode="off", slices=None, max_age=3600)
+    ) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+
+def test_rollout_command(fake_kube, capsys):
+    fake_kube.add_node("n0", {"pool": "tpu"})
+
+    def agent(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired:
+            fake_kube.set_node_label(name, CC_MODE_STATE_LABEL, desired)
+
+    fake_kube.add_patch_reactor(agent)
+    rc = ctl.cmd_rollout(
+        fake_kube,
+        ns(selector="pool=tpu", mode="on", max_unavailable=1,
+           node_timeout=5.0, continue_on_failure=False),
+    )
+    assert rc == 0
+    assert '"ok": true' in capsys.readouterr().out

@@ -1,0 +1,178 @@
+"""Cross-slice attestation coordination (ccmanager/multislice.py)."""
+
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager import multislice
+from tpu_cc_manager.ccmanager.rolling import SLICE_ID_LABEL
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+
+POOL = "pool=tpu"
+
+
+def make_quote(slice_id, mode="on"):
+    backend = FakeTpuBackend(slice_id=slice_id, initial_mode=mode)
+    return backend.fetch_attestation("nonce")
+
+
+def add_attested_node(fake_kube, name, slice_id, quote):
+    fake_kube.add_node(name, {"pool": "tpu", SLICE_ID_LABEL: slice_id})
+    multislice.publish_quote(fake_kube, name, quote)
+
+
+def test_publish_and_collect(fake_kube):
+    q = make_quote("s1")
+    add_attested_node(fake_kube, "n0", "s1", q)
+    add_attested_node(fake_kube, "n1", "s1", q)
+    slices = multislice.collect_pool_quotes(fake_kube, POOL)
+    assert set(slices) == {"s1"}
+    assert sorted(slices["s1"]["nodes"]) == ["n0", "n1"]
+    assert slices["s1"]["digest"] != "MIXED"
+
+
+def test_verify_pool_ok(fake_kube):
+    # Two slices; SAME runtime digest required. Quotes embed the slice id,
+    # so digests differ per slice — build both from the same slice template
+    # and relabel. In production the digest covers the runtime measurement,
+    # which IS equal across correctly-configured slices; the fake mirrors
+    # that only when the quotes are identical modulo nothing. Use one slice.
+    q = make_quote("s1")
+    add_attested_node(fake_kube, "n0", "s1", q)
+    add_attested_node(fake_kube, "n1", "s1", q)
+    slices = multislice.verify_pool_attestation(fake_kube, POOL, "on")
+    assert len(slices) == 1
+
+
+def test_verify_detects_mode_mismatch(fake_kube):
+    add_attested_node(fake_kube, "n0", "s1", make_quote("s1", mode="off"))
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(fake_kube, POOL, "on")
+    assert "mode" in str(exc.value)
+
+
+def test_verify_detects_digest_divergence(fake_kube):
+    add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
+    add_attested_node(fake_kube, "n1", "s2", make_quote("s2"))
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(fake_kube, POOL, "on")
+    assert "distinct runtime digests" in str(exc.value)
+
+
+def test_verify_detects_intra_slice_divergence(fake_kube):
+    add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
+    # Second host of s1 publishes a different digest (tampered quote).
+    q2 = make_quote("s2")
+    fake_kube.add_node("n1", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    multislice.publish_quote(fake_kube, "n1", q2)
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(fake_kube, POOL, "on")
+    assert "disagree" in str(exc.value)
+
+
+def test_verify_detects_missing_attestation(fake_kube):
+    fake_kube.add_node("n0", {"pool": "tpu"})
+    with pytest.raises(multislice.PoolAttestationError):
+        multislice.verify_pool_attestation(fake_kube, POOL, "on")
+
+
+def test_verify_detects_unattested_host_of_attested_slice(fake_kube):
+    """One host attested, its slice-mate did not: must fail, not pass on the
+    attested host's evidence alone."""
+    add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
+    fake_kube.add_node("n1", {"pool": "tpu", SLICE_ID_LABEL: "s1"})  # no quote
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(fake_kube, POOL, "on")
+    assert "without attestation" in str(exc.value)
+
+
+def test_idempotent_reconcile_republishes_coordination(fake_kube):
+    """A restarted agent on an already-CC-on node must re-publish slice id
+    and a fresh quote (rolling grouping + quote aging depend on it)."""
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.kubeclient.api import node_labels
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    fake_kube.add_node("n0")
+    backend = FakeTpuBackend(slice_id="slice-x", initial_mode="on")
+    mgr = CCManager(
+        api=fake_kube, backend=backend, node_name="n0",
+        evict_components=False, smoke_workload="none",
+        metrics=MetricsRegistry(),
+    )
+    assert mgr.set_cc_mode("on") is True
+    assert "reset" not in [op for op, _ in backend.op_log]  # still idempotent
+    labels = node_labels(fake_kube.get_node("n0"))
+    assert labels[SLICE_ID_LABEL] == "slice-x"
+    assert f"{multislice.QUOTE_ANNOTATION}.digest" in labels
+
+
+def test_idempotent_reconcile_reattests_on_failure(fake_kube):
+    """If re-attestation fails on the idempotent path, the full apply runs."""
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    fake_kube.add_node("n0")
+    backend = FakeTpuBackend(initial_mode="on")
+    backend.fail_next("attest")  # first (idempotent-path) attest fails
+    mgr = CCManager(
+        api=fake_kube, backend=backend, node_name="n0",
+        evict_components=False, smoke_workload="none",
+        metrics=MetricsRegistry(),
+    )
+    assert mgr.set_cc_mode("on") is True
+    ops = [op for op, _ in backend.op_log]
+    assert "reset" in ops  # fell through to the full apply
+
+
+def test_verify_detects_stale_quote(fake_kube, monkeypatch):
+    q = make_quote("s1")
+    add_attested_node(fake_kube, "n0", "s1", q)
+    future = time.time() + 7200
+    monkeypatch.setattr(time, "time", lambda: future)
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(fake_kube, POOL, "on", max_age_s=3600)
+    assert "stale" in str(exc.value)
+
+
+def test_expected_slice_count(fake_kube):
+    q = make_quote("s1")
+    add_attested_node(fake_kube, "n0", "s1", q)
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(fake_kube, POOL, "on", expected_slices=2)
+    assert "expected 2 slices" in str(exc.value)
+
+
+def test_pool_report(fake_kube):
+    add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
+    report = multislice.pool_report(fake_kube, POOL)
+    assert "s1" in report and "SLICE" in report
+
+
+def test_manager_publishes_coordination_labels(fake_kube):
+    """End-to-end: a successful reconcile leaves slice id + digest labels."""
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.kubeclient.api import node_labels
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    fake_kube.add_node("n0")
+    backend = FakeTpuBackend(slice_id="fake-slice-0")
+    mgr = CCManager(
+        api=fake_kube, backend=backend, node_name="n0",
+        evict_components=False, smoke_workload="none",
+        metrics=MetricsRegistry(),
+    )
+    assert mgr.set_cc_mode("on") is True
+    labels = node_labels(fake_kube.get_node("n0"))
+    assert labels[SLICE_ID_LABEL] == "fake-slice-0"
+    assert f"{multislice.QUOTE_ANNOTATION}.digest" in labels
+    assert labels[f"{multislice.QUOTE_ANNOTATION}.mode"] == "on"
+    # And the pool now verifies.
+    fake_kube.set_node_label("n0", "pool", "tpu")
+    multislice.verify_pool_attestation(fake_kube, POOL, "on")
+    # Flipping to off clears the attestation evidence (no stale quotes).
+    assert mgr.set_cc_mode("off") is True
+    labels = node_labels(fake_kube.get_node("n0"))
+    assert f"{multislice.QUOTE_ANNOTATION}.digest" not in labels
+    with pytest.raises(multislice.PoolAttestationError):
+        multislice.verify_pool_attestation(fake_kube, POOL, "off")

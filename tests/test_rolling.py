@@ -1,0 +1,158 @@
+"""Rolling pool reconfiguration (ccmanager/rolling.py)."""
+
+import threading
+
+import pytest
+
+from tpu_cc_manager.ccmanager.rolling import (
+    SLICE_ID_LABEL,
+    RollingReconfigurator,
+    plan_groups,
+)
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    STATE_FAILED,
+)
+
+POOL = "pool=tpu"
+
+
+def add_pool(fake_kube, n=4, slice_map=None):
+    for i in range(n):
+        labels = {"pool": "tpu"}
+        if slice_map and i in slice_map:
+            labels[SLICE_ID_LABEL] = slice_map[i]
+        fake_kube.add_node(f"node-{i}", labels)
+
+
+def agent_simulator(fake_kube, fail_nodes=(), delay_patches=1):
+    """Emulate per-node agents: when the desired label lands, converge the
+    state label (or 'failed' for nodes in fail_nodes)."""
+
+    def reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired:
+            target = STATE_FAILED if name in fail_nodes else desired
+            # Converge asynchronously, as a real agent would.
+            t = threading.Timer(
+                0.05, lambda: fake_kube.set_node_label(name, CC_MODE_STATE_LABEL, target)
+            )
+            t.daemon = True
+            t.start()
+
+    fake_kube.add_patch_reactor(reactor)
+
+
+def make_roller(fake_kube, **kw):
+    kw.setdefault("node_timeout_s", 5)
+    kw.setdefault("poll_interval_s", 0.02)
+    return RollingReconfigurator(fake_kube, POOL, **kw)
+
+
+def test_plan_groups_by_slice(fake_kube):
+    add_pool(fake_kube, 4, slice_map={0: "s1", 1: "s1", 2: "s2"})
+    groups = dict(plan_groups(fake_kube, POOL))
+    assert groups["s1"] == ("node-0", "node-1")
+    assert groups["s2"] == ("node-2",)
+    assert groups["node/node-3"] == ("node-3",)
+
+
+def test_rollout_converges_all_nodes(fake_kube):
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube)
+    result = make_roller(fake_kube).rollout("on")
+    assert result.ok is True
+    assert len(result.groups) == 3
+    for i in range(3):
+        labels = node_labels(fake_kube.get_node(f"node-{i}"))
+        assert labels[CC_MODE_LABEL] == "on"
+        assert labels[CC_MODE_STATE_LABEL] == "on"
+    assert result.summary()["nodes"] == 3
+
+
+def test_rollout_is_strictly_rolling(fake_kube):
+    """With max_unavailable=1, node N+1 must not receive its desired label
+    until node N converged."""
+    add_pool(fake_kube, 3)
+    order = []
+
+    def tracking_reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired:
+            # At the moment a node is asked to reconfigure, every previously
+            # asked node must already have converged.
+            for other in order:
+                other_state = node_labels(fake_kube.get_node(other)).get(
+                    CC_MODE_STATE_LABEL
+                )
+                assert other_state == desired, (
+                    f"{name} asked while {other} still {other_state}"
+                )
+            order.append(name)
+            fake_kube.set_node_label(name, CC_MODE_STATE_LABEL, desired)
+
+    fake_kube.add_patch_reactor(tracking_reactor)
+    result = make_roller(fake_kube, max_unavailable=1).rollout("on")
+    assert result.ok and len(order) == 3
+
+
+def test_rollout_halts_on_failure(fake_kube):
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube, fail_nodes={"node-1"})
+    result = make_roller(fake_kube).rollout("on")
+    assert result.ok is False
+    # node-2 was never asked (halt before its group).
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("node-2"))
+
+
+def test_rollout_continue_on_failure(fake_kube):
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube, fail_nodes={"node-1"})
+    result = make_roller(fake_kube, continue_on_failure=True).rollout("on")
+    assert result.ok is False
+    assert len(result.groups) == 3
+    assert node_labels(fake_kube.get_node("node-2"))[CC_MODE_STATE_LABEL] == "on"
+
+
+def test_multihost_slice_bounced_together(fake_kube):
+    """Both hosts of a slice get their label in the same window."""
+    add_pool(fake_kube, 4, slice_map={0: "s1", 1: "s1", 2: "s2", 3: "s2"})
+    agent_simulator(fake_kube)
+    result = make_roller(fake_kube).rollout("slice")
+    assert result.ok
+    assert [g.group for g in result.groups] == ["s1", "s2"]
+    assert result.groups[0].nodes == ("node-0", "node-1")
+
+
+def test_window_fully_awaited_on_failure(fake_kube):
+    """With max_unavailable=2 and one group failing, the other group in the
+    same window already got its label and must still be awaited/reported."""
+    add_pool(fake_kube, 2)
+    agent_simulator(fake_kube, fail_nodes={"node-0"})
+    result = make_roller(fake_kube, max_unavailable=2).rollout("on")
+    assert result.ok is False
+    assert len(result.groups) == 2  # both window members reported
+    states = {g.nodes[0]: g.states[g.nodes[0]] for g in result.groups}
+    assert states["node-0"] == STATE_FAILED
+    assert states["node-1"] == "on"
+
+
+def test_wall_time_uses_windows_not_group_sums(fake_kube):
+    add_pool(fake_kube, 4)
+    agent_simulator(fake_kube)
+    result = make_roller(fake_kube, max_unavailable=2).rollout("on")
+    assert result.ok
+    assert len(result.window_seconds) == 2  # 4 groups / window of 2
+    # Total is the window sum, strictly less than the overlapping group sum.
+    assert result.seconds <= sum(g.seconds for g in result.groups) + 1e-6
+
+
+def test_rollout_timeout_reported(fake_kube):
+    add_pool(fake_kube, 1)  # no agent simulator: nothing converges
+    result = make_roller(fake_kube, node_timeout_s=0.1).rollout("on")
+    assert result.ok is False
+    assert result.groups[0].states["node-0"] == "timeout"

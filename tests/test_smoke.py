@@ -20,6 +20,26 @@ def test_matmul_uses_all_virtual_devices():
     assert result["devices"] == len(jax.devices())
 
 
+def test_matmul_pallas_kernel_mode():
+    result = runner.run_workload("matmul", size=256, iters=1, kernel="pallas")
+    assert result["ok"] is True
+    assert result["kernel"] == "pallas"
+    assert result["devices"] == 1
+
+
+def test_llama_smoke_passes():
+    result = runner.run_workload("llama", batch=2, prompt_len=8, decode_len=4)
+    assert result["ok"] is True
+    assert result["oracle_ok"] is True
+    assert result["tokens_per_sec"] > 0
+
+
+def test_resnet_smoke_passes():
+    result = runner.run_workload("resnet", steps=3)
+    assert result["ok"] is True
+    assert result["loss_last"] < result["loss_first"]
+
+
 def test_unknown_workload_rejected():
     with pytest.raises(runner.SmokeError):
         runner.run_workload("does-not-exist")

@@ -50,6 +50,15 @@ from tpu_cc_manager.utils import metrics as metrics_mod
 
 log = logging.getLogger(__name__)
 
+
+def _label_safe(value: str, max_len: int = 63) -> str:
+    """Coerce a string into a valid k8s label value (alnum/-/_/. and 63
+    chars; must start and end alphanumeric)."""
+    cleaned = "".join(c if (c.isalnum() or c in "-_.") else "-" for c in value)
+    cleaned = cleaned[:max_len].strip("-_.")
+    return cleaned or "unknown"
+
+
 DEFAULT_READINESS_FILE = "/run/tpu/validations/.tpu-cc-manager-ctr-ready"
 # Reference operational constants (SURVEY.md §6).
 WATCH_TIMEOUT_S = 300
@@ -180,9 +189,33 @@ class CCManager:
             return True
 
         if self._mode_is_set(chips, mode):
-            log.info("CC mode %s already set on all %d chip(s)", mode, len(chips))
-            state.set_cc_state_label(self.api, self.node_name, mode)
-            return True
+            # Idempotent path (reference main.py:255-258) — but a restarted
+            # agent must still re-attest and re-publish coordination labels:
+            # slice grouping and pool attestation read them, and quotes age
+            # out. A failed re-attestation falls through to the full apply.
+            quote = None
+            if mode != MODE_OFF:
+                try:
+                    nonce = attestation.fresh_nonce()
+                    quote = self.backend.fetch_attestation(nonce)
+                    attestation.verify_quote(
+                        quote,
+                        nonce,
+                        expected_mode=mode,
+                        expected_slice_id=topo.slice_id,
+                        debug_policy=(mode == MODE_DEVTOOLS),
+                    )
+                except TpuError as e:
+                    log.warning(
+                        "mode %s reads as set but re-attestation failed (%s); "
+                        "running the full apply", mode, e,
+                    )
+                    quote = None
+            if mode == MODE_OFF or quote is not None:
+                log.info("CC mode %s already set on all %d chip(s)", mode, len(chips))
+                state.set_cc_state_label(self.api, self.node_name, mode)
+                self._publish_coordination_labels(topo, quote)
+                return True
 
         m = self.metrics.start(mode)
         try:
@@ -294,6 +327,7 @@ class CCManager:
                         f"wanted {mode}, device reports {got}"
                     )
             # Verify 2: attestation (new; skipped for plain 'off').
+            quote = None
             if mode != MODE_OFF:
                 with m.phase(metrics_mod.PHASE_ATTEST):
                     nonce = attestation.fresh_nonce()
@@ -317,9 +351,39 @@ class CCManager:
             m.result = "failed"
             return False
         state.set_cc_state_label(self.api, self.node_name, mode)
+        self._publish_coordination_labels(topo, quote)
         m.result = "ok"
         log.info("CC mode %s applied and verified on %d chip(s)", mode, len(chips))
         return True
+
+    def _publish_coordination_labels(self, topo: SliceTopology, quote) -> None:
+        """Advertise slice membership + attestation digest on the node so the
+        rolling orchestrator can group hosts by slice and the multi-slice
+        verifier can compare runtime digests (ccmanager/rolling.py,
+        ccmanager/multislice.py). Best-effort: coordination metadata must
+        never fail a reconcile."""
+        try:
+            from tpu_cc_manager.ccmanager import multislice
+            from tpu_cc_manager.ccmanager.rolling import SLICE_ID_LABEL
+
+            slice_label = _label_safe(topo.slice_id)
+            self.api.patch_node_labels(self.node_name, {SLICE_ID_LABEL: slice_label})
+            if quote is not None:
+                multislice.publish_quote(self.api, self.node_name, quote)
+            else:
+                # No quote this reconcile (mode off): clear any stale
+                # attestation labels so pool verification can't read
+                # evidence from a previous mode.
+                self.api.patch_node_labels(
+                    self.node_name,
+                    {
+                        f"{multislice.QUOTE_ANNOTATION}.digest": None,
+                        f"{multislice.QUOTE_ANNOTATION}.mode": None,
+                        f"{multislice.QUOTE_ANNOTATION}.ts": None,
+                    },
+                )
+        except Exception as e:  # noqa: BLE001 - advisory metadata only
+            log.warning("could not publish coordination labels: %s", e)
 
     def _run_smoke(self, workload: str) -> dict:
         if self.smoke_runner is not None:

@@ -1,0 +1,165 @@
+"""Cross-slice attestation for multi-slice data parallelism over DCN.
+
+BASELINE.json configs[4] ("2×v5p-64: CC attestation + Llama-3-8B DP over
+DCN"); SURVEY.md §7.9 hard part #3: "cross-slice attestation + re-forming
+the DCN mesh after a slice bounces". No reference counterpart.
+
+Protocol (control-plane side — the label/annotation transport mirrors how
+the reference carries all its state on node objects):
+
+1. After a slice's CC transition verifies locally, its node agent publishes
+   the quote *digest* and mode as node annotations (``publish_quote``) —
+   digests, not quotes: annotations are world-readable, and the digest is
+   all a peer needs for the equality check.
+2. Before a training job re-forms its DCN mesh, it (or the rolling
+   orchestrator) calls ``verify_pool_attestation``: every slice in the pool
+   must report (a) the expected mode, (b) a fresh-enough quote, and (c) the
+   SAME runtime digest — heterogeneous digests mean some slice runs a
+   different (possibly unmeasured) runtime and must not join the mesh.
+3. The data-plane side then runs
+   :func:`tpu_cc_manager.parallel.distributed.verify_dcn_mesh` for the
+   collective-path health check before the first real step.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+from tpu_cc_manager.kubeclient.api import KubeApi, node_labels
+from tpu_cc_manager.tpudev.attestation import quote_digest
+from tpu_cc_manager.tpudev.contract import AttestationQuote
+
+log = logging.getLogger(__name__)
+
+from tpu_cc_manager.labels import SLICE_ID_LABEL  # noqa: E402 - shared constant
+
+QUOTE_ANNOTATION = "cloud.google.com/tpu-cc.attestation"
+
+
+class PoolAttestationError(Exception):
+    """The pool's slices do not present coherent attestation evidence."""
+
+
+def publish_quote(api: KubeApi, node_name: str, quote: AttestationQuote) -> dict:
+    """Publish a quote's digest+mode on the node as an annotation payload.
+
+    Node annotations travel in metadata like labels, so the same
+    merge-patch endpoint carries them (the in-tree kubeclient patches
+    metadata.labels; annotations piggyback on a dedicated label-safe
+    JSON value here to keep the client surface minimal)."""
+    payload = {
+        "slice": quote.slice_id,
+        "mode": quote.mode,
+        "digest": quote_digest(quote),
+        "ts": int(time.time()),
+    }
+    # Label values are constrained (63 chars, alphanum/-/_/.); pack the
+    # payload into multiple labels instead of one JSON blob.
+    api.patch_node_labels(
+        node_name,
+        {
+            f"{QUOTE_ANNOTATION}.digest": payload["digest"],
+            f"{QUOTE_ANNOTATION}.mode": payload["mode"],
+            f"{QUOTE_ANNOTATION}.ts": str(payload["ts"]),
+        },
+    )
+    log.info("published attestation for %s: %s", node_name, payload)
+    return payload
+
+
+def collect_pool_quotes(api: KubeApi, selector: str) -> dict[str, dict]:
+    """slice_id -> {digest, mode, ts, nodes, missing} across matching nodes.
+
+    Every host of a slice must attest, so hosts carrying the slice label but
+    no quote are recorded in ``missing`` (not silently skipped), modes must
+    agree across hosts (else ``mode`` becomes "MIXED"), and ``ts`` is the
+    OLDEST host's timestamp so staleness checks see the worst host."""
+    slices: dict[str, dict] = {}
+    for node in api.list_nodes(selector):
+        labels = node_labels(node)
+        name = node["metadata"]["name"]
+        digest = labels.get(f"{QUOTE_ANNOTATION}.digest")
+        slice_id = labels.get(SLICE_ID_LABEL) or f"node/{name}"
+        entry = slices.setdefault(
+            slice_id,
+            {"digest": None, "mode": None, "ts": None, "nodes": [], "missing": []},
+        )
+        if digest is None:
+            entry["missing"].append(name)
+            continue
+        mode = labels.get(f"{QUOTE_ANNOTATION}.mode", "")
+        ts = int(labels.get(f"{QUOTE_ANNOTATION}.ts", "0") or 0)
+        entry["nodes"].append(name)
+        entry["digest"] = digest if entry["digest"] in (None, digest) else "MIXED"
+        entry["mode"] = mode if entry["mode"] in (None, mode) else "MIXED"
+        entry["ts"] = ts if entry["ts"] is None else min(entry["ts"], ts)
+    # Slices where no host attested at all keep digest None.
+    return slices
+
+
+def verify_pool_attestation(
+    api: KubeApi,
+    selector: str,
+    expected_mode: str,
+    expected_slices: int | None = None,
+    max_age_s: float | None = 3600.0,
+) -> dict[str, dict]:
+    """Check every slice attests the expected mode with one common digest.
+
+    Returns the slice map on success; raises PoolAttestationError with the
+    full discrepancy list otherwise."""
+    slices = collect_pool_quotes(api, selector)
+    problems: list[str] = []
+    if not any(e["nodes"] for e in slices.values()):
+        problems.append("no slice published any attestation")
+    if expected_slices is not None and len(slices) != expected_slices:
+        problems.append(f"expected {expected_slices} slices, found {len(slices)}")
+    now = time.time()
+    digests = set()
+    for sid, entry in sorted(slices.items()):
+        if entry["missing"]:
+            problems.append(
+                f"slice {sid}: host(s) without attestation: "
+                f"{sorted(entry['missing'])}"
+            )
+        if entry["digest"] is None:
+            continue  # covered by the missing-hosts problem above
+        if entry["digest"] == "MIXED":
+            problems.append(f"slice {sid}: hosts disagree on runtime digest")
+        else:
+            digests.add(entry["digest"])
+        if entry["mode"] == "MIXED":
+            problems.append(f"slice {sid}: hosts disagree on attested mode")
+        elif entry["mode"] != expected_mode:
+            problems.append(
+                f"slice {sid}: mode {entry['mode']!r} != expected {expected_mode!r}"
+            )
+        if max_age_s is not None and now - entry["ts"] > max_age_s:
+            problems.append(f"slice {sid}: quote is stale ({int(now - entry['ts'])}s)")
+    if len(digests) > 1:
+        problems.append(
+            f"slices report {len(digests)} distinct runtime digests: "
+            f"{sorted(digests)}"
+        )
+    if problems:
+        raise PoolAttestationError("; ".join(problems))
+    log.info(
+        "pool attestation verified: %d slice(s), digest=%s, mode=%s",
+        len(slices), next(iter(digests)), expected_mode,
+    )
+    return slices
+
+
+def pool_report(api: KubeApi, selector: str) -> str:
+    """Human-readable attestation table (CLI helper)."""
+    slices = collect_pool_quotes(api, selector)
+    lines = [f"{'SLICE':<28} {'MODE':<10} {'DIGEST':<18} {'ATTESTED':<9} MISSING"]
+    for sid, e in sorted(slices.items()):
+        lines.append(
+            f"{sid:<28} {str(e['mode'] or '-'):<10} "
+            f"{str(e['digest'] or '-'):<18} {len(e['nodes']):<9} "
+            f"{len(e['missing'])}"
+        )
+    return "\n".join(lines)

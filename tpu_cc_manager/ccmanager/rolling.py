@@ -1,0 +1,187 @@
+"""Rolling CC reconfiguration across a node pool.
+
+New logic with no reference counterpart (SURVEY.md §7.8: "the reference is
+purely per-node independent; rolling coordination across a pool is new").
+Drives BASELINE.json configs[3] — flip a whole v5p-32 pool to CC-on under a
+live training job — by setting each node's desired-mode label and waiting
+for the per-node agents (the DaemonSet) to converge, with:
+
+- **slice grouping**: multi-host slices are bounced as one unit, because a
+  TPU slice is unusable while *any* of its hosts is down (SURVEY.md §7
+  hard part (a)) — bouncing its hosts one at a time would just multiply the
+  disruption window;
+- **bounded concurrency**: at most ``max_unavailable`` groups in flight
+  (PodDisruptionBudget-style, default 1 — strictly rolling);
+- **failure policy**: a node converging to ``failed`` halts the rollout by
+  default (``continue_on_failure`` to override);
+- per-group latency records for the <90 s/node north-star accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+from tpu_cc_manager.kubeclient.api import KubeApi, node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    STATE_FAILED,
+    canonical_mode,
+)
+
+from tpu_cc_manager.labels import SLICE_ID_LABEL  # noqa: F401 - re-export
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GroupResult:
+    group: str
+    nodes: tuple[str, ...]
+    ok: bool
+    seconds: float
+    states: dict[str, str]
+
+
+@dataclasses.dataclass
+class RolloutResult:
+    mode: str
+    ok: bool
+    groups: list[GroupResult]
+    # Wall-clock per concurrency window (groups inside a window run in
+    # parallel, so their per-group durations overlap; only window times sum
+    # to the rollout's wall time).
+    window_seconds: list[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def seconds(self) -> float:
+        return sum(self.window_seconds)
+
+    def summary(self) -> dict:
+        converged = [g for g in self.groups if g.ok]
+        converged_nodes = sum(len(g.nodes) for g in converged)
+        return {
+            "mode": self.mode,
+            "ok": self.ok,
+            "groups": len(self.groups),
+            "nodes": sum(len(g.nodes) for g in self.groups),
+            "total_seconds": round(self.seconds, 2),
+            "max_group_seconds": round(
+                max((g.seconds for g in self.groups), default=0.0), 2
+            ),
+            "mean_seconds_per_node": round(
+                self.seconds / converged_nodes, 2
+            ) if converged_nodes and self.ok else None,
+        }
+
+
+def plan_groups(api: KubeApi, selector: str) -> list[tuple[str, tuple[str, ...]]]:
+    """Group matching nodes by slice id; single-host nodes group alone.
+
+    Groups are ordered by name for deterministic rollouts.
+    """
+    nodes = api.list_nodes(selector)
+    groups: dict[str, list[str]] = {}
+    for node in nodes:
+        name = node["metadata"]["name"]
+        slice_id = node_labels(node).get(SLICE_ID_LABEL) or f"node/{name}"
+        groups.setdefault(slice_id, []).append(name)
+    return [(gid, tuple(sorted(names))) for gid, names in sorted(groups.items())]
+
+
+class RollingReconfigurator:
+    def __init__(
+        self,
+        api: KubeApi,
+        selector: str,
+        max_unavailable: int = 1,
+        node_timeout_s: float = 600.0,
+        poll_interval_s: float = 2.0,
+        continue_on_failure: bool = False,
+    ) -> None:
+        self.api = api
+        self.selector = selector
+        self.max_unavailable = max(1, max_unavailable)
+        self.node_timeout_s = node_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.continue_on_failure = continue_on_failure
+
+    def rollout(self, mode: str) -> RolloutResult:
+        mode = canonical_mode(mode)
+        groups = plan_groups(self.api, self.selector)
+        log.info(
+            "rolling %s over %d group(s) (%d node(s)), max_unavailable=%d",
+            mode, len(groups),
+            sum(len(n) for _, n in groups), self.max_unavailable,
+        )
+        results: list[GroupResult] = []
+        window_seconds: list[float] = []
+        ok = True
+        # Strictly bounded concurrency: process in windows of max_unavailable.
+        for i in range(0, len(groups), self.max_unavailable):
+            window = groups[i : i + self.max_unavailable]
+            started = time.monotonic()
+            for gid, names in window:
+                self._set_desired(names, mode)
+            # Always await the FULL window even after a failure: every group
+            # in it already received its desired label and is transitioning —
+            # halting without awaiting would report in-flight slices as
+            # untouched.
+            window_failed = []
+            for gid, names in window:
+                gres = self._await_group(gid, names, mode, started)
+                results.append(gres)
+                if not gres.ok:
+                    ok = False
+                    window_failed.append(gid)
+            window_seconds.append(time.monotonic() - started)
+            if window_failed and not self.continue_on_failure:
+                log.error(
+                    "group(s) %s failed; halting rollout (%d group(s) not "
+                    "attempted)", window_failed, len(groups) - i - len(window),
+                )
+                return RolloutResult(
+                    mode=mode, ok=False, groups=results,
+                    window_seconds=window_seconds,
+                )
+        return RolloutResult(
+            mode=mode, ok=ok, groups=results, window_seconds=window_seconds
+        )
+
+    # -- internals --------------------------------------------------------
+
+    def _set_desired(self, names: tuple[str, ...], mode: str) -> None:
+        for name in names:
+            log.info("setting %s=%s on %s", CC_MODE_LABEL, mode, name)
+            self.api.patch_node_labels(name, {CC_MODE_LABEL: mode})
+
+    def _await_group(
+        self, gid: str, names: tuple[str, ...], mode: str, started: float
+    ) -> GroupResult:
+        deadline = started + self.node_timeout_s
+        pending = set(names)
+        states: dict[str, str] = {}
+        while pending and time.monotonic() < deadline:
+            for name in sorted(pending):
+                state = node_labels(self.api.get_node(name)).get(CC_MODE_STATE_LABEL)
+                if state == mode:
+                    states[name] = state
+                    pending.discard(name)
+                elif state == STATE_FAILED:
+                    states[name] = state
+                    pending.discard(name)
+            if pending:
+                time.sleep(self.poll_interval_s)
+        for name in pending:  # timed out
+            states[name] = "timeout"
+        seconds = time.monotonic() - started
+        ok = all(s == mode for s in states.values())
+        (log.info if ok else log.error)(
+            "group %s -> %s in %.1fs (states=%s)", gid,
+            "converged" if ok else "FAILED", seconds, states,
+        )
+        return GroupResult(
+            group=gid, nodes=names, ok=ok, seconds=seconds, states=states
+        )

@@ -1,0 +1,130 @@
+"""tpu-cc-ctl: operator CLI for pool-level operations.
+
+The reference has no pool tooling (its only entry point is the per-node
+agent); this CLI drives the new coordination layers:
+
+- ``rollout``  rolling CC reconfiguration across a pool
+  (ccmanager/rolling.py; BASELINE.json configs[3]),
+- ``attest``   cross-slice attestation verification
+  (ccmanager/multislice.py; configs[4]),
+- ``status``   one-line-per-node view of desired/actual/ready labels.
+
+Usage: ``python -m tpu_cc_manager.ctl <command> ...`` or the
+``tpu-cc-ctl`` console script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from tpu_cc_manager.ccmanager.multislice import (
+    PoolAttestationError,
+    pool_report,
+    verify_pool_attestation,
+)
+from tpu_cc_manager.ccmanager.rolling import SLICE_ID_LABEL, RollingReconfigurator
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    CC_READY_STATE_LABEL,
+    VALID_MODES,
+)
+from tpu_cc_manager.utils.logging import setup_logging
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu-cc-ctl")
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("-d", "--debug", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    r = sub.add_parser("rollout", help="rolling CC reconfiguration over a pool")
+    r.add_argument("--selector", required=True, help="node label selector, e.g. pool=tpu")
+    r.add_argument("--mode", required=True, help=f"target mode: {VALID_MODES}")
+    r.add_argument("--max-unavailable", type=int, default=1)
+    r.add_argument("--node-timeout", type=float, default=600.0)
+    r.add_argument("--continue-on-failure", action="store_true")
+
+    a = sub.add_parser("attest", help="verify cross-slice attestation coherence")
+    a.add_argument("--selector", required=True)
+    a.add_argument("--mode", required=True)
+    a.add_argument("--slices", type=int, default=None, help="expected slice count")
+    a.add_argument("--max-age", type=float, default=3600.0)
+
+    s = sub.add_parser("status", help="per-node CC state table")
+    s.add_argument("--selector", required=True)
+    return p
+
+
+def cmd_rollout(api, args) -> int:
+    roller = RollingReconfigurator(
+        api,
+        args.selector,
+        max_unavailable=args.max_unavailable,
+        node_timeout_s=args.node_timeout,
+        continue_on_failure=args.continue_on_failure,
+    )
+    result = roller.rollout(args.mode)
+    print(json.dumps(result.summary()))
+    return 0 if result.ok else 1
+
+
+def cmd_attest(api, args) -> int:
+    print(pool_report(api, args.selector))
+    try:
+        verify_pool_attestation(
+            api, args.selector, args.mode,
+            expected_slices=args.slices, max_age_s=args.max_age,
+        )
+    except PoolAttestationError as e:
+        print(f"FAIL: {e}")
+        return 1
+    print("OK: pool attestation coherent")
+    return 0
+
+
+def cmd_status(api, args) -> int:
+    rows = [
+        f"{'NODE':<24} {'SLICE':<20} {'DESIRED':<10} {'STATE':<10} READY"
+    ]
+    for node in api.list_nodes(args.selector):
+        labels = node_labels(node)
+        rows.append(
+            f"{node['metadata']['name']:<24} "
+            f"{labels.get(SLICE_ID_LABEL, '-'):<20} "
+            f"{labels.get(CC_MODE_LABEL, '-'):<10} "
+            f"{labels.get(CC_MODE_STATE_LABEL, '-'):<10} "
+            f"{labels.get(CC_READY_STATE_LABEL, '-')}"
+        )
+    print("\n".join(rows))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(debug=args.debug)
+    try:
+        api = RestKube(ClusterConfig.load(args.kubeconfig))
+    except Exception as e:  # noqa: BLE001 - any config failure is fatal here
+        log.error("could not configure kubernetes client: %s", e)
+        return 1
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    try:
+        return {"rollout": cmd_rollout, "attest": cmd_attest, "status": cmd_status}[
+            args.command
+        ](api, args)
+    except KubeApiError as e:
+        log.error("apiserver error: %s", e)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,11 @@ DRAIN_COMPONENT_LABELS = {
     "google.com/tpu.deploy.workload-validator": "tpu-workload-validator",
 }
 
+# Slice membership, published by the agent after a successful reconcile;
+# nodes of one multi-host ICI slice carry the same value. Consumed by the
+# rolling orchestrator (group-by-slice) and multi-slice attestation.
+SLICE_ID_LABEL = "cloud.google.com/tpu-slice-id"
+
 # Pause protocol (reference gpu_operator_eviction.py:43-95):
 #   'true'        -> PAUSED_VALUE
 #   custom 'v'    -> 'v' + PAUSED_SUFFIX

@@ -1,0 +1,13 @@
+"""Flax model definitions for the smoke workloads.
+
+No reference counterpart (the reference has no models at all, SURVEY.md §2);
+these exist to satisfy BASELINE.json's validation ladder: Llama-2-7B /
+Llama-3-8B inference (configs[2], [4]) and ResNet-50 training (configs[3]).
+Written TPU-first: bf16 compute with f32 accumulation, static shapes,
+`lax.scan` over layers, shard-annotated parameters.
+"""
+
+from tpu_cc_manager.models.llama import LlamaConfig, LlamaModel
+from tpu_cc_manager.models.resnet import ResNet50
+
+__all__ = ["LlamaConfig", "LlamaModel", "ResNet50"]

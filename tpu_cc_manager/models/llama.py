@@ -1,0 +1,299 @@
+"""Llama-family decoder, written TPU-first in flax.linen.
+
+Used by the inference smoke workload (BASELINE.json configs[2]: Llama-2-7B
+on v5p-8; configs[4]: Llama-3-8B DP over DCN). Design notes for the MXU/XLA:
+
+- bf16 activations, f32 parameters and f32 for RoPE phases, softmax and
+  logits — the standard TPU numerics recipe;
+- one ``nn.scan`` over identical decoder blocks: one compile of one block
+  regardless of depth, layer-stacked parameters (leading 'layers' axis), and
+  the natural place to hang ``nn.remat`` for HBM-bound training;
+- grouped-query attention (Llama-2-70B / Llama-3 style) expressed as einsum
+  over a (kv_head, group) split so XLA keeps a single large contraction;
+- static-shape KV cache for decode: fixed (max_len) buffers updated with
+  ``lax.dynamic_update_slice_in_dim`` and masked by position — no dynamic
+  shapes, so the decode step compiles exactly once;
+- named sharding axes ('embed', 'heads', 'kv_heads', 'mlp', 'vocab',
+  'layers') via ``nn.with_logical_partitioning``, mapped onto mesh axes by
+  parallel/sharding.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    # Use the pallas flash-attention kernel (ops/flash_attention.py) on the
+    # no-cache (training/prefill) path; the cached decode path always uses
+    # the einsum attention (its working set is already small).
+    use_flash: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # ---- standard family members --------------------------------------
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(**{**dict(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+                             n_kv_heads=32, hidden_dim=11008, max_seq_len=4096), **kw})
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**{**dict(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                             n_kv_heads=8, hidden_dim=14336, max_seq_len=8192,
+                             rope_theta=500000.0), **kw})
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """CI/test config: ~1M params, same code paths."""
+        return cls(**{**dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                             n_kv_heads=2, hidden_dim=128, max_seq_len=128), **kw})
+
+    @classmethod
+    def smoke_500m(cls, **kw) -> "LlamaConfig":
+        """Single-chip smoke config (~400M params): big enough to exercise
+        the MXU seriously, small enough to init fast on any chip."""
+        return cls(**{**dict(vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+                             n_kv_heads=8, hidden_dim=4096, max_seq_len=2048), **kw})
+
+    def param_count(self) -> int:
+        head = self.head_dim
+        attn = self.dim * (self.n_heads * head) * 2 + self.dim * (
+            self.n_kv_heads * head
+        ) * 2
+        mlp = 3 * self.dim * self.hidden_dim
+        per_layer = attn + mlp + 2 * self.dim
+        return self.vocab_size * self.dim * 2 + per_layer * self.n_layers + self.dim
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        normed = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jnp.ndarray:
+    """(max_len, head_dim//2) rotation phases, f32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(max_len, dtype=jnp.float32)
+    return jnp.outer(pos, inv_freq)
+
+
+def apply_rope(x: jnp.ndarray, phases: jnp.ndarray) -> jnp.ndarray:
+    """x: (B, S, H, D); phases: (S, D/2). Rotation in f32, cast back."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    cos = jnp.cos(phases)[None, :, None, :]
+    sin = jnp.sin(phases)[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _dense(features: int, axes: tuple[str, str], dtype, name: str):
+    return nn.Dense(
+        features,
+        use_bias=False,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), axes
+        ),
+        name=name,
+    )
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, phases, mask, layer_cache=None, position=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, KV, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+        q = _dense(H * D, ("embed", "heads"), cfg.dtype, "wq")(x).reshape(B, S, H, D)
+        k = _dense(KV * D, ("embed", "kv_heads"), cfg.dtype, "wk")(x).reshape(B, S, KV, D)
+        v = _dense(KV * D, ("embed", "kv_heads"), cfg.dtype, "wv")(x).reshape(B, S, KV, D)
+
+        q = apply_rope(q, phases)
+        k = apply_rope(k, phases)
+
+        if layer_cache is not None:
+            # Static-shape decode: write this step's K/V at `position` into
+            # the (B, max_len, KV, D) buffers, then attend over the buffers.
+            k_buf, v_buf = layer_cache
+            k_buf = lax.dynamic_update_slice_in_dim(
+                k_buf, k.astype(k_buf.dtype), position, axis=1
+            )
+            v_buf = lax.dynamic_update_slice_in_dim(
+                v_buf, v.astype(v_buf.dtype), position, axis=1
+            )
+            k, v = k_buf, v_buf
+            layer_cache = (k_buf, v_buf)
+
+        if layer_cache is None and cfg.use_flash:
+            from tpu_cc_manager.ops.flash_attention import flash_attention
+
+            # Kernel layout is (B, H, S, D); GQA via kv-head repetition.
+            qf = q.transpose(0, 2, 1, 3)
+            kf = jnp.repeat(k, H // KV, axis=2).transpose(0, 2, 1, 3)
+            vf = jnp.repeat(v, H // KV, axis=2).transpose(0, 2, 1, 3)
+            out = flash_attention(qf, kf, vf).transpose(0, 2, 1, 3)
+            out = out.reshape(B, S, H * D).astype(cfg.dtype)
+            return _dense(cfg.dim, ("heads", "embed"), cfg.dtype, "wo")(out), None
+
+        # GQA: fold heads into (kv groups, group size) so the contraction
+        # stays one big einsum on the MXU.
+        G = H // KV
+        qg = q.reshape(B, S, KV, G, D)
+        scores = jnp.einsum(
+            "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.float32(D))
+        scores = scores + mask  # additive causal mask, broadcast to (B,KV,G,S,T)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+        out = out.reshape(B, S, H * D)
+        return _dense(cfg.dim, ("heads", "embed"), cfg.dtype, "wo")(out), layer_cache
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = _dense(cfg.hidden_dim, ("embed", "mlp"), cfg.dtype, "w_gate")(x)
+        up = _dense(cfg.hidden_dim, ("embed", "mlp"), cfg.dtype, "w_up")(x)
+        return _dense(cfg.dim, ("mlp", "embed"), cfg.dtype, "w_down")(
+            nn.silu(gate) * up
+        )
+
+
+class DecoderBlock(nn.Module):
+    """Scanned unit: carry is (activations, phases, mask, position) —
+    invariant in shape; per-layer KV cache rides the scan's xs/ys."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, carry, layer_cache):
+        x, phases, mask, position = carry
+        h, layer_cache = Attention(self.cfg, name="attn")(
+            RMSNorm(self.cfg.norm_eps, self.cfg.dtype, name="attn_norm")(x),
+            phases, mask, layer_cache, position,
+        )
+        x = x + h
+        x = x + MLP(self.cfg, name="mlp")(
+            RMSNorm(self.cfg.norm_eps, self.cfg.dtype, name="mlp_norm")(x)
+        )
+        return (x, phases, mask, position), layer_cache
+
+
+class LlamaModel(nn.Module):
+    """Decoder-only transformer; __call__ covers both training (full
+    sequence, cache=None) and decode (S=1 with a KV cache)."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, cache=None, position=None):
+        cfg = self.cfg
+        B, S = tokens.shape
+        embed = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.dim),
+            jnp.float32,
+        )
+        x = embed[tokens].astype(cfg.dtype)
+
+        all_phases = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        if cache is not None:
+            T = cache[0].shape[2]  # cache: (k, v) each (L, B, T, KV, D)
+            phases = lax.dynamic_slice_in_dim(all_phases, position, S, axis=0)
+            t = jnp.arange(T)
+            # Causal over absolute positions: query i (at position+i) sees
+            # cache slots <= position+i. Covers decode (S=1) and multi-token
+            # prefill with one formula.
+            q_pos = position + jnp.arange(S)
+            mask = jnp.where(
+                t[None, None, None, None, :] <= q_pos[None, None, None, :, None],
+                0.0,
+                -jnp.inf,
+            )
+        else:
+            phases = all_phases[:S]
+            t = jnp.arange(S)
+            mask = jnp.where(t[None, :] <= t[:, None], 0.0, -jnp.inf)[
+                None, None, None, :, :
+            ]
+
+        block_cls = DecoderBlock
+        if cfg.remat:
+            block_cls = nn.remat(DecoderBlock, prevent_cse=False)
+        scan_block = nn.scan(
+            block_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            in_axes=0,
+            out_axes=0,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        carry = (x, phases, mask, position)
+        xs = None if cache is None else cache
+        (x, _, _, _), new_cache = scan_block(cfg, name="blocks")(carry, xs)
+
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        lm_head = self.param(
+            "lm_head",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "vocab")
+            ),
+            (cfg.dim, cfg.vocab_size),
+            jnp.float32,
+        )
+        logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), lm_head)
+        return logits, new_cache
+
+    # ---- cache helpers ----------------------------------------------------
+
+    def init_cache(self, batch: int, max_len: int | None = None):
+        cfg = self.cfg
+        max_len = max_len or cfg.max_seq_len
+        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))

@@ -1,0 +1,90 @@
+"""ResNet-50 in flax.linen, TPU-first.
+
+Used by the training smoke workload (BASELINE.json configs[3]: rolling CC
+reconfig under live ResNet-50 training on v5p-32). Conventions:
+
+- NHWC layout (XLA:TPU's native conv layout — channels-last feeds the MXU
+  as a matmul over (spatial, C_in) x (C_in, C_out));
+- bf16 activations, f32 parameters and f32 batch-norm statistics;
+- BatchNorm in inference or train mode via the ``train`` flag, with batch
+  stats carried in the standard flax 'batch_stats' collection.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                name="proj",
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+                    name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=self.dtype, param_dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    filters=64 * 2**stage,
+                    strides=strides,
+                    dtype=self.dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+                     name="classifier")(x)
+        return x
+
+
+def ResNet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def ResNetTiny(num_classes: int = 10, dtype: Any = jnp.bfloat16) -> ResNet:
+    """CI/test config: same code paths, 2 stages of 1 block."""
+    return ResNet(stage_sizes=(1, 1), num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,20 @@
+"""Pallas TPU kernels and sequence-parallel primitives for the smoke models.
+
+No reference counterpart (the reference has no compute path at all,
+SURVEY.md §2); these exist so the validation workloads exercise the same
+hot ops a production TPU serving/training stack would:
+
+- :mod:`flash_attention` — fused online-softmax attention (pallas, MXU),
+- :mod:`matmul` — tiled f32-accumulating bf16 matmul (pallas),
+- :mod:`ring_attention` — ring/sequence parallelism over an ICI mesh axis
+  via shard_map + ppermute (the long-context path).
+
+Kernels compile on TPU; on CPU (tests, dry-runs) they run in pallas
+interpreter mode, selected automatically.
+"""
+
+from tpu_cc_manager.ops.flash_attention import flash_attention
+from tpu_cc_manager.ops.matmul import tiled_matmul
+from tpu_cc_manager.ops.ring_attention import ring_attention
+
+__all__ = ["flash_attention", "tiled_matmul", "ring_attention"]

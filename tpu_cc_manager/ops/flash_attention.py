@@ -1,0 +1,142 @@
+"""Flash attention (fused online-softmax) as a pallas TPU kernel.
+
+Forward pass never materializes the (S, S) score matrix: the grid walks
+query blocks, and an inner fori_loop streams key/value blocks through VMEM
+maintaining the running max / normalizer / accumulator (the
+Dao et al. online-softmax recurrence). Backward recomputes attention from
+the saved inputs with the plain-XLA reference implementation — flash's
+standard memory/FLOPs trade, and exact to f32 accumulation either way.
+
+Layout: (B, H, S, D) with D the head dim (<=128: one MXU lane tile).
+Causal only (that is what the smoke models need). On CPU the kernel runs in
+pallas interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Plain-XLA attention, the numerics oracle and the backward path."""
+    _, _, S, D = q.shape
+    scores = jnp.einsum(
+        "bhsd,bhtd->bhst", q, k, preferred_element_type=jnp.float32
+    ) / (D**0.5)
+    if causal:
+        t = jnp.arange(S)
+        mask = t[None, :] <= t[:, None]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                seq_len: int, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        # Skip key blocks strictly after this query block's last position
+        # (valid for any block_q/block_k ratio).
+        last_q_pos = (qi + 1) * block_q - 1
+        k_hi = jnp.minimum(last_q_pos // block_k + 1, num_k_blocks)
+    else:
+        k_hi = num_k_blocks
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, k_hi, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    B, H, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    grid = (B * H, pl.cdiv(S, block_q))
+
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_q=block_q, block_k=block_k,
+            seq_len=S, causal=causal,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * S * S * D,
+            bytes_accessed=(3 * B * H * S * D + B * H * S * D) * q.dtype.itemsize,
+            transcendentals=B * H * S * S,
+        ),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, S, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128):
+    """Fused causal attention. q/k/v: (B, H, S, D); returns (B, H, S, D)."""
+    interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd_rule(q, k, v, causal, block_q, block_k):
+    out = flash_attention(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd_rule(causal, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,75 @@
+"""Tiled bf16 matmul with f32 VMEM accumulation, as a pallas kernel.
+
+The MXU-canonical pattern: 3-D grid over (M, N, K) tiles, K innermost so
+each (i, j) output tile accumulates across the K walk in a f32 VMEM
+scratch, written back once on the last K step. Used by the matmul smoke
+workload's ``kernel='pallas'`` mode to prove custom-kernel compilation on a
+freshly reconfigured slice (the XLA path proves the stock compiler; this
+proves Mosaic).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _mm_kernel(a_ref, b_ref, o_ref, acc_ref, *, k_steps: int):
+    @pl.when(pl.program_id(2) == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def tiled_matmul(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    block_m: int = 512,
+    block_n: int = 512,
+    block_k: int = 512,
+    out_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """a: (M, K) @ b: (K, N) -> (M, N). Dims must divide by the blocks
+    (callers pad; the smoke workload always passes multiples of 128)."""
+    M, K = a.shape
+    K2, N = b.shape
+    assert K == K2, (a.shape, b.shape)
+    block_m = min(block_m, M)
+    block_n = min(block_n, N)
+    block_k = min(block_k, K)
+    if M % block_m or N % block_n or K % block_k:
+        raise ValueError(
+            f"shapes ({M},{K})x({K},{N}) not divisible by blocks "
+            f"({block_m},{block_n},{block_k})"
+        )
+    k_steps = K // block_k
+    grid = (M // block_m, N // block_n, k_steps)
+
+    return pl.pallas_call(
+        functools.partial(_mm_kernel, k_steps=k_steps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, k: (i, k)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * M * N * K,
+            bytes_accessed=(M * K + K * N) * a.dtype.itemsize + M * N * 4,
+            transcendentals=0,
+        ),
+        interpret=jax.default_backend() != "tpu",
+    )(a, b)

@@ -1,0 +1,24 @@
+"""Parallelism: meshes, sharding rules, train steps, checkpoint, multi-slice.
+
+No reference counterpart as software (SURVEY.md §2 "Parallelism strategies":
+the reference has none) — but the reference's one fabric-wide invariant,
+PPCIe's stage-all/reset-all atomicity over NVLink, maps onto the structures
+here: the ICI mesh axes are the slice fabric, the 'dcn' axis is the
+inter-slice data-parallel path (BASELINE.json configs[4]), and
+jax.distributed is the coordination bootstrap (SURVEY.md §5).
+"""
+
+from tpu_cc_manager.parallel.mesh import MeshSpec, make_mesh
+from tpu_cc_manager.parallel.sharding import (
+    LOGICAL_AXIS_RULES,
+    logical_state_sharding,
+    mesh_sharding,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "LOGICAL_AXIS_RULES",
+    "logical_state_sharding",
+    "mesh_sharding",
+]

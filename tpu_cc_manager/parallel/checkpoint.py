@@ -1,0 +1,53 @@
+"""Checkpoint/resume for training across CC reconfigurations.
+
+New component with no reference counterpart (SURVEY.md §5 "Checkpoint /
+resume: none in the reference"): the rolling-reconfig scenario
+(BASELINE.json configs[3]) drains nodes out from under a live ResNet-50/
+Llama training job, so the job must snapshot before the drain and restore
+after re-admission. Orbax-backed; restores respect the target's shardings
+(arrays come back already distributed on the mesh).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+class TrainCheckpointer:
+    """Thin orbax CheckpointManager wrapper for TrainState pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state, wait: bool = True) -> None:
+        self.manager.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+        log.info("checkpoint saved at step %d", step)
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def restore(self, abstract_state, step: int | None = None):
+        """Restore into the structure/shardings of ``abstract_state``
+        (typically ``jax.eval_shape`` of the init, with shardings attached
+        via ``jax.tree.map(lambda s, sh: jax.ShapeDtypeStruct(s.shape,
+        s.dtype, sharding=sh), ...)``)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        state = self.manager.restore(step, args=ocp.args.StandardRestore(abstract_state))
+        log.info("checkpoint restored from step %d", step)
+        return state
+
+    def close(self) -> None:
+        self.manager.close()

@@ -1,0 +1,108 @@
+"""Multi-host / multi-slice coordination bootstrap.
+
+The reference's "distributed backend" is implicit (PPCIe's fabric-wide
+stage/reset invariant; SURVEY.md §5): there is no NCCL/MPI to port. The
+TPU-native equivalents here are:
+
+- ``bootstrap()``: ``jax.distributed.initialize`` from the env GKE TPU
+  pods carry (the NCCL-bootstrap analogue) — coordinator address from the
+  JobSet/TPU env, process count/id from TPU worker env;
+- ``verify_dcn_mesh()``: a one-psum health check across the 'dcn' axis,
+  used after a slice bounces (CC reconfig) to prove the DCN data-parallel
+  mesh re-formed before training resumes (BASELINE.json configs[4]);
+- quote exchange helpers for cross-slice attestation live in
+  ccmanager/multislice.py and use these primitives.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def _env_int(*names: str, default: int | None = None) -> int | None:
+    for n in names:
+        v = os.environ.get(n)
+        if v not in (None, ""):
+            try:
+                return int(v)
+            except ValueError:
+                continue
+    return default
+
+
+def bootstrap(timeout_s: int = 300) -> dict:
+    """Initialize jax.distributed from the environment, idempotently.
+
+    Recognized env (first match wins):
+    - coordinator: JAX_COORDINATOR_ADDRESS, MEGASCALE_COORDINATOR_ADDRESS,
+      or TPU_WORKER_HOSTNAMES[0] (GKE TPU podslice convention) + port 8476;
+    - process count: JAX_NUM_PROCESSES, else len(TPU_WORKER_HOSTNAMES);
+    - process id: JAX_PROCESS_ID, TPU_WORKER_ID.
+
+    Single-process (no env) is a no-op. Returns a summary dict for logs.
+    """
+    num = _env_int("JAX_NUM_PROCESSES")
+    hostnames = [
+        h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    ]
+    if num is None and len(hostnames) > 1:
+        num = len(hostnames)
+    if not num or num <= 1:
+        log.info("distributed bootstrap: single process, nothing to do")
+        return {"processes": 1, "initialized": False}
+
+    pid = _env_int("JAX_PROCESS_ID", "TPU_WORKER_ID", default=0)
+    coordinator = (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        or (f"{hostnames[0]}:8476" if hostnames else None)
+    )
+    if coordinator is None:
+        raise RuntimeError(
+            "multi-process env detected but no coordinator address "
+            "(set JAX_COORDINATOR_ADDRESS)"
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=pid,
+        initialization_timeout=timeout_s,
+    )
+    log.info(
+        "jax.distributed initialized: coordinator=%s process %d/%d "
+        "local_devices=%d global_devices=%d",
+        coordinator, pid, num, jax.local_device_count(), jax.device_count(),
+    )
+    return {"processes": num, "process_id": pid, "initialized": True}
+
+
+def verify_dcn_mesh(mesh) -> bool:
+    """Prove the data-parallel mesh is live end-to-end: an all-reduce of
+    ones over every data axis must equal the number of participants.
+
+    Run after a slice returns from a CC bounce and before training resumes
+    — a half-formed DCN mesh hangs or mis-reduces here instead of corrupting
+    gradients silently."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_axes = ("dcn", "dp", "fsdp")
+    n = 1
+    for a in data_axes:
+        n *= mesh.shape.get(a, 1)
+    ones = jax.device_put(
+        jnp.ones((n,), jnp.float32), NamedSharding(mesh, P(data_axes))
+    )
+    total = jax.jit(
+        lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+    )(ones)
+    ok = int(total) == n
+    (log.info if ok else log.error)(
+        "DCN mesh verification: expected %d, got %d -> %s", n, int(total), ok
+    )
+    return ok

@@ -1,0 +1,52 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+Models annotate parameters with *logical* axis names
+(``nn.with_logical_partitioning`` in models/llama.py); the rules here map
+those names onto the mesh axes of parallel/mesh.py. This indirection is the
+idiomatic flax/pjit pattern: the model is written once, and dp/fsdp/tp
+layouts are a table change, not a model change.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (None = replicated along that logical axis).
+LOGICAL_AXIS_RULES = (
+    ("batch", ("dcn", "dp", "fsdp")),  # global batch over all data axes
+    ("seq", None),                      # sequence sharding arrives with ring attention (ops/)
+    ("embed", "fsdp"),                  # ZeRO-style weight sharding
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("layers", None),                   # scan axis stays replicated
+)
+
+
+def mesh_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def logical_state_sharding(tree, mesh: Mesh):
+    """Pytree of NamedShardings for a pytree carrying flax logical metadata
+    (a boxed params tree / TrainState from ``jax.eval_shape`` over a boxed
+    init). Structure of the result matches the *unboxed* tree, so it can be
+    passed straight to ``jit(..., out_shardings=...)`` of an unboxing init.
+    Leaves without metadata are replicated."""
+    logical_specs = nn.get_partition_spec(tree)
+    shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, LOGICAL_AXIS_RULES)
+    # logical_to_mesh_sharding leaves bare P()/None for unboxed leaves; wrap
+    # everything as NamedSharding for a uniform out_shardings tree.
+    return jax.tree.map(
+        lambda s: s if isinstance(s, NamedSharding) else NamedSharding(mesh, s or P()),
+        shardings,
+        is_leaf=lambda x: isinstance(x, (NamedSharding, P)) or x is None,
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input-batch sharding: leading axis over all data axes."""
+    return NamedSharding(mesh, P(("dcn", "dp", "fsdp")))

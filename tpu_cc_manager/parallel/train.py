@@ -1,0 +1,84 @@
+"""Sharded train-step construction for the smoke models.
+
+Builds (state, step_fn) pairs where the state is initialized *sharded*
+(params never materialize replicated on one host) and the step is a single
+pjit-compiled function: forward, loss, grad, optimizer update — XLA inserts
+the psum/reduce-scatter collectives implied by the shardings.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_cc_manager.models.llama import LlamaConfig, LlamaModel
+from tpu_cc_manager.parallel.sharding import batch_sharding, logical_state_sharding
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState (params + optax state + step)."""
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt = jax.nn.one_hot(targets, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(logp * tgt, axis=-1))
+
+
+def make_llama_train_state(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+    seed: int = 0,
+) -> tuple[TrainState, Any]:
+    """Sharded-init Llama TrainState + its sharding pytree."""
+    import flax.linen as nn
+
+    model = LlamaModel(cfg)
+    sample = jnp.zeros((1, 8), jnp.int32)
+    tx = optax.adamw(learning_rate, weight_decay=0.01)
+
+    def boxed_init(rng):
+        variables = model.init(rng, sample)
+        return TrainState.create(apply_fn=model.apply, params=variables["params"], tx=tx)
+
+    # Shapes only (keeps the flax Partitioned metadata), derive mesh
+    # shardings from it, then run the real init already-sharded — parameters
+    # never materialize replicated (jit with out_shardings shards the init
+    # computation itself).
+    abstract = jax.eval_shape(boxed_init, jax.random.PRNGKey(seed))
+    shardings = logical_state_sharding(abstract, mesh)
+    with mesh:
+        state = jax.jit(
+            lambda rng: nn.unbox(boxed_init(rng)), out_shardings=shardings
+        )(jax.random.PRNGKey(seed))
+    return state, shardings
+
+
+def make_llama_train_step(cfg: LlamaConfig, mesh: Mesh, state_shardings):
+    """One pjit-compiled next-token-prediction training step."""
+    data_sharding = batch_sharding(mesh)
+
+    @partial(
+        jax.jit,
+        in_shardings=(state_shardings, data_sharding),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    def train_step(state: TrainState, tokens: jnp.ndarray):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+        def loss_fn(params):
+            logits, _ = state.apply_fn({"params": params}, inputs)
+            return cross_entropy(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    return train_step

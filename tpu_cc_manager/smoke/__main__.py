@@ -10,15 +10,17 @@ import sys
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tpu_cc_manager.smoke")
     p.add_argument("--workload", required=True)
-    p.add_argument("--size", type=int, default=None,
-                   help="problem-size override (workload-specific)")
+    p.add_argument("--size", default=None,
+                   help="problem-size override: an integer for matmul, a "
+                   "named config for llama/resnet (e.g. tiny, 500m, "
+                   "llama2-7b, resnet50)")
     args = p.parse_args(argv)
 
     from tpu_cc_manager.smoke.runner import SmokeError, run_workload
 
     kwargs = {}
     if args.size is not None:
-        kwargs["size"] = args.size
+        kwargs["size"] = int(args.size) if args.size.isdigit() else args.size
     try:
         result = run_workload(args.workload, **kwargs)
     except SmokeError as e:

@@ -20,13 +20,19 @@ import time
 from functools import partial
 
 
-def run(size: int | None = None, iters: int = 8, seed: int = 0) -> dict:
+def run(size: int | None = None, iters: int = 8, seed: int = 0,
+        kernel: str = "xla") -> dict:
+    """kernel='xla' uses jnp.matmul (stock compiler); kernel='pallas' uses
+    the Mosaic tiled kernel (ops/matmul.py) — single-device only, used to
+    prove custom-kernel compilation works on a reconfigured slice."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devices = jax.devices()
     backend = jax.default_backend()
+    if kernel == "pallas":
+        devices = devices[:1]  # the Mosaic kernel is single-device
     if size is None:
         size = 4096 if backend == "tpu" else 256
     # Round to a multiple of (128 * device count) — keeps every shard aligned
@@ -45,9 +51,20 @@ def run(size: int | None = None, iters: int = 8, seed: int = 0) -> dict:
     a = jax.device_put(a, row_sharding)
     b = jax.device_put(b, repl)
 
-    @partial(jax.jit, out_shardings=row_sharding)
-    def mm(a, b):
-        return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+    if kernel == "pallas":
+        from tpu_cc_manager.ops.matmul import tiled_matmul
+
+        block = 512 if size % 512 == 0 else 128
+
+        @jax.jit
+        def mm(a, b):
+            return tiled_matmul(a, b, block_m=block, block_n=block, block_k=block)
+
+    else:
+
+        @partial(jax.jit, out_shardings=row_sharding)
+        def mm(a, b):
+            return jnp.matmul(a, b, preferred_element_type=jnp.float32)
 
     # Warmup/compile.
     out = mm(a, b)
@@ -76,6 +93,7 @@ def run(size: int | None = None, iters: int = 8, seed: int = 0) -> dict:
     return {
         "ok": bool(ok),
         "workload": "matmul",
+        "kernel": kernel,
         "backend": backend,
         "devices": n_dev,
         "size": size,

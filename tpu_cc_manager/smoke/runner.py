@@ -19,6 +19,8 @@ log = logging.getLogger(__name__)
 
 WORKLOADS = {
     "matmul": "tpu_cc_manager.smoke.matmul",
+    "llama": "tpu_cc_manager.smoke.llama_infer",
+    "resnet": "tpu_cc_manager.smoke.resnet_train",
 }
 
 
