@@ -31,17 +31,17 @@ def test_publish_and_collect(fake_kube):
     assert slices["s1"]["digest"] != "MIXED"
 
 
-def test_verify_pool_ok(fake_kube):
-    # Two slices; SAME runtime digest required. Quotes embed the slice id,
-    # so digests differ per slice — build both from the same slice template
-    # and relabel. In production the digest covers the runtime measurement,
-    # which IS equal across correctly-configured slices; the fake mirrors
-    # that only when the quotes are identical modulo nothing. Use one slice.
-    q = make_quote("s1")
-    add_attested_node(fake_kube, "n0", "s1", q)
-    add_attested_node(fake_kube, "n1", "s1", q)
-    slices = multislice.verify_pool_attestation(fake_kube, POOL, "on")
-    assert len(slices) == 1
+def test_verify_pool_ok_two_slices(fake_kube):
+    """Two healthy slices of one DP pool: identical runtimes must produce
+    identical digests (quote_digest excludes slice identity), so the pool
+    verifies — the BASELINE configs[4] multi-slice flow."""
+    add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
+    add_attested_node(fake_kube, "n1", "s2", make_quote("s2"))
+    slices = multislice.verify_pool_attestation(
+        fake_kube, POOL, "on", expected_slices=2
+    )
+    assert len(slices) == 2
+    assert slices["s1"]["digest"] == slices["s2"]["digest"]
 
 
 def test_verify_detects_mode_mismatch(fake_kube):
@@ -52,8 +52,12 @@ def test_verify_detects_mode_mismatch(fake_kube):
 
 
 def test_verify_detects_digest_divergence(fake_kube):
+    # s2 runs a genuinely different runtime fingerprint (chip count differs).
     add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
-    add_attested_node(fake_kube, "n1", "s2", make_quote("s2"))
+    q2 = FakeTpuBackend(
+        slice_id="s2", initial_mode="on", num_chips=8
+    ).fetch_attestation("nonce")
+    add_attested_node(fake_kube, "n1", "s2", q2)
     with pytest.raises(multislice.PoolAttestationError) as exc:
         multislice.verify_pool_attestation(fake_kube, POOL, "on")
     assert "distinct runtime digests" in str(exc.value)
@@ -61,8 +65,10 @@ def test_verify_detects_digest_divergence(fake_kube):
 
 def test_verify_detects_intra_slice_divergence(fake_kube):
     add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
-    # Second host of s1 publishes a different digest (tampered quote).
-    q2 = make_quote("s2")
+    # Second host of s1 publishes a different digest (tampered runtime).
+    q2 = FakeTpuBackend(
+        slice_id="s1", initial_mode="on", num_chips=8
+    ).fetch_attestation("nonce")
     fake_kube.add_node("n1", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
     multislice.publish_quote(fake_kube, "n1", q2)
     with pytest.raises(multislice.PoolAttestationError) as exc:

@@ -29,6 +29,21 @@ class TestFlashAttention:
         ref = reference_attention(q, k, v, causal=False)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_noncausal_indivisible_seq_masks_padding(self):
+        """S=96 with 64-blocks pads the tail key block; phantom keys must
+        not enter the softmax normalizer (regression: the padding mask was
+        only applied on the causal path)."""
+        q, k, v = attn_inputs(S=96)
+        out = flash_attention(q, k, v, False, 64, 64)
+        ref = reference_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_causal_indivisible_seq(self):
+        q, k, v = attn_inputs(S=96)
+        out = flash_attention(q, k, v, True, 64, 64)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
     def test_unequal_blocks(self):
         q, k, v = attn_inputs(S=128)
         out = flash_attention(q, k, v, True, 64, 32)

@@ -60,6 +60,32 @@ def test_plan_groups_by_slice(fake_kube):
     assert groups["node/node-3"] == ("node-3",)
 
 
+def test_rollout_rejects_invalid_mode(fake_kube):
+    """A typo'd mode must fail fast, before any node's desired label is
+    written (otherwise the pool hangs for node_timeout_s per group)."""
+    import pytest
+
+    from tpu_cc_manager.labels import CC_MODE_LABEL
+    from tpu_cc_manager.kubeclient.api import node_labels
+
+    add_pool(fake_kube, 2)
+    roller = RollingReconfigurator(fake_kube, POOL)
+    with pytest.raises(ValueError, match="invalid CC mode"):
+        roller.rollout("onn")
+    for node in fake_kube.list_nodes(POOL):
+        assert CC_MODE_LABEL not in node_labels(node)
+
+
+def test_rollout_accepts_ppcie_alias(fake_kube):
+    """The deprecated reference alias canonicalizes instead of erroring."""
+    add_pool(fake_kube, 1)
+    roller = RollingReconfigurator(
+        fake_kube, POOL, node_timeout_s=0.5, poll_interval_s=0.01
+    )
+    result = roller.rollout("ppcie")  # -> slice; no agents run, so timeout
+    assert result.mode == "slice"
+
+
 def test_rollout_converges_all_nodes(fake_kube):
     add_pool(fake_kube, 3)
     agent_simulator(fake_kube)

@@ -366,21 +366,18 @@ class CCManager:
             from tpu_cc_manager.ccmanager import multislice
             from tpu_cc_manager.ccmanager.rolling import SLICE_ID_LABEL
 
-            slice_label = _label_safe(topo.slice_id)
-            self.api.patch_node_labels(self.node_name, {SLICE_ID_LABEL: slice_label})
+            # One merge-patch for slice id + quote labels (or None-clears
+            # when mode off): a single apiserver round trip, and no window
+            # where the slice label is visible with a stale quote.
+            patch = {SLICE_ID_LABEL: _label_safe(topo.slice_id)}
+            patch.update(multislice.quote_label_patch(quote))
+            self.api.patch_node_labels(self.node_name, patch)
             if quote is not None:
-                multislice.publish_quote(self.api, self.node_name, quote)
-            else:
-                # No quote this reconcile (mode off): clear any stale
-                # attestation labels so pool verification can't read
-                # evidence from a previous mode.
-                self.api.patch_node_labels(
+                log.info(
+                    "published attestation for %s: digest=%s mode=%s",
                     self.node_name,
-                    {
-                        f"{multislice.QUOTE_ANNOTATION}.digest": None,
-                        f"{multislice.QUOTE_ANNOTATION}.mode": None,
-                        f"{multislice.QUOTE_ANNOTATION}.ts": None,
-                    },
+                    patch[f"{multislice.QUOTE_ANNOTATION}.digest"],
+                    quote.mode,
                 )
         except Exception as e:  # noqa: BLE001 - advisory metadata only
             log.warning("could not publish coordination labels: %s", e)

@@ -42,6 +42,27 @@ class PoolAttestationError(Exception):
     """The pool's slices do not present coherent attestation evidence."""
 
 
+def quote_label_patch(quote: AttestationQuote | None) -> dict:
+    """Label entries advertising a quote — or None-clears when there is no
+    quote (mode off), so pool verification can't read stale evidence.
+
+    Returned as a plain dict so callers can fold it into a single node
+    merge-patch together with other coordination labels."""
+    if quote is None:
+        return {
+            f"{QUOTE_ANNOTATION}.digest": None,
+            f"{QUOTE_ANNOTATION}.mode": None,
+            f"{QUOTE_ANNOTATION}.ts": None,
+        }
+    # Label values are constrained (63 chars, alphanum/-/_/.); pack the
+    # payload into multiple labels instead of one JSON blob.
+    return {
+        f"{QUOTE_ANNOTATION}.digest": quote_digest(quote),
+        f"{QUOTE_ANNOTATION}.mode": quote.mode,
+        f"{QUOTE_ANNOTATION}.ts": str(int(time.time())),
+    }
+
+
 def publish_quote(api: KubeApi, node_name: str, quote: AttestationQuote) -> dict:
     """Publish a quote's digest+mode on the node as an annotation payload.
 
@@ -49,22 +70,14 @@ def publish_quote(api: KubeApi, node_name: str, quote: AttestationQuote) -> dict
     merge-patch endpoint carries them (the in-tree kubeclient patches
     metadata.labels; annotations piggyback on a dedicated label-safe
     JSON value here to keep the client surface minimal)."""
+    patch = quote_label_patch(quote)
+    api.patch_node_labels(node_name, patch)
     payload = {
         "slice": quote.slice_id,
         "mode": quote.mode,
-        "digest": quote_digest(quote),
-        "ts": int(time.time()),
+        "digest": patch[f"{QUOTE_ANNOTATION}.digest"],
+        "ts": int(patch[f"{QUOTE_ANNOTATION}.ts"]),
     }
-    # Label values are constrained (63 chars, alphanum/-/_/.); pack the
-    # payload into multiple labels instead of one JSON blob.
-    api.patch_node_labels(
-        node_name,
-        {
-            f"{QUOTE_ANNOTATION}.digest": payload["digest"],
-            f"{QUOTE_ANNOTATION}.mode": payload["mode"],
-            f"{QUOTE_ANNOTATION}.ts": str(payload["ts"]),
-        },
-    )
     log.info("published attestation for %s: %s", node_name, payload)
     return payload
 

@@ -28,6 +28,7 @@ from tpu_cc_manager.labels import (
     CC_MODE_LABEL,
     CC_MODE_STATE_LABEL,
     STATE_FAILED,
+    VALID_MODES,
     canonical_mode,
 )
 
@@ -110,6 +111,13 @@ class RollingReconfigurator:
 
     def rollout(self, mode: str) -> RolloutResult:
         mode = canonical_mode(mode)
+        if mode not in VALID_MODES:
+            # Fail fast: a typo'd mode written pool-wide would make every
+            # node agent refuse (without a 'failed' state label) and the
+            # rollout would burn node_timeout_s per group before reporting.
+            raise ValueError(
+                f"invalid CC mode {mode!r} (valid: {VALID_MODES})"
+            )
         groups = plan_groups(self.api, self.selector)
         log.info(
             "rolling %s over %d group(s) (%d node(s)), max_unavailable=%d",

@@ -121,6 +121,9 @@ def main(argv: list[str] | None = None) -> int:
         return {"rollout": cmd_rollout, "attest": cmd_attest, "status": cmd_status}[
             args.command
         ](api, args)
+    except ValueError as e:
+        log.error("usage error: %s", e)
+        return 2
     except KubeApiError as e:
         log.error("apiserver error: %s", e)
         return 1

@@ -1,0 +1,146 @@
+"""Hugging Face → flax weight conversion for the Llama family.
+
+BASELINE.json configs[2]/[4] name real checkpoints (Llama-2-7B,
+Llama-3-8B); the smoke workloads run with random weights for speed, but an
+operator pointing the verify phase at a real model needs its weights in our
+parameter layout. This converts a ``transformers`` Llama state dict into
+the layer-stacked pytree produced by ``models/llama.py`` (one leading
+'layers' axis from ``nn.scan`` — SURVEY.md has no counterpart; the
+reference manages no model weights at all).
+
+Conventions handled:
+- torch ``nn.Linear`` stores (out, in); flax ``Dense`` kernels are
+  (in, out) → transpose every projection;
+- HF's rotary convention is rotate-half, matching ``apply_rope``'s
+  split-in-half layout, so Q/K need no permutation;
+- per-layer tensors are stacked on axis 0 to match the scan layout.
+
+Gated on ``transformers``/``torch`` being importable; pure-numpy state
+dicts work without either.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from tpu_cc_manager.models.llama import LlamaConfig
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch.Tensor (cpu) — avoid importing torch just for the isinstance.
+    detach = getattr(t, "detach", None)
+    if detach is not None:
+        t = detach()
+        if hasattr(t, "float"):
+            t = t.float()
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def _rope_scaling_from_hf(hf_config: Any) -> tuple[float, float, float, int] | None:
+    """Map HF ``rope_scaling`` to our tuple; reject types we'd silently get
+    wrong (linear/yarn/dynamic) rather than produce diverging numerics."""
+    rs = getattr(hf_config, "rope_scaling", None)
+    if not rs:
+        return None
+    rope_type = rs.get("rope_type") or rs.get("type")
+    if rope_type == "default":
+        return None
+    if rope_type != "llama3":
+        raise NotImplementedError(
+            f"rope_scaling type {rope_type!r} is not supported "
+            "(supported: llama3); refusing to convert with wrong RoPE"
+        )
+    return (
+        float(rs["factor"]),
+        float(rs["low_freq_factor"]),
+        float(rs["high_freq_factor"]),
+        int(rs["original_max_position_embeddings"]),
+    )
+
+
+def config_from_hf(hf_config: Any) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` onto ours."""
+    return LlamaConfig(
+        rope_scaling=_rope_scaling_from_hf(hf_config),
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+        or hf_config.num_attention_heads,
+        hidden_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        norm_eps=hf_config.rms_norm_eps,
+    )
+
+
+def hf_state_dict_to_params(
+    state_dict: Mapping[str, Any], cfg: LlamaConfig
+) -> dict:
+    """Convert an HF ``LlamaForCausalLM`` state dict to our params pytree.
+
+    Accepts torch tensors or numpy arrays. Returns ``{"params": {...}}``
+    ready for ``LlamaModel(cfg).apply``.
+    """
+    sd = {k: _to_numpy(v) for k, v in state_dict.items()}
+    L = cfg.n_layers
+
+    def proj(i: int, name: str) -> np.ndarray:
+        return sd[f"model.layers.{i}.{name}.weight"].T.astype(np.float32)
+
+    def stack(name: str) -> np.ndarray:
+        return np.stack([proj(i, name) for i in range(L)], axis=0)
+
+    def stack_norm(name: str) -> np.ndarray:
+        return np.stack(
+            [
+                sd[f"model.layers.{i}.{name}.weight"].astype(np.float32)
+                for i in range(L)
+            ],
+            axis=0,
+        )
+
+    embed = sd["model.embed_tokens.weight"].astype(np.float32)
+    # Tied embeddings (Llama-3.2 style) fall back to the input embedding.
+    lm_head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    params = {
+        "embedding": embed,
+        "lm_head": lm_head.T.astype(np.float32),
+        "final_norm": {"scale": sd["model.norm.weight"].astype(np.float32)},
+        "blocks": {
+            "attn": {
+                "wq": {"kernel": stack("self_attn.q_proj")},
+                "wk": {"kernel": stack("self_attn.k_proj")},
+                "wv": {"kernel": stack("self_attn.v_proj")},
+                "wo": {"kernel": stack("self_attn.o_proj")},
+            },
+            "attn_norm": {"scale": stack_norm("input_layernorm")},
+            "mlp_norm": {"scale": stack_norm("post_attention_layernorm")},
+            "mlp": {
+                "w_gate": {"kernel": stack("mlp.gate_proj")},
+                "w_up": {"kernel": stack("mlp.up_proj")},
+                "w_down": {"kernel": stack("mlp.down_proj")},
+            },
+        },
+    }
+    return {"params": params}
+
+
+def load_hf_llama(model_name_or_path: str):
+    """Load an HF Llama checkpoint → (LlamaConfig, variables pytree).
+
+    Requires ``transformers`` + ``torch``; heavyweight, call from tooling
+    (e.g. a checkpoint-conversion job), not from the reconcile loop.
+    """
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_config = AutoConfig.from_pretrained(model_name_or_path)
+    cfg = config_from_hf(hf_config)
+    model = AutoModelForCausalLM.from_pretrained(model_name_or_path)
+    return cfg, hf_state_dict_to_params(model.state_dict(), cfg)

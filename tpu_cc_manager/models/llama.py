@@ -39,6 +39,10 @@ class LlamaConfig:
     hidden_dim: int = 11008
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
+    # Llama-3.1-style frequency scaling for long context, as a hashable
+    # tuple (factor, low_freq_factor, high_freq_factor, original_max_len);
+    # None = unscaled RoPE (Llama-2/3.0).
+    rope_scaling: tuple[float, float, float, int] | None = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
@@ -104,9 +108,31 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(self.dtype)
 
 
-def rope_frequencies(head_dim: int, max_len: int, theta: float) -> jnp.ndarray:
-    """(max_len, head_dim//2) rotation phases, f32."""
+def rope_frequencies(
+    head_dim: int,
+    max_len: int,
+    theta: float,
+    scaling: tuple[float, float, float, int] | None = None,
+) -> jnp.ndarray:
+    """(max_len, head_dim//2) rotation phases, f32.
+
+    ``scaling`` applies the Llama-3.1 long-context recipe: wavelengths far
+    beyond the original training context are divided by ``factor``, short
+    wavelengths are kept, and the band in between is smoothly interpolated.
+    """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        factor, low_ff, high_ff, original_max = scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wavelen = original_max / low_ff
+        high_wavelen = original_max / high_ff
+        smooth = (original_max / wavelen - low_ff) / (high_ff - low_ff)
+        interpolated = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > low_wavelen,
+            inv_freq / factor,
+            jnp.where(wavelen < high_wavelen, inv_freq, interpolated),
+        )
     pos = jnp.arange(max_len, dtype=jnp.float32)
     return jnp.outer(pos, inv_freq)
 
@@ -241,7 +267,9 @@ class LlamaModel(nn.Module):
         )
         x = embed[tokens].astype(cfg.dtype)
 
-        all_phases = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        all_phases = rope_frequencies(
+            cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+        )
         if cache is not None:
             T = cache[0].shape[2]  # cache: (k, v) each (L, B, T, KV, D)
             phases = lax.dynamic_slice_in_dim(all_phases, position, S, axis=0)

@@ -64,14 +64,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (block_q, block_k)
+        # Tail padding: when seq_len % block_k != 0 the last key block reads
+        # past the sequence; those phantom keys must never enter the softmax
+        # (causal or not).
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_pos < seq_len
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -97,6 +102,15 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kr = k.reshape(B * H, S, D)
     vr = v.reshape(B * H, S, D)
 
+    # Pad keys/values to a block multiple: the kernel's pl.ds slice clamps
+    # at the buffer end (dynamic-slice semantics), so an unpadded tail block
+    # would silently re-read earlier rows under a wrong k_pos. The in-kernel
+    # `k_pos < seq_len` mask nulls the zero-padded phantoms.
+    s_pad = pl.cdiv(S, block_k) * block_k
+    if s_pad != S:
+        kr = jnp.pad(kr, ((0, 0), (0, s_pad - S), (0, 0)))
+        vr = jnp.pad(vr, ((0, 0), (0, s_pad - S), (0, 0)))
+
     out = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_q=block_q, block_k=block_k,
@@ -105,8 +119,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, D), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),

@@ -15,8 +15,10 @@ TPU.
 Causal handling: ring step r on device i processes the K/V shard that
 started at device (i - r) mod n. With sequence shards laid out in device
 order, that shard covers keys strictly before this device's queries when
-(i - r) mod n < i — full block; equal — local causal block; later — skipped
-(contributes nothing, masked entirely).
+(i - r) mod n < i — full block; equal — local causal block; later — the
+attention math is skipped with ``lax.cond`` (every score would be masked);
+the ppermute itself still runs on every step so all devices join each
+collective.
 """
 
 from __future__ import annotations
@@ -88,12 +90,29 @@ def ring_attention(
         def body(r, carry):
             k_cur, v_cur, m, l, acc = carry
             # Pass K/V to the next device; receive from the previous one.
+            # The ppermute runs unconditionally (every device must join the
+            # collective); only the attention math is skipped.
             perm = [(i, (i + 1) % n) for i in range(n)]
             k_cur = jax.lax.ppermute(k_cur, axis, perm)
             v_cur = jax.lax.ppermute(v_cur, axis, perm)
             src = (idx - r) % n  # owner of the shard we just received
-            m2, l2, acc2 = _block_attn(q, k_cur, v_cur, q_off, src * shard, scale)
-            m, l, acc = _merge(m, l, acc, m2, l2, acc2)
+
+            def attend(operand):
+                k_in, v_in, m, l, acc = operand
+                m2, l2, acc2 = _block_attn(
+                    q, k_in, v_in, q_off, src * shard, scale
+                )
+                return _merge(m, l, acc, m2, l2, acc2)
+
+            # Shards owned by later devices are entirely in this Q shard's
+            # future: every score would be masked, so skip the two einsums
+            # (on average (n-1)/2 steps per device — half the ring FLOPs).
+            m, l, acc = jax.lax.cond(
+                src <= idx,
+                attend,
+                lambda operand: (operand[2], operand[3], operand[4]),
+                (k_cur, v_cur, m, l, acc),
+            )
             return k_cur, v_cur, m, l, acc
 
         _, _, m, l, acc = jax.lax.fori_loop(1, n, body, (k, v, m, l, acc))

@@ -16,6 +16,12 @@ def main(argv: list[str] | None = None) -> int:
                    "llama2-7b, resnet50)")
     args = p.parse_args(argv)
 
+    # Before any jax import: persistent XLA cache makes every verify run
+    # after the first compile-free (see utils/compilation_cache.py).
+    from tpu_cc_manager.utils.compilation_cache import enable
+
+    enable()
+
     from tpu_cc_manager.smoke.runner import SmokeError, run_workload
 
     kwargs = {}

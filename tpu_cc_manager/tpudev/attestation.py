@@ -137,9 +137,14 @@ def verify_quote(
 def quote_digest(quote: AttestationQuote) -> str:
     """Short stable digest of a quote, for logs and cross-slice comparison
     (multi-slice DP verifies every slice attests the same runtime digest
-    before re-forming the DCN mesh, parallel/multislice.py)."""
+    before re-forming the DCN mesh, ccmanager/multislice.py).
+
+    Deliberately excludes ``slice_id``: the digest is the pool-wide "same
+    runtime, same mode" fingerprint, and two healthy slices of one DP pool
+    must produce EQUAL digests. Per-slice identity is checked separately by
+    ``verify_quote(expected_slice_id=...)``."""
     msg = json.dumps(
-        {"slice": quote.slice_id, "mode": quote.mode, "m": quote.measurements},
+        {"mode": quote.mode, "m": quote.measurements},
         sort_keys=True,
     ).encode()
     return hashlib.sha256(msg).hexdigest()[:16]

@@ -1,0 +1,72 @@
+"""Persistent XLA compilation cache for smoke workloads and the bench.
+
+The end-to-end verify latency (the <90 s north-star, BASELINE.md) is
+dominated by XLA's first compile of the smoke workload — tens of seconds on
+a cold process. XLA's persistent compilation cache turns every run after the
+first into a disk hit, so a node's verify phase after a CC bounce costs
+milliseconds of compile instead of tens of seconds. The reference has no
+analogue (its verify is a register read, SURVEY.md §3.2 phase 4); this is
+the TPU-native cost of upgrading verification to a real numerical workload,
+and the cache is how we keep it under the latency target.
+
+Must be called BEFORE jax is first imported by the process (env-var config
+is read at import). Opt out with TPU_CC_NO_COMPILATION_CACHE=1.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+_ENV_DIR = "JAX_COMPILATION_CACHE_DIR"
+
+
+def candidate_cache_dirs() -> list[str]:
+    """Preference order: TPU_CC_CACHE_DIR override, repo-local dir, tmpdir.
+
+    Repo-local keeps the cache on the image's writable layer next to the
+    code that produced it; the tmpdir fallback matters in the distroless
+    image where the site-packages tree is root-owned and the agent runs as
+    nonroot (a silent no-cache there would re-pay the full XLA compile on
+    every post-bounce verify)."""
+    import tempfile
+
+    override = os.environ.get("TPU_CC_CACHE_DIR")
+    if override:
+        return [override]
+    repo_root = pathlib.Path(__file__).resolve().parents[2]
+    return [
+        str(repo_root / ".jax_cache"),
+        os.path.join(tempfile.gettempdir(), "tpu-cc-jax-cache"),
+    ]
+
+
+def enable(cache_dir: str | None = None) -> str | None:
+    """Point XLA's persistent cache at the first writable candidate dir.
+
+    Returns the directory in use, or None when disabled/unwritable. Safe to
+    call multiple times; an existing JAX_COMPILATION_CACHE_DIR wins.
+    """
+    if os.environ.get("TPU_CC_NO_COMPILATION_CACHE") == "1":
+        return None
+    candidates = [os.environ[_ENV_DIR]] if os.environ.get(_ENV_DIR) else []
+    if cache_dir:
+        candidates.append(cache_dir)
+    candidates.extend(candidate_cache_dirs())
+    path = None
+    for candidate in candidates:
+        try:
+            pathlib.Path(candidate).mkdir(parents=True, exist_ok=True)
+            if os.access(candidate, os.W_OK):
+                path = candidate
+                break
+        except OSError:
+            continue
+    if path is None:
+        return None
+    os.environ[_ENV_DIR] = path
+    # Cache every executable: the smoke models compile few, large programs,
+    # so entry-count blowup is not a concern and misses are expensive.
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    return path
