@@ -40,19 +40,25 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
     n_dev = len(devices)
     size = max(128 * n_dev, (size // (128 * n_dev)) * (128 * n_dev))
 
-    key = jax.random.PRNGKey(seed)
-    k1, k2 = jax.random.split(key)
-    a = jax.random.normal(k1, (size, size), dtype=jnp.bfloat16)
-    b = jax.random.normal(k2, (size, size), dtype=jnp.bfloat16)
-
     mesh = Mesh(devices, ("x",))
     row_sharding = NamedSharding(mesh, P("x", None))
     repl = NamedSharding(mesh, P())
-    a = jax.device_put(a, row_sharding)
-    b = jax.device_put(b, repl)
 
-    # One product definition shared by the warm-up/numerics path (`mm`) and
-    # the timed chain, so kernel dispatch and block sizing can't diverge.
+    # Generate operands ON device with their final shardings: a host-side
+    # random.normal + device_put would push 2×size² bf16 through the (possibly
+    # tunnelled) host↔device link, which costs more than the whole timed loop.
+    @partial(jax.jit, out_shardings=(row_sharding, repl))
+    def gen_operands(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (size, size), dtype=jnp.bfloat16)
+        b = jax.random.normal(k2, (size, size), dtype=jnp.bfloat16)
+        return a, b
+
+    a, b = gen_operands(jax.random.PRNGKey(seed))
+    a.block_until_ready()
+
+    # One product definition shared by the numerics path and the timed
+    # chain, so kernel dispatch and block sizing can't diverge.
     if kernel == "pallas":
         from tpu_cc_manager.ops.matmul import tiled_matmul
 
@@ -61,17 +67,10 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
         def product(x, y):
             return tiled_matmul(x, y, block_m=block, block_n=block, block_k=block)
 
-        mm = jax.jit(product)
     else:
 
         def product(x, y):
             return jnp.matmul(x, y, preferred_element_type=jnp.float32)
-
-        mm = partial(jax.jit, out_shardings=row_sharding)(product)
-
-    # Warmup/compile.
-    out = mm(a, b)
-    out.block_until_ready()
 
     # Timed loop: dependency-chained inside ONE jitted fori_loop so the
     # iterations are provably sequential on-device — independent identical
@@ -80,7 +79,11 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
     # overflowing across the chain and costs O(n²) against the O(n³) matmul.
     from jax import lax
 
-    @partial(jax.jit, static_argnums=(2,), out_shardings=row_sharding)
+    # `iters` is a TRACED argument (fori_loop lowers to while_loop), so one
+    # compiled program serves every chain length — on a tunnelled device each
+    # extra remote compile costs seconds, dwarfing the while- vs scan-loop
+    # difference for 4096³ matmul bodies.
+    @partial(jax.jit, out_shardings=row_sharding)
     def mm_chain(a, b, iters):
         def body(_, acc):
             # Constant renorm: rows of acc@b grow by ~sqrt(n) for unit
@@ -120,15 +123,26 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
     tflops = 2 * size**3 / dt / 1e12 if timing_valid else None
 
     # Numerics: identity sanity (A @ I == A within bf16 cast error) plus a
-    # row-sum cross-check of the measured product: out @ 1 == A @ (B @ 1).
-    eye = jax.device_put(jnp.eye(size, dtype=jnp.bfloat16), repl)
-    ident = mm(a, eye)
-    ident_err = float(jnp.max(jnp.abs(ident - a.astype(jnp.float32))))
-    ones = jnp.ones((size, 1), dtype=jnp.float32)
-    lhs = jnp.matmul(out, ones)
-    rhs = jnp.matmul(a.astype(jnp.float32), jnp.matmul(b.astype(jnp.float32), ones))
-    scale = float(jnp.max(jnp.abs(rhs))) + 1e-6
-    rowsum_rel_err = float(jnp.max(jnp.abs(lhs - rhs))) / scale
+    # row-sum cross-check of the product under test: (A·B) @ 1 == A @ (B @ 1).
+    # One fused jitted program: the product, the on-device identity matrix
+    # (no size² host transfer), and all three checks come back as scalars in
+    # a single dispatch instead of ~eight op-by-op round trips.
+    @jax.jit
+    def numerics(a, b):
+        out = product(a, b)
+        eye = jnp.eye(size, dtype=jnp.bfloat16)
+        ident_err = jnp.max(jnp.abs(product(a, eye) - a.astype(jnp.float32)))
+        ones = jnp.ones((size, 1), dtype=jnp.float32)
+        lhs = jnp.matmul(out, ones)
+        rhs = jnp.matmul(
+            a.astype(jnp.float32), jnp.matmul(b.astype(jnp.float32), ones)
+        )
+        scale = jnp.max(jnp.abs(rhs))
+        return ident_err, jnp.max(jnp.abs(lhs - rhs)), scale
+
+    ident_err_v, rowsum_err_v, scale_v = numerics(a, b)
+    ident_err = float(ident_err_v)
+    rowsum_rel_err = float(rowsum_err_v) / (float(scale_v) + 1e-6)
     # bf16 has ~8 mantissa bits; row-sum of `size` products loses a few more.
     ok = ident_err <= 1e-6 and rowsum_rel_err <= 2e-2
 

@@ -31,14 +31,18 @@ def candidate_cache_dirs() -> list[str]:
     every post-bounce verify)."""
     import tempfile
 
-    override = os.environ.get("TPU_CC_CACHE_DIR")
-    if override:
-        return [override]
     repo_root = pathlib.Path(__file__).resolve().parents[2]
-    return [
+    candidates = [
         str(repo_root / ".jax_cache"),
         os.path.join(tempfile.gettempdir(), "tpu-cc-jax-cache"),
     ]
+    override = os.environ.get("TPU_CC_CACHE_DIR")
+    if override:
+        # Preferred, not exclusive: an unwritable override (e.g. a hostPath
+        # the kubelet created root-owned while we run nonroot) must fall
+        # through to tmpdir rather than silently disabling the cache.
+        candidates.insert(0, override)
+    return candidates
 
 
 def enable(cache_dir: str | None = None) -> str | None:
