@@ -10,7 +10,10 @@ def test_matmul_smoke_passes():
     assert result["ok"] is True
     assert result["workload"] == "matmul"
     assert result["devices"] >= 1
-    assert result["tflops"] > 0
+    # Throughput is None when differential timing is swamped by host noise
+    # (timing_valid=False); correctness must hold either way.
+    if result["timing_valid"]:
+        assert result["tflops"] > 0
 
 
 def test_matmul_uses_all_virtual_devices():
@@ -31,7 +34,8 @@ def test_llama_smoke_passes():
     result = runner.run_workload("llama", batch=2, prompt_len=8, decode_len=4)
     assert result["ok"] is True
     assert result["oracle_ok"] is True
-    assert result["tokens_per_sec"] > 0
+    if result["timing_valid"]:
+        assert result["tokens_per_sec"] > 0
 
 
 def test_resnet_smoke_passes():

@@ -5,12 +5,14 @@ inference") and configs[4] (Llama-3-8B DP over DCN). As a smoke it must be
 fast *and* an actual correctness oracle:
 
 - sharded init over all visible devices (tp over heads when >1 device);
-- one compiled prefill (full prompt into the KV cache) + one compiled
-  decode step re-used for every generated token (static shapes);
-- oracle: teacher-forced cached decode must reproduce the no-cache full
-  forward's argmax sequence exactly — this catches wrong cache indexing,
-  mask or RoPE bugs, the classic CC-mode-flip failure being "numerics
-  changed after runtime restart".
+- one compiled prefill (full prompt into the KV cache) + the ENTIRE greedy
+  decode as one compiled ``lax.scan`` over static-length steps — the loop
+  lives on device, so generating N tokens costs one dispatch, not N
+  host round trips (tens of ms each through a tunnelled chip);
+- oracle: teacher-forced cached decode (also a scan) must reproduce the
+  no-cache full forward's argmax sequence exactly — this catches wrong
+  cache indexing, mask or RoPE bugs, the classic CC-mode-flip failure
+  being "numerics changed after runtime restart".
 """
 
 from __future__ import annotations
@@ -68,44 +70,94 @@ def run(
     with mesh:
         variables = jax.jit(lambda r: nn.unbox(boxed_init(r)), out_shardings=shardings)(key)
 
+        from functools import partial
+
+        from jax import lax
+
         def prefill(variables, prompt, cache):
             logits, cache = model.apply(variables, prompt, cache=cache, position=0)
             return jnp.argmax(logits[:, -1], axis=-1), cache
 
-        def decode_step(variables, token, cache, position):
+        prefill = jax.jit(prefill, donate_argnums=(2,))
+
+        def step(variables, token, cache, position):
             logits, cache = model.apply(
                 variables, token[:, None], cache=cache, position=position
             )
             return jnp.argmax(logits[:, 0], axis=-1), cache
 
-        prefill = jax.jit(prefill, donate_argnums=(2,))
-        decode_step = jax.jit(decode_step, donate_argnums=(2,))
+        # Teacher-forced scan: feed the given tokens, emit each step's argmax.
+        @partial(jax.jit, donate_argnums=(2,))
+        def teacher_forced(variables, tokens, cache):
+            def body(carry, tok):
+                cache, pos = carry
+                out, cache = step(variables, tok, cache, pos)
+                return (cache, pos + 1), out
+
+            (_, _), outs = lax.scan(body, (cache, jnp.int32(0)), tokens.T)
+            return outs.T
+
+        # Greedy chain: each step feeds its own argmax forward, the whole
+        # loop lives on device. The step count is a TRACED fori_loop bound
+        # so every chain length shares one executable (an extra remote
+        # compile costs seconds through a tunnelled chip). Only the final
+        # token is returned — the timed runs need a sync value, not the
+        # transcript. No cache donation: the timed runs below re-use the
+        # post-prefill cache across repetitions.
+        @jax.jit
+        def greedy_decode_n(variables, tok, cache, position, n):
+            def body(_, carry):
+                tok, cache, pos = carry
+                ntok, cache = step(variables, tok, cache, pos)
+                return (ntok, cache, pos + 1)
+
+            tok, cache, _ = lax.fori_loop(
+                0, n, body, (tok, cache, jnp.int32(position))
+            )
+            return tok
 
         # --- correctness oracle (tiny lengths, cache vs no-cache) --------
         oracle_len = min(8, prompt_len)
         full_logits, _ = jax.jit(model.apply)(variables, prompt[:, :oracle_len])
         expected = jnp.argmax(full_logits, axis=-1)
         cache = model.init_cache(batch, max_len)
-        got = []
-        for i in range(oracle_len):
-            tok, cache = decode_step(variables, prompt[:, i], cache, i)
-            got.append(tok)
-        got = jnp.stack(got, axis=1)
+        got = teacher_forced(variables, prompt[:, :oracle_len], cache)
         oracle_ok = bool(jnp.array_equal(got, expected))
 
         # --- timed run ---------------------------------------------------
-        cache = model.init_cache(batch, max_len)
-        tok, cache = prefill(variables, prompt, cache)
-        tok.block_until_ready()
-        t0 = time.perf_counter()
-        position = prompt_len
-        for _ in range(decode_len):
-            tok, cache = decode_step(variables, tok, cache, position)
-            position += 1
-        tok.block_until_ready()
-        dt = time.perf_counter() - t0
+        # Differential timing, as in smoke/matmul.py: median T(hi steps) -
+        # median T(lo steps) cancels the constant dispatch + readback
+        # overhead (~0.1 s through a tunnelled chip, which would otherwise
+        # swamp a short decode), leaving hi-lo steps of pure device time.
+        # Sync via a host readback — on the tunnel backend
+        # block_until_ready can return before the work is truly retired.
+        # The long chain stays within cfg.max_seq_len: positions past the
+        # RoPE phase table would silently clamp to the last row.
+        import statistics
 
-    tokens_per_sec = batch * decode_len / dt
+        hi = min(4 * decode_len, cfg.max_seq_len - prompt_len)
+        lo = max(1, hi // 4)
+        cache = model.init_cache(batch, prompt_len + hi)
+        tok, cache = prefill(variables, prompt, cache)
+
+        def _sync(x):
+            return float(jnp.sum(x[:1]))
+
+        def _timed(steps: int, reps: int = 3) -> float:
+            _sync(greedy_decode_n(variables, tok, cache, prompt_len, steps))
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _sync(greedy_decode_n(variables, tok, cache, prompt_len, steps))
+                times.append(time.perf_counter() - t0)
+            return statistics.median(times)
+
+        diff = _timed(hi) - _timed(lo)
+        timing_valid = diff > 0 and hi > lo
+        per_step = diff / (hi - lo) if timing_valid else None
+        dt = per_step * decode_len if timing_valid else None
+
+    tokens_per_sec = batch * decode_len / dt if timing_valid else None
     return {
         "ok": oracle_ok,
         "workload": "llama",
@@ -115,8 +167,9 @@ def run(
         "params": cfg.param_count(),
         "batch": batch,
         "decode_len": decode_len,
-        "tokens_per_sec": round(tokens_per_sec, 2),
-        "ms_per_token": round(1e3 * dt / decode_len, 3),
+        "timing_valid": bool(timing_valid),
+        "tokens_per_sec": round(tokens_per_sec, 2) if timing_valid else None,
+        "ms_per_token": round(1e3 * dt / decode_len, 3) if timing_valid else None,
         "oracle_ok": oracle_ok,
     }
 

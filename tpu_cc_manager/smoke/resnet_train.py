@@ -79,18 +79,22 @@ def run(size: str | None = None, batch: int | None = None, steps: int = 6,
     with mesh:
         state = jax.jit(init_fn, out_shardings=repl)(key)
 
-        @partial(jax.jit, donate_argnums=(0,))
-        def train_step(state, images, labels):
+        # One loss definition shared by the train step and the oracle's
+        # eval, so the oracle always compares the metric being optimized.
+        def _loss(apply_fn, params, batch_stats, images, labels):
+            logits, mutated = apply_fn(
+                {"params": params, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            onehot = jax.nn.one_hot(labels, logits.shape[-1])
+            loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, axis=-1))
+            return loss, mutated["batch_stats"]
+
+        def train_step_impl(state, images, labels):
             def loss_fn(params):
-                logits, mutated = state.apply_fn(
-                    {"params": params, "batch_stats": state.batch_stats},
-                    images, train=True, mutable=["batch_stats"],
+                return _loss(
+                    state.apply_fn, params, state.batch_stats, images, labels
                 )
-                onehot = jax.nn.one_hot(labels, logits.shape[-1])
-                loss = -jnp.mean(
-                    jnp.sum(jax.nn.log_softmax(logits) * onehot, axis=-1)
-                )
-                return loss, mutated["batch_stats"]
 
             (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params
@@ -98,31 +102,75 @@ def run(size: str | None = None, batch: int | None = None, steps: int = 6,
             state = state.apply_gradients(grads=grads)
             return state.replace(batch_stats=new_stats), loss
 
-        # Warmup/compile, then timed steps.
-        state, loss0 = train_step(state, images, labels)
-        jax.block_until_ready(loss0)
-        losses = [float(loss0)]
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, loss = train_step(state, images, labels)
-            losses.append(float(loss))
-        jax.block_until_ready(loss)
-        dt = (time.perf_counter() - t0) / steps
+
+        # Multi-step chains compiled as whole programs: a per-step host
+        # readback of the loss would put one dispatch+RTT per step inside
+        # the clock — through a tunnelled chip that overhead exceeds the
+        # step itself. Two programs total: a traced-length fori_loop chain
+        # (one executable serves every chain length, for both training and
+        # timing) and a cheap forward-only loss eval for the
+        # oracle's before/after comparison.
+        from jax import lax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_n(state, images, labels, n):
+            return lax.fori_loop(
+                0, n, lambda _, s: train_step_impl(s, images, labels)[0], state
+            )
+
+        @jax.jit
+        def eval_loss(state, images, labels):
+            return _loss(
+                state.apply_fn, state.params, state.batch_stats, images, labels
+            )[0]
+
+        # Correctness oracle: loss after `steps` SGD steps must be finite
+        # and strictly below the initial loss.
+        loss_first = float(eval_loss(state, images, labels))
+        state = train_n(state, images, labels, steps)
+        loss_last = float(eval_loss(state, images, labels))
+        losses = [loss_first, loss_last]
+
+        # Differential timing (as in smoke/matmul.py): median T(4N) - median
+        # T(N) cancels constant dispatch + readback overhead, leaving 3N
+        # steps of pure device time. Sync via a host readback of state.step
+        # (data-dependent on the whole chain) — on the tunnel backend
+        # block_until_ready can return before work retires.
+        import statistics
+
+        def _timed(n: int, reps: int = 3) -> float:
+            nonlocal state
+            state = train_n(state, images, labels, n)
+            int(state.step)
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                state = train_n(state, images, labels, n)
+                int(state.step)
+                times.append(time.perf_counter() - t0)
+            return statistics.median(times)
+
+        diff = _timed(4 * steps) - _timed(steps)
+        timing_valid = diff > 0
+        dt = diff / (3 * steps) if timing_valid else None
 
     # FLOPs from the compiled executable when XLA reports them, else the
     # textbook 4.1 GFLOPs/image fwd x3 for fwd+bwd.
     try:
-        flops = (
-            jax.jit(train_step, donate_argnums=())
-            .lower(state, images, labels)
-            .compile()
-            .cost_analysis()["flops"]
-        )
+        lowered = jax.jit(train_step_impl).lower(state, images, labels)
+        try:
+            flops = lowered.cost_analysis()["flops"]
+        except (KeyError, TypeError, NotImplementedError):
+            flops = lowered.compile().cost_analysis()["flops"]
     except Exception:  # noqa: BLE001 - cost analysis is best-effort
         per_image = 4.1e9 if size == "resnet50" else 5e7
         flops = 3 * per_image * batch
 
-    mfu = flops / dt / (_peak_flops_per_device() * n_dev) if backend == "tpu" else 0.0
+    mfu = (
+        flops / dt / (_peak_flops_per_device() * n_dev)
+        if backend == "tpu" and timing_valid
+        else 0.0
+    )
     finite = all(l == l and abs(l) != float("inf") for l in losses)
     decreasing = losses[-1] < losses[0]
     return {
@@ -132,8 +180,9 @@ def run(size: str | None = None, batch: int | None = None, steps: int = 6,
         "backend": backend,
         "devices": n_dev,
         "batch": batch,
-        "seconds_per_step": round(dt, 4),
-        "images_per_sec": round(batch / dt, 1),
+        "timing_valid": bool(timing_valid),
+        "seconds_per_step": round(dt, 4) if timing_valid else None,
+        "images_per_sec": round(batch / dt, 1) if timing_valid else None,
         "mfu": round(mfu, 4),
         "loss_first": round(losses[0], 4),
         "loss_last": round(losses[-1], 4),
