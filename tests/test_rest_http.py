@@ -1,0 +1,216 @@
+"""HTTP-level tests of the stdlib REST client (kubeclient/rest.py).
+
+Everything else in the suite exercises the control plane against the
+in-process FakeKube; these tests put a real HTTP apiserver mock behind
+``RestKube`` so the wire layer itself is covered: URL/query construction,
+bearer-token header, merge-patch bodies, selector pass-through, HTTPError →
+KubeApiError mapping, and the streaming JSON-lines watch protocol
+(chunked transfer, server-side close on timeout) that the reference consumed
+via ``watch.Watch().stream`` (reference main.py:622-632).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from tpu_cc_manager.kubeclient.api import KubeApiError
+from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+NODE = "node-a"
+
+
+class _MockApiserver:
+    """Minimal nodes/pods/watch apiserver over stdlib http.server."""
+
+    def __init__(self):
+        self.node = {
+            "kind": "Node",
+            "metadata": {"name": NODE, "resourceVersion": "1", "labels": {}},
+        }
+        self.pods = [
+            {"metadata": {"name": "p1", "labels": {"app": "x"}},
+             "spec": {"nodeName": NODE}},
+            {"metadata": {"name": "p2", "labels": {"app": "y"}},
+             "spec": {"nodeName": "other"}},
+        ]
+        # Recorded for assertions.
+        self.requests: list[dict] = []
+        # Events served to the next watch request, then the stream closes.
+        self.watch_events: list[dict] = []
+
+        state = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: D102 - silence
+                pass
+
+            def _record(self, body=None):
+                state.requests.append({
+                    "method": self.command,
+                    "path": urlparse(self.path).path,
+                    "query": parse_qs(urlparse(self.path).query),
+                    "headers": dict(self.headers),
+                    "body": body,
+                })
+
+            def _json(self, obj, code=200):
+                raw = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                self._record()
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                if u.path == f"/api/v1/nodes/{NODE}":
+                    return self._json(state.node)
+                if u.path.startswith("/api/v1/nodes/"):
+                    return self._json(
+                        {"kind": "Status", "code": 404, "message": "nope"}, 404
+                    )
+                if u.path == "/api/v1/nodes" and q.get("watch") == ["true"]:
+                    # Chunked JSON-lines stream: emit queued events, close.
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    for ev in state.watch_events:
+                        data = (json.dumps(ev) + "\n").encode()
+                        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                    return None
+                if u.path == "/api/v1/nodes":
+                    return self._json({"kind": "NodeList", "items": [state.node]})
+                if u.path.endswith("/pods"):
+                    items = list(state.pods)
+                    sel = q.get("labelSelector", [None])[0]
+                    if sel:
+                        k, v = sel.split("=", 1)
+                        items = [p for p in items
+                                 if p["metadata"]["labels"].get(k) == v]
+                    fsel = q.get("fieldSelector", [None])[0]
+                    if fsel and fsel.startswith("spec.nodeName="):
+                        want = fsel.split("=", 1)[1]
+                        items = [p for p in items
+                                 if p["spec"]["nodeName"] == want]
+                    return self._json({"kind": "PodList", "items": items})
+                return self._json({"kind": "Status", "code": 404}, 404)
+
+            def do_PATCH(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                self._record(body)
+                if urlparse(self.path).path == f"/api/v1/nodes/{NODE}":
+                    for k, v in (body.get("metadata", {}).get("labels") or {}).items():
+                        if v is None:
+                            state.node["metadata"]["labels"].pop(k, None)
+                        else:
+                            state.node["metadata"]["labels"][k] = v
+                    return self._json(state.node)
+                return self._json({"kind": "Status", "code": 404}, 404)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def apiserver():
+    srv = _MockApiserver()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def client(apiserver):
+    return RestKube(ClusterConfig(server=apiserver.url, token="sekret"))
+
+
+def test_get_node_and_bearer_token(apiserver, client):
+    node = client.get_node(NODE)
+    assert node["metadata"]["name"] == NODE
+    auth = apiserver.requests[-1]["headers"].get("Authorization")
+    assert auth == "Bearer sekret"
+
+
+def test_get_unknown_node_maps_to_kube_api_error(client):
+    with pytest.raises(KubeApiError) as exc:
+        client.get_node("ghost")
+    assert exc.value.status == 404
+
+
+def test_patch_node_labels_is_merge_patch(apiserver, client):
+    client.patch_node_labels(NODE, {"a": "1", "gone": None})
+    req = apiserver.requests[-1]
+    assert req["method"] == "PATCH"
+    assert req["headers"].get("Content-Type") == "application/merge-patch+json"
+    # Only metadata.labels in the body — never a full read-modify-write of
+    # the node object (reference bug, SURVEY.md §8.3).
+    assert req["body"] == {"metadata": {"labels": {"a": "1", "gone": None}}}
+    assert apiserver.node["metadata"]["labels"] == {"a": "1"}
+
+
+def test_list_pods_passes_selectors(apiserver, client):
+    pods = client.list_pods("ns", label_selector="app=x",
+                            field_selector=f"spec.nodeName={NODE}")
+    assert [p["metadata"]["name"] for p in pods] == ["p1"]
+    q = apiserver.requests[-1]["query"]
+    assert q["labelSelector"] == ["app=x"]
+    assert q["fieldSelector"] == [f"spec.nodeName={NODE}"]
+
+
+def test_list_nodes(client):
+    nodes = client.list_nodes()
+    assert [n["metadata"]["name"] for n in nodes] == [NODE]
+
+
+def test_watch_streams_json_lines_until_server_close(apiserver, client):
+    apiserver.watch_events = [
+        {"type": "ADDED", "object": {"metadata": {"name": NODE,
+                                                  "resourceVersion": "2"}}},
+        {"type": "MODIFIED", "object": {"metadata": {"name": NODE,
+                                                     "resourceVersion": "3"}}},
+    ]
+    events = list(client.watch_nodes(NODE, resource_version="1",
+                                     timeout_seconds=5))
+    assert [e.type for e in events] == ["ADDED", "MODIFIED"]
+    assert events[-1].object["metadata"]["resourceVersion"] == "3"
+    q = apiserver.requests[-1]["query"]
+    assert q["fieldSelector"] == [f"metadata.name={NODE}"]
+    assert q["timeoutSeconds"] == ["5"]
+    assert q["resourceVersion"] == ["1"]
+
+
+def test_watch_bad_frame_raises(apiserver):
+    import io
+
+    bad = RestKube(ClusterConfig(server=apiserver.url))
+    bad._open = lambda *a, **kw: io.BytesIO(b"not-json\n")  # type: ignore[method-assign]
+    with pytest.raises(KubeApiError):
+        list(bad.watch_nodes(NODE))
+
+
+def test_connection_refused_maps_to_kube_api_error():
+    client = RestKube(ClusterConfig(server="http://127.0.0.1:1"))
+    with pytest.raises(KubeApiError) as exc:
+        client.get_node(NODE)
+    assert exc.value.status is None
