@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Local end-to-end demo: the real agent against hack/mock_apiserver.py with
+# the fake TPU backend. Shows the full drain -> stage/reset -> attest ->
+# smoke -> re-admit cycle on a laptop (no cluster, no TPU).
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+PORT="${PORT:-18080}"
+METRICS_PORT="${METRICS_PORT:-19090}"
+WORK="$(mktemp -d)"
+PIDS=()
+trap 'kill "${PIDS[@]}" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+
+cat > "$WORK/kubeconfig.yaml" <<EOF
+apiVersion: v1
+kind: Config
+clusters:
+- cluster: {server: "http://127.0.0.1:$PORT"}
+  name: mock
+contexts:
+- context: {cluster: mock, user: mock}
+  name: mock
+current-context: mock
+users:
+- name: mock
+  user: {}
+EOF
+
+echo ">>> starting mock apiserver on :$PORT"
+PYTHONPATH="$REPO_ROOT" python "$REPO_ROOT/hack/mock_apiserver.py" "$PORT" &
+PIDS+=($!)
+sleep 1
+
+echo ">>> starting tpu-cc-manager (fake backend, CPU smoke)"
+NODE_NAME=demo-node-0 \
+KUBECONFIG="$WORK/kubeconfig.yaml" \
+JAX_PLATFORMS=cpu \
+CC_READINESS_FILE="$WORK/readiness" \
+OPERATOR_NAMESPACE=tpu-operator \
+PYTHONPATH="$REPO_ROOT" \
+python -m tpu_cc_manager --tpu-backend fake --smoke-workload matmul \
+  --debug --metrics-port "$METRICS_PORT" &
+PIDS+=($!)
+sleep 5
+
+echo ">>> desired mode -> on"
+curl -fsS -X POST "localhost:$PORT/_ctl/set-label" \
+  -d '{"key":"cloud.google.com/tpu-cc.mode","value":"on"}' > /dev/null
+
+for _ in $(seq 1 60); do
+  state=$(curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' |
+    python -c 'import json,sys; print(json.load(sys.stdin)["labels"].get("cloud.google.com/tpu-cc.mode.state",""))')
+  [ "$state" = on ] && break
+  sleep 2
+done
+
+echo ">>> node state:"
+curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' | python -m json.tool
+echo ">>> phase metrics:"
+curl -fsS "localhost:$METRICS_PORT/metrics" | grep -E '^tpu_cc_(phase|reconcile)'
+[ "$state" = on ] && echo ">>> demo OK" || { echo ">>> demo FAILED"; exit 1; }

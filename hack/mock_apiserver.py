@@ -1,0 +1,215 @@
+"""Localhost mock apiserver speaking the 4 verbs the agent uses.
+
+For the local demo (`hack/demo_local.sh`) and manual end-to-end
+verification on machines without kind/kubectl: node GET/PATCH (merge-patch
+on metadata.labels), pod LIST with selectors, node WATCH as chunked JSON
+lines. Includes an "operator reaction" thread — the external behavior the
+drain protocol relies on (SURVEY.md §5): deletes component pods ~0.5 s
+after their google.com/tpu.deploy.* label becomes paused, restores them on
+unpause. Control endpoints (not part of k8s): POST /_ctl/set-label,
+POST /_ctl/state.
+"""
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+try:
+    from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS as COMPONENTS
+except ImportError:  # standalone use without the package on sys.path
+    COMPONENTS = {
+        "google.com/tpu.deploy.device-plugin": "tpu-device-plugin",
+        "google.com/tpu.deploy.dra-driver": "tpu-dra-driver",
+        "google.com/tpu.deploy.metrics-agent": "tpu-metrics-agent",
+        "google.com/tpu.deploy.sandbox-validator": "tpu-sandbox-validator",
+        "google.com/tpu.deploy.workload-validator": "tpu-workload-validator",
+    }
+
+NODE = "demo-node-0"
+NS = "tpu-operator"
+
+lock = threading.Lock()
+rv = [1]
+node = {
+    "kind": "Node",
+    "apiVersion": "v1",
+    "metadata": {
+        "name": NODE,
+        "resourceVersion": "1",
+        "labels": {k: "true" for k in COMPONENTS},
+    },
+}
+pods = {}  # name -> pod dict
+for key, app in COMPONENTS.items():
+    pods[f"{app}-pod"] = {
+        "metadata": {"name": f"{app}-pod", "namespace": NS, "labels": {"app": app}},
+        "spec": {"nodeName": NODE},
+        "status": {"phase": "Running"},
+    }
+
+watchers = []  # list of (wfile, condition) — simplistic: each watcher gets events pushed
+
+
+def bump_rv():
+    rv[0] += 1
+    node["metadata"]["resourceVersion"] = str(rv[0])
+
+
+def emit_watch_event():
+    ev = json.dumps({"type": "MODIFIED", "object": node}) + "\n"
+    dead = []
+    for wf in watchers:
+        try:
+            wf.write(ev.encode())
+            wf.flush()
+        except Exception:
+            dead.append(wf)
+    for wf in dead:
+        watchers.remove(wf)
+
+
+def is_paused(v):
+    return v is not None and "paused-for" in v
+
+
+def operator_reactor():
+    """Delete component pods shortly after their deploy label pauses; restore
+    them when unpaused."""
+    while True:
+        time.sleep(0.5)
+        with lock:
+            labels = node["metadata"]["labels"]
+            for key, app in COMPONENTS.items():
+                name = f"{app}-pod"
+                if is_paused(labels.get(key)):
+                    pods.pop(name, None)
+                elif labels.get(key) == "true" and name not in pods:
+                    pods[name] = {
+                        "metadata": {"name": name, "namespace": NS,
+                                     "labels": {"app": app}},
+                        "spec": {"nodeName": NODE},
+                        "status": {"phase": "Running"},
+                    }
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        q = parse_qs(u.query)
+        if u.path == f"/api/v1/nodes/{NODE}":
+            with lock:
+                return self._json(node)
+        if u.path == "/api/v1/nodes" and q.get("watch") == ["true"]:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            class ChunkWriter:
+                def __init__(self, raw):
+                    self.raw = raw
+
+                def write(self, data):
+                    self.raw.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                    return len(data)
+
+                def flush(self):
+                    self.raw.flush()
+
+            cw = ChunkWriter(self.wfile)
+            with lock:
+                ev = json.dumps({"type": "ADDED", "object": node}) + "\n"
+                cw.write(ev.encode())
+                cw.flush()
+                watchers.append(cw)
+            # Hold the connection open; events pushed by emit_watch_event.
+            timeout = float(q.get("timeoutSeconds", ["300"])[0])
+            time.sleep(timeout)
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                pass
+            with lock:
+                if cw in watchers:
+                    watchers.remove(cw)
+            return
+        if u.path == "/api/v1/nodes":
+            with lock:
+                return self._json({"kind": "NodeList",
+                                   "items": [node],
+                                   "metadata": {"resourceVersion": str(rv[0])}})
+        if u.path == f"/api/v1/namespaces/{NS}/pods":
+            sel = q.get("labelSelector", [None])[0]
+            fsel = q.get("fieldSelector", [None])[0]
+            with lock:
+                items = list(pods.values())
+            if sel:
+                m = re.match(r"^([^=]+)=(.+)$", sel)
+                k, v = m.group(1), m.group(2)
+                items = [p for p in items if p["metadata"]["labels"].get(k) == v]
+            if fsel:
+                m = re.match(r"^spec\.nodeName=(.+)$", fsel)
+                if m:
+                    items = [p for p in items if p["spec"]["nodeName"] == m.group(1)]
+            return self._json({"kind": "PodList", "items": items})
+        self._json({"kind": "Status", "code": 404, "message": "not found"}, 404)
+
+    def do_PATCH(self):
+        u = urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        if u.path == f"/api/v1/nodes/{NODE}":
+            with lock:
+                patch_labels = (body.get("metadata") or {}).get("labels") or {}
+                for k, v in patch_labels.items():
+                    if v is None:
+                        node["metadata"]["labels"].pop(k, None)
+                    else:
+                        node["metadata"]["labels"][k] = v
+                bump_rv()
+                emit_watch_event()
+                return self._json(node)
+        self._json({"kind": "Status", "code": 404}, 404)
+
+    def do_POST(self):
+        u = urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        if u.path == "/_ctl/set-label":
+            with lock:
+                if body.get("value") is None:
+                    node["metadata"]["labels"].pop(body["key"], None)
+                else:
+                    node["metadata"]["labels"][body["key"]] = body["value"]
+                bump_rv()
+                emit_watch_event()
+                return self._json({"ok": True, "labels": node["metadata"]["labels"]})
+        if u.path == "/_ctl/state":
+            with lock:
+                return self._json({"labels": node["metadata"]["labels"],
+                                   "pods": sorted(pods)})
+        self._json({"kind": "Status", "code": 404}, 404)
+
+
+if __name__ == "__main__":
+    import sys
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 18080
+    threading.Thread(target=operator_reactor, daemon=True).start()
+    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    print(f"mock apiserver on :{port}", flush=True)
+    srv.serve_forever()

@@ -14,6 +14,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="problem-size override: an integer for matmul, a "
                    "named config for llama/resnet (e.g. tiny, 500m, "
                    "llama2-7b, resnet50)")
+    p.add_argument("--kernel", default=None, choices=["xla", "pallas"],
+                   help="matmul only: 'pallas' runs the Mosaic tiled kernel "
+                   "(ops/matmul.py) to prove custom-kernel compilation on a "
+                   "reconfigured slice")
     args = p.parse_args(argv)
 
     # Before any jax import: persistent XLA cache makes every verify run
@@ -27,6 +31,8 @@ def main(argv: list[str] | None = None) -> int:
     kwargs = {}
     if args.size is not None:
         kwargs["size"] = int(args.size) if args.size.isdigit() else args.size
+    if args.kernel is not None:
+        kwargs["kernel"] = args.kernel
     try:
         result = run_workload(args.workload, **kwargs)
     except SmokeError as e:
