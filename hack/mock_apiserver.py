@@ -58,16 +58,27 @@ def bump_rv():
 
 
 def emit_watch_event():
-    ev = json.dumps({"type": "MODIFIED", "object": node}) + "\n"
-    dead = []
-    for wf in watchers:
-        try:
-            wf.write(ev.encode())
-            wf.flush()
-        except Exception:
-            dead.append(wf)
-    for wf in dead:
-        watchers.remove(wf)
+    """Serialize under the caller's lock, write OUTSIDE it: a stalled
+    watch client (TCP backpressure, suspended agent) must not wedge every
+    other endpoint by blocking sendall while the lock is held."""
+    ev = (json.dumps({"type": "MODIFIED", "object": node}) + "\n").encode()
+    targets = list(watchers)
+
+    def deliver():
+        dead = []
+        for wf in targets:
+            try:
+                wf.write(ev)
+                wf.flush()
+            except Exception:
+                dead.append(wf)
+        if dead:
+            with lock:
+                for wf in dead:
+                    if wf in watchers:
+                        watchers.remove(wf)
+
+    threading.Thread(target=deliver, daemon=True).start()
 
 
 def is_paused(v):

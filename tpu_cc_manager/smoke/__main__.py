@@ -32,6 +32,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.size is not None:
         kwargs["size"] = int(args.size) if args.size.isdigit() else args.size
     if args.kernel is not None:
+        if args.workload != "matmul":
+            print(json.dumps({
+                "ok": False, "workload": args.workload,
+                "error": "--kernel only applies to the matmul workload",
+            }))
+            return 1
         kwargs["kernel"] = args.kernel
     try:
         result = run_workload(args.workload, **kwargs)
