@@ -10,6 +10,7 @@ unpause. Control endpoints (not part of k8s): POST /_ctl/set-label,
 POST /_ctl/state.
 """
 import json
+import queue
 import re
 import threading
 import time
@@ -57,14 +58,22 @@ def bump_rv():
     node["metadata"]["resourceVersion"] = str(rv[0])
 
 
-def emit_watch_event():
-    """Serialize under the caller's lock, write OUTSIDE it: a stalled
-    watch client (TCP backpressure, suspended agent) must not wedge every
-    other endpoint by blocking sendall while the lock is held."""
-    ev = (json.dumps({"type": "MODIFIED", "object": node}) + "\n").encode()
-    targets = list(watchers)
+_event_queue: "queue.Queue[bytes]" = queue.Queue()
 
-    def deliver():
+
+def emit_watch_event():
+    """Serialize under the caller's lock, enqueue for the single writer
+    thread: writes happen OUTSIDE the lock (a stalled watch client must
+    not wedge the other endpoints by blocking sendall while holding it),
+    and one writer preserves both frame integrity and event ordering."""
+    _event_queue.put((json.dumps({"type": "MODIFIED", "object": node}) + "\n").encode())
+
+
+def _watch_writer():
+    while True:
+        ev = _event_queue.get()
+        with lock:
+            targets = list(watchers)
         dead = []
         for wf in targets:
             try:
@@ -77,8 +86,6 @@ def emit_watch_event():
                 for wf in dead:
                     if wf in watchers:
                         watchers.remove(wf)
-
-    threading.Thread(target=deliver, daemon=True).start()
 
 
 def is_paused(v):
@@ -221,6 +228,7 @@ if __name__ == "__main__":
     import sys
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 18080
     threading.Thread(target=operator_reactor, daemon=True).start()
+    threading.Thread(target=_watch_writer, daemon=True).start()
     srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     print(f"mock apiserver on :{port}", flush=True)
     srv.serve_forever()

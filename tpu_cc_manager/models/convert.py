@@ -90,9 +90,16 @@ def hf_state_dict_to_params(
     """
     sd = {k: _to_numpy(v) for k, v in state_dict.items()}
     L = cfg.n_layers
+    # Store in the config's parameter dtype: the bf16 inference path's
+    # footprint/bandwidth win must survive real-checkpoint loading, not
+    # just random init. (ml_dtypes, pulled in by jax, teaches numpy about
+    # bfloat16.)
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(cfg.param_dtype)
 
     def proj(i: int, name: str) -> np.ndarray:
-        return sd[f"model.layers.{i}.{name}.weight"].T.astype(np.float32)
+        return sd[f"model.layers.{i}.{name}.weight"].T.astype(dtype)
 
     def stack(name: str) -> np.ndarray:
         return np.stack([proj(i, name) for i in range(L)], axis=0)
@@ -100,19 +107,19 @@ def hf_state_dict_to_params(
     def stack_norm(name: str) -> np.ndarray:
         return np.stack(
             [
-                sd[f"model.layers.{i}.{name}.weight"].astype(np.float32)
+                sd[f"model.layers.{i}.{name}.weight"].astype(dtype)
                 for i in range(L)
             ],
             axis=0,
         )
 
-    embed = sd["model.embed_tokens.weight"].astype(np.float32)
+    embed = sd["model.embed_tokens.weight"].astype(dtype)
     # Tied embeddings (Llama-3.2 style) fall back to the input embedding.
     lm_head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
     params = {
         "embedding": embed,
-        "lm_head": lm_head.T.astype(np.float32),
-        "final_norm": {"scale": sd["model.norm.weight"].astype(np.float32)},
+        "lm_head": lm_head.T.astype(dtype),
+        "final_norm": {"scale": sd["model.norm.weight"].astype(dtype)},
         "blocks": {
             "attn": {
                 "wq": {"kernel": stack("self_attn.q_proj")},

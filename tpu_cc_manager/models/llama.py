@@ -45,6 +45,11 @@ class LlamaConfig:
     rope_scaling: tuple[float, float, float, int] | None = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # Parameter storage dtype. f32 for training (optimizer-grade master
+    # weights); bf16 for inference, where decode is HBM-bandwidth-bound on
+    # reading the weights each step — bf16 params double tokens/s and halve
+    # the footprint (what fits a 7B model on one chip).
+    param_dtype: Any = jnp.float32
     remat: bool = False
     # Use the pallas flash-attention kernel (ops/flash_attention.py) on the
     # no-cache (training/prefill) path; the cached decode path always uses
@@ -94,6 +99,7 @@ class LlamaConfig:
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -101,11 +107,11 @@ class RMSNorm(nn.Module):
             "scale",
             nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
             (x.shape[-1],),
-            jnp.float32,
+            self.param_dtype,
         )
         x32 = x.astype(jnp.float32)
         normed = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
-        return (normed * scale).astype(self.dtype)
+        return (normed * scale.astype(jnp.float32)).astype(self.dtype)
 
 
 def rope_frequencies(
@@ -147,12 +153,12 @@ def apply_rope(x: jnp.ndarray, phases: jnp.ndarray) -> jnp.ndarray:
     return out.astype(x.dtype)
 
 
-def _dense(features: int, axes: tuple[str, str], dtype, name: str):
+def _dense(features: int, axes: tuple[str, str], cfg: "LlamaConfig", name: str):
     return nn.Dense(
         features,
         use_bias=False,
-        dtype=dtype,
-        param_dtype=jnp.float32,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.normal(stddev=0.02), axes
         ),
@@ -169,9 +175,9 @@ class Attention(nn.Module):
         B, S, _ = x.shape
         H, KV, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-        q = _dense(H * D, ("embed", "heads"), cfg.dtype, "wq")(x).reshape(B, S, H, D)
-        k = _dense(KV * D, ("embed", "kv_heads"), cfg.dtype, "wk")(x).reshape(B, S, KV, D)
-        v = _dense(KV * D, ("embed", "kv_heads"), cfg.dtype, "wv")(x).reshape(B, S, KV, D)
+        q = _dense(H * D, ("embed", "heads"), cfg, "wq")(x).reshape(B, S, H, D)
+        k = _dense(KV * D, ("embed", "kv_heads"), cfg, "wk")(x).reshape(B, S, KV, D)
+        v = _dense(KV * D, ("embed", "kv_heads"), cfg, "wv")(x).reshape(B, S, KV, D)
 
         q = apply_rope(q, phases)
         k = apply_rope(k, phases)
@@ -198,7 +204,7 @@ class Attention(nn.Module):
             vf = jnp.repeat(v, H // KV, axis=2).transpose(0, 2, 1, 3)
             out = flash_attention(qf, kf, vf).transpose(0, 2, 1, 3)
             out = out.reshape(B, S, H * D).astype(cfg.dtype)
-            return _dense(cfg.dim, ("heads", "embed"), cfg.dtype, "wo")(out), None
+            return _dense(cfg.dim, ("heads", "embed"), cfg, "wo")(out), None
 
         # GQA: fold heads into (kv groups, group size) so the contraction
         # stays one big einsum on the MXU.
@@ -211,7 +217,7 @@ class Attention(nn.Module):
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
         out = out.reshape(B, S, H * D)
-        return _dense(cfg.dim, ("heads", "embed"), cfg.dtype, "wo")(out), layer_cache
+        return _dense(cfg.dim, ("heads", "embed"), cfg, "wo")(out), layer_cache
 
 
 class MLP(nn.Module):
@@ -220,9 +226,9 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        gate = _dense(cfg.hidden_dim, ("embed", "mlp"), cfg.dtype, "w_gate")(x)
-        up = _dense(cfg.hidden_dim, ("embed", "mlp"), cfg.dtype, "w_up")(x)
-        return _dense(cfg.dim, ("mlp", "embed"), cfg.dtype, "w_down")(
+        gate = _dense(cfg.hidden_dim, ("embed", "mlp"), cfg, "w_gate")(x)
+        up = _dense(cfg.hidden_dim, ("embed", "mlp"), cfg, "w_up")(x)
+        return _dense(cfg.dim, ("mlp", "embed"), cfg, "w_down")(
             nn.silu(gate) * up
         )
 
@@ -237,12 +243,12 @@ class DecoderBlock(nn.Module):
     def __call__(self, carry, layer_cache):
         x, phases, mask, position = carry
         h, layer_cache = Attention(self.cfg, name="attn")(
-            RMSNorm(self.cfg.norm_eps, self.cfg.dtype, name="attn_norm")(x),
+            RMSNorm(self.cfg.norm_eps, self.cfg.dtype, self.cfg.param_dtype, name="attn_norm")(x),
             phases, mask, layer_cache, position,
         )
         x = x + h
         x = x + MLP(self.cfg, name="mlp")(
-            RMSNorm(self.cfg.norm_eps, self.cfg.dtype, name="mlp_norm")(x)
+            RMSNorm(self.cfg.norm_eps, self.cfg.dtype, self.cfg.param_dtype, name="mlp_norm")(x)
         )
         return (x, phases, mask, position), layer_cache
 
@@ -263,7 +269,7 @@ class LlamaModel(nn.Module):
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")
             ),
             (cfg.vocab_size, cfg.dim),
-            jnp.float32,
+            cfg.param_dtype,
         )
         x = embed[tokens].astype(cfg.dtype)
 
@@ -306,16 +312,18 @@ class LlamaModel(nn.Module):
         xs = None if cache is None else cache
         (x, _, _, _), new_cache = scan_block(cfg, name="blocks")(carry, xs)
 
-        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name="final_norm")(x)
         lm_head = self.param(
             "lm_head",
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ("embed", "vocab")
             ),
             (cfg.dim, cfg.vocab_size),
-            jnp.float32,
+            cfg.param_dtype,
         )
-        logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), lm_head)
+        logits = jnp.einsum(
+            "bsd,dv->bsv", x.astype(jnp.float32), lm_head.astype(jnp.float32)
+        )
         return logits, new_cache
 
     # ---- cache helpers ----------------------------------------------------

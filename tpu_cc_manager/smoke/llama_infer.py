@@ -21,9 +21,10 @@ import time
 
 
 def _pick_config(size: str | None):
-    from tpu_cc_manager.models.llama import LlamaConfig
-
     import jax
+    import jax.numpy as jnp
+
+    from tpu_cc_manager.models.llama import LlamaConfig
 
     if size is None:
         size = "tiny" if jax.default_backend() == "cpu" else "500m"
@@ -35,7 +36,10 @@ def _pick_config(size: str | None):
     }
     if size not in table:
         raise ValueError(f"unknown llama smoke size {size!r} (have {sorted(table)})")
-    return size, table[size]()
+    # Inference-only workload: bf16 parameter storage. Decode reads every
+    # weight every step, so tokens/s is bounded by param bytes — bf16
+    # doubles it and is what fits the 7B configs on one chip.
+    return size, table[size](param_dtype=jnp.bfloat16)
 
 
 def run(
