@@ -26,6 +26,28 @@ import sys
 import time
 
 
+def _tpu_preflight(timeout_s: float = 90.0) -> bool:
+    """Probe the accelerator OUTSIDE the timed region.
+
+    A wedged TPU transport hangs dispatches without erroring; discovering
+    that inside the timed reconcile would charge the hang + CPU retry to
+    the drain→ready metric. Probe in a child process first and pin the
+    smoke to CPU when the chip isn't usable.
+    """
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "print(float(jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128)))))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True,
+            timeout=timeout_s, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0
+
+
 def _smoke_subprocess(workload: str, timeout_s: float, force_cpu: bool) -> dict:
     env = dict(os.environ)
     if force_cpu:
@@ -87,13 +109,16 @@ def main() -> int:
 
     backend_used = {"backend": "unknown"}
     smoke_detail = {}
+    tpu_usable = _tpu_preflight()
 
     def smoke_runner(workload: str) -> dict:
         try:
-            result = _smoke_subprocess(workload, timeout_s=240.0, force_cpu=False)
+            result = _smoke_subprocess(
+                workload, timeout_s=240.0, force_cpu=not tpu_usable
+            )
         except (RuntimeError, subprocess.TimeoutExpired):
-            # TPU tunnel unavailable/wedged: fall back to CPU so the bench
-            # still measures the pipeline end-to-end.
+            # Chip passed preflight but failed mid-run: fall back to CPU so
+            # the bench still measures the pipeline end-to-end.
             result = _smoke_subprocess(workload, timeout_s=240.0, force_cpu=True)
         backend_used["backend"] = result.get("backend", "?")
         smoke_detail.update(result)

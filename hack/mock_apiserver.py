@@ -149,6 +149,10 @@ class Handler(BaseHTTPRequestHandler):
                 def flush(self):
                     self.raw.flush()
 
+            # Bound writes to this watcher: a stalled client (full TCP
+            # buffer) must raise, get dropped by the writer thread, and
+            # never block delivery to the healthy watchers.
+            self.connection.settimeout(10.0)
             cw = ChunkWriter(self.wfile)
             with lock:
                 ev = json.dumps({"type": "ADDED", "object": node}) + "\n"

@@ -54,3 +54,18 @@ def test_subprocess_runner_matmul():
     # never holds the TPU.
     result = runner.run_workload_subprocess("matmul", timeout_s=300)
     assert result["ok"] is True
+
+
+def test_llama_size_table_includes_all_family_members():
+    from tpu_cc_manager.smoke.llama_infer import _pick_config
+
+    for size in ("tiny", "500m", "llama2-7b", "llama3-8b", "llama3.1-8b"):
+        got, cfg = _pick_config(size)
+        assert got == size
+        import jax.numpy as jnp
+
+        assert cfg.param_dtype == jnp.bfloat16  # inference storage dtype
+    _, cfg31 = _pick_config("llama3.1-8b")
+    assert cfg31.rope_scaling == (8.0, 1.0, 4.0, 8192)
+    with pytest.raises(ValueError):
+        _pick_config("gpt5")

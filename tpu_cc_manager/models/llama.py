@@ -74,6 +74,13 @@ class LlamaConfig:
                              rope_theta=500000.0), **kw})
 
     @classmethod
+    def llama3_1_8b(cls, **kw) -> "LlamaConfig":
+        """Llama-3.1-8B: the 3.0 geometry with 128k context via the
+        llama3 rope-scaling recipe (factor 8 over the 8192 base)."""
+        return cls.llama3_8b(**{**dict(max_seq_len=131072,
+                                       rope_scaling=(8.0, 1.0, 4.0, 8192)), **kw})
+
+    @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
         """CI/test config: ~1M params, same code paths."""
         return cls(**{**dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
@@ -321,8 +328,15 @@ class LlamaModel(nn.Module):
             (cfg.dim, cfg.vocab_size),
             cfg.param_dtype,
         )
+        # bf16 params keep bf16 operands (MXU-native, half the bandwidth)
+        # with f32 accumulation; f32 master weights keep the full-f32
+        # contraction of the training recipe.
+        mm_dtype = cfg.dtype if cfg.param_dtype == cfg.dtype else jnp.float32
         logits = jnp.einsum(
-            "bsd,dv->bsv", x.astype(jnp.float32), lm_head.astype(jnp.float32)
+            "bsd,dv->bsv",
+            x.astype(mm_dtype),
+            lm_head.astype(mm_dtype),
+            preferred_element_type=jnp.float32,
         )
         return logits, new_cache
 

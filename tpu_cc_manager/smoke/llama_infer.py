@@ -33,6 +33,7 @@ def _pick_config(size: str | None):
         "500m": LlamaConfig.smoke_500m,
         "llama2-7b": LlamaConfig.llama2_7b,
         "llama3-8b": LlamaConfig.llama3_8b,
+        "llama3.1-8b": LlamaConfig.llama3_1_8b,
     }
     if size not in table:
         raise ValueError(f"unknown llama smoke size {size!r} (have {sorted(table)})")
