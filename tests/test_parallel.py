@@ -17,7 +17,7 @@ from tpu_cc_manager.parallel.train import (
 
 
 def test_mesh_spec_resolution():
-    assert MeshSpec(dp=-1, tp=2).resolve(8) == {"dcn": 1, "dp": 4, "fsdp": 1, "tp": 2}
+    assert MeshSpec(dp=-1, tp=2).resolve(8) == {"dcn": 1, "dp": 4, "fsdp": 1, "sp": 1, "tp": 2}
     assert MeshSpec(dcn=2, dp=2, fsdp=1, tp=2).resolve(8)["dp"] == 2
     with pytest.raises(ValueError):
         MeshSpec(dp=3, tp=3).resolve(8)
@@ -25,12 +25,12 @@ def test_mesh_spec_resolution():
 
 def test_default_spec():
     assert default_spec_for(8).resolve(8)["tp"] == 4
-    assert default_spec_for(1).resolve(1) == {"dcn": 1, "dp": 1, "fsdp": 1, "tp": 1}
+    assert default_spec_for(1).resolve(1) == {"dcn": 1, "dp": 1, "fsdp": 1, "sp": 1, "tp": 1}
 
 
 def test_make_mesh_axes():
     mesh = make_mesh(MeshSpec(dp=-1, tp=2))
-    assert mesh.axis_names == ("dcn", "dp", "fsdp", "tp")
+    assert mesh.axis_names == ("dcn", "dp", "fsdp", "sp", "tp")
     assert mesh.shape["tp"] == 2
     assert pad_batch_to(3, mesh) == 4
 

@@ -55,6 +55,14 @@ class LlamaConfig:
     # no-cache (training/prefill) path; the cached decode path always uses
     # the einsum attention (its working set is already small).
     use_flash: bool = False
+    # Long-context sequence/context parallelism: when a mesh is given, the
+    # no-cache (training/prefill) attention runs as ring attention
+    # (ops/ring_attention.py) with the sequence sharded over ``ring_axis``
+    # — K/V shards stream around the ICI ring with ppermute, so no device
+    # ever holds full K/V. The mesh is static module metadata (hashable),
+    # like the dtypes.
+    ring_mesh: Any = None
+    ring_axis: str = "sp"
 
     @property
     def head_dim(self) -> int:
@@ -202,15 +210,31 @@ class Attention(nn.Module):
             k, v = k_buf, v_buf
             layer_cache = (k_buf, v_buf)
 
-        if layer_cache is None and cfg.use_flash:
-            from tpu_cc_manager.ops.flash_attention import flash_attention
-
-            # Kernel layout is (B, H, S, D); GQA via kv-head repetition.
+        if layer_cache is None and (cfg.ring_mesh is not None or cfg.use_flash):
+            # Kernel layout is (B, heads, S, D).
             qf = q.transpose(0, 2, 1, 3)
-            kf = jnp.repeat(k, H // KV, axis=2).transpose(0, 2, 1, 3)
-            vf = jnp.repeat(v, H // KV, axis=2).transpose(0, 2, 1, 3)
-            out = flash_attention(qf, kf, vf).transpose(0, 2, 1, 3)
-            out = out.reshape(B, S, H * D).astype(cfg.dtype)
+            kf = k.transpose(0, 2, 1, 3)
+            vf = v.transpose(0, 2, 1, 3)
+            if cfg.ring_mesh is not None:
+                from tpu_cc_manager.ops.ring_attention import (
+                    ring_attention_in_jit,
+                )
+
+                # Sequence-parallel long-context path: K/V shards stream
+                # around the ring KV-head-shaped (GQA grouping happens
+                # inside the kernel — no H/KV-fold traffic inflation).
+                out = ring_attention_in_jit(
+                    qf, kf, vf, cfg.ring_mesh, cfg.ring_axis
+                )
+            else:
+                from tpu_cc_manager.ops.flash_attention import flash_attention
+
+                # The pallas kernel wants equal head counts: GQA via
+                # kv-head repetition.
+                kf = jnp.repeat(kf, H // KV, axis=1)
+                vf = jnp.repeat(vf, H // KV, axis=1)
+                out = flash_attention(qf, kf, vf)
+            out = out.transpose(0, 2, 1, 3).reshape(B, S, H * D).astype(cfg.dtype)
             return _dense(cfg.dim, ("heads", "embed"), cfg, "wo")(out), None
 
         # GQA: fold heads into (kv groups, group size) so the contraction

@@ -38,21 +38,25 @@ NEG_INF = -1e30
 
 def _block_attn(q, k, v, q_off, k_off, scale):
     """Partial (unnormalized-softmax) attention of a Q shard against one K/V
-    shard with absolute-position causal masking. Returns (m, l, acc)."""
-    s = jnp.einsum("bhsd,bhtd->bhst", q, k, preferred_element_type=jnp.float32)
+    shard with absolute-position causal masking. Returns (m, l, acc).
+
+    Grouped-query layout: q is (B, KV, G, S, D), k/v are (B, KV, T, D) —
+    K/V stay KV-head-shaped (never repeated to full head count), so ring
+    traffic and per-device K/V memory are 1/G of the repeated form."""
+    s = jnp.einsum("bkgsd,bktd->bkgst", q, k, preferred_element_type=jnp.float32)
     s = s * scale
-    Sq, Sk = q.shape[2], k.shape[2]
+    Sq, Sk = q.shape[3], k.shape[2]
     q_pos = q_off + jnp.arange(Sq)
     k_pos = k_off + jnp.arange(Sk)
     mask = k_pos[None, :] <= q_pos[:, None]
-    s = jnp.where(mask[None, None], s, NEG_INF)
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     # Guard fully-masked rows (m == NEG_INF) against exp overflow to nan.
     m_safe = jnp.maximum(m, -1e29)
     p = jnp.exp(s - m_safe)
-    p = jnp.where(mask[None, None], p, 0.0)
+    p = jnp.where(mask[None, None, None], p, 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bhst,bhtd->bhsd", p, v.astype(jnp.float32))
+    acc = jnp.einsum("bkgst,bktd->bkgsd", p, v.astype(jnp.float32))
     return m_safe, l, acc
 
 
@@ -64,29 +68,53 @@ def _merge(m1, l1, acc1, m2, l2, acc2):
     return m, a1 * l1 + a2 * l2, a1 * acc1 + a2 * acc2
 
 
-def ring_attention(
+def ring_attention_in_jit(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     mesh: Mesh,
     axis: str = "dp",
 ) -> jnp.ndarray:
-    """Causal attention with Q/K/V sequence-sharded over ``axis``.
+    """Jit-composable ring attention: no device_put, caller owns placement.
 
-    q/k/v: (B, H, S, D) global shape, S divisible by the axis size.
-    Returns (B, H, S, D) with the same sharding.
+    Safe to call from inside a jitted model forward (shard_map composes
+    with the surrounding pjit; the in_specs act as sharding constraints).
+    q: (B, H, S, D); k/v: (B, KV, S, D) with H divisible by KV (GQA — K/V
+    are streamed KV-head-shaped, never repeated). S divisible by the axis
+    size. Batch rides every mesh axis except ``axis`` and 'tp'; heads ride
+    'tp' when present — so wiring the ring into a dp/fsdp/tp-sharded train
+    step adds no cross-axis regather of activations.
     """
     n = mesh.shape[axis]
     B, H, S, D = q.shape
+    KV = k.shape[1]
     if S % n:
         raise ValueError(f"sequence {S} not divisible by ring size {n}")
+    if H % KV:
+        raise ValueError(f"{H} query heads not divisible by {KV} kv heads")
     shard = S // n
     scale = 1.0 / (D**0.5)
-    seq_sharding = NamedSharding(mesh, P(None, None, axis, None))
+    import math
+
+    # Shapes are static at trace time: drop the batch/head sharding when a
+    # dimension doesn't divide (e.g. the batch-1 init trace) — the math is
+    # identical, just replicated over those axes for that trace.
+    batch_axes = tuple(a for a in mesh.axis_names if a not in (axis, "tp"))
+    if batch_axes and B % math.prod(mesh.shape[a] for a in batch_axes):
+        batch_axes = ()
+    head_axis = "tp" if ("tp" in mesh.axis_names and axis != "tp") else None
+    if head_axis and (KV % mesh.shape["tp"] or H % mesh.shape["tp"]):
+        head_axis = None
+    spec = P(batch_axes or None, head_axis, axis, None)
 
     def local(q, k, v):
         idx = jax.lax.axis_index(axis)
         q_off = idx * shard
+        # Local grouped layout: (B, KV, G, S, D); KV here is the local
+        # (possibly tp-sharded) kv-head count.
+        kv_local = k.shape[1]
+        q = q.reshape(q.shape[0], kv_local, q.shape[1] // kv_local,
+                      q.shape[2], q.shape[3])
 
         m, l, acc = _block_attn(q, k, v, q_off, idx * shard, scale)
 
@@ -119,15 +147,31 @@ def ring_attention(
             return k_cur, v_cur, m, l, acc
 
         _, _, m, l, acc = jax.lax.fori_loop(1, n, body, (k, v, m, l, acc))
-        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        # (B, KV, G, S, D) -> (B, H, S, D)
+        return out.reshape(out.shape[0], -1, out.shape[3], out.shape[4])
 
     mapped = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(None, None, axis, None),) * 3,
-        out_specs=P(None, None, axis, None),
+        in_specs=(spec,) * 3,
+        out_specs=spec,
     )
+    return mapped(q, k, v)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "dp",
+) -> jnp.ndarray:
+    """Standalone entry: places Q/K/V sequence-sharded over ``axis``, then
+    runs :func:`ring_attention_in_jit`. q: (B, H, S, D), k/v: (B, KV, S, D);
+    returns (B, H, S, D), same sharding."""
+    seq_sharding = NamedSharding(mesh, P(None, None, axis, None))
     q = jax.device_put(q, seq_sharding)
     k = jax.device_put(k, seq_sharding)
     v = jax.device_put(v, seq_sharding)
-    return mapped(q, k, v)
+    return ring_attention_in_jit(q, k, v, mesh, axis)

@@ -8,6 +8,9 @@ Axis convention (outermost first):
 - ``fsdp`` parameter sharding over ICI (ZeRO-style); merged into dp-like
   usage — kept as its own axis so weight shards and batch shards can scale
   independently.
+- ``sp``   sequence/context parallelism over ICI (ring attention,
+  ops/ring_attention.py): long sequences sharded across devices, K/V
+  shards streamed with ppermute; size 1 unless running long-context.
 - ``tp``   tensor parallelism (attention heads / MLP) over the fastest ICI
   dimension.
 
@@ -29,7 +32,7 @@ from jax.sharding import Mesh
 
 log = logging.getLogger(__name__)
 
-AXES = ("dcn", "dp", "fsdp", "tp")
+AXES = ("dcn", "dp", "fsdp", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,15 +42,16 @@ class MeshSpec:
     dcn: int = 1
     dp: int = -1
     fsdp: int = 1
+    sp: int = 1
     tp: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
-        fixed = self.dcn * self.fsdp * self.tp
+        fixed = self.dcn * self.fsdp * self.sp * self.tp
         dp = self.dp
         if dp == -1:
             if n_devices % fixed:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by dcn*fsdp*tp={fixed}"
+                    f"{n_devices} devices not divisible by dcn*fsdp*sp*tp={fixed}"
                 )
             dp = n_devices // fixed
         total = fixed * dp
@@ -55,7 +59,7 @@ class MeshSpec:
             raise ValueError(
                 f"mesh {self} needs {total} devices, have {n_devices}"
             )
-        return {"dcn": self.dcn, "dp": dp, "fsdp": self.fsdp, "tp": self.tp}
+        return {"dcn": self.dcn, "dp": dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
 
 
 def make_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
@@ -75,7 +79,7 @@ def make_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
         try:
             dev_array = mesh_utils.create_hybrid_device_mesh(
                 mesh_shape=shape[1:],
-                dcn_mesh_shape=(sizes["dcn"], 1, 1),
+                dcn_mesh_shape=(sizes["dcn"], 1, 1, 1),
                 devices=devices,
             )
         except (ValueError, AssertionError) as e:
