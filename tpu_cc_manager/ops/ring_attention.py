@@ -36,6 +36,25 @@ except ImportError:  # pragma: no cover - older jax
 NEG_INF = -1e30
 
 
+def ring_spec(mesh: Mesh, axis: str, B: int, H: int, KV: int) -> P:
+    """The PartitionSpec ring attention uses for (B, heads, S, D) tensors:
+    sequence on ``axis``, batch on every other non-tp axis, heads on 'tp'.
+
+    Shapes are static at trace time: batch/head sharding is dropped when a
+    dimension doesn't divide (e.g. the batch-1 init trace) — the math is
+    identical, just replicated over those axes for that trace.
+    """
+    import math
+
+    batch_axes = tuple(a for a in mesh.axis_names if a not in (axis, "tp"))
+    if batch_axes and B % math.prod(mesh.shape[a] for a in batch_axes):
+        batch_axes = ()
+    head_axis = "tp" if ("tp" in mesh.axis_names and axis != "tp") else None
+    if head_axis and (KV % mesh.shape["tp"] or H % mesh.shape["tp"]):
+        head_axis = None
+    return P(batch_axes or None, head_axis, axis, None)
+
+
 def _block_attn(q, k, v, q_off, k_off, scale):
     """Partial (unnormalized-softmax) attention of a Q shard against one K/V
     shard with absolute-position causal masking. Returns (m, l, acc).
@@ -94,18 +113,7 @@ def ring_attention_in_jit(
         raise ValueError(f"{H} query heads not divisible by {KV} kv heads")
     shard = S // n
     scale = 1.0 / (D**0.5)
-    import math
-
-    # Shapes are static at trace time: drop the batch/head sharding when a
-    # dimension doesn't divide (e.g. the batch-1 init trace) — the math is
-    # identical, just replicated over those axes for that trace.
-    batch_axes = tuple(a for a in mesh.axis_names if a not in (axis, "tp"))
-    if batch_axes and B % math.prod(mesh.shape[a] for a in batch_axes):
-        batch_axes = ()
-    head_axis = "tp" if ("tp" in mesh.axis_names and axis != "tp") else None
-    if head_axis and (KV % mesh.shape["tp"] or H % mesh.shape["tp"]):
-        head_axis = None
-    spec = P(batch_axes or None, head_axis, axis, None)
+    spec = ring_spec(mesh, axis, B, H, KV)
 
     def local(q, k, v):
         idx = jax.lax.axis_index(axis)
@@ -167,11 +175,14 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "dp",
 ) -> jnp.ndarray:
-    """Standalone entry: places Q/K/V sequence-sharded over ``axis``, then
-    runs :func:`ring_attention_in_jit`. q: (B, H, S, D), k/v: (B, KV, S, D);
-    returns (B, H, S, D), same sharding."""
-    seq_sharding = NamedSharding(mesh, P(None, None, axis, None))
-    q = jax.device_put(q, seq_sharding)
-    k = jax.device_put(k, seq_sharding)
-    v = jax.device_put(v, seq_sharding)
+    """Standalone entry: places Q/K/V with the same spec the kernel uses
+    (sequence on ``axis``, batch/heads on their mesh shards — see
+    :func:`ring_spec`), then runs :func:`ring_attention_in_jit`.
+    q: (B, H, S, D), k/v: (B, KV, S, D); returns (B, H, S, D) with that
+    spec."""
+    spec = ring_spec(mesh, axis, q.shape[0], q.shape[1], k.shape[1])
+    sharding = NamedSharding(mesh, spec)
+    q = jax.device_put(q, sharding)
+    k = jax.device_put(k, sharding)
+    v = jax.device_put(v, sharding)
     return ring_attention_in_jit(q, k, v, mesh, axis)

@@ -20,7 +20,7 @@ import time
 from functools import partial
 
 
-def run(size: int | None = None, iters: int = 32, seed: int = 0,
+def run(size: int | None = None, iters: int | None = None, seed: int = 0,
         kernel: str = "xla") -> dict:
     """kernel='xla' uses jnp.matmul (stock compiler); kernel='pallas' uses
     the Mosaic tiled kernel (ops/matmul.py) — single-device only, used to
@@ -35,6 +35,11 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
         devices = devices[:1]  # the Mosaic kernel is single-device
     if size is None:
         size = 4096 if backend == "tpu" else 256
+    if iters is None:
+        # Long enough that the T(4N)-T(N) differential (~3N iters of device
+        # time) dwarfs dispatch/readback jitter — short chains can report
+        # above-peak TFLOPs on a noisy transport.
+        iters = 64 if backend == "tpu" else 4
     # Round to a multiple of (128 * device count) — keeps every shard aligned
     # to the MXU/VPU lane width after sharding.
     n_dev = len(devices)
@@ -44,18 +49,19 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
     row_sharding = NamedSharding(mesh, P("x", None))
     repl = NamedSharding(mesh, P())
 
-    # Generate operands ON device with their final shardings: a host-side
-    # random.normal + device_put would push 2×size² bf16 through the (possibly
-    # tunnelled) host↔device link, which costs more than the whole timed loop.
-    @partial(jax.jit, out_shardings=(row_sharding, repl))
+    # Operands are generated ON device, inlined into each program (two
+    # compiled programs total): a host-side random.normal + device_put would
+    # push 2×size² bf16 through the (possibly tunnelled) host↔device link,
+    # and a separate generator program would be a third remote compile —
+    # each costs seconds through a tunnel. Regenerating per call costs ~one
+    # chain iteration.
     def gen_operands(key):
         k1, k2 = jax.random.split(key)
         a = jax.random.normal(k1, (size, size), dtype=jnp.bfloat16)
         b = jax.random.normal(k2, (size, size), dtype=jnp.bfloat16)
         return a, b
 
-    a, b = gen_operands(jax.random.PRNGKey(seed))
-    a.block_until_ready()
+    key = jax.random.PRNGKey(seed)
 
     # One product definition shared by the numerics path and the timed
     # chain, so kernel dispatch and block sizing can't diverge.
@@ -84,7 +90,15 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
     # extra remote compile costs seconds, dwarfing the while- vs scan-loop
     # difference for 4096³ matmul bodies.
     @partial(jax.jit, out_shardings=row_sharding)
-    def mm_chain(a, b, iters):
+    def mm_chain(key, iters):
+        a, b = gen_operands(key)
+        a = jax.lax.with_sharding_constraint(a, row_sharding)
+        b = jax.lax.with_sharding_constraint(b, repl)
+        # Barrier: without it XLA can recompute the RNG inside different
+        # fusions, one consumer seeing pre-bf16-rounding values — the
+        # identity oracle would then compare two different "a"s.
+        a, b = jax.lax.optimization_barrier((a, b))
+
         def body(_, acc):
             # Constant renorm: rows of acc@b grow by ~sqrt(n) for unit
             # Gaussian operands, so a fixed 1/sqrt(n) keeps the chain
@@ -106,21 +120,26 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
     import statistics
 
     def _timed(n: int, reps: int = 3) -> float:
-        _sync(mm_chain(a, b, n))  # compile + warm
+        _sync(mm_chain(key, n))  # compile + warm
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            _sync(mm_chain(a, b, n))
+            _sync(mm_chain(key, n))
             times.append(time.perf_counter() - t0)
         return statistics.median(times)
 
-    diff = _timed(4 * iters) - _timed(iters)
+    diff = _timed(4 * iters, reps=5) - _timed(iters, reps=5)
     # A non-positive differential means overhead variance swamped 3N iters
     # of device time: the numerics verdict stands, but the throughput
     # measurement is invalid and must not be reported as a number.
     timing_valid = diff > 0
     dt = diff / (3 * iters) if timing_valid else None
     tflops = 2 * size**3 / dt / 1e12 if timing_valid else None
+    mfu = None
+    if timing_valid and backend == "tpu":
+        from tpu_cc_manager.utils.tpu_info import peak_flops_per_chip
+
+        mfu = round(tflops * 1e12 / (peak_flops_per_chip() * n_dev), 4)
 
     # Numerics: identity sanity (A @ I == A within bf16 cast error) plus a
     # row-sum cross-check of the product under test: (A·B) @ 1 == A @ (B @ 1).
@@ -128,7 +147,14 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
     # (no size² host transfer), and all three checks come back as scalars in
     # a single dispatch instead of ~eight op-by-op round trips.
     @jax.jit
-    def numerics(a, b):
+    def numerics(key):
+        a, b = gen_operands(key)
+        a = jax.lax.with_sharding_constraint(a, row_sharding)
+        b = jax.lax.with_sharding_constraint(b, repl)
+        # Barrier: without it XLA can recompute the RNG inside different
+        # fusions, one consumer seeing pre-bf16-rounding values — the
+        # identity oracle would then compare two different "a"s.
+        a, b = jax.lax.optimization_barrier((a, b))
         out = product(a, b)
         eye = jnp.eye(size, dtype=jnp.bfloat16)
         ident_err = jnp.max(jnp.abs(product(a, eye) - a.astype(jnp.float32)))
@@ -140,7 +166,7 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
         scale = jnp.max(jnp.abs(rhs))
         return ident_err, jnp.max(jnp.abs(lhs - rhs)), scale
 
-    ident_err_v, rowsum_err_v, scale_v = numerics(a, b)
+    ident_err_v, rowsum_err_v, scale_v = numerics(key)
     ident_err = float(ident_err_v)
     rowsum_rel_err = float(rowsum_err_v) / (float(scale_v) + 1e-6)
     # bf16 has ~8 mantissa bits; row-sum of `size` products loses a few more.
@@ -156,6 +182,7 @@ def run(size: int | None = None, iters: int = 32, seed: int = 0,
         "timing_valid": bool(timing_valid),
         "seconds_per_iter": dt,
         "tflops": round(tflops, 2) if tflops is not None else None,
+        "mfu": mfu,
         "ident_err": ident_err,
         "rowsum_rel_err": rowsum_rel_err,
     }

@@ -14,17 +14,7 @@ import time
 from functools import partial
 
 
-# Peak bf16 TFLOP/s per chip for MFU accounting (public figures).
-_PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
-
-
-def _peak_flops_per_device() -> float:
-    import os
-
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN") or os.environ.get(
-        "TPU_ACCELERATOR_TYPE", ""
-    ).split("-")[0]
-    return _PEAK_TFLOPS.get(gen, 197.0) * 1e12
+from tpu_cc_manager.utils.tpu_info import peak_flops_per_chip as _peak_flops_per_device
 
 
 def run(size: str | None = None, batch: int | None = None, steps: int = 6,

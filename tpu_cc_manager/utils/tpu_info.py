@@ -1,0 +1,53 @@
+"""TPU generation detection + public peak-FLOPs table for MFU accounting.
+
+Shared by the smoke workloads so every reported MFU uses the same
+denominator. Peak numbers are the public bf16 figures per chip.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Public peak dense bf16 TFLOP/s per chip.
+PEAK_BF16_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+}
+
+
+def _normalize(gen: str) -> str | None:
+    """Canonicalize a generation string ('v5litepod' → 'v5e', 'tpuv6lite'
+    → 'v6e'), mirroring tpudev/tpuvm.py's accelerator-type parsing."""
+    gen = gen.lower().replace("tpu", "").replace(" ", "")
+    if gen.startswith("v5lite"):
+        return "v5e"
+    if gen.startswith("v6lite"):
+        return "v6e"
+    for name in PEAK_BF16_TFLOPS:
+        if gen.startswith(name):
+            return name
+    return None
+
+
+def tpu_generation() -> str | None:
+    """Best-effort TPU generation: env override, else device_kind."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN") or os.environ.get(
+        "TPU_ACCELERATOR_TYPE", ""
+    ).split("-")[0]
+    if gen:
+        return _normalize(gen)
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 - detection is best-effort
+        return None
+    return _normalize(kind)
+
+
+def peak_flops_per_chip(default_tflops: float = 197.0) -> float:
+    """Peak bf16 FLOP/s for MFU math; conservative default when unknown."""
+    gen = tpu_generation()
+    return PEAK_BF16_TFLOPS.get(gen, default_tflops) * 1e12
