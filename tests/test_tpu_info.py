@@ -1,0 +1,46 @@
+"""TPU generation detection / peak-FLOPs table (utils/tpu_info.py)."""
+
+import pytest
+
+from tpu_cc_manager.utils import tpu_info
+
+
+@pytest.mark.parametrize(
+    ("raw", "want"),
+    [
+        ("v5e", "v5e"),
+        ("v5litepod", "v5e"),
+        ("v5lite", "v5e"),
+        ("TPU v5 lite", "v5e"),
+        ("TPU v5p", "v5p"),
+        ("v5p", "v5p"),
+        ("v4", "v4"),
+        ("v6e", "v6e"),
+        ("TPU v6 lite", "v6e"),
+        ("v6lite", "v6e"),
+        ("cpu", None),
+        ("", None),
+    ],
+)
+def test_normalize(raw, want):
+    assert tpu_info._normalize(raw) == want
+
+
+def test_env_override_wins(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5p")
+    assert tpu_info.tpu_generation() == "v5p"
+    assert tpu_info.peak_flops_per_chip() == 459.0e12
+
+
+def test_accelerator_type_env(monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_TPU_GEN", raising=False)
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    assert tpu_info.tpu_generation() == "v5e"
+    assert tpu_info.peak_flops_per_chip() == 197.0e12
+
+
+def test_unknown_falls_back_conservative(monkeypatch):
+    monkeypatch.delenv("PALLAS_AXON_TPU_GEN", raising=False)
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "wat-9000")
+    assert tpu_info.tpu_generation() is None
+    assert tpu_info.peak_flops_per_chip() == 197.0e12
