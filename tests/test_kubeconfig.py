@@ -57,3 +57,46 @@ def test_missing_context_raises(tmp_path):
     path.write_text(yaml.safe_dump({"clusters": []}))
     with pytest.raises(KubeApiError):
         ClusterConfig.from_kubeconfig(str(path))
+
+
+def test_in_cluster_config(tmp_path, monkeypatch):
+    """Service-account path: token + CA read from the mounted SA dir, server
+    from the KUBERNETES_SERVICE_* env (reference main.py:129-140's
+    load_incluster_config analogue)."""
+    sa = tmp_path / "serviceaccount"
+    sa.mkdir()
+    (sa / "token").write_text("sa-token\n")
+    (sa / "ca.crt").write_text("CA PEM")
+    monkeypatch.setattr(
+        "tpu_cc_manager.kubeclient.rest.SERVICEACCOUNT_DIR", str(sa)
+    )
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+    cfg = ClusterConfig.in_cluster()
+    assert cfg.server == "https://10.0.0.1:6443"
+    assert cfg.token == "sa-token"
+    assert cfg.ca_file == str(sa / "ca.crt")
+
+
+def test_in_cluster_requires_sa_mount(monkeypatch, tmp_path):
+    monkeypatch.setattr(
+        "tpu_cc_manager.kubeclient.rest.SERVICEACCOUNT_DIR",
+        str(tmp_path / "missing"),
+    )
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    with pytest.raises(KubeApiError):
+        ClusterConfig.in_cluster()
+
+
+def test_load_prefers_in_cluster(tmp_path, monkeypatch):
+    """load() order: in-cluster first, kubeconfig fallback."""
+    sa = tmp_path / "serviceaccount"
+    sa.mkdir()
+    (sa / "token").write_text("tok")
+    monkeypatch.setattr(
+        "tpu_cc_manager.kubeclient.rest.SERVICEACCOUNT_DIR", str(sa)
+    )
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.9.9.9")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    cfg = ClusterConfig.load(kubeconfig="/nonexistent/kubeconfig")
+    assert cfg.server == "https://10.9.9.9:443"
