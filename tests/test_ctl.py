@@ -48,7 +48,8 @@ def test_rollout_command(fake_kube, capsys):
     rc = ctl.cmd_rollout(
         fake_kube,
         ns(selector="pool=tpu", mode="on", max_unavailable=1,
-           node_timeout=5.0, continue_on_failure=False),
+           node_timeout=5.0, continue_on_failure=False,
+           rollback_on_failure=False),
     )
     assert rc == 0
     assert '"ok": true' in capsys.readouterr().out

@@ -182,3 +182,81 @@ def test_rollout_timeout_reported(fake_kube):
     result = make_roller(fake_kube, node_timeout_s=0.1).rollout("on")
     assert result.ok is False
     assert result.groups[0].states["node-0"] == "timeout"
+
+
+def test_rollback_on_failure_reverts_converged_groups(fake_kube):
+    """Group 0/1 converge to 'on', group 2 fails -> halt + groups 0/1
+    reverted to their prior desired mode ('off'); the failed group is left
+    for the operator."""
+    add_pool(fake_kube, 3)
+    for i in range(3):
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_LABEL, "off")
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_STATE_LABEL, "off")
+    agent_simulator(fake_kube, fail_nodes=("node-2",))
+    result = make_roller(fake_kube, rollback_on_failure=True).rollout("on")
+    assert result.ok is False
+    assert [g.group for g in result.rolled_back] == ["node/node-1", "node/node-0"]
+    for g in result.rolled_back:
+        assert g.ok, g.states
+    for i in (0, 1):
+        labels = node_labels(fake_kube.get_node(f"node-{i}"))
+        assert labels[CC_MODE_LABEL] == "off"
+        assert labels[CC_MODE_STATE_LABEL] == "off"
+    # The failed node keeps its target desired label and failed state.
+    labels = node_labels(fake_kube.get_node("node-2"))
+    assert labels[CC_MODE_LABEL] == "on"
+    assert labels[CC_MODE_STATE_LABEL] == STATE_FAILED
+
+
+def test_rollback_removes_previously_absent_label(fake_kube):
+    """Nodes that had no desired label get it removed on rollback (the
+    default mode applies again) and are not awaited."""
+    add_pool(fake_kube, 2)
+    agent_simulator(fake_kube, fail_nodes=("node-1",))
+    result = make_roller(fake_kube, rollback_on_failure=True).rollout("on")
+    assert result.ok is False
+    assert len(result.rolled_back) == 1
+    assert result.rolled_back[0].states == {"node-0": "reverted-unawaited"}
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("node-0"))
+
+
+def test_no_rollback_by_default(fake_kube):
+    add_pool(fake_kube, 2)
+    agent_simulator(fake_kube, fail_nodes=("node-1",))
+    result = make_roller(fake_kube).rollout("on")
+    assert result.ok is False
+    assert result.rolled_back == []
+    assert node_labels(fake_kube.get_node("node-0"))[CC_MODE_LABEL] == "on"
+
+
+def test_rollback_and_continue_are_mutually_exclusive(fake_kube):
+    with pytest.raises(ValueError):
+        make_roller(fake_kube, continue_on_failure=True, rollback_on_failure=True)
+
+
+def test_summary_reports_failed_rollback(fake_kube):
+    """A revert that times out must read as 'failed', not silently OK."""
+    add_pool(fake_kube, 2)
+    for i in range(2):
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_LABEL, "off")
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_STATE_LABEL, "off")
+
+    # Agent that converges forward transitions but wedges on the revert.
+    def reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired == "on" and state != desired:
+            target = STATE_FAILED if name == "node-1" else "on"
+            t = threading.Timer(
+                0.05,
+                lambda: fake_kube.set_node_label(name, CC_MODE_STATE_LABEL, target),
+            )
+            t.daemon = True
+            t.start()
+
+    fake_kube.add_patch_reactor(reactor)
+    result = make_roller(
+        fake_kube, rollback_on_failure=True, node_timeout_s=0.3
+    ).rollout("on")
+    assert result.ok is False
+    assert result.summary()["rolled_back"] == {"node/node-0": "failed"}

@@ -55,6 +55,9 @@ class RolloutResult:
     # parallel, so their per-group durations overlap; only window times sum
     # to the rollout's wall time).
     window_seconds: list[float] = dataclasses.field(default_factory=list)
+    # Groups reverted to their pre-rollout desired mode after a failure
+    # halt (rollback_on_failure).
+    rolled_back: list[GroupResult] = dataclasses.field(default_factory=list)
 
     @property
     def seconds(self) -> float:
@@ -75,6 +78,11 @@ class RolloutResult:
             "mean_seconds_per_node": round(
                 self.seconds / converged_nodes, 2
             ) if converged_nodes and self.ok else None,
+            # Per-group revert outcome: a rollback that itself failed or
+            # timed out must not read as "safely restored".
+            "rolled_back": {
+                g.group: ("ok" if g.ok else "failed") for g in self.rolled_back
+            } or None,
         }
 
 
@@ -101,6 +109,7 @@ class RollingReconfigurator:
         node_timeout_s: float = 600.0,
         poll_interval_s: float = 2.0,
         continue_on_failure: bool = False,
+        rollback_on_failure: bool = False,
     ) -> None:
         self.api = api
         self.selector = selector
@@ -108,6 +117,14 @@ class RollingReconfigurator:
         self.node_timeout_s = node_timeout_s
         self.poll_interval_s = poll_interval_s
         self.continue_on_failure = continue_on_failure
+        self.rollback_on_failure = rollback_on_failure
+        if continue_on_failure and rollback_on_failure:
+            # Contradictory: one says press on past failures, the other
+            # says undo on failure. Reject rather than silently pick one.
+            raise ValueError(
+                "continue_on_failure and rollback_on_failure are mutually "
+                "exclusive"
+            )
 
     def rollout(self, mode: str) -> RolloutResult:
         mode = canonical_mode(mode)
@@ -126,12 +143,18 @@ class RollingReconfigurator:
         )
         results: list[GroupResult] = []
         window_seconds: list[float] = []
+        # Pre-rollout desired mode per node, for rollback_on_failure.
+        prior: dict[str, str | None] = {}
         ok = True
         # Strictly bounded concurrency: process in windows of max_unavailable.
         for i in range(0, len(groups), self.max_unavailable):
             window = groups[i : i + self.max_unavailable]
             started = time.monotonic()
             for gid, names in window:
+                for name in names:
+                    prior[name] = node_labels(self.api.get_node(name)).get(
+                        CC_MODE_LABEL
+                    )
                 self._set_desired(names, mode)
             # Always await the FULL window even after a failure: every group
             # in it already received its desired label and is transitioning —
@@ -150,15 +173,59 @@ class RollingReconfigurator:
                     "group(s) %s failed; halting rollout (%d group(s) not "
                     "attempted)", window_failed, len(groups) - i - len(window),
                 )
+                rolled_back = (
+                    self._rollback(results, prior)
+                    if self.rollback_on_failure
+                    else []
+                )
                 return RolloutResult(
                     mode=mode, ok=False, groups=results,
-                    window_seconds=window_seconds,
+                    window_seconds=window_seconds, rolled_back=rolled_back,
                 )
         return RolloutResult(
             mode=mode, ok=ok, groups=results, window_seconds=window_seconds
         )
 
     # -- internals --------------------------------------------------------
+
+    def _rollback(
+        self, results: list[GroupResult], prior: dict[str, str | None]
+    ) -> list[GroupResult]:
+        """Revert groups that converged OK to their pre-rollout desired
+        mode, newest first (the failed group itself is left for the
+        operator — re-driving a slice that just failed would thrash it).
+
+        Nodes whose prior label was absent get the label removed; their
+        agents re-apply the default mode, which depends on host capability,
+        so convergence is only awaited where the prior mode is known."""
+        rolled_back: list[GroupResult] = []
+        for gres in reversed([g for g in results if g.ok]):
+            modes = {prior.get(n) for n in gres.nodes}
+            log.warning(
+                "rolling back group %s to prior desired mode(s) %s",
+                gres.group, sorted(str(m) for m in modes),
+            )
+            for name in gres.nodes:
+                self.api.patch_node_labels(name, {CC_MODE_LABEL: prior.get(name)})
+            if len(modes) == 1:
+                prior_mode = next(iter(modes))
+                prior_mode = canonical_mode(prior_mode) if prior_mode else None
+                if prior_mode in VALID_MODES:
+                    rolled_back.append(
+                        self._await_group(
+                            gres.group, gres.nodes, prior_mode,
+                            time.monotonic(),
+                        )
+                    )
+                    continue
+            rolled_back.append(
+                GroupResult(
+                    group=gres.group, nodes=gres.nodes, ok=True,
+                    seconds=0.0,
+                    states={n: "reverted-unawaited" for n in gres.nodes},
+                )
+            )
+        return rolled_back
 
     def _set_desired(self, names: tuple[str, ...], mode: str) -> None:
         for name in names:

@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--max-unavailable", type=int, default=1)
     r.add_argument("--node-timeout", type=float, default=600.0)
     r.add_argument("--continue-on-failure", action="store_true")
+    r.add_argument(
+        "--rollback-on-failure", action="store_true",
+        help="on halt, revert already-converged groups to their prior "
+        "desired mode (the failed group is left for the operator)",
+    )
 
     a = sub.add_parser("attest", help="verify cross-slice attestation coherence")
     a.add_argument("--selector", required=True)
@@ -70,6 +75,7 @@ def cmd_rollout(api, args) -> int:
         max_unavailable=args.max_unavailable,
         node_timeout_s=args.node_timeout,
         continue_on_failure=args.continue_on_failure,
+        rollback_on_failure=args.rollback_on_failure,
     )
     result = roller.rollout(args.mode)
     print(json.dumps(result.summary()))
