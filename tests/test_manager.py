@@ -261,3 +261,38 @@ def test_phase_metrics_recorded(fake_kube, fake_tpu):
     text = registry.render_prometheus()
     assert "tpu_cc_reconcile_seconds" in text
     assert 'phase="reset"' in text
+
+
+def test_strict_eviction_timeout_fails_without_touching_hardware(
+    fake_kube, fake_tpu
+):
+    """CC_STRICT_EVICTION semantics (SURVEY.md §8.5): a drain timeout fails
+    the reconcile — 'failed' state, components re-admitted, chips never
+    staged/reset — instead of the reference's proceed-anyway."""
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "stuck", NODE, labels={"app": DP_APP})  # never drains
+    mgr = make_manager(
+        fake_kube, fake_tpu,
+        evict_components=True, strict_eviction=True,
+        eviction_timeout_s=0.05,
+    )
+    assert mgr.set_cc_mode("on") is False
+    assert state_of(fake_kube)[0] == "failed"
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[DP_LABEL] == "true"  # re-admitted, not left paused
+    assert not [op for op in fake_tpu.op_log if op[0] == "reset"]  # hardware untouched
+    for chip in fake_tpu.discover().chips:
+        assert fake_tpu.query_cc_mode(chip) == "off"
+
+
+def test_lenient_eviction_timeout_proceeds(fake_kube, fake_tpu):
+    """Default (reference) behavior: timeout warns and proceeds to the
+    hardware phase."""
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "stuck", NODE, labels={"app": DP_APP})
+    mgr = make_manager(
+        fake_kube, fake_tpu,
+        evict_components=True, eviction_timeout_s=0.05,
+    )
+    assert mgr.set_cc_mode("on") is True
+    assert state_of(fake_kube)[0] == "on"

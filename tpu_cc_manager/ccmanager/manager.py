@@ -81,6 +81,7 @@ class CCManager:
         smoke_runner: Callable[[str], dict] | None = None,
         eviction_timeout_s: float = evict.DEFAULT_EVICTION_TIMEOUT_S,
         eviction_poll_interval_s: float = evict.DEFAULT_POLL_INTERVAL_S,
+        strict_eviction: bool | None = None,
         ready_timeout_s: float = DEFAULT_READY_TIMEOUT_S,
         readiness_file: str | None = None,
         watch_timeout_s: int = WATCH_TIMEOUT_S,
@@ -111,6 +112,15 @@ class CCManager:
         self.smoke_runner = smoke_runner
         self.eviction_timeout_s = eviction_timeout_s
         self.eviction_poll_interval_s = eviction_poll_interval_s
+        # The reference proceeds to the hardware phase on a drain timeout
+        # (gpu_operator_eviction.py:205-207) — risky but deliberate; strict
+        # mode (CC_STRICT_EVICTION=1) fails the reconcile instead
+        # (SURVEY.md §8.5: "preserve behavior behind a flag").
+        if strict_eviction is None:
+            strict_eviction = os.environ.get(
+                "CC_STRICT_EVICTION", ""
+            ).lower() in ("true", "1", "yes")
+        self.strict_eviction = strict_eviction
         self.ready_timeout_s = ready_timeout_s
         self.readiness_file = readiness_file or os.environ.get(
             "CC_READINESS_FILE", DEFAULT_READINESS_FILE
@@ -282,15 +292,29 @@ class CCManager:
         """Drain, reconfigure, re-admit (reference main.py:544-578).
 
         Re-admission runs even when the reconfigure fails, so components are
-        never left paused by a failed toggle."""
-        with m.phase(metrics_mod.PHASE_DRAIN):
-            original = evict.evict_components(
-                self.api,
-                self.node_name,
-                self.operator_namespace,
-                timeout_s=self.eviction_timeout_s,
-                poll_interval_s=self.eviction_poll_interval_s,
-            )
+        never left paused by a failed toggle — including a strict-mode drain
+        timeout, which fails the reconcile without touching the hardware."""
+        try:
+            with m.phase(metrics_mod.PHASE_DRAIN):
+                original = evict.evict_components(
+                    self.api,
+                    self.node_name,
+                    self.operator_namespace,
+                    timeout_s=self.eviction_timeout_s,
+                    poll_interval_s=self.eviction_poll_interval_s,
+                    proceed_on_timeout=not self.strict_eviction,
+                )
+        except evict.EvictionTimeout as e:
+            log.error("strict eviction failed: %s — not touching hardware", e)
+            m.result = "failed"
+            try:
+                state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
+            finally:
+                # Re-admit even if the state-label patch itself fails —
+                # components must never stay paused behind a failed toggle.
+                with m.phase(metrics_mod.PHASE_READMIT):
+                    evict.readmit_components(self.api, self.node_name, e.original)
+            return False
         try:
             return self._apply_direct(topo, chips, mode, m)
         finally:

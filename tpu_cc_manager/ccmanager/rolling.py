@@ -151,10 +151,11 @@ class RollingReconfigurator:
             window = groups[i : i + self.max_unavailable]
             started = time.monotonic()
             for gid, names in window:
-                for name in names:
-                    prior[name] = node_labels(self.api.get_node(name)).get(
-                        CC_MODE_LABEL
-                    )
+                if self.rollback_on_failure:
+                    for name in names:
+                        prior[name] = node_labels(self.api.get_node(name)).get(
+                            CC_MODE_LABEL
+                        )
                 self._set_desired(names, mode)
             # Always await the FULL window even after a failure: every group
             # in it already received its desired label and is transitioning —
@@ -205,24 +206,29 @@ class RollingReconfigurator:
                 "rolling back group %s to prior desired mode(s) %s",
                 gres.group, sorted(str(m) for m in modes),
             )
+            started = time.monotonic()
             for name in gres.nodes:
                 self.api.patch_node_labels(name, {CC_MODE_LABEL: prior.get(name)})
-            if len(modes) == 1:
-                prior_mode = next(iter(modes))
+            # Await each node against ITS OWN prior mode (they may differ
+            # within a slice); absent priors can't be awaited — the default
+            # mode the agent re-applies depends on host capability.
+            ok = True
+            states: dict[str, str] = {}
+            for name in gres.nodes:
+                prior_mode = prior.get(name)
                 prior_mode = canonical_mode(prior_mode) if prior_mode else None
                 if prior_mode in VALID_MODES:
-                    rolled_back.append(
-                        self._await_group(
-                            gres.group, gres.nodes, prior_mode,
-                            time.monotonic(),
-                        )
+                    nres = self._await_group(
+                        gres.group, (name,), prior_mode, started
                     )
-                    continue
+                    ok = ok and nres.ok
+                    states.update(nres.states)
+                else:
+                    states[name] = "reverted-unawaited"
             rolled_back.append(
                 GroupResult(
-                    group=gres.group, nodes=gres.nodes, ok=True,
-                    seconds=0.0,
-                    states={n: "reverted-unawaited" for n in gres.nodes},
+                    group=gres.group, nodes=gres.nodes, ok=ok,
+                    seconds=time.monotonic() - started, states=states,
                 )
             )
         return rolled_back

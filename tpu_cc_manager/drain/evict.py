@@ -29,7 +29,14 @@ DEFAULT_POLL_INTERVAL_S = 2.0
 
 
 class EvictionTimeout(Exception):
-    """Raised (only when proceed_on_timeout=False) if pods outlive the wait."""
+    """Raised (only when proceed_on_timeout=False) if pods outlive the wait.
+
+    Carries the pre-drain label values so the caller can still re-admit
+    the components it paused."""
+
+    def __init__(self, msg: str, original: dict[str, str]):
+        super().__init__(msg)
+        self.original = original
 
 
 def fetch_component_labels(api: KubeApi, node_name: str) -> dict[str, str]:
@@ -100,7 +107,7 @@ def evict_components(
                     # phase anyway (gpu_operator_eviction.py:205-207).
                     log.warning("%s — continuing anyway", msg)
                     break
-                raise EvictionTimeout(msg)
+                raise EvictionTimeout(msg, original)
             time.sleep(poll_interval_s)
     return original
 
