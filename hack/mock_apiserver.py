@@ -92,9 +92,13 @@ def is_paused(v):
     return v is not None and "paused-for" in v
 
 
+sticky_pods = set()  # pods the emulated operator refuses to delete
+
+
 def operator_reactor():
     """Delete component pods shortly after their deploy label pauses; restore
-    them when unpaused."""
+    them when unpaused. Pods marked sticky (POST /_ctl/stick-pod) are never
+    deleted — simulates a wedged drain for strict-eviction testing."""
     while True:
         time.sleep(0.5)
         with lock:
@@ -102,7 +106,8 @@ def operator_reactor():
             for key, app in COMPONENTS.items():
                 name = f"{app}-pod"
                 if is_paused(labels.get(key)):
-                    pods.pop(name, None)
+                    if name not in sticky_pods:
+                        pods.pop(name, None)
                 elif labels.get(key) == "true" and name not in pods:
                     pods[name] = {
                         "metadata": {"name": name, "namespace": NS,
@@ -221,6 +226,13 @@ class Handler(BaseHTTPRequestHandler):
                 bump_rv()
                 emit_watch_event()
                 return self._json({"ok": True, "labels": node["metadata"]["labels"]})
+        if u.path == "/_ctl/stick-pod":
+            with lock:
+                if body.get("stuck", True):
+                    sticky_pods.add(body["name"])
+                else:
+                    sticky_pods.discard(body["name"])
+                return self._json({"ok": True, "sticky": sorted(sticky_pods)})
         if u.path == "/_ctl/state":
             with lock:
                 return self._json({"labels": node["metadata"]["labels"],

@@ -79,7 +79,7 @@ class CCManager:
         evict_components: bool | None = None,
         smoke_workload: str | None = None,
         smoke_runner: Callable[[str], dict] | None = None,
-        eviction_timeout_s: float = evict.DEFAULT_EVICTION_TIMEOUT_S,
+        eviction_timeout_s: float | None = None,
         eviction_poll_interval_s: float = evict.DEFAULT_POLL_INTERVAL_S,
         strict_eviction: bool | None = None,
         ready_timeout_s: float = DEFAULT_READY_TIMEOUT_S,
@@ -110,6 +110,12 @@ class CCManager:
             else os.environ.get("CC_SMOKE_WORKLOAD", "none")
         )
         self.smoke_runner = smoke_runner
+        if eviction_timeout_s is None:
+            eviction_timeout_s = float(
+                os.environ.get(
+                    "CC_EVICTION_TIMEOUT_S", evict.DEFAULT_EVICTION_TIMEOUT_S
+                )
+            )
         self.eviction_timeout_s = eviction_timeout_s
         self.eviction_poll_interval_s = eviction_poll_interval_s
         # The reference proceeds to the hardware phase on a drain timeout
