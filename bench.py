@@ -5,14 +5,24 @@ reference publishes no numbers (SURVEY.md §6); 90 s is the target from
 BASELINE.json and ``vs_baseline`` reports how many times under target we
 land (value 9 s → vs_baseline 10.0).
 
-What runs: the REAL reconcile pipeline (CCManager) against the in-memory
-apiserver fake and the fake TPU device layer — pause labels, pod-drain
-polling with an emulated operator controller, stage/reset/wait, attestation
-fetch + verification, and the REAL JAX matmul smoke workload executed in a
-subprocess on whatever accelerator this machine has (the driver runs this on
-one real TPU chip). Device reset/boot latencies are the fake's (zero): the
-measurement is the control plane's own overhead plus the end-to-end JAX
-verification — the part this framework is responsible for.
+Two scenarios run, both through the REAL reconcile pipeline (CCManager)
+against the in-memory apiserver fake and the fake TPU device layer — pause
+labels, pod-drain polling with an emulated operator controller,
+stage/reset/wait, attestation fetch + verification, and the REAL JAX matmul
+smoke workload in a subprocess on whatever accelerator this machine has
+(the driver runs this on one real TPU chip):
+
+- **control** (the headline ``value``): zero device latencies — measures the
+  control plane's own overhead plus the end-to-end JAX verification, the
+  part this framework is responsible for.
+- **realistic**: the fake device is configured with defensible real-world
+  latencies (30 s runtime reset, 20 s boot — the order of a TPU runtime
+  restart — and a 3 s pod-termination delay per the operator controller),
+  so the <90 s claim is made against simulated-real device time, not
+  zero-cost fakes.
+
+The result is self-describing: smoke backend, chip generation, and MFU ride
+along so the throughput number carries its own denominator.
 
 Prints exactly one JSON line.
 """
@@ -23,6 +33,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -73,12 +84,14 @@ def _smoke_subprocess(workload: str, timeout_s: float, force_cpu: bool) -> dict:
     return result
 
 
-def main() -> int:
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import logging
-
-    logging.basicConfig(level=logging.WARNING)  # keep stdout to one JSON line
-
+def run_scenario(
+    tpu_usable: bool,
+    reset_latency_s: float = 0.0,
+    boot_latency_s: float = 0.0,
+    pod_delete_delay_s: float = 0.0,
+) -> dict:
+    """One drain→CC-on→ready pass through the real pipeline; returns the
+    measurement plus the smoke detail."""
     from tpu_cc_manager.ccmanager.manager import CCManager
     from tpu_cc_manager.drain.pause import is_paused
     from tpu_cc_manager.kubeclient.api import node_labels
@@ -94,22 +107,29 @@ def main() -> int:
     kube = FakeKube()
     labels = {key: "true" for key in DRAIN_COMPONENT_LABELS}
     kube.add_node(node, labels)
-    for i, (key, app) in enumerate(DRAIN_COMPONENT_LABELS.items()):
+    for key, app in DRAIN_COMPONENT_LABELS.items():
         kube.add_pod(ns, f"{app}-pod", node, labels={"app": app})
 
     # Emulated operator controller: deletes a component's pods when its
     # deploy label flips to paused (the external behavior the protocol
-    # relies on; SURVEY.md §5).
+    # relies on; SURVEY.md §5) — after the configured termination delay in
+    # the realistic scenario (pods have grace periods; deletion is not
+    # instantaneous on a real cluster).
     def reactor(name, patched):
         for key, app in DRAIN_COMPONENT_LABELS.items():
             if is_paused(node_labels(patched).get(key)):
-                kube.delete_pods_matching(ns, f"app={app}")
+                if pod_delete_delay_s > 0:
+                    threading.Timer(
+                        pod_delete_delay_s,
+                        kube.delete_pods_matching, (ns, f"app={app}"),
+                    ).start()
+                else:
+                    kube.delete_pods_matching(ns, f"app={app}")
 
     kube.add_patch_reactor(reactor)
 
     backend_used = {"backend": "unknown"}
-    smoke_detail = {}
-    tpu_usable = _tpu_preflight()
+    smoke_detail: dict = {}
 
     def smoke_runner(workload: str) -> dict:
         try:
@@ -125,7 +145,12 @@ def main() -> int:
         return result
 
     registry = MetricsRegistry()
-    backend = FakeTpuBackend(num_chips=4, accelerator_type="v5p-8")
+    backend = FakeTpuBackend(
+        num_chips=4,
+        accelerator_type="v5p-8",
+        reset_latency_s=reset_latency_s,
+        boot_latency_s=boot_latency_s,
+    )
     mgr = CCManager(
         api=kube,
         backend=backend,
@@ -144,19 +169,54 @@ def main() -> int:
 
     state = node_labels(kube.get_node(node)).get(CC_MODE_STATE_LABEL)
     m = registry.last()
-    phases = {p.name: round(p.seconds, 3) for p in (m.phases if m else [])}
+    return {
+        "seconds": round(dt, 2),
+        "ok": bool(ok and state == "on"),
+        "phases": {p.name: round(p.seconds, 3) for p in (m.phases if m else [])},
+        "smoke": smoke_detail,
+        "backend": backend_used["backend"],
+    }
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)  # keep stdout to one JSON line
+
+    tpu_usable = _tpu_preflight()
+
+    control = run_scenario(tpu_usable)
+    realistic = run_scenario(
+        tpu_usable,
+        reset_latency_s=30.0,
+        boot_latency_s=20.0,
+        pod_delete_delay_s=3.0,
+    )
+
+    dt = control["seconds"]
+    smoke = control["smoke"]
     result = {
         "metric": "node_drain_cc_on_ready_sec",
-        "value": round(dt, 2),
+        "value": dt,
         "unit": "s",
         "vs_baseline": round(90.0 / dt, 2) if dt > 0 else 0.0,
-        "ok": bool(ok and state == "on"),
-        "smoke_backend": backend_used["backend"],
-        "smoke_tflops": smoke_detail.get("tflops"),
-        "phases": phases,
+        "ok": bool(control["ok"] and realistic["ok"]),
+        "smoke_backend": control["backend"],
+        "chip_generation": smoke.get("generation"),
+        "smoke_tflops": smoke.get("tflops"),
+        "smoke_mfu": smoke.get("mfu"),
+        "phases": control["phases"],
+        # The <90 s claim against simulated-real device time (30 s reset,
+        # 20 s boot, 3 s pod termination), not zero-cost fakes.
+        "realistic": {
+            "seconds": realistic["seconds"],
+            "under_target": realistic["seconds"] < 90.0,
+            "phases": realistic["phases"],
+        },
     }
     print(json.dumps(result))
-    return 0 if result["ok"] else 1
+    return 0 if result["ok"] and result["realistic"]["under_target"] else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,225 @@
+"""Real attestation signature verification (tpudev/jwks.py + attestation.py).
+
+The production verifier is pure stdlib; these tests generate a throwaway
+RSA keypair with the ``cryptography`` package (test-only dependency), build
+a local JWKS, and prove: a correctly signed Google-issuer JWT passes; a bad
+signature, a wrong issuer, an expired token, and a foreign key all fail
+closed; missing key material fails closed; and fake-platform quotes are
+rejected unless explicitly allowed.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+
+import pytest
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+from tpu_cc_manager.labels import MODE_ON
+from tpu_cc_manager.tpudev import jwks
+from tpu_cc_manager.tpudev.attestation import (
+    AttestationError,
+    fresh_nonce,
+    verify_quote,
+)
+from tpu_cc_manager.tpudev.contract import AttestationQuote
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode("ascii")
+
+
+def _int_bytes(n: int) -> bytes:
+    return n.to_bytes((n.bit_length() + 7) // 8, "big")
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+    keyset = {
+        "keys": [
+            {
+                "kty": "RSA",
+                "kid": "test-key-1",
+                "alg": "RS256",
+                "use": "sig",
+                "n": _b64url(_int_bytes(pub.n)),
+                "e": _b64url(_int_bytes(pub.e)),
+            }
+        ]
+    }
+    return key, keyset
+
+
+def make_jwt(key, claims: dict, kid: str = "test-key-1", alg: str = "RS256") -> str:
+    header = {"alg": alg, "kid": kid, "typ": "JWT"}
+
+    def seg(obj) -> str:
+        return _b64url(json.dumps(obj).encode())
+
+    signing_input = f"{seg(header)}.{seg(claims)}"
+    sig = key.sign(signing_input.encode(), padding.PKCS1v15(), hashes.SHA256())
+    return f"{signing_input}.{_b64url(sig)}"
+
+
+def gce_claims(nonce: str, **over) -> dict:
+    claims = {
+        "iss": "https://accounts.google.com",
+        "aud": f"tpu-cc-manager/{nonce}",
+        "sub": "1234567890",
+        "iat": int(time.time()),
+        "exp": int(time.time()) + 3600,
+    }
+    claims.update(over)
+    return claims
+
+
+def tpuvm_quote(jwt: str, nonce: str, mode: str = MODE_ON) -> AttestationQuote:
+    return AttestationQuote(
+        slice_id="slice-a",
+        nonce=nonce,
+        mode=mode,
+        measurements={
+            "accelerator_type": "v5p-8",
+            "runtime_digest": "d" * 64,
+            "cc_mode": mode,
+        },
+        signature=jwt,
+        platform="tpuvm",
+    )
+
+
+@pytest.fixture()
+def jwks_env(keypair, tmp_path, monkeypatch):
+    """Point the verifier at the local JWKS via the offline-file path."""
+    _, keyset = keypair
+    path = tmp_path / "jwks.json"
+    path.write_text(json.dumps(keyset))
+    monkeypatch.setenv(jwks.JWKS_FILE_ENV, str(path))
+    return keyset
+
+
+class TestVerifyRs256:
+    def test_valid_signature(self, keypair):
+        key, keyset = keypair
+        token = make_jwt(key, {"hello": "world"})
+        assert jwks.verify_rs256(token, keyset) == {"hello": "world"}
+
+    def test_tampered_payload_fails(self, keypair):
+        key, keyset = keypair
+        token = make_jwt(key, {"hello": "world"})
+        h, p, s = token.split(".")
+        p2 = _b64url(json.dumps({"hello": "mallory"}).encode())
+        with pytest.raises(jwks.JwksError):
+            jwks.verify_rs256(f"{h}.{p2}.{s}", keyset)
+
+    def test_foreign_key_fails(self, keypair):
+        _, keyset = keypair
+        other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        token = make_jwt(other, {"hello": "world"})
+        with pytest.raises(jwks.JwksError):
+            jwks.verify_rs256(token, keyset)
+
+    def test_non_rs256_rejected(self, keypair):
+        key, keyset = keypair
+        token = make_jwt(key, {"x": 1}, alg="none")
+        with pytest.raises(jwks.JwksError):
+            jwks.verify_rs256(token, keyset)
+
+    def test_unknown_kid_still_tries_all_keys(self, keypair):
+        key, keyset = keypair
+        token = make_jwt(key, {"x": 1}, kid="rotated-away")
+        assert jwks.verify_rs256(token, keyset) == {"x": 1}
+
+    def test_empty_jwks_fails(self, keypair):
+        key, _ = keypair
+        token = make_jwt(key, {"x": 1})
+        with pytest.raises(jwks.JwksError):
+            jwks.verify_rs256(token, {"keys": []})
+
+
+class TestLoadJwks:
+    def test_offline_file_wins(self, jwks_env):
+        assert jwks.load_jwks() == jwks_env
+
+    def test_nothing_available_returns_none(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(jwks.JWKS_FILE_ENV, raising=False)
+        assert (
+            jwks.load_jwks(
+                cache_file=str(tmp_path / "absent.json"),
+                url="http://127.0.0.1:1/certs",
+                fetch_timeout_s=0.2,
+            )
+            is None
+        )
+
+    def test_broken_offline_file_fails_closed(self, tmp_path, monkeypatch):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        monkeypatch.setenv(jwks.JWKS_FILE_ENV, str(bad))
+        assert jwks.load_jwks() is None
+
+
+class TestTpuvmQuoteVerification:
+    def test_valid_quote_passes(self, keypair, jwks_env):
+        key, _ = keypair
+        nonce = fresh_nonce()
+        quote = tpuvm_quote(make_jwt(key, gce_claims(nonce)), nonce)
+        assert verify_quote(quote, nonce, MODE_ON, "slice-a") == []
+
+    def test_bad_signature_fails_closed(self, keypair, jwks_env):
+        key, _ = keypair
+        nonce = fresh_nonce()
+        token = make_jwt(key, gce_claims(nonce))
+        h, p, _ = token.split(".")
+        forged = f"{h}.{p}.{_b64url(b'0' * 256)}"
+        with pytest.raises(AttestationError, match="signature"):
+            verify_quote(tpuvm_quote(forged, nonce), nonce, MODE_ON, "slice-a")
+
+    def test_wrong_issuer_fails_closed(self, keypair, jwks_env):
+        key, _ = keypair
+        nonce = fresh_nonce()
+        token = make_jwt(key, gce_claims(nonce, iss="https://evil.example"))
+        with pytest.raises(AttestationError, match="issuer"):
+            verify_quote(tpuvm_quote(token, nonce), nonce, MODE_ON, "slice-a")
+
+    def test_expired_token_fails_closed(self, keypair, jwks_env):
+        key, _ = keypair
+        nonce = fresh_nonce()
+        token = make_jwt(key, gce_claims(nonce, exp=int(time.time()) - 10))
+        with pytest.raises(AttestationError, match="expired"):
+            verify_quote(tpuvm_quote(token, nonce), nonce, MODE_ON, "slice-a")
+
+    def test_unbound_nonce_fails_closed(self, keypair, jwks_env):
+        key, _ = keypair
+        nonce = fresh_nonce()
+        token = make_jwt(key, gce_claims("a-different-nonce"))
+        with pytest.raises(AttestationError, match="nonce"):
+            verify_quote(tpuvm_quote(token, nonce), nonce, MODE_ON, "slice-a")
+
+    def test_no_key_material_fails_closed(self, keypair, monkeypatch):
+        key, _ = keypair
+        nonce = fresh_nonce()
+        quote = tpuvm_quote(make_jwt(key, gce_claims(nonce)), nonce)
+        from tpu_cc_manager.tpudev import attestation as att_mod
+
+        monkeypatch.setattr(att_mod.jwks, "load_jwks", lambda **kw: None)
+        with pytest.raises(AttestationError, match="failing closed"):
+            verify_quote(quote, nonce, MODE_ON, "slice-a")
+
+
+class TestFakeQuotePolicy:
+    def test_fake_quote_rejected_by_default(self, fake_tpu):
+        nonce = fresh_nonce()
+        quote = fake_tpu.fetch_attestation(nonce)
+        with pytest.raises(AttestationError, match="fake-platform"):
+            verify_quote(quote, nonce, quote.mode)
+
+    def test_fake_quote_allowed_when_opted_in(self, fake_tpu):
+        nonce = fresh_nonce()
+        quote = fake_tpu.fetch_attestation(nonce)
+        assert verify_quote(quote, nonce, quote.mode, allow_fake=True) == []

@@ -96,13 +96,37 @@ def test_slice_mode_requires_all_chips(fake_kube):
     backend = FakeTpuBackend(slice_cc_supported=[True, True, True, False])
     fake_kube.add_node(NODE)
     mgr = make_manager(fake_kube, backend)
-    # Reference PPCIe all-must-support rule (main.py:279-282).
-    with pytest.raises(SystemExit):
-        mgr.set_cc_mode(MODE_SLICE)
+    # Reference PPCIe all-must-support rule (main.py:279-282) — but unlike
+    # the reference's sys.exit(1) crash loop, stable hardware
+    # misconfiguration fails SOFT with a reason label.
+    assert mgr.set_cc_mode(MODE_SLICE) is False
+    from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
+
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels.get(CC_MODE_STATE_LABEL) == STATE_FAILED
+    assert labels.get(CC_FAILED_REASON_LABEL) == "slice-mode-unsupported"
+    # Hardware untouched.
+    assert "reset" not in [op for op, _ in backend.op_log]
+
+
+def test_failed_reason_cleared_on_recovery(fake_kube):
+    backend = FakeTpuBackend(slice_cc_supported=[True, True, True, False])
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_SLICE) is False
+    # Operator fixes the desired mode; the reason label must not linger.
+    assert mgr.set_cc_mode(MODE_ON) is True
+    from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
+
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels.get(CC_MODE_STATE_LABEL) == MODE_ON
+    assert CC_FAILED_REASON_LABEL not in labels
 
 
 def test_slice_mode_happy_path(fake_kube):
-    backend = FakeTpuBackend(num_hosts=2, accelerator_type="v5p-32")
+    # Single-host slice topology: mode 'slice' without the multi-host
+    # barrier (the cross-host case is covered by tests/test_slicecoord.py).
+    backend = FakeTpuBackend(accelerator_type="v5p-8")
     fake_kube.add_node(NODE)
     mgr = make_manager(fake_kube, backend)
     assert mgr.set_cc_mode(MODE_SLICE) is True
@@ -261,6 +285,15 @@ def test_phase_metrics_recorded(fake_kube, fake_tpu):
     text = registry.render_prometheus()
     assert "tpu_cc_reconcile_seconds" in text
     assert 'phase="reset"' in text
+    # Cumulative counters survive the bounded history: a scraper that
+    # misses a reconcile still sees its latency in the totals.
+    assert 'tpu_cc_phase_seconds_total{mode="on",phase="reset"}' in text
+    assert 'tpu_cc_phase_runs_total{mode="on",phase="reset"} 1' in text
+    assert 'tpu_cc_reconciles_total{result="ok"} 1' in text
+    mgr.set_cc_mode(MODE_OFF)
+    text = registry.render_prometheus()
+    assert 'tpu_cc_phase_runs_total{mode="off",phase="reset"} 1' in text
+    assert 'tpu_cc_reconciles_total{result="ok"} 2' in text
 
 
 def test_strict_eviction_timeout_fails_without_touching_hardware(

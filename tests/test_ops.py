@@ -74,6 +74,67 @@ class TestFlashAttention:
         for g, rg in zip(grads, ref_grads):
             np.testing.assert_allclose(g, rg, atol=1e-4, rtol=1e-4)
 
+    def _grad_check(self, S, causal, block_q, block_k, atol=1e-4):
+        """dq/dk/dv of the flash backward (block recomputation, never an
+        (S,S) buffer) against the plain-XLA vjp."""
+        q, k, v = attn_inputs(S=S)
+        # A non-symmetric loss so dq/dk/dv all get distinct cotangents.
+        w = jnp.arange(S, dtype=jnp.float32)[None, None, :, None] / S
+
+        def loss(q, k, v):
+            return jnp.sum(w * flash_attention(q, k, v, causal, block_q, block_k))
+
+        def ref_loss(q, k, v):
+            return jnp.sum(w * reference_attention(q, k, v, causal))
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, rg, name in zip(grads, ref_grads, "q k v".split()):
+            np.testing.assert_allclose(
+                g, rg, atol=atol, rtol=atol, err_msg=f"d{name} mismatch"
+            )
+
+    def test_gradients_indivisible_seq(self):
+        # Tail blocks on BOTH the dq (key tail) and dk/dv (query tail)
+        # kernels: 96 % 64 != 0.
+        self._grad_check(S=96, causal=True, block_q=64, block_k=64)
+
+    def test_gradients_unequal_blocks(self):
+        self._grad_check(S=128, causal=True, block_q=64, block_k=32)
+
+    def test_gradients_non_causal(self):
+        self._grad_check(S=96, causal=False, block_q=64, block_k=64)
+
+    def test_gradients_bf16(self):
+        q, k, v = attn_inputs(S=64, dtype=jnp.bfloat16)
+
+        def loss(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, 32, 32).astype(jnp.float32)
+            )
+
+        def ref_loss(q, k, v):
+            return jnp.sum(
+                reference_attention(
+                    q.astype(jnp.float32),
+                    k.astype(jnp.float32),
+                    v.astype(jnp.float32),
+                )
+            )
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, rg in zip(grads, ref_grads):
+            assert g.dtype == jnp.bfloat16  # grads match primal dtype
+            np.testing.assert_allclose(
+                g.astype(jnp.float32), rg, atol=5e-2, rtol=5e-2
+            )
+
+    def test_gradients_under_jit_and_larger_seq(self):
+        # A size where materializing (S,S) per head would dominate memory;
+        # the backward must still agree with the reference vjp under jit.
+        self._grad_check(S=384, causal=True, block_q=128, block_k=128)
+
 
 class TestTiledMatmul:
     def test_matches_xla(self):

@@ -218,6 +218,8 @@ def test_rollback_removes_previously_absent_label(fake_kube):
     assert len(result.rolled_back) == 1
     assert result.rolled_back[0].states == {"node-0": "reverted-unawaited"}
     assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("node-0"))
+    # The summary must not report an unawaited revert success-shaped.
+    assert result.summary()["rolled_back"] == {"node/node-0": "unverified"}
 
 
 def test_no_rollback_by_default(fake_kube):

@@ -1,0 +1,263 @@
+"""Slice-wide multi-host commit barrier (ccmanager/slicecoord.py).
+
+The invariant under test is the cross-host generalization of the reference's
+PPCIe fabric atomicity (reference main.py:362-368): **no host of an ICI
+slice resets its runtime before every host of the slice is staged and
+drained**, plus the crash/timeout recovery semantics around it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.slicecoord import (
+    SLICE_COMMIT_LABEL,
+    SLICE_STAGED_LABEL,
+)
+from tpu_cc_manager.drain.pause import is_paused
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    DRAIN_COMPONENT_LABELS,
+    MODE_OFF,
+    MODE_ON,
+    MODE_SLICE,
+    SLICE_ID_LABEL,
+    STATE_FAILED,
+)
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NS = "tpu-operator"
+SLICE = "test-slice-0"
+DP_LABEL = "google.com/tpu.deploy.device-plugin"
+DP_APP = DRAIN_COMPONENT_LABELS[DP_LABEL]
+
+
+class SeqBackend(FakeTpuBackend):
+    """Fake backend that mirrors stage/reset ops into a shared, lock-guarded
+    global sequence so cross-host ordering can be asserted."""
+
+    def __init__(self, seq: list, seq_lock: threading.Lock, host: int, **kw):
+        super().__init__(**kw)
+        self._seq = seq
+        self._seq_lock = seq_lock
+        self._host = host
+
+    def _note(self, op: str) -> None:
+        with self._seq_lock:
+            self._seq.append((self._host, op))
+
+    def stage_cc_mode(self, chips, mode):
+        super().stage_cc_mode(chips, mode)
+        self._note("stage")  # after: "staged" is true once this returns
+
+    def reset(self, chips):
+        self._note("reset")  # before: "resetting" is true once this starts
+        super().reset(chips)
+
+
+def node_name(i: int) -> str:
+    return f"tpu-node-{i}"
+
+
+def make_host(
+    kube, seq, seq_lock, i: int, num_hosts: int = 2, *, evict=False, **kw
+) -> tuple[CCManager, SeqBackend]:
+    backend = SeqBackend(
+        seq,
+        seq_lock,
+        i,
+        num_chips=4,
+        accelerator_type="v5p-32",
+        num_hosts=num_hosts,
+        host_index=i,
+        slice_id=SLICE,
+    )
+    mgr = CCManager(
+        api=kube,
+        backend=backend,
+        node_name=node_name(i),
+        operator_namespace=NS,
+        evict_components=evict,
+        smoke_workload="none",
+        metrics=MetricsRegistry(),
+        eviction_timeout_s=2,
+        eviction_poll_interval_s=0.01,
+        slice_barrier_timeout_s=kw.pop("slice_barrier_timeout_s", 10.0),
+        slice_barrier_poll_interval_s=0.01,
+        **kw,
+    )
+    return mgr, backend
+
+
+def run_all(mgrs, mode):
+    results = {}
+
+    def drive(i, mgr):
+        results[i] = mgr.set_cc_mode(mode)
+
+    threads = [
+        threading.Thread(target=drive, args=(i, mgr)) for i, mgr in enumerate(mgrs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return results
+
+
+def test_no_reset_before_all_hosts_staged_and_drained(fake_kube):
+    """The core invariant, with eviction on: every host's drain + stage
+    completes before ANY host's reset begins."""
+    seq, seq_lock = [], threading.Lock()
+    mgrs, backends = [], []
+    for i in range(2):
+        fake_kube.add_node(node_name(i), labels={DP_LABEL: "true"})
+        fake_kube.add_pod(NS, f"dp-{i}", node_name(i), labels={"app": DP_APP})
+        mgr, be = make_host(fake_kube, seq, seq_lock, i, evict=True)
+        mgrs.append(mgr)
+        backends.append(be)
+
+    # Emulate the operator controller: a paused component label on a node
+    # deletes that node's component pods (reference relies on the external
+    # GPU operator for this, gpu_operator_eviction.py:185-207).
+    def reactor(name, node):
+        if is_paused(node_labels(node).get(DP_LABEL, "")):
+            i = int(name.rsplit("-", 1)[1])
+            fake_kube.delete_pod(NS, f"dp-{i}")
+
+    fake_kube.add_patch_reactor(reactor)
+
+    results = run_all(mgrs, MODE_SLICE)
+    assert results == {0: True, 1: True}
+
+    # Ordering invariant: both hosts staged before either reset.
+    first_reset = next(k for k, (_, op) in enumerate(seq) if op == "reset")
+    staged_hosts = {h for h, op in seq[:first_reset] if op == "stage"}
+    assert staged_hosts == {0, 1}, f"reset before full staging: {seq}"
+
+    for i, be in enumerate(backends):
+        labels = node_labels(fake_kube.get_node(node_name(i)))
+        assert labels.get(CC_MODE_STATE_LABEL) == MODE_SLICE
+        assert labels.get(SLICE_ID_LABEL) == SLICE
+        # Barrier markers are cleaned up after completion.
+        assert SLICE_STAGED_LABEL not in labels
+        assert SLICE_COMMIT_LABEL not in labels
+        assert set(be.committed.values()) == {MODE_SLICE}
+        # Components re-admitted (drain labels restored).
+        assert labels.get(DP_LABEL) == "true"
+
+
+def test_multi_host_barrier_applies_to_any_mode(fake_kube):
+    """A plain 'on' change on a multi-host slice also rides the barrier:
+    the whole ICI domain bounces, so fabric atomicity applies regardless
+    of the mode value."""
+    seq, seq_lock = [], threading.Lock()
+    mgrs = []
+    for i in range(2):
+        fake_kube.add_node(node_name(i))
+        mgr, _ = make_host(fake_kube, seq, seq_lock, i)
+        mgrs.append(mgr)
+    results = run_all(mgrs, MODE_ON)
+    assert results == {0: True, 1: True}
+    first_reset = next(k for k, (_, op) in enumerate(seq) if op == "reset")
+    assert {h for h, op in seq[:first_reset] if op == "stage"} == {0, 1}
+
+
+def test_barrier_timeout_fails_soft(fake_kube):
+    """A host whose peers never show up must not touch the hardware: it
+    fails the reconcile, labels itself failed, withdraws its staged marker,
+    and re-admits its components."""
+    seq, seq_lock = [], threading.Lock()
+    fake_kube.add_node(node_name(0), labels={DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "dp-0", node_name(0), labels={"app": DP_APP})
+
+    def reactor(name, node):
+        if is_paused(node_labels(node).get(DP_LABEL, "")):
+            fake_kube.delete_pod(NS, "dp-0")
+
+    fake_kube.add_patch_reactor(reactor)
+    mgr, backend = make_host(
+        fake_kube, seq, seq_lock, 0, evict=True, slice_barrier_timeout_s=0.3
+    )
+    assert mgr.set_cc_mode(MODE_SLICE) is False
+    labels = node_labels(fake_kube.get_node(node_name(0)))
+    assert labels.get(CC_MODE_STATE_LABEL) == STATE_FAILED
+    assert SLICE_STAGED_LABEL not in labels  # withdrew from the barrier
+    assert labels.get(DP_LABEL) == "true"  # components re-admitted
+    assert ("reset") not in [op for _, op in seq]  # hardware untouched
+    assert set(backend.committed.values()) == {MODE_OFF}
+
+
+def test_crash_mid_barrier_peer_recovers(fake_kube):
+    """Host 1 crashes after staging (its marker is on the node but its agent
+    is gone). Host 0 — the leader — correctly proceeds: the invariant is
+    'all staged+drained before reset', which held. When host 1's agent
+    restarts, its re-apply converges against the already-committed peer."""
+    seq, seq_lock = [], threading.Lock()
+    fake_kube.add_node(node_name(0))
+    fake_kube.add_node(node_name(1))
+    # Host 1 staged, then crashed: marker present, agent dead.
+    fake_kube.set_node_label(node_name(1), SLICE_ID_LABEL, SLICE)
+    fake_kube.set_node_label(node_name(1), SLICE_STAGED_LABEL, MODE_SLICE)
+
+    mgr0, be0 = make_host(fake_kube, seq, seq_lock, 0)
+    assert mgr0.set_cc_mode(MODE_SLICE) is True
+    assert set(be0.committed.values()) == {MODE_SLICE}
+
+    # Host 1's agent restarts and re-runs the apply. Its peer has already
+    # committed (state label says so), so the barrier admits the straggler
+    # without requiring the peer to re-stage.
+    mgr1, be1 = make_host(fake_kube, seq, seq_lock, 1)
+    assert mgr1.set_cc_mode(MODE_SLICE) is True
+    assert set(be1.committed.values()) == {MODE_SLICE}
+
+    for i in range(2):
+        labels = node_labels(fake_kube.get_node(node_name(i)))
+        assert labels.get(CC_MODE_STATE_LABEL) == MODE_SLICE
+        assert SLICE_STAGED_LABEL not in labels
+
+
+def test_leader_crash_after_commit_recovers(fake_kube):
+    """The leader crashed after publishing its commit marker; the follower
+    (who saw the marker) completed. The restarted leader's re-apply clears
+    its stale marker and converges via the peers-already-committed path."""
+    seq, seq_lock = [], threading.Lock()
+    fake_kube.add_node(node_name(0))
+    fake_kube.add_node(node_name(1))
+    # Leftover state from the crashed round: leader committed marker + its
+    # own staged marker; follower completed fully.
+    fake_kube.set_node_label(node_name(0), SLICE_ID_LABEL, SLICE)
+    fake_kube.set_node_label(node_name(0), SLICE_STAGED_LABEL, MODE_SLICE)
+    fake_kube.set_node_label(node_name(0), SLICE_COMMIT_LABEL, MODE_SLICE)
+    fake_kube.set_node_label(node_name(1), SLICE_ID_LABEL, SLICE)
+    fake_kube.set_node_label(node_name(1), CC_MODE_STATE_LABEL, MODE_SLICE)
+
+    mgr0, be0 = make_host(fake_kube, seq, seq_lock, 0)
+    assert mgr0.set_cc_mode(MODE_SLICE) is True
+    assert set(be0.committed.values()) == {MODE_SLICE}
+    labels = node_labels(fake_kube.get_node(node_name(0)))
+    assert labels.get(CC_MODE_STATE_LABEL) == MODE_SLICE
+    assert SLICE_STAGED_LABEL not in labels
+    assert SLICE_COMMIT_LABEL not in labels
+
+
+def test_single_host_topology_skips_barrier(fake_kube, fake_tpu):
+    """Single-host nodes never publish barrier markers (no peers to wait
+    for); the apply is the plain reference-shaped phase sequence."""
+    fake_kube.add_node(node_name(0))
+    mgr = CCManager(
+        api=fake_kube,
+        backend=fake_tpu,
+        node_name=node_name(0),
+        operator_namespace=NS,
+        evict_components=False,
+        smoke_workload="none",
+        metrics=MetricsRegistry(),
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    labels = node_labels(fake_kube.get_node(node_name(0)))
+    assert SLICE_STAGED_LABEL not in labels
+    assert SLICE_COMMIT_LABEL not in labels

@@ -34,8 +34,37 @@ def test_llama_smoke_passes():
     result = runner.run_workload("llama", batch=2, prompt_len=8, decode_len=4)
     assert result["ok"] is True
     assert result["oracle_ok"] is True
+    assert result["transcript_ok"] is True
     if result["timing_valid"]:
         assert result["tokens_per_sec"] > 0
+
+
+def test_llama_transcript_oracle_spans_32_decode_positions():
+    """The decode oracle covers the full ≥32-token greedy chain, every
+    position checked against the no-cache forward (VERDICT r2 item 8)."""
+    result = runner.run_workload("llama", batch=2, prompt_len=8, decode_len=32)
+    assert result["ok"] is True
+    assert result["transcript_ok"] is True
+    assert result["transcript_positions"] >= 32
+
+
+def test_llama_oracle_catches_cache_position_off_by_one():
+    """A seeded off-by-one in the cached-decode position MUST trip the
+    oracle — proof the smoke can catch the bug class it exists for."""
+    from tpu_cc_manager.smoke import llama_infer
+    from tpu_cc_manager.smoke.runner import SmokeError
+
+    with pytest.raises(SmokeError):
+        # runner.run_workload raises when the workload reports not-ok.
+        runner.run_workload(
+            "llama", batch=2, prompt_len=8, decode_len=16,
+            cache_position_offset=1,
+        )
+    # And directly: the transcript oracle specifically is what fails.
+    result = llama_infer.run(
+        batch=2, prompt_len=8, decode_len=16, cache_position_offset=1
+    )
+    assert result["ok"] is False
 
 
 def test_resnet_smoke_passes():

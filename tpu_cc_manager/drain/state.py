@@ -12,21 +12,33 @@ import logging
 
 from tpu_cc_manager.kubeclient.api import KubeApi
 from tpu_cc_manager.labels import (
+    CC_FAILED_REASON_LABEL,
     CC_MODE_STATE_LABEL,
     CC_READY_STATE_LABEL,
+    STATE_FAILED,
+    label_safe,
     ready_state_for,
 )
 
 log = logging.getLogger(__name__)
 
 
-def set_cc_state_label(api: KubeApi, node_name: str, state: str) -> None:
+def set_cc_state_label(
+    api: KubeApi, node_name: str, state: str, reason: str | None = None
+) -> None:
+    """Report actual state; on ``failed`` also publish a machine-readable
+    reason label, cleared again by any non-failed state. One merge-patch."""
     ready = ready_state_for(state)
     log.info(
-        "reporting state on %s: %s=%s %s=%s",
+        "reporting state on %s: %s=%s %s=%s%s",
         node_name, CC_MODE_STATE_LABEL, state, CC_READY_STATE_LABEL, ready,
+        f" reason={reason}" if reason else "",
     )
-    api.patch_node_labels(
-        node_name,
-        {CC_MODE_STATE_LABEL: state, CC_READY_STATE_LABEL: ready},
-    )
+    patch: dict[str, str | None] = {
+        CC_MODE_STATE_LABEL: state,
+        CC_READY_STATE_LABEL: ready,
+        CC_FAILED_REASON_LABEL: (
+            label_safe(reason) if state == STATE_FAILED and reason else None
+        ),
+    }
+    api.patch_node_labels(node_name, patch)

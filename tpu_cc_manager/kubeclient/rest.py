@@ -20,6 +20,7 @@ import logging
 import os
 import ssl
 import tempfile
+import time
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass
@@ -122,8 +123,20 @@ class ClusterConfig:
 
 
 class RestKube(KubeApi):
-    def __init__(self, config: ClusterConfig):
+    # Transient statuses worth one more try on the non-watch verbs; a watch
+    # stream has its own reconnect loop in the caller (manager.py) and is
+    # never retried here.
+    RETRYABLE_STATUS = (429, 500, 502, 503, 504)
+
+    def __init__(
+        self,
+        config: ClusterConfig,
+        retry_attempts: int = 3,
+        retry_base_delay_s: float = 0.5,
+    ):
         self.config = config
+        self.retry_attempts = max(1, retry_attempts)
+        self.retry_base_delay_s = retry_base_delay_s
         self._ssl_ctx = self._build_ssl_context(config)
 
     @staticmethod
@@ -166,9 +179,29 @@ class RestKube(KubeApi):
 
     def _request_json(self, method: str, path: str, query: dict | None = None,
                       body: dict | None = None, content_type: str | None = None) -> dict:
+        """One apiserver round trip with bounded retry on transient
+        failures (connection errors, 429, 5xx). All the verbs this client
+        retries are idempotent (GET, label merge-patch), so a retry after
+        an ambiguous failure is safe. Client-side errors (4xx) propagate
+        immediately — a 404/409 will not improve with repetition."""
         raw = json.dumps(body).encode() if body is not None else None
-        with self._open(method, path, query, raw, content_type) as resp:
-            return json.loads(resp.read().decode("utf-8"))
+        delay = self.retry_base_delay_s
+        for attempt in range(self.retry_attempts):
+            try:
+                with self._open(method, path, query, raw, content_type) as resp:
+                    return json.loads(resp.read().decode("utf-8"))
+            except KubeApiError as e:
+                transient = e.status is None or e.status in self.RETRYABLE_STATUS
+                if not transient or attempt == self.retry_attempts - 1:
+                    raise
+                log.warning(
+                    "transient apiserver error (%s/%s) on %s %s: %s — "
+                    "retrying in %.1fs",
+                    attempt + 1, self.retry_attempts, method, path, e, delay,
+                )
+                time.sleep(delay)
+                delay *= 2
+        raise AssertionError("unreachable")  # loop always returns or raises
 
     # ---- KubeApi ---------------------------------------------------------
 

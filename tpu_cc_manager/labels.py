@@ -45,6 +45,13 @@ MODE_ALIASES = {"ppcie": MODE_SLICE}
 # (reference gpu_operator_eviction.py:268).
 STATE_FAILED = "failed"
 
+# Machine-readable failure reason, set alongside state=failed and cleared on
+# any other state. No reference counterpart (the reference's only failure
+# signal is the bare 'failed' value); added so operators can distinguish a
+# misconfigured node (e.g. slice mode on non-slice hardware) from a
+# transient device fault without scraping agent logs.
+CC_FAILED_REASON_LABEL = "cloud.google.com/tpu-cc.failed.reason"
+
 # Drained components: label key on the node -> pod app label selector value.
 # Reference analogue: the five nvidia.com/gpu.deploy.* components and their
 # app-label map (gpu_operator_eviction.py:23-38). The TPU set covers the GKE
@@ -76,6 +83,16 @@ PAUSED_SUFFIX = "_paused-for-tpu-cc-mode-change"
 def canonical_mode(mode: str) -> str:
     """Map deprecated aliases onto canonical mode names (``ppcie``→``slice``)."""
     return MODE_ALIASES.get(mode, mode)
+
+
+def label_safe(value: str, max_len: int = 63) -> str:
+    """Coerce a string into a valid k8s label value (alnum/-/_/. and at most
+    63 chars; must start and end alphanumeric). The single shared sanitizer
+    — every module writing derived label values (slice ids, failure
+    reasons) must produce identical output for identical input."""
+    cleaned = "".join(c if (c.isalnum() or c in "-_.") else "-" for c in value)
+    cleaned = cleaned[:max_len].strip("-_.")
+    return cleaned or "unknown"
 
 
 def ready_state_for(state: str) -> str:

@@ -53,8 +53,12 @@ class LlamaConfig:
     remat: bool = False
     # Use the pallas flash-attention kernel (ops/flash_attention.py) on the
     # no-cache (training/prefill) path; the cached decode path always uses
-    # the einsum attention (its working set is already small).
-    use_flash: bool = False
+    # the einsum attention (its working set is already small). None (the
+    # default) resolves to True on TPU — the kernel (forward AND flash
+    # backward, O(S·D) memory) is the production path — and False elsewhere
+    # (on CPU pallas runs in interpreter mode, which is for correctness
+    # tests, not speed).
+    use_flash: bool | None = None
     # Long-context sequence/context parallelism: when a mesh is given, the
     # no-cache (training/prefill) attention runs as ring attention
     # (ops/ring_attention.py) with the sequence sharded over ``ring_axis``
@@ -210,7 +214,10 @@ class Attention(nn.Module):
             k, v = k_buf, v_buf
             layer_cache = (k_buf, v_buf)
 
-        if layer_cache is None and (cfg.ring_mesh is not None or cfg.use_flash):
+        use_flash = cfg.use_flash
+        if use_flash is None:
+            use_flash = jax.default_backend() == "tpu"
+        if layer_cache is None and (cfg.ring_mesh is not None or use_flash):
             # Kernel layout is (B, heads, S, D).
             qf = q.transpose(0, 2, 1, 3)
             kf = k.transpose(0, 2, 1, 3)

@@ -1,15 +1,23 @@
-"""Flash attention (fused online-softmax) as a pallas TPU kernel.
+"""Flash attention (fused online-softmax) as pallas TPU kernels.
 
 Forward pass never materializes the (S, S) score matrix: the grid walks
 query blocks, and an inner fori_loop streams key/value blocks through VMEM
-maintaining the running max / normalizer / accumulator (the
-Dao et al. online-softmax recurrence). Backward recomputes attention from
-the saved inputs with the plain-XLA reference implementation — flash's
-standard memory/FLOPs trade, and exact to f32 accumulation either way.
+maintaining the running max / normalizer / accumulator (the Dao et al.
+online-softmax recurrence), saving per-row logsumexp for the backward.
+
+Backward is flash too (standard block recomputation): two pallas kernels —
+dQ over query blocks, dK/dV over key blocks — rebuild each P block as
+``exp(s − lse)`` from the saved inputs, so training memory stays
+O(S·D + S), never O(S²). The classic identity
+``dS = P ∘ (dP − rowsum(dO ∘ O))`` supplies the softmax backward without
+storing P.
 
 Layout: (B, H, S, D) with D the head dim (<=128: one MXU lane tile).
-Causal only (that is what the smoke models need). On CPU the kernel runs in
-pallas interpreter mode.
+Causal and non-causal. On CPU the kernels run in pallas interpreter mode.
+
+Reference counterpart: none (the reference has no ML/kernel code,
+SURVEY.md §2); this exists for the smoke/validation workloads and the
+long-context training path (models/llama.py).
 """
 
 from __future__ import annotations
@@ -24,7 +32,7 @@ NEG_INF = -1e30
 
 
 def reference_attention(q, k, v, causal: bool = True):
-    """Plain-XLA attention, the numerics oracle and the backward path."""
+    """Plain-XLA attention, the numerics oracle for the kernels."""
     _, _, S, D = q.shape
     scores = jnp.einsum(
         "bhsd,bhtd->bhst", q, k, preferred_element_type=jnp.float32
@@ -37,8 +45,13 @@ def reference_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                seq_len: int, causal: bool):
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+                block_k: int, seq_len: int, causal: bool):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (block_q, D)
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -88,7 +101,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, k_hi, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # Per-row logsumexp in the scaled-score domain; the backward rebuilds
+    # each P block as exp(s - lse).
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -111,7 +128,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         kr = jnp.pad(kr, ((0, 0), (0, s_pad - S), (0, 0)))
         vr = jnp.pad(vr, ((0, 0), (0, s_pad - S), (0, 0)))
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_q=block_q, block_k=block_k,
             seq_len=S, causal=causal,
@@ -122,8 +139,14 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, s_pad, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s_pad, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S), jnp.float32),
+        ],
         cost_estimate=pl.CostEstimate(
             flops=4 * B * H * S * S * D,
             bytes_accessed=(3 * B * H * S * D + B * H * S * D) * q.dtype.itemsize,
@@ -131,7 +154,237 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ),
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, H, S, D)
+    return out.reshape(B, H, S, D), lse
+
+
+# ---------------------------------------------------------------------------
+# Backward (flash: block recomputation from saved q/k/v/lse)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   block_q: int, block_k: int, seq_len: int, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # (block_q, D)
+    do = do_ref[0].astype(jnp.float32)        # (block_q, D)
+    lse = lse_ref[0][:, None]                 # (block_q, 1)
+    delta = delta_ref[0][:, None]             # (block_q, 1)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        last_q_pos = (qi + 1) * block_q - 1
+        k_hi = jnp.minimum(last_q_pos // block_k + 1, num_k_blocks)
+    else:
+        k_hi = num_k_blocks
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(ki, acc):
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        # q_pos < seq_len guards the partial tail query block: its phantom
+        # rows are dropped on write, but NEG_INF − garbage-lse can overflow
+        # exp; keep them exactly zero instead.
+        valid = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # recomputed P block
+        dp = jax.lax.dot_general(                  # dP = dO Vᵀ
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale              # softmax backward
+        return acc + jax.lax.dot_general(          # dQ += dS K
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq_ref[0] = jax.lax.fori_loop(0, k_hi, body, acc0).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, block_k: int,
+                    seq_len: int, padded_q_len: int, causal: bool):
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)      # (block_k, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    D = k_blk.shape[-1]
+    scale = 1.0 / (D**0.5)
+
+    num_q_blocks = padded_q_len // block_q
+    # Causal: query blocks strictly before this key block contribute nothing.
+    start = (ki * block_k) // block_q if causal else 0
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        # Phantom (zero-padded) query rows carry lse=0/delta=0; masking s to
+        # NEG_INF makes their recomputed P rows exactly zero, so they add
+        # nothing to dK/dV. Phantom key columns are sliced away by the
+        # caller.
+        valid = (q_pos < seq_len) & (k_pos < seq_len)
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse_blk)
+        dv = dv + jax.lax.dot_general(             # dV += Pᵀ dO
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(                  # dP = dO Vᵀ
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_blk) * scale
+        dk = dk + jax.lax.dot_general(             # dK += dSᵀ Q
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, D), jnp.float32)
+    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    B, H, S, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+    dor = g.reshape(B * H, S, D)
+    outr = out.reshape(B * H, S, D)
+
+    # delta_i = rowsum(dO ∘ O): the softmax-backward correction term,
+    # computed once in XLA (elementwise + reduce; no S² anywhere).
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1
+    )  # (B*H, S)
+
+    # --- dQ: grid over query blocks, stream key blocks -------------------
+    s_pad_k = pl.cdiv(S, block_k) * block_k
+    kr_p, vr_p = kr, vr
+    if s_pad_k != S:
+        kr_p = jnp.pad(kr, ((0, 0), (0, s_pad_k - S), (0, 0)))
+        vr_p = jnp.pad(vr, ((0, 0), (0, s_pad_k - S), (0, 0)))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            seq_len=S, causal=causal,
+        ),
+        grid=(B * H, pl.cdiv(S, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_pad_k, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad_k, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=5 * B * H * S * S * D,
+            bytes_accessed=4 * B * H * S * D * q.dtype.itemsize,
+            transcendentals=B * H * S * S,
+        ),
+        interpret=interpret,
+    )(qr, kr_p, vr_p, dor, lse, delta)
+
+    # --- dK/dV: grid over key blocks, stream query blocks ----------------
+    # Queries/dO/lse/delta are zero-padded to a block_q multiple so the
+    # kernel's pl.ds reads are in-bounds; lse=0 + s=NEG_INF keeps phantom
+    # rows exactly zero (see kernel comment).
+    s_pad_q = pl.cdiv(S, block_q) * block_q
+    qr_p, dor_p, lse_p, delta_p = qr, dor, lse, delta
+    if s_pad_q != S:
+        pad = s_pad_q - S
+        qr_p = jnp.pad(qr, ((0, 0), (0, pad), (0, 0)))
+        dor_p = jnp.pad(dor, ((0, 0), (0, pad), (0, 0)))
+        lse_p = jnp.pad(lse, ((0, 0), (0, pad)))
+        delta_p = jnp.pad(delta, ((0, 0), (0, pad)))
+    if s_pad_k != S:
+        # Padded dk/dv outputs; phantom key rows are zero (masked) and
+        # sliced away below.
+        kr_p2, vr_p2 = kr_p, vr_p
+    else:
+        kr_p2, vr_p2 = kr, vr
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            seq_len=S, padded_q_len=s_pad_q, causal=causal,
+        ),
+        grid=(B * H, s_pad_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_pad_q, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad_q, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad_q), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, s_pad_q), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, s_pad_k, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, s_pad_k, D), v.dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=5 * B * H * S * S * D,
+            bytes_accessed=4 * B * H * S * D * q.dtype.itemsize,
+            transcendentals=B * H * S * S,
+        ),
+        interpret=interpret,
+    )(kr_p2, vr_p2, qr_p, dor_p, lse_p, delta_p)
+    if s_pad_k != S:
+        dk = dk[:, :S]
+        dv = dv[:, :S]
+
+    return (
+        dq.reshape(B, H, S, D),
+        dk.reshape(B, H, S, D),
+        dv.reshape(B, H, S, D),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -139,18 +392,22 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                     block_k: int = 128):
     """Fused causal attention. q/k/v: (B, H, S, D); returns (B, H, S, D)."""
     interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _fwd_rule(q, k, v, causal, block_q, block_k):
-    out = flash_attention(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd_rule(causal, block_q, block_k, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    interpret = jax.default_backend() != "tpu"
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import time
 
+from tpu_cc_manager.utils.tpu_info import generation_for
+
 
 def _pick_config(size: str | None):
     import jax
@@ -49,7 +51,13 @@ def run(
     prompt_len: int = 32,
     decode_len: int = 32,
     seed: int = 0,
+    cache_position_offset: int = 0,
 ) -> dict:
+    """``cache_position_offset`` is a test-only fault hook: it shifts every
+    cached-decode position by the given amount, emulating the classic
+    off-by-one cache-indexing bug. tests/test_smoke.py proves the decode
+    oracle FAILS when it is non-zero — an oracle that can't catch the bug
+    it exists for is decoration."""
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -87,7 +95,8 @@ def run(
 
         def step(variables, token, cache, position):
             logits, cache = model.apply(
-                variables, token[:, None], cache=cache, position=position
+                variables, token[:, None], cache=cache,
+                position=position + cache_position_offset,
             )
             return jnp.argmax(logits[:, 0], axis=-1), cache
 
@@ -121,13 +130,49 @@ def run(
             )
             return tok
 
-        # --- correctness oracle (tiny lengths, cache vs no-cache) --------
+        # --- oracle 1: teacher-forced cached prefix vs no-cache ----------
         oracle_len = min(8, prompt_len)
         full_logits, _ = jax.jit(model.apply)(variables, prompt[:, :oracle_len])
         expected = jnp.argmax(full_logits, axis=-1)
         cache = model.init_cache(batch, max_len)
         got = teacher_forced(variables, prompt[:, :oracle_len], cache)
         oracle_ok = bool(jnp.array_equal(got, expected))
+
+        # --- oracle 2: the WHOLE greedy decode transcript ----------------
+        # Decode ``decode_len`` tokens through the cache, then teacher-force
+        # the produced transcript through the no-cache forward and demand
+        # argmax agreement at EVERY generated position. A cache-position
+        # bug past the first few steps (which oracle 1's short prefix would
+        # miss) shifts RoPE phases / attention spans and breaks agreement.
+        oracle_decode = max(1, min(decode_len, cfg.max_seq_len - prompt_len))
+        cache = model.init_cache(batch, prompt_len + oracle_decode)
+        tok0, cache = prefill(variables, prompt, cache)
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def greedy_transcript(variables, tok, cache, position):
+            def body(carry, _):
+                tok, cache, pos = carry
+                ntok, cache = step(variables, tok, cache, pos)
+                return (ntok, cache, pos + 1), ntok
+
+            _, toks = lax.scan(
+                body, (tok, cache, jnp.int32(position)), None,
+                length=oracle_decode - 1,
+            )
+            return toks.T  # (batch, oracle_decode - 1)
+
+        if oracle_decode > 1:
+            rest = greedy_transcript(variables, tok0, cache, prompt_len)
+            gen = jnp.concatenate([tok0[:, None], rest], axis=1)
+        else:
+            gen = tok0[:, None]
+        # Feed prompt + all-but-last generated token; the no-cache argmax
+        # from position prompt_len-1 on must reproduce the transcript.
+        x = jnp.concatenate([prompt, gen[:, :-1]], axis=1)
+        nocache_logits, _ = jax.jit(model.apply)(variables, x)
+        expected_gen = jnp.argmax(nocache_logits[:, prompt_len - 1 :], axis=-1)
+        transcript_ok = bool(jnp.array_equal(gen, expected_gen))
+        oracle_ok = oracle_ok and transcript_ok
 
         # --- timed run ---------------------------------------------------
         # Differential timing, as in smoke/matmul.py: median T(hi steps) -
@@ -168,6 +213,7 @@ def run(
         "workload": "llama",
         "model": size,
         "backend": jax.default_backend(),
+        "generation": generation_for(jax.default_backend()),
         "devices": n_dev,
         "params": cfg.param_count(),
         "batch": batch,
@@ -176,6 +222,8 @@ def run(
         "tokens_per_sec": round(tokens_per_sec, 2) if timing_valid else None,
         "ms_per_token": round(1e3 * dt / decode_len, 3) if timing_valid else None,
         "oracle_ok": oracle_ok,
+        "transcript_ok": transcript_ok,
+        "transcript_positions": int(oracle_decode),
     }
 
 

@@ -172,11 +172,14 @@ def run(size: int | None = None, iters: int | None = None, seed: int = 0,
     # bf16 has ~8 mantissa bits; row-sum of `size` products loses a few more.
     ok = ident_err <= 1e-6 and rowsum_rel_err <= 2e-2
 
+    from tpu_cc_manager.utils.tpu_info import generation_for
+
     return {
         "ok": bool(ok),
         "workload": "matmul",
         "kernel": kernel,
         "backend": backend,
+        "generation": generation_for(backend),
         "devices": n_dev,
         "size": size,
         "timing_valid": bool(timing_valid),

@@ -14,6 +14,7 @@ import time
 from functools import partial
 
 
+from tpu_cc_manager.utils.tpu_info import generation_for
 from tpu_cc_manager.utils.tpu_info import peak_flops_per_chip as _peak_flops_per_device
 
 
@@ -168,6 +169,7 @@ def run(size: str | None = None, batch: int | None = None, steps: int = 6,
         "workload": "resnet",
         "model": size,
         "backend": backend,
+        "generation": generation_for(backend),
         "devices": n_dev,
         "batch": batch,
         "timing_valid": bool(timing_valid),

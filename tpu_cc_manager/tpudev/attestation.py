@@ -8,11 +8,15 @@ relaxed: problems are logged, not fatal (the reference's devtools is a GPU
 debug mode; the TPU analogue is a debug attestation policy, labels.py).
 
 Verifier dispatch is by quote ``platform``:
-- ``fake``  — HMAC with the shared test key (tpudev/fake.py),
-- ``tpuvm`` — GCE instance-identity JWT checks (tpudev/tpuvm.py); offline
-  parts only (issuer/audience/expiry structure), signature verification
-  against Google's JWKS requires egress and is delegated to the caller's
-  environment.
+- ``fake``  — HMAC with the shared test key (tpudev/fake.py). Rejected
+  outright unless the caller explicitly allows fake quotes (the manager
+  does so only when the operator selected the fake device layer) — a forged
+  ``platform="fake"`` quote must never verify in production.
+- ``tpuvm`` — GCE instance-identity JWT (tpudev/tpuvm.py): structural
+  checks (audience carries the nonce, not expired), issuer must be Google,
+  and the RS256 signature is verified against Google's JWKS
+  (tpudev/jwks.py: offline file > cache > live fetch). Missing key
+  material fails closed.
 """
 
 from __future__ import annotations
@@ -25,6 +29,7 @@ import logging
 import secrets
 import time
 
+from tpu_cc_manager.tpudev import jwks
 from tpu_cc_manager.tpudev.contract import AttestationQuote, TpuError
 
 log = logging.getLogger(__name__)
@@ -55,9 +60,10 @@ def _decode_jwt_segment(seg: str) -> dict:
 
 
 def _check_tpuvm_signature(quote: AttestationQuote) -> list[str]:
-    """Structural checks on a GCE instance-identity JWT carried in
-    ``signature``. Full RS256 verification against Google's JWKS needs
-    network egress; environments with egress can layer it on top."""
+    """Verify a GCE instance-identity JWT carried in ``signature``:
+    structure, nonce binding, expiry, Google issuer, and the RS256
+    signature against Google's JWKS (tpudev/jwks.py). No key material at
+    all is a failure — a quote that *cannot* be checked must not pass."""
     problems = []
     parts = quote.signature.split(".")
     if len(parts) != 3:
@@ -67,7 +73,7 @@ def _check_tpuvm_signature(quote: AttestationQuote) -> list[str]:
         claims = _decode_jwt_segment(parts[1])
     except Exception as e:  # noqa: BLE001 - any decode failure is the finding
         return [f"tpuvm quote JWT undecodable: {e}"]
-    if header.get("alg") not in ("RS256", "ES256"):
+    if header.get("alg") != "RS256":
         problems.append(f"unexpected JWT alg {header.get('alg')!r}")
     aud = claims.get("aud")
     if not aud:
@@ -79,6 +85,20 @@ def _check_tpuvm_signature(quote: AttestationQuote) -> list[str]:
     exp = claims.get("exp")
     if isinstance(exp, (int, float)) and exp < time.time():
         problems.append("JWT expired")
+    if claims.get("iss") not in jwks.GOOGLE_ISSUERS:
+        problems.append(f"unexpected JWT issuer {claims.get('iss')!r}")
+    keyset = jwks.load_jwks()
+    if keyset is None:
+        problems.append(
+            "no JWKS key material for signature verification (set "
+            f"{jwks.JWKS_FILE_ENV} or allow egress to {jwks.GOOGLE_JWKS_URL}); "
+            "failing closed"
+        )
+    else:
+        try:
+            jwks.verify_rs256(quote.signature, keyset)
+        except jwks.JwksError as e:
+            problems.append(f"JWT signature verification failed: {e}")
     return problems
 
 
@@ -94,13 +114,24 @@ def verify_quote(
     expected_mode: str,
     expected_slice_id: str | None = None,
     debug_policy: bool = False,
+    allow_fake: bool = False,
 ) -> list[str]:
     """Verify a quote; returns the (possibly empty) problem list.
 
     Raises AttestationError on any problem unless ``debug_policy`` is set
     (devtools mode), in which case problems are logged and returned.
+
+    ``allow_fake`` admits ``platform="fake"`` quotes (HMAC with the shared
+    test key). The manager enables it only when the operator explicitly
+    selected the fake device layer; everywhere else a fake-platform quote
+    is an attack, not a test.
     """
     problems: list[str] = []
+    if quote.platform == "fake" and not allow_fake:
+        problems.append(
+            "fake-platform quote rejected: the fake device layer is not in "
+            "use (select --tpu-backend=fake for dry-runs)"
+        )
     if quote.nonce != nonce:
         problems.append(f"nonce mismatch: sent {nonce}, quote has {quote.nonce}")
     if quote.mode != expected_mode:

@@ -1,0 +1,171 @@
+"""RS256 JWT verification against a JWKS — pure standard library.
+
+The TPU VM backend's attestation evidence is a GCE instance-identity JWT
+(tpudev/tpuvm.py); trusting it requires verifying its RSASSA-PKCS1-v1_5 /
+SHA-256 signature against Google's published JWKS. *Verification* (unlike
+signing) needs only one modular exponentiation and a constant-time byte
+comparison, so this module is stdlib-only and the distroless production
+image (deployments/container/Dockerfile.distroless) carries no crypto
+dependency. Tests generate throwaway RSA keypairs with the ``cryptography``
+package, which is a test-only dependency.
+
+Key material comes from, in order:
+
+1. an operator-provided offline JWKS file (``CC_GOOGLE_JWKS_FILE``) — the
+   air-gapped / egress-less path; the DaemonSet can mount one fetched at
+   deploy time,
+2. a cached copy from a previous fetch (``CC_JWKS_CACHE_FILE``),
+3. a live fetch of ``GOOGLE_JWKS_URL`` (written back to the cache).
+
+No key material at all is a verification *failure*, not a skip — the
+reference's device layer never reports success without querying the device
+(reference main.py:524-528); the attestation layer holds the same line.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import logging
+import os
+import time
+import urllib.error
+import urllib.request
+
+log = logging.getLogger(__name__)
+
+GOOGLE_JWKS_URL = "https://www.googleapis.com/oauth2/v3/certs"
+# Both spellings are documented for GCE instance-identity tokens.
+GOOGLE_ISSUERS = ("https://accounts.google.com", "accounts.google.com")
+
+JWKS_FILE_ENV = "CC_GOOGLE_JWKS_FILE"
+JWKS_CACHE_ENV = "CC_JWKS_CACHE_FILE"
+DEFAULT_CACHE_FILE = "/var/lib/tpu-cc-manager/jwks-cache.json"
+CACHE_TTL_S = 6 * 3600.0
+
+# DER prefix of DigestInfo for SHA-256 (RFC 8017 §9.2 note 1).
+_SHA256_DIGESTINFO = bytes.fromhex(
+    "3031300d060960864801650304020105000420"
+)
+
+
+class JwksError(Exception):
+    """Signature verification failed or no usable key material."""
+
+
+def _b64url_decode(seg: str) -> bytes:
+    return base64.urlsafe_b64decode(seg + "=" * (-len(seg) % 4))
+
+
+def _b64url_to_int(seg: str) -> int:
+    return int.from_bytes(_b64url_decode(seg), "big")
+
+
+def _emsa_pkcs1_v15_sha256(message: bytes, em_len: int) -> bytes:
+    """EMSA-PKCS1-v1_5 encoding of SHA-256(message) (RFC 8017 §9.2)."""
+    t = _SHA256_DIGESTINFO + hashlib.sha256(message).digest()
+    if em_len < len(t) + 11:
+        raise JwksError("RSA modulus too short for SHA-256 signature")
+    ps = b"\xff" * (em_len - len(t) - 3)
+    return b"\x00\x01" + ps + b"\x00" + t
+
+
+def _candidate_keys(jwks: dict, kid: str | None) -> list[dict]:
+    keys = [k for k in jwks.get("keys", []) if k.get("kty") == "RSA"]
+    if kid is not None:
+        matched = [k for k in keys if k.get("kid") == kid]
+        # An unknown kid falls back to trying every RSA key: Google rotates
+        # keys, and a slightly stale JWKS with the right key under a new kid
+        # should still verify rather than hard-fail on metadata.
+        return matched or keys
+    return keys
+
+
+def verify_rs256(token: str, jwks: dict) -> dict:
+    """Verify an RS256 JWT against a JWKS; return the claims on success.
+
+    Raises :class:`JwksError` on a malformed token, a non-RS256 algorithm,
+    or a signature that verifies under none of the JWKS's RSA keys.
+    """
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise JwksError("token is not a three-part JWT")
+    try:
+        header = json.loads(_b64url_decode(parts[0]))
+        claims = json.loads(_b64url_decode(parts[1]))
+        signature = _b64url_decode(parts[2])
+    except Exception as e:  # noqa: BLE001 - any decode failure is the finding
+        raise JwksError(f"JWT undecodable: {e}") from e
+    if header.get("alg") != "RS256":
+        raise JwksError(f"unsupported JWT alg {header.get('alg')!r}")
+    signing_input = f"{parts[0]}.{parts[1]}".encode("ascii")
+    keys = _candidate_keys(jwks, header.get("kid"))
+    if not keys:
+        raise JwksError("JWKS contains no RSA keys")
+    s = int.from_bytes(signature, "big")
+    for key in keys:
+        try:
+            n = _b64url_to_int(key["n"])
+            e = _b64url_to_int(key["e"])
+        except (KeyError, ValueError):
+            continue
+        k = (n.bit_length() + 7) // 8
+        if len(signature) != k or s >= n:
+            continue
+        em = pow(s, e, n).to_bytes(k, "big")
+        if hmac.compare_digest(em, _emsa_pkcs1_v15_sha256(signing_input, k)):
+            return claims
+    raise JwksError("signature verifies under no JWKS key")
+
+
+def load_jwks(
+    offline_file: str | None = None,
+    cache_file: str | None = None,
+    url: str = GOOGLE_JWKS_URL,
+    fetch_timeout_s: float = 5.0,
+) -> dict | None:
+    """Load key material: offline file > fresh cache > live fetch > stale
+    cache. Returns None when nothing is available (the caller fails closed).
+    """
+    offline_file = offline_file or os.environ.get(JWKS_FILE_ENV)
+    if offline_file:
+        try:
+            with open(offline_file, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            log.error("configured JWKS file %s unreadable: %s", offline_file, e)
+            # An explicitly configured file that is broken should not fall
+            # through to the network: surface the misconfiguration.
+            return None
+
+    cache_file = cache_file or os.environ.get(JWKS_CACHE_ENV, DEFAULT_CACHE_FILE)
+    cached: dict | None = None
+    try:
+        with open(cache_file, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+        cached = payload.get("jwks")
+        if time.time() - float(payload.get("fetched_at", 0)) < CACHE_TTL_S:
+            return cached
+    except (OSError, ValueError):
+        cached = None
+
+    try:
+        with urllib.request.urlopen(url, timeout=fetch_timeout_s) as resp:
+            jwks = json.loads(resp.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError, TimeoutError) as e:
+        if cached is not None:
+            log.warning("JWKS fetch failed (%s); using stale cache", e)
+            return cached
+        log.error("JWKS fetch failed and no cache/offline file: %s", e)
+        return None
+    try:
+        os.makedirs(os.path.dirname(cache_file), exist_ok=True)
+        tmp = cache_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"fetched_at": time.time(), "jwks": jwks}, f)
+        os.replace(tmp, cache_file)
+    except OSError as e:
+        log.warning("could not write JWKS cache %s: %s", cache_file, e)
+    return jwks

@@ -21,6 +21,7 @@ import glob
 import json
 import logging
 import os
+import socket
 import subprocess
 import time
 import urllib.error
@@ -42,8 +43,15 @@ DEFAULT_STATE_DIR = "/var/lib/tpu-cc-manager"
 # Restarting the runtime is the commit point (the reset_with_os analogue,
 # reference main.py:519). Overridable for non-systemd hosts.
 DEFAULT_RESET_CMD = ["systemctl", "restart", "tpu-runtime"]
-# libtpu's default gRPC/health port on TPU VMs.
-DEFAULT_HEALTH_PROBE_CMD = None  # None -> device-node + state-file probe
+DEFAULT_HEALTH_PROBE_CMD = None  # None -> health_port / systemd / device-node probe
+# Cross-check that the reset actually bounced the runtime: the reference's
+# device layer reads truth back from the hardware (reset_with_os +
+# wait_for_boot query the device, main.py:519-528); the systemd unit's
+# monotonic activation timestamp is this backend's equivalent ground truth.
+DEFAULT_SHOW_CMD = [
+    "systemctl", "show", "tpu-runtime",
+    "--property=ActiveState,ActiveEnterTimestampMonotonic",
+]
 
 # chips per host by generation (v4/v5p: 4 chips/host; v5e/v6e: up to 8).
 _CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
@@ -75,6 +83,8 @@ class TpuVmBackend(TpuCcBackend):
         state_dir: str = DEFAULT_STATE_DIR,
         reset_cmd: list[str] | None = None,
         health_probe_cmd: list[str] | None = DEFAULT_HEALTH_PROBE_CMD,
+        show_cmd: list[str] | None = None,
+        health_port: int | None = None,
         metadata_url: str = METADATA_URL,
         device_glob: str = "/dev/accel*",
         vfio_glob: str = "/dev/vfio/[0-9]*",
@@ -82,9 +92,24 @@ class TpuVmBackend(TpuCcBackend):
         self.state_dir = state_dir
         self.reset_cmd = reset_cmd or list(DEFAULT_RESET_CMD)
         self.health_probe_cmd = health_probe_cmd
+        # show_cmd=[] (or CC_RUNTIME_SHOW_CMD="") disables the systemd
+        # cross-checks on non-systemd hosts; None means the default.
+        if show_cmd is None:
+            env = os.environ.get("CC_RUNTIME_SHOW_CMD")
+            show_cmd = env.split() if env is not None else list(DEFAULT_SHOW_CMD)
+        self.show_cmd = show_cmd
+        if health_port is None:
+            health_port = int(os.environ.get("CC_RUNTIME_HEALTH_PORT", "0")) or None
+        self.health_port = health_port
         self.metadata_url = metadata_url
         self.device_glob = device_glob
         self.vfio_glob = vfio_glob
+        # The activation stamp is a HOST fact, but query_cc_mode is per-chip
+        # (contract parity): a short-TTL memo keeps an idempotency sweep
+        # over N chips at one subprocess instead of N. Set to 0 to disable
+        # (tests that rewrite the injected show output mid-flow do).
+        self.stamp_cache_ttl_s = 0.5
+        self._stamp_cache: tuple[float, tuple[str, int] | None] | None = None
 
     # ---- metadata / persistence helpers ---------------------------------
 
@@ -116,6 +141,44 @@ class TpuVmBackend(TpuCcBackend):
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f)
         os.replace(tmp, self._state_path(name))
+
+    # ---- runtime ground truth (systemd) ---------------------------------
+
+    def _runtime_stamp(self, fresh: bool = False) -> tuple[str, int] | None:
+        """(ActiveState, ActiveEnterTimestampMonotonic µs) of the runtime
+        unit, or None when the probe is disabled/unavailable. The monotonic
+        activation timestamp is the backend's ground truth for "the runtime
+        actually restarted" — state files alone can never disagree with the
+        manager that wrote them.
+
+        ``fresh`` bypasses the short-TTL memo — the reset pre/post stamps
+        must never see a cached value."""
+        if not self.show_cmd:
+            return None
+        if not fresh and self._stamp_cache is not None:
+            cached_at, value = self._stamp_cache
+            if time.monotonic() - cached_at < self.stamp_cache_ttl_s:
+                return value
+        try:
+            out = subprocess.run(
+                self.show_cmd, capture_output=True, timeout=10, check=True
+            ).stdout.decode("utf-8", "replace")
+        except (OSError, subprocess.SubprocessError):
+            return None
+        state: str | None = None
+        ts: int | None = None
+        for line in out.splitlines():
+            key, _, value = line.partition("=")
+            if key == "ActiveState":
+                state = value.strip()
+            elif key == "ActiveEnterTimestampMonotonic":
+                try:
+                    ts = int(value.strip())
+                except ValueError:
+                    pass
+        result = None if state is None and ts is None else (state or "unknown", ts or 0)
+        self._stamp_cache = (time.monotonic(), result)
+        return result
 
     # ---- contract --------------------------------------------------------
 
@@ -181,7 +244,26 @@ class TpuVmBackend(TpuCcBackend):
             return "resetting"
         committed = self._read_state("committed.json")
         mode = committed.get(str(chip.index), committed.get("*", MODE_OFF))
-        return mode if mode in VALID_MODES else MODE_OFF
+        if mode not in VALID_MODES:
+            return MODE_OFF
+        if mode != MODE_OFF:
+            # External-restart detection: if the runtime's activation stamp
+            # no longer matches the one recorded at commit time, something
+            # other than this manager bounced the runtime — the committed
+            # mode can no longer be trusted, so report an in-between state
+            # that fails every idempotency check and forces a full re-apply
+            # (re-commit + re-attest).
+            recorded = self._read_state("runtime.json").get("enter_ts")
+            if recorded:
+                current = self._runtime_stamp()
+                if current is not None and current[1] != recorded:
+                    log.warning(
+                        "TPU runtime restarted outside the manager "
+                        "(activation stamp %d != committed %d); reporting "
+                        "'resetting'", current[1], recorded,
+                    )
+                    return "resetting"
+        return mode
 
     def stage_cc_mode(self, chips: tuple[TpuChip, ...], mode: str) -> None:
         staged = self._read_state("staged.json")
@@ -206,6 +288,7 @@ class TpuVmBackend(TpuCcBackend):
         # (crash-as-retry safety, SURVEY.md §7(c)).
         self._write_state("pending.json", pending)
         self._write_state("staged.json", staged)
+        pre_stamp = self._runtime_stamp(fresh=True)
         log.info("restarting TPU runtime: %s", " ".join(self.reset_cmd))
         try:
             subprocess.run(
@@ -220,9 +303,36 @@ class TpuVmBackend(TpuCcBackend):
                 f"reset command failed rc={e.returncode}: "
                 f"{(e.stderr or b'').decode('utf-8', 'replace')[:256]}"
             ) from e
+        # Cross-check the restart actually happened: a reset command that
+        # exits 0 without bouncing the runtime (wrong unit name, masked
+        # unit, no-op wrapper) must not promote pending -> committed. The
+        # pending markers stay behind, so query_cc_mode reports 'resetting'
+        # and the reconcile retries instead of trusting a commit that never
+        # happened.
+        post_stamp = self._runtime_stamp(fresh=True)
+        if (
+            pre_stamp is not None
+            and post_stamp is not None
+            and post_stamp[1] <= pre_stamp[1]
+        ):
+            raise TpuError(
+                "reset command succeeded but the TPU runtime did not "
+                f"restart (ActiveEnterTimestampMonotonic {post_stamp[1]} "
+                f"not newer than {pre_stamp[1]})"
+            )
         committed = self._read_state("committed.json")
         committed.update(pending)
         self._write_state("committed.json", committed)
+        # Record the post-restart stamp; when the probe was unavailable,
+        # CLEAR the record rather than leave a stale one — a stale stamp
+        # would make the next query_cc_mode falsely report an external
+        # restart and fail a healthy reconcile.
+        self._write_state(
+            "runtime.json",
+            {"active_state": post_stamp[0], "enter_ts": post_stamp[1]}
+            if post_stamp is not None
+            else {},
+        )
         self._write_state("pending.json", {})
 
     def wait_ready(self, chips: tuple[TpuChip, ...], timeout_s: float) -> None:
@@ -237,6 +347,11 @@ class TpuVmBackend(TpuCcBackend):
             time.sleep(1.0)
 
     def _probe_healthy(self, chips: tuple[TpuChip, ...]) -> bool:
+        """Layered health probe, strongest available signal first:
+        explicit probe command > runtime health port (TCP) > systemd
+        ActiveState + device nodes > device nodes alone. Bare device-node
+        existence is the weakest signal (nodes persist across a wedged
+        runtime) and is only the last resort."""
         if self.health_probe_cmd is not None:
             try:
                 rc = subprocess.run(
@@ -245,7 +360,17 @@ class TpuVmBackend(TpuCcBackend):
                 return rc == 0
             except (OSError, subprocess.TimeoutExpired):
                 return False
-        # Default probe: every chip's device node is back.
+        if self.health_port:
+            try:
+                with socket.create_connection(
+                    ("127.0.0.1", self.health_port), timeout=2
+                ):
+                    return True
+            except OSError:
+                return False
+        stamp = self._runtime_stamp()
+        if stamp is not None and stamp[0] not in ("active", "unknown"):
+            return False
         return all(os.path.exists(c.device_path) for c in chips)
 
     def fetch_attestation(self, nonce: str) -> AttestationQuote:

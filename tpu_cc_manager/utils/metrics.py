@@ -20,6 +20,7 @@ log = logging.getLogger(__name__)
 # Canonical phase names, in pipeline order.
 PHASE_DRAIN = "drain"
 PHASE_STAGE = "stage"
+PHASE_BARRIER = "barrier"
 PHASE_RESET = "reset"
 PHASE_WAIT_READY = "wait_ready"
 PHASE_ATTEST = "attest"
@@ -48,6 +49,11 @@ class ReconcileMetrics:
     end: float = 0.0
     phases: list[PhaseRecord] = field(default_factory=list)
     result: str = "pending"  # pending | ok | failed | noop
+    # Set by MetricsRegistry.start(); finish() folds this reconcile into the
+    # registry's cumulative counters (which survive the bounded history).
+    registry: "MetricsRegistry | None" = field(
+        default=None, repr=False, compare=False
+    )
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -71,6 +77,8 @@ class ReconcileMetrics:
     def finish(self, result: str) -> None:
         self.end = time.monotonic()
         self.result = result
+        if self.registry is not None:
+            self.registry._accumulate(self)
         log.info(
             "reconcile mode=%s result=%s total=%.2fs phases=%s",
             self.mode,
@@ -105,15 +113,28 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._history: list[ReconcileMetrics] = []
+        # Cumulative counters (unbounded lifetime, unlike the history): a
+        # scraper that misses a reconcile still sees its latency in the
+        # totals — last-reconcile gauges alone lose data between scrapes.
+        self._result_totals: dict[str, int] = {}
+        self._phase_totals: dict[tuple[str, str], list[float]] = {}
 
     def start(self, mode: str) -> ReconcileMetrics:
-        m = ReconcileMetrics(mode=mode)
+        m = ReconcileMetrics(mode=mode, registry=self)
         with self._lock:
             self._history.append(m)
             # Bound memory: keep the last 256 reconciles.
             if len(self._history) > 256:
                 del self._history[: len(self._history) - 256]
         return m
+
+    def _accumulate(self, m: ReconcileMetrics) -> None:
+        with self._lock:
+            self._result_totals[m.result] = self._result_totals.get(m.result, 0) + 1
+            for p in m.phases:
+                tot = self._phase_totals.setdefault((m.mode, p.name), [0.0, 0])
+                tot[0] += p.seconds
+                tot[1] += 1
 
     @property
     def history(self) -> list[ReconcileMetrics]:
@@ -145,10 +166,33 @@ class MetricsRegistry:
                 )
         lines.append("# HELP tpu_cc_reconciles_total Reconciles since process start.")
         lines.append("# TYPE tpu_cc_reconciles_total counter")
-        hist = self.history
+        with self._lock:
+            result_totals = dict(self._result_totals)
+            phase_totals = {k: list(v) for k, v in self._phase_totals.items()}
         for result in ("ok", "failed", "noop"):
-            n = sum(1 for m in hist if m.result == result)
-            lines.append('tpu_cc_reconciles_total{result="%s"} %d' % (result, n))
+            lines.append(
+                'tpu_cc_reconciles_total{result="%s"} %d'
+                % (result, result_totals.get(result, 0))
+            )
+        lines.append(
+            "# HELP tpu_cc_phase_seconds_total Cumulative seconds spent per "
+            "phase since process start."
+        )
+        lines.append("# TYPE tpu_cc_phase_seconds_total counter")
+        lines.append(
+            "# HELP tpu_cc_phase_runs_total Cumulative phase executions "
+            "since process start."
+        )
+        lines.append("# TYPE tpu_cc_phase_runs_total counter")
+        for (mode, phase), (seconds, count) in sorted(phase_totals.items()):
+            lines.append(
+                'tpu_cc_phase_seconds_total{mode="%s",phase="%s"} %.3f'
+                % (mode, phase, seconds)
+            )
+            lines.append(
+                'tpu_cc_phase_runs_total{mode="%s",phase="%s"} %d'
+                % (mode, phase, count)
+            )
         return "\n".join(lines) + "\n"
 
 

@@ -47,6 +47,13 @@ def tpu_generation() -> str | None:
     return _normalize(kind)
 
 
+def generation_for(backend: str) -> str | None:
+    """Chip generation when running on TPU, else None (smoke-result field:
+    the bench artifact must carry its own denominator — a TFLOP/s number is
+    only evidence next to the chip it ran on)."""
+    return tpu_generation() if backend == "tpu" else None
+
+
 def peak_flops_per_chip(default_tflops: float = 197.0) -> float:
     """Peak bf16 FLOP/s for MFU math; conservative default when unknown."""
     gen = tpu_generation()
