@@ -60,28 +60,14 @@ def _tpu_preflight(timeout_s: float = 90.0) -> bool:
 
 
 def _smoke_subprocess(workload: str, timeout_s: float, force_cpu: bool) -> dict:
-    env = dict(os.environ)
-    if force_cpu:
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-    cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", workload]
-    proc = subprocess.run(
-        cmd, capture_output=True, timeout=timeout_s, text=True, env=env,
+    # Shared subprocess-smoke contract (tpu_cc_manager/smoke/runner.py);
+    # imported lazily so the module parses before sys.path setup.
+    from tpu_cc_manager.smoke.runner import run_workload_subprocess
+
+    return run_workload_subprocess(
+        workload, timeout_s=timeout_s, force_cpu=force_cpu,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
-    result = None
-    for line in proc.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                result = json.loads(line)
-            except json.JSONDecodeError:
-                pass
-    if proc.returncode != 0 or not result or not result.get("ok"):
-        raise RuntimeError(
-            f"smoke rc={proc.returncode} result={result} stderr={proc.stderr[-300:]}"
-        )
-    return result
 
 
 def run_scenario(
@@ -132,11 +118,13 @@ def run_scenario(
     smoke_detail: dict = {}
 
     def smoke_runner(workload: str) -> dict:
+        from tpu_cc_manager.smoke.runner import SmokeError
+
         try:
             result = _smoke_subprocess(
                 workload, timeout_s=240.0, force_cpu=not tpu_usable
             )
-        except (RuntimeError, subprocess.TimeoutExpired):
+        except SmokeError:
             # Chip passed preflight but failed mid-run: fall back to CPU so
             # the bench still measures the pipeline end-to-end.
             result = _smoke_subprocess(workload, timeout_s=240.0, force_cpu=True)

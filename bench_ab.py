@@ -24,7 +24,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -38,29 +37,14 @@ THROUGHPUT_FIELD = {
 
 
 def _smoke_subprocess(workload: str, timeout_s: float, force_cpu: bool) -> dict:
-    env = dict(os.environ)
-    if force_cpu:
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-    cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", workload]
-    proc = subprocess.run(
-        cmd, capture_output=True, timeout=timeout_s, text=True, env=env,
+    # Shared subprocess-smoke contract (tpu_cc_manager/smoke/runner.py);
+    # imported lazily so the module parses before sys.path setup.
+    from tpu_cc_manager.smoke.runner import run_workload_subprocess
+
+    return run_workload_subprocess(
+        workload, timeout_s=timeout_s, force_cpu=force_cpu,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
-    result = None
-    for line in proc.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                result = json.loads(line)
-            except json.JSONDecodeError:
-                pass
-    if proc.returncode != 0 or not result or not result.get("ok"):
-        raise RuntimeError(
-            f"smoke {workload} rc={proc.returncode} result={result} "
-            f"stderr={proc.stderr[-300:]}"
-        )
-    return result
 
 
 def drive_mode(mgr, kube, node: str, mode: str) -> None:

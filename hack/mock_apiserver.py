@@ -1,13 +1,17 @@
 """Localhost mock apiserver speaking the 4 verbs the agent uses.
 
-For the local demo (`hack/demo_local.sh`) and manual end-to-end
-verification on machines without kind/kubectl: node GET/PATCH (merge-patch
-on metadata.labels), pod LIST with selectors, node WATCH as chunked JSON
-lines. Includes an "operator reaction" thread — the external behavior the
-drain protocol relies on (SURVEY.md §5): deletes component pods ~0.5 s
-after their google.com/tpu.deploy.* label becomes paused, restores them on
-unpause. Control endpoints (not part of k8s): POST /_ctl/set-label,
-POST /_ctl/state.
+For the local demos (`hack/demo_local.sh`, `hack/demo_multihost.sh`) and
+manual end-to-end verification on machines without kind/kubectl: node
+GET/PATCH (merge-patch on metadata.labels), node LIST with label
+selectors, pod LIST with selectors, node WATCH as chunked JSON lines.
+Serves N nodes (second CLI arg, default 1: ``demo-node-0..N-1``) so
+multi-host slice-barrier flows can run against the real HTTP surface.
+
+Includes an "operator reaction" thread — the external behavior the drain
+protocol relies on (SURVEY.md §5): deletes component pods ~0.5 s after
+their google.com/tpu.deploy.* label becomes paused, restores them on
+unpause. Control endpoints (not part of k8s): POST /_ctl/set-label
+(optional "node"), POST /_ctl/stick-pod, POST /_ctl/state.
 """
 import json
 import queue
@@ -28,54 +32,67 @@ except ImportError:  # standalone use without the package on sys.path
         "google.com/tpu.deploy.workload-validator": "tpu-workload-validator",
     }
 
-NODE = "demo-node-0"
 NS = "tpu-operator"
+DEFAULT_NODE = "demo-node-0"
 
 lock = threading.Lock()
 rv = [1]
-node = {
-    "kind": "Node",
-    "apiVersion": "v1",
-    "metadata": {
-        "name": NODE,
-        "resourceVersion": "1",
-        "labels": {k: "true" for k in COMPONENTS},
-    },
-}
-pods = {}  # name -> pod dict
-for key, app in COMPONENTS.items():
-    pods[f"{app}-pod"] = {
-        "metadata": {"name": f"{app}-pod", "namespace": NS, "labels": {"app": app}},
-        "spec": {"nodeName": NODE},
-        "status": {"phase": "Running"},
+nodes: dict[str, dict] = {}
+pods: dict[str, dict] = {}  # pod name -> pod dict
+
+
+def add_node(name: str) -> None:
+    nodes[name] = {
+        "kind": "Node",
+        "apiVersion": "v1",
+        "metadata": {
+            "name": name,
+            "resourceVersion": "1",
+            "labels": {k: "true" for k in COMPONENTS},
+        },
     }
+    for key, app in COMPONENTS.items():
+        pods[f"{app}-{name}"] = {
+            "metadata": {
+                "name": f"{app}-{name}", "namespace": NS,
+                "labels": {"app": app},
+            },
+            "spec": {"nodeName": name},
+            "status": {"phase": "Running"},
+        }
 
-watchers = []  # list of (wfile, condition) — simplistic: each watcher gets events pushed
+
+# watchers: list of (chunk_writer, node_name_filter or None)
+watchers = []
 
 
-def bump_rv():
+def bump_rv(node: dict) -> None:
     rv[0] += 1
     node["metadata"]["resourceVersion"] = str(rv[0])
 
 
-_event_queue: "queue.Queue[bytes]" = queue.Queue()
+_event_queue: "queue.Queue[tuple[str, bytes]]" = queue.Queue()
 
 
-def emit_watch_event():
+def emit_watch_event(node: dict) -> None:
     """Serialize under the caller's lock, enqueue for the single writer
     thread: writes happen OUTSIDE the lock (a stalled watch client must
     not wedge the other endpoints by blocking sendall while holding it),
     and one writer preserves both frame integrity and event ordering."""
-    _event_queue.put((json.dumps({"type": "MODIFIED", "object": node}) + "\n").encode())
+    name = node["metadata"]["name"]
+    frame = (json.dumps({"type": "MODIFIED", "object": node}) + "\n").encode()
+    _event_queue.put((name, frame))
 
 
 def _watch_writer():
     while True:
-        ev = _event_queue.get()
+        name, ev = _event_queue.get()
         with lock:
-            targets = list(watchers)
+            targets = [
+                (wf, flt) for wf, flt in watchers if flt is None or flt == name
+            ]
         dead = []
-        for wf in targets:
+        for wf, _ in targets:
             try:
                 wf.write(ev)
                 wf.flush()
@@ -83,38 +100,54 @@ def _watch_writer():
                 dead.append(wf)
         if dead:
             with lock:
-                for wf in dead:
-                    if wf in watchers:
-                        watchers.remove(wf)
+                watchers[:] = [(wf, flt) for wf, flt in watchers if wf not in dead]
 
 
 def is_paused(v):
     return v is not None and "paused-for" in v
 
 
+def _match_label_selector(labels: dict, selector: str | None) -> bool:
+    if not selector:
+        return True
+    for term in selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "=" in term:
+            k, _, v = term.partition("=")
+            if labels.get(k.strip()) != v.strip():
+                return False
+        elif labels.get(term) is None:
+            return False
+    return True
+
+
 sticky_pods = set()  # pods the emulated operator refuses to delete
 
 
 def operator_reactor():
-    """Delete component pods shortly after their deploy label pauses; restore
-    them when unpaused. Pods marked sticky (POST /_ctl/stick-pod) are never
-    deleted — simulates a wedged drain for strict-eviction testing."""
+    """Delete component pods shortly after their node's deploy label pauses;
+    restore them when unpaused. Pods marked sticky (POST /_ctl/stick-pod)
+    are never deleted — simulates a wedged drain for strict-eviction
+    testing."""
     while True:
         time.sleep(0.5)
         with lock:
-            labels = node["metadata"]["labels"]
-            for key, app in COMPONENTS.items():
-                name = f"{app}-pod"
-                if is_paused(labels.get(key)):
-                    if name not in sticky_pods:
-                        pods.pop(name, None)
-                elif labels.get(key) == "true" and name not in pods:
-                    pods[name] = {
-                        "metadata": {"name": name, "namespace": NS,
-                                     "labels": {"app": app}},
-                        "spec": {"nodeName": NODE},
-                        "status": {"phase": "Running"},
-                    }
+            for node_name, node in nodes.items():
+                labels = node["metadata"]["labels"]
+                for key, app in COMPONENTS.items():
+                    name = f"{app}-{node_name}"
+                    if is_paused(labels.get(key)):
+                        if name not in sticky_pods:
+                            pods.pop(name, None)
+                    elif labels.get(key) == "true" and name not in pods:
+                        pods[name] = {
+                            "metadata": {"name": name, "namespace": NS,
+                                         "labels": {"app": app}},
+                            "spec": {"nodeName": node_name},
+                            "status": {"phase": "Running"},
+                        }
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -134,10 +167,25 @@ class Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         u = urlparse(self.path)
         q = parse_qs(u.query)
-        if u.path == f"/api/v1/nodes/{NODE}":
+        m = re.match(r"^/api/v1/nodes/([^/]+)$", u.path)
+        if m:
+            with lock:
+                node = nodes.get(m.group(1))
+            if node is None:
+                return self._json(
+                    {"kind": "Status", "code": 404, "message": "no such node"},
+                    404,
+                )
             with lock:
                 return self._json(node)
         if u.path == "/api/v1/nodes" and q.get("watch") == ["true"]:
+            # Field selector metadata.name=<n> scopes the stream to one node
+            # (the agent's watch); absent means all nodes.
+            flt = None
+            fsel = q.get("fieldSelector", [None])[0]
+            fm = re.match(r"^metadata\.name=(.+)$", fsel or "")
+            if fm:
+                flt = fm.group(1)
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
@@ -160,10 +208,12 @@ class Handler(BaseHTTPRequestHandler):
             self.connection.settimeout(10.0)
             cw = ChunkWriter(self.wfile)
             with lock:
-                ev = json.dumps({"type": "ADDED", "object": node}) + "\n"
-                cw.write(ev.encode())
+                for name, node in nodes.items():
+                    if flt is None or flt == name:
+                        ev = json.dumps({"type": "ADDED", "object": node}) + "\n"
+                        cw.write(ev.encode())
                 cw.flush()
-                watchers.append(cw)
+                watchers.append((cw, flt))
             # Hold the connection open; events pushed by emit_watch_event.
             timeout = float(q.get("timeoutSeconds", ["300"])[0])
             time.sleep(timeout)
@@ -172,13 +222,17 @@ class Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
             with lock:
-                if cw in watchers:
-                    watchers.remove(cw)
+                watchers[:] = [(wf, f) for wf, f in watchers if wf is not cw]
             return
         if u.path == "/api/v1/nodes":
+            sel = q.get("labelSelector", [None])[0]
             with lock:
+                items = [
+                    n for n in nodes.values()
+                    if _match_label_selector(n["metadata"]["labels"], sel)
+                ]
                 return self._json({"kind": "NodeList",
-                                   "items": [node],
+                                   "items": items,
                                    "metadata": {"resourceVersion": str(rv[0])}})
         if u.path == f"/api/v1/namespaces/{NS}/pods":
             sel = q.get("labelSelector", [None])[0]
@@ -200,16 +254,20 @@ class Handler(BaseHTTPRequestHandler):
         u = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(length) or b"{}")
-        if u.path == f"/api/v1/nodes/{NODE}":
+        m = re.match(r"^/api/v1/nodes/([^/]+)$", u.path)
+        if m:
             with lock:
+                node = nodes.get(m.group(1))
+                if node is None:
+                    return self._json({"kind": "Status", "code": 404}, 404)
                 patch_labels = (body.get("metadata") or {}).get("labels") or {}
                 for k, v in patch_labels.items():
                     if v is None:
                         node["metadata"]["labels"].pop(k, None)
                     else:
                         node["metadata"]["labels"][k] = v
-                bump_rv()
-                emit_watch_event()
+                bump_rv(node)
+                emit_watch_event(node)
                 return self._json(node)
         self._json({"kind": "Status", "code": 404}, 404)
 
@@ -219,12 +277,15 @@ class Handler(BaseHTTPRequestHandler):
         body = json.loads(self.rfile.read(length) or b"{}")
         if u.path == "/_ctl/set-label":
             with lock:
+                node = nodes.get(body.get("node", DEFAULT_NODE))
+                if node is None:
+                    return self._json({"ok": False, "error": "no such node"}, 404)
                 if body.get("value") is None:
                     node["metadata"]["labels"].pop(body["key"], None)
                 else:
                     node["metadata"]["labels"][body["key"]] = body["value"]
-                bump_rv()
-                emit_watch_event()
+                bump_rv(node)
+                emit_watch_event(node)
                 return self._json({"ok": True, "labels": node["metadata"]["labels"]})
         if u.path == "/_ctl/stick-pod":
             with lock:
@@ -235,16 +296,28 @@ class Handler(BaseHTTPRequestHandler):
                 return self._json({"ok": True, "sticky": sorted(sticky_pods)})
         if u.path == "/_ctl/state":
             with lock:
-                return self._json({"labels": node["metadata"]["labels"],
-                                   "pods": sorted(pods)})
+                if len(nodes) == 1:
+                    # Single-node shape kept for demo_local.sh compat.
+                    (node,) = nodes.values()
+                    return self._json({"labels": node["metadata"]["labels"],
+                                       "pods": sorted(pods)})
+                return self._json({
+                    "nodes": {
+                        name: n["metadata"]["labels"] for name, n in nodes.items()
+                    },
+                    "pods": sorted(pods),
+                })
         self._json({"kind": "Status", "code": 404}, 404)
 
 
 if __name__ == "__main__":
     import sys
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 18080
+    n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    for i in range(n_nodes):
+        add_node(f"demo-node-{i}")
     threading.Thread(target=operator_reactor, daemon=True).start()
     threading.Thread(target=_watch_writer, daemon=True).start()
     srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    print(f"mock apiserver on :{port}", flush=True)
+    print(f"mock apiserver on :{port} ({n_nodes} node(s))", flush=True)
     srv.serve_forever()

@@ -163,6 +163,19 @@ class TestLoadJwks:
         monkeypatch.setenv(jwks.JWKS_FILE_ENV, str(bad))
         assert jwks.load_jwks() is None
 
+    def test_missing_offline_file_falls_through(self, keypair, tmp_path,
+                                                monkeypatch):
+        """The DaemonSet sets the offline path unconditionally; absence is
+        optional provisioning, not misconfiguration — the cache still
+        serves."""
+        _, keyset = keypair
+        monkeypatch.setenv(jwks.JWKS_FILE_ENV, str(tmp_path / "absent.json"))
+        cache = tmp_path / "cache.json"
+        cache.write_text(
+            json.dumps({"fetched_at": time.time(), "jwks": keyset})
+        )
+        assert jwks.load_jwks(cache_file=str(cache)) == keyset
+
 
 class TestTpuvmQuoteVerification:
     def test_valid_quote_passes(self, keypair, jwks_env):

@@ -22,6 +22,26 @@ def test_status_lists_nodes(fake_kube, capsys):
     assert rc == 0 and "n0" in out and "on" in out
 
 
+def test_status_surfaces_barrier_and_failure_reason(fake_kube, capsys):
+    from tpu_cc_manager.ccmanager.slicecoord import SLICE_STAGED_LABEL
+    from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
+
+    fake_kube.add_node("n1", {
+        "pool": "tpu",
+        SLICE_STAGED_LABEL: "slice",  # mid-barrier
+    })
+    fake_kube.add_node("n2", {
+        "pool": "tpu",
+        CC_MODE_STATE_LABEL: "failed",
+        CC_FAILED_REASON_LABEL: "slice-mode-unsupported",
+    })
+    rc = ctl.cmd_status(fake_kube, ns(selector="pool=tpu"))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "barrier:staged=slice" in out
+    assert "reason=slice-mode-unsupported" in out
+
+
 def test_attest_ok_and_fail(fake_kube, capsys):
     quote = FakeTpuBackend(slice_id="s1", initial_mode="on").fetch_attestation("n")
     fake_kube.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})

@@ -1,0 +1,141 @@
+"""Full-stack integration: rolling orchestrator driving REAL per-node
+agents (CCManager.watch_and_apply in threads) over multi-host slices.
+
+This is the closest no-hardware approximation of BASELINE.json configs[3]
+(flip a pool one ICI-slice group at a time): the orchestrator writes
+desired labels; real watch loops observe them; each slice's hosts drain,
+stage, cross the slice commit barrier (ccmanager/slicecoord.py), reset,
+attest, and report — and the orchestrator's max_unavailable=1 window
+keeps slice B untouched until slice A converged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    MODE_OFF,
+    MODE_ON,
+    SLICE_ID_LABEL,
+)
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+POOL = {  # two 2-host slices
+    "slice-a": ("node-a0", "node-a1"),
+    "slice-b": ("node-b0", "node-b1"),
+}
+
+
+class SeqBackend(FakeTpuBackend):
+    """Mirrors stage/reset into a shared sequence tagged (slice, host)."""
+
+    def __init__(self, seq, lock, tag, **kw):
+        super().__init__(**kw)
+        self._seq, self._seq_lock, self._tag = seq, lock, tag
+
+    def stage_cc_mode(self, chips, mode):
+        super().stage_cc_mode(chips, mode)
+        with self._seq_lock:
+            self._seq.append((*self._tag, "stage"))
+
+    def reset(self, chips):
+        with self._seq_lock:
+            self._seq.append((*self._tag, "reset"))
+        super().reset(chips)
+
+
+def test_rollout_over_multi_host_slices_with_real_agents(fake_kube, tmp_path):
+    seq: list = []
+    seq_lock = threading.Lock()
+    stop = threading.Event()
+    agents, backends, threads = [], {}, []
+
+    for slice_id, nodes in POOL.items():
+        for host_index, name in enumerate(nodes):
+            fake_kube.add_node(name, {"pool": "tpu"})
+            backend = SeqBackend(
+                seq, seq_lock, (slice_id, name),
+                num_chips=2, accelerator_type="v5p-32",
+                num_hosts=len(nodes), host_index=host_index,
+                slice_id=slice_id,
+            )
+            backends[name] = backend
+            mgr = CCManager(
+                api=fake_kube,
+                backend=backend,
+                node_name=name,
+                default_mode=MODE_OFF,
+                operator_namespace="tpu-operator",
+                evict_components=False,
+                smoke_workload="none",
+                metrics=MetricsRegistry(),
+                watch_timeout_s=1,
+                reconnect_delay_s=0.0,
+                slice_barrier_timeout_s=20.0,
+                slice_barrier_poll_interval_s=0.01,
+                readiness_file=str(tmp_path / f"ready-{name}"),
+            )
+            agents.append(mgr)
+            t = threading.Thread(
+                target=mgr.watch_and_apply, args=(stop,), daemon=True
+            )
+            threads.append(t)
+
+    for t in threads:
+        t.start()
+
+    try:
+        # Agents settle at the default mode and publish slice membership
+        # (the orchestrator's group-by-slice needs the labels agents write).
+        deadline = time.monotonic() + 30
+        all_nodes = [n for nodes in POOL.values() for n in nodes]
+        while time.monotonic() < deadline:
+            labels = {n: node_labels(fake_kube.get_node(n)) for n in all_nodes}
+            if all(
+                l.get(CC_MODE_STATE_LABEL) == MODE_OFF
+                and l.get(SLICE_ID_LABEL)
+                for l in labels.values()
+            ):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(f"agents never settled: {labels}")
+
+        roller = RollingReconfigurator(
+            fake_kube, "pool=tpu", max_unavailable=1,
+            node_timeout_s=30, poll_interval_s=0.02,
+        )
+        result = roller.rollout(MODE_ON)
+        assert result.ok, result.summary()
+        assert [g.group for g in result.groups] == ["slice-a", "slice-b"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+    # Every host converged on the hardware, not just in labels.
+    for name, backend in backends.items():
+        assert set(backend.committed.values()) == {MODE_ON}, name
+        assert node_labels(fake_kube.get_node(name))[CC_MODE_STATE_LABEL] == MODE_ON
+
+    # Barrier invariant per slice: both hosts staged before either reset.
+    for slice_id in POOL:
+        ops = [(h, op) for s, h, op in seq if s == slice_id]
+        first_reset = next(i for i, (_, op) in enumerate(ops) if op == "reset")
+        staged_hosts = {h for h, op in ops[:first_reset] if op == "stage"}
+        assert staged_hosts == set(POOL[slice_id]), (slice_id, ops)
+
+    # Rolling window invariant (max_unavailable=1): slice-a finished all
+    # its hardware ops before slice-b started any.
+    slice_order = [s for s, _, _ in seq]
+    last_a = max(i for i, s in enumerate(slice_order) if s == "slice-a")
+    first_b = min(i for i, s in enumerate(slice_order) if s == "slice-b")
+    assert last_a < first_b, seq

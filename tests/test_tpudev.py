@@ -209,6 +209,17 @@ class TestRuntimeTruth:
         with pytest.raises(TpuError):
             backend.wait_ready(topo.chips, timeout_s=0.05)
 
+    def test_state_only_show_output_disables_cross_check(self, rig, tmp_path):
+        """A show_cmd that yields ActiveState but no usable activation
+        timestamp must read as probe-unavailable — NOT as ts=0, which would
+        fail every restart cross-check and brick the node."""
+        backend, _, show_file = rig
+        show_file.write_text("ActiveState=active\n")  # no timestamp property
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        backend.reset(topo.chips)  # must NOT raise "did not restart"
+        assert all(backend.query_cc_mode(c) == MODE_ON for c in topo.chips)
+
     def test_health_port_probe(self, rig):
         import socket
 

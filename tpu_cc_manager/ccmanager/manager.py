@@ -291,12 +291,21 @@ class CCManager:
                 self._publish_coordination_labels(topo, quote)
                 return True
 
+        barrier = None
+        if topo.is_multi_host:
+            barrier = slicecoord.SliceBarrier(
+                self.api,
+                self.node_name,
+                topo,
+                timeout_s=self.slice_barrier_timeout_s,
+                poll_interval_s=self.slice_barrier_poll_interval_s,
+            )
         m = self.metrics.start(mode)
         try:
             if self.evict_components:
-                ok = self._apply_with_eviction(topo, chips, mode, m)
+                ok = self._apply_with_eviction(topo, chips, mode, m, barrier)
             else:
-                ok = self._apply_direct(topo, chips, mode, m)
+                ok = self._apply_direct(topo, chips, mode, m, barrier)
         except BaseException:
             # An escaping exception (e.g. KubeApiError mid-drain) must not be
             # recorded as a successful reconcile.
@@ -305,6 +314,12 @@ class CCManager:
             raise
         finally:
             m.finish(m.result if m.result != "pending" else "noop")
+        if ok and barrier is not None:
+            # Barrier completion AFTER re-admit: the leader's (bounded) wait
+            # for peers to clear their staged markers before retiring the
+            # commit marker must never keep this host's components paused —
+            # only the leader's own watch loop lingers, not the drain window.
+            barrier.complete(mode)
         return ok
 
     def _cc_mode_chips(
@@ -356,6 +371,7 @@ class CCManager:
     def _apply_with_eviction(
         self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
         m: metrics_mod.ReconcileMetrics,
+        barrier: slicecoord.SliceBarrier | None = None,
     ) -> bool:
         """Drain, reconfigure, re-admit (reference main.py:544-578).
 
@@ -384,7 +400,7 @@ class CCManager:
                     evict.readmit_components(self.api, self.node_name, e.original)
             return False
         try:
-            return self._apply_direct(topo, chips, mode, m)
+            return self._apply_direct(topo, chips, mode, m, barrier)
         finally:
             with m.phase(metrics_mod.PHASE_READMIT):
                 evict.readmit_components(self.api, self.node_name, original)
@@ -392,6 +408,7 @@ class CCManager:
     def _apply_direct(
         self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
         m: metrics_mod.ReconcileMetrics,
+        barrier: slicecoord.SliceBarrier | None = None,
     ) -> bool:
         """The phased hardware transition (reference main.py:449-542,
         restructured: slice atomicity is structural in the backend contract,
@@ -399,19 +416,12 @@ class CCManager:
 
         On a multi-host slice, ANY mode change disrupts the whole ICI
         domain, so the reset is gated behind the slice-wide commit barrier
-        (ccmanager/slicecoord.py): no host resets before every host of the
-        slice is staged and drained — the cross-host generalization of the
-        reference's PPCIe stage-all/reset-all fabric atomicity
-        (main.py:362-368)."""
-        barrier = None
-        if topo.is_multi_host:
-            barrier = slicecoord.SliceBarrier(
-                self.api,
-                self.node_name,
-                topo,
-                timeout_s=self.slice_barrier_timeout_s,
-                poll_interval_s=self.slice_barrier_poll_interval_s,
-            )
+        (``barrier``, built by set_cc_mode): no host resets before every
+        host of the slice is staged and drained — the cross-host
+        generalization of the reference's PPCIe stage-all/reset-all fabric
+        atomicity (main.py:362-368). Barrier COMPLETION (marker cleanup,
+        the leader's bounded wait for peers) happens in set_cc_mode after
+        re-admission, so it never extends the drain window."""
         try:
             with m.phase(metrics_mod.PHASE_STAGE):
                 self.backend.stage_cc_mode(chips, mode)
@@ -462,7 +472,10 @@ class CCManager:
             return False
         state.set_cc_state_label(self.api, self.node_name, mode)
         if barrier is not None:
-            barrier.complete(mode)
+            # Withdraw this host's staged marker now (it is no longer
+            # mid-transition); the leader's commit-marker retirement waits
+            # until set_cc_mode's post-readmit completion.
+            barrier.clear_staged()
         self._publish_coordination_labels(topo, quote)
         m.result = "ok"
         log.info("CC mode %s applied and verified on %d chip(s)", mode, len(chips))

@@ -194,28 +194,31 @@ class SliceBarrier:
                 )
             time.sleep(self.poll_interval_s)
 
-    def abort(self) -> None:
-        """Withdraw from the barrier (this host is re-admitting components,
-        so its staged marker no longer describes reality). Best-effort."""
-        try:
-            self.api.patch_node_labels(self.node_name, {SLICE_STAGED_LABEL: None})
-        except KubeApiError as e:
-            log.warning("slice barrier abort: could not clear staged marker: %s", e)
-
-    def complete(self, mode: str) -> None:
-        """Clear this host's staged marker; the leader additionally waits
-        (bounded) for its peers to finish, then retires the commit marker.
-
-        Clearing the commit marker too early would strand followers still
-        polling for it, so the leader keeps it until every peer's staged
-        marker is gone or the completion window closes. A leftover marker is
-        harmless — followers never act on a commit marker without
-        re-verifying full staging — and is cleared at the next barrier entry.
-        """
+    def clear_staged(self) -> None:
+        """Withdraw this host's staged marker (it is either done or about
+        to re-admit components — either way no longer "staged and
+        drained"). Best-effort."""
         try:
             self.api.patch_node_labels(self.node_name, {SLICE_STAGED_LABEL: None})
         except KubeApiError as e:
             log.warning("slice barrier: could not clear staged marker: %s", e)
+
+    def abort(self) -> None:
+        self.clear_staged()
+
+    def complete(self, mode: str) -> None:
+        """Retire the barrier. The caller runs this AFTER re-admitting
+        components (manager.set_cc_mode), so the leader's bounded wait for
+        peers never extends the drain window — it only delays the leader's
+        own next watch iteration.
+
+        The leader waits for every peer's staged marker to clear before
+        retiring the commit marker: clearing it too early would strand
+        followers still polling for it. A leftover marker is harmless —
+        followers never act on a commit marker without re-verifying full
+        staging — and is cleared at the next barrier entry.
+        """
+        self.clear_staged()  # idempotent; normally already cleared
         if not self.is_leader:
             return
         deadline = time.monotonic() + self.complete_timeout_s

@@ -97,17 +97,34 @@ def cmd_attest(api, args) -> int:
 
 
 def cmd_status(api, args) -> int:
+    from tpu_cc_manager.ccmanager.slicecoord import (
+        SLICE_COMMIT_LABEL,
+        SLICE_STAGED_LABEL,
+    )
+    from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
+
     rows = [
-        f"{'NODE':<24} {'SLICE':<20} {'DESIRED':<10} {'STATE':<10} READY"
+        f"{'NODE':<24} {'SLICE':<20} {'DESIRED':<10} {'STATE':<10} "
+        f"{'READY':<6} NOTE"
     ]
     for node in api.list_nodes(args.selector):
         labels = node_labels(node)
+        # Transient barrier markers / failure reason: the things an
+        # operator staring at a stuck rollout needs to see first.
+        notes = []
+        if labels.get(SLICE_STAGED_LABEL):
+            notes.append(f"barrier:staged={labels[SLICE_STAGED_LABEL]}")
+        if labels.get(SLICE_COMMIT_LABEL):
+            notes.append(f"barrier:commit={labels[SLICE_COMMIT_LABEL]}")
+        if labels.get(CC_FAILED_REASON_LABEL):
+            notes.append(f"reason={labels[CC_FAILED_REASON_LABEL]}")
         rows.append(
             f"{node['metadata']['name']:<24} "
             f"{labels.get(SLICE_ID_LABEL, '-'):<20} "
             f"{labels.get(CC_MODE_LABEL, '-'):<10} "
             f"{labels.get(CC_MODE_STATE_LABEL, '-'):<10} "
-            f"{labels.get(CC_READY_STATE_LABEL, '-')}"
+            f"{labels.get(CC_READY_STATE_LABEL, '-'):<6} "
+            f"{' '.join(notes) or '-'}"
         )
     print("\n".join(rows))
     return 0

@@ -39,15 +39,37 @@ def run_workload(name: str, **kwargs) -> dict:
     return result
 
 
-def run_workload_subprocess(name: str, timeout_s: float = 900.0) -> dict:
+def run_workload_subprocess(
+    name: str,
+    timeout_s: float = 900.0,
+    force_cpu: bool = False,
+    cwd: str | None = None,
+) -> dict:
     """Run a workload as ``python -m tpu_cc_manager.smoke`` and parse the
-    final JSON line from its stdout."""
+    final JSON line from its stdout.
+
+    ``force_cpu`` pins the child to the CPU backend (and strips the image's
+    TPU-tunnel trigger variable) — the bench scripts use it when the
+    accelerator failed preflight. This is the single subprocess-smoke
+    contract; bench.py and bench_ab.py import it rather than keeping
+    copies in sync.
+    """
     if name not in WORKLOADS:
         raise SmokeError(f"unknown smoke workload {name!r} (have {sorted(WORKLOADS)})")
+    env = None
+    if force_cpu:
+        import os
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
     cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", name]
     log.info("running smoke workload: %s", " ".join(cmd))
     try:
-        proc = subprocess.run(cmd, capture_output=True, timeout=timeout_s, text=True)
+        proc = subprocess.run(
+            cmd, capture_output=True, timeout=timeout_s, text=True,
+            env=env, cwd=cwd,
+        )
     except subprocess.TimeoutExpired as e:
         raise SmokeError(f"workload {name} timed out after {timeout_s:.0f}s") from e
     last_json = None

@@ -27,10 +27,26 @@ __all__ = [
 def load_backend(name: str, **kwargs) -> TpuCcBackend:
     """Backend factory: ``fake`` or ``tpuvm`` (reference picks its device
     library at image build time, Dockerfile.distroless:22; we pick at runtime
-    via --tpu-backend / TPU_CC_BACKEND so the kind dry-run needs no hardware)."""
+    via --tpu-backend / TPU_CC_BACKEND so the kind dry-run needs no hardware).
+
+    The fake backend's topology is env-configurable
+    (``TPU_CC_FAKE_{NUM_CHIPS,NUM_HOSTS,HOST_INDEX,SLICE_ID}``) so
+    multi-host slice flows — the commit barrier above all — can be driven
+    end-to-end by separate agent processes (hack/demo_multihost.sh)."""
     if name == "fake":
+        import os
+
         from tpu_cc_manager.tpudev.fake import FakeTpuBackend
 
+        env = os.environ
+        if "TPU_CC_FAKE_NUM_CHIPS" in env:
+            kwargs.setdefault("num_chips", int(env["TPU_CC_FAKE_NUM_CHIPS"]))
+        if "TPU_CC_FAKE_NUM_HOSTS" in env:
+            kwargs.setdefault("num_hosts", int(env["TPU_CC_FAKE_NUM_HOSTS"]))
+        if "TPU_CC_FAKE_HOST_INDEX" in env:
+            kwargs.setdefault("host_index", int(env["TPU_CC_FAKE_HOST_INDEX"]))
+        if "TPU_CC_FAKE_SLICE_ID" in env:
+            kwargs.setdefault("slice_id", env["TPU_CC_FAKE_SLICE_ID"])
         return FakeTpuBackend(**kwargs)
     if name == "tpuvm":
         from tpu_cc_manager.tpudev.tpuvm import TpuVmBackend

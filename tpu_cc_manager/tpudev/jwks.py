@@ -134,10 +134,18 @@ def load_jwks(
         try:
             with open(offline_file, "r", encoding="utf-8") as f:
                 return json.load(f)
+        except FileNotFoundError:
+            # The offline file is OPTIONAL provisioning (the DaemonSet sets
+            # the path unconditionally); absence falls through to
+            # cache/fetch.
+            log.info(
+                "offline JWKS file %s not present; falling back to "
+                "cache/fetch", offline_file,
+            )
         except (OSError, json.JSONDecodeError) as e:
             log.error("configured JWKS file %s unreadable: %s", offline_file, e)
-            # An explicitly configured file that is broken should not fall
-            # through to the network: surface the misconfiguration.
+            # A file that EXISTS but is broken should not fall through to
+            # the network: surface the misconfiguration.
             return None
 
     cache_file = cache_file or os.environ.get(JWKS_CACHE_ENV, DEFAULT_CACHE_FILE)

@@ -176,7 +176,14 @@ class TpuVmBackend(TpuCcBackend):
                     ts = int(value.strip())
                 except ValueError:
                     pass
-        result = None if state is None and ts is None else (state or "unknown", ts or 0)
+        # ts stays None when the property is absent/garbled: "no timestamp"
+        # must read as probe-unavailable, not as 0 — a show_cmd that emits
+        # only ActiveState would otherwise fail every restart cross-check.
+        result = (
+            None
+            if state is None and ts is None
+            else (state or "unknown", ts)
+        )
         self._stamp_cache = (time.monotonic(), result)
         return result
 
@@ -256,7 +263,11 @@ class TpuVmBackend(TpuCcBackend):
             recorded = self._read_state("runtime.json").get("enter_ts")
             if recorded:
                 current = self._runtime_stamp()
-                if current is not None and current[1] != recorded:
+                if (
+                    current is not None
+                    and current[1] is not None
+                    and current[1] != recorded
+                ):
                     log.warning(
                         "TPU runtime restarted outside the manager "
                         "(activation stamp %d != committed %d); reporting "
@@ -313,6 +324,8 @@ class TpuVmBackend(TpuCcBackend):
         if (
             pre_stamp is not None
             and post_stamp is not None
+            and pre_stamp[1] is not None
+            and post_stamp[1] is not None
             and post_stamp[1] <= pre_stamp[1]
         ):
             raise TpuError(
@@ -330,7 +343,7 @@ class TpuVmBackend(TpuCcBackend):
         self._write_state(
             "runtime.json",
             {"active_state": post_stamp[0], "enter_ts": post_stamp[1]}
-            if post_stamp is not None
+            if post_stamp is not None and post_stamp[1]
             else {},
         )
         self._write_state("pending.json", {})
