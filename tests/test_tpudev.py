@@ -236,6 +236,37 @@ class TestRuntimeTruth:
         assert backend._probe_healthy(topo.chips) is False
 
 
+class TestHostWrap:
+    def test_identity_without_host_root(self, monkeypatch):
+        from tpu_cc_manager.tpudev.tpuvm import host_wrap
+
+        monkeypatch.delenv("CC_RUNTIME_SHOW_CMD", raising=False)
+        monkeypatch.delenv("CC_HOST_ROOT", raising=False)
+        assert host_wrap(["systemctl", "show", "x"]) == ["systemctl", "show", "x"]
+
+    def test_wrap_executes_inside_host_root(self, tmp_path):
+        """Functional check of the chroot wrapper (the test runs as root on
+        this image): a command resolves against the fake host rootfs, with
+        stdout captured by the outer subprocess as the backend expects."""
+        import os
+        import shutil
+        import subprocess
+
+        from tpu_cc_manager.tpudev.tpuvm import host_wrap
+
+        if os.geteuid() != 0:
+            pytest.skip("chroot requires root")
+        # Minimal fake host rootfs: busybox-style /bin/sh via the static sh
+        # is overkill — copy the system's sh + needed libs is fragile, so
+        # use a statically-linked helper we already build: native/rmutil/rm
+        # is static. Simpler still: chroot to the REAL root ('/') — a
+        # no-op boundary that still exercises the wrapper plumbing.
+        cmd = host_wrap(["echo", "host-hello"], host_root="/")
+        out = subprocess.run(cmd, capture_output=True, timeout=10, text=True)
+        assert out.returncode == 0
+        assert out.stdout.strip() == "host-hello"
+
+
 @pytest.mark.parametrize(
     "accel,gen,chips,hosts",
     [

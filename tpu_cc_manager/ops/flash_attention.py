@@ -31,6 +31,15 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _block_for(requested: int, seq_len: int) -> int:
+    """Clamp a block size to the sequence, rounded up to a multiple of 8:
+    Mosaic requires sublane-dim block sizes divisible by 8 and dynamic-slice
+    offsets (``ki * block``) statically provable as multiples of 8. A block
+    may exceed the (padded/masked) array tail — an unaligned one may not
+    exist at all."""
+    return min(requested, (seq_len + 7) // 8 * 8)
+
+
 def reference_attention(q, k, v, causal: bool = True):
     """Plain-XLA attention, the numerics oracle for the kernels."""
     _, _, S, D = q.shape
@@ -104,15 +113,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     # Per-row logsumexp in the scaled-score domain; the backward rebuilds
-    # each P block as exp(s - lse).
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    # each P block as exp(s - lse). Layout (BH, S, 1) — a column vector —
+    # so every block shape is Mosaic-legal (sublane dim divisible by 8,
+    # lane dim equal to the array's) and the backward's dynamic slices run
+    # on the 8-granular sublane dim, never the 128-granular lane dim.
+    lse_ref[0] = m + jnp.log(l_safe)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                    interpret: bool):
     B, H, S, D = q.shape
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    block_q = _block_for(block_q, S)
+    block_k = _block_for(block_k, S)
     grid = (B * H, pl.cdiv(S, block_q))
 
     qr = q.reshape(B * H, S, D)
@@ -141,11 +153,11 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * B * H * S * S * D,
@@ -167,8 +179,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)          # (block_q, D)
     do = do_ref[0].astype(jnp.float32)        # (block_q, D)
-    lse = lse_ref[0][:, None]                 # (block_q, 1)
-    delta = delta_ref[0][:, None]             # (block_q, 1)
+    lse = lse_ref[0]                          # (block_q, 1)
+    delta = delta_ref[0]                      # (block_q, 1)
     scale = 1.0 / (q.shape[-1] ** 0.5)
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
@@ -234,8 +246,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
-        delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q), :]      # (bq, 1)
+        delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q), :]  # (bq, 1)
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -277,8 +289,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                     block_k: int, interpret: bool):
     B, H, S, D = q.shape
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    block_q = _block_for(block_q, S)
+    block_k = _block_for(block_k, S)
 
     qr = q.reshape(B * H, S, D)
     kr = k.reshape(B * H, S, D)
@@ -287,18 +299,32 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     outr = out.reshape(B * H, S, D)
 
     # delta_i = rowsum(dO ∘ O): the softmax-backward correction term,
-    # computed once in XLA (elementwise + reduce; no S² anywhere).
+    # computed once in XLA (elementwise + reduce; no S² anywhere). Shaped
+    # (B*H, S, 1) like lse (see the forward kernel's layout note).
     delta = jnp.sum(
-        dor.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1
-    )  # (B*H, S)
+        dor.astype(jnp.float32) * outr.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # (B*H, S, 1)
 
-    # --- dQ: grid over query blocks, stream key blocks -------------------
     s_pad_k = pl.cdiv(S, block_k) * block_k
     kr_p, vr_p = kr, vr
     if s_pad_k != S:
         kr_p = jnp.pad(kr, ((0, 0), (0, s_pad_k - S), (0, 0)))
         vr_p = jnp.pad(vr, ((0, 0), (0, s_pad_k - S), (0, 0)))
+    # lse/delta zero-padded to the query-block grid: both kernels read them
+    # in block_q-sized pieces, and a block that is neither 128-divisible
+    # nor the whole (unpadded) dim is illegal on TPU. Zeros keep phantom
+    # rows exactly zero after the s=NEG_INF mask (see kernel comments).
+    s_pad_q = pl.cdiv(S, block_q) * block_q
+    qr_p, dor_p, lse_p, delta_p = qr, dor, lse, delta
+    if s_pad_q != S:
+        pad = s_pad_q - S
+        qr_p = jnp.pad(qr, ((0, 0), (0, pad), (0, 0)))
+        dor_p = jnp.pad(dor, ((0, 0), (0, pad), (0, 0)))
+        lse_p = jnp.pad(lse, ((0, 0), (0, pad), (0, 0)))
+        delta_p = jnp.pad(delta, ((0, 0), (0, pad), (0, 0)))
 
+    # --- dQ: grid over query blocks, stream key blocks -------------------
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_q=block_q, block_k=block_k,
@@ -310,8 +336,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             pl.BlockSpec((1, s_pad_k, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s_pad_k, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
@@ -321,27 +347,11 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             transcendentals=B * H * S * S,
         ),
         interpret=interpret,
-    )(qr, kr_p, vr_p, dor, lse, delta)
+    )(qr, kr_p, vr_p, dor_p, lse_p, delta_p)
 
     # --- dK/dV: grid over key blocks, stream query blocks ----------------
-    # Queries/dO/lse/delta are zero-padded to a block_q multiple so the
-    # kernel's pl.ds reads are in-bounds; lse=0 + s=NEG_INF keeps phantom
-    # rows exactly zero (see kernel comment).
-    s_pad_q = pl.cdiv(S, block_q) * block_q
-    qr_p, dor_p, lse_p, delta_p = qr, dor, lse, delta
-    if s_pad_q != S:
-        pad = s_pad_q - S
-        qr_p = jnp.pad(qr, ((0, 0), (0, pad), (0, 0)))
-        dor_p = jnp.pad(dor, ((0, 0), (0, pad), (0, 0)))
-        lse_p = jnp.pad(lse, ((0, 0), (0, pad)))
-        delta_p = jnp.pad(delta, ((0, 0), (0, pad)))
-    if s_pad_k != S:
-        # Padded dk/dv outputs; phantom key rows are zero (masked) and
-        # sliced away below.
-        kr_p2, vr_p2 = kr_p, vr_p
-    else:
-        kr_p2, vr_p2 = kr, vr
-
+    # dk/dv outputs are block_k-grid padded; phantom key rows are zero
+    # (masked) and sliced away below.
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, block_k=block_k,
@@ -353,8 +363,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, s_pad_q, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s_pad_q, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s_pad_q), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, s_pad_q), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, s_pad_q, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad_q, 1), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
@@ -370,7 +380,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             transcendentals=B * H * S * S,
         ),
         interpret=interpret,
-    )(kr_p2, vr_p2, qr_p, dor_p, lse_p, delta_p)
+    )(kr_p, vr_p, qr_p, dor_p, lse_p, delta_p)
     if s_pad_k != S:
         dk = dk[:, :S]
         dv = dv[:, :S]

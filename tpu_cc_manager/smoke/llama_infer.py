@@ -130,13 +130,43 @@ def run(
             )
             return tok
 
+        # The decode oracles compare the cached path against a no-cache
+        # forward. The cached path always uses the einsum attention, while
+        # the no-cache path defaults to the flash kernel on TPU — two
+        # kernels whose (MXU-precision) logit differences can flip argmax
+        # at near-ties. Cache-position correctness must be isolated from
+        # kernel choice, so the oracle forwards pin the einsum path; the
+        # flash kernel is checked separately below with a numeric
+        # tolerance on the logits.
+        import dataclasses
+
+        model_ref = LlamaModel(dataclasses.replace(cfg, use_flash=False))
+
+        # Margin-aware argmax agreement: exact argmax equality across two
+        # differently-shaped reductions is brittle on TPU — f32 summation
+        # order differs between the cached (padded-buffer) and no-cache
+        # attention, and a near-tie can flip the argmax with both paths
+        # mathematically correct. Accept a produced token when its
+        # reference logit is within ``rel_margin`` of the row max: numeric
+        # jitter is O(1e-3·scale); a genuine cache/RoPE/mask bug moves
+        # logits by O(scale) and still fails (proven by the seeded
+        # off-by-one test, tests/test_smoke.py).
+        def argmax_agrees(ref_logits, got, rel_margin=1e-2) -> bool:
+            scale = jnp.max(jnp.abs(ref_logits))
+            top = jnp.max(ref_logits, axis=-1)
+            gotv = jnp.take_along_axis(
+                ref_logits, got[..., None], axis=-1
+            )[..., 0]
+            return bool(jnp.all(top - gotv <= rel_margin * scale))
+
         # --- oracle 1: teacher-forced cached prefix vs no-cache ----------
         oracle_len = min(8, prompt_len)
-        full_logits, _ = jax.jit(model.apply)(variables, prompt[:, :oracle_len])
-        expected = jnp.argmax(full_logits, axis=-1)
+        full_logits, _ = jax.jit(model_ref.apply)(
+            variables, prompt[:, :oracle_len]
+        )
         cache = model.init_cache(batch, max_len)
         got = teacher_forced(variables, prompt[:, :oracle_len], cache)
-        oracle_ok = bool(jnp.array_equal(got, expected))
+        oracle_ok = argmax_agrees(full_logits, got)
 
         # --- oracle 2: the WHOLE greedy decode transcript ----------------
         # Decode ``decode_len`` tokens through the cache, then teacher-force
@@ -169,10 +199,25 @@ def run(
         # Feed prompt + all-but-last generated token; the no-cache argmax
         # from position prompt_len-1 on must reproduce the transcript.
         x = jnp.concatenate([prompt, gen[:, :-1]], axis=1)
-        nocache_logits, _ = jax.jit(model.apply)(variables, x)
-        expected_gen = jnp.argmax(nocache_logits[:, prompt_len - 1 :], axis=-1)
-        transcript_ok = bool(jnp.array_equal(gen, expected_gen))
+        nocache_logits, _ = jax.jit(model_ref.apply)(variables, x)
+        transcript_ok = argmax_agrees(nocache_logits[:, prompt_len - 1 :], gen)
         oracle_ok = oracle_ok and transcript_ok
+
+        # --- oracle 3: flash-kernel numeric consistency ------------------
+        # When the default no-cache path uses the pallas flash kernel, its
+        # logits must agree with the einsum path within MXU precision —
+        # a relative tolerance, not argmax equality.
+        kernel_rel_err = None
+        uses_flash = cfg.use_flash
+        if uses_flash is None:
+            uses_flash = jax.default_backend() == "tpu"
+        if uses_flash:
+            flash_logits, _ = jax.jit(model.apply)(variables, x)
+            scale = float(jnp.max(jnp.abs(nocache_logits))) + 1e-6
+            kernel_rel_err = float(
+                jnp.max(jnp.abs(flash_logits - nocache_logits))
+            ) / scale
+            oracle_ok = oracle_ok and kernel_rel_err < 5e-2
 
         # --- timed run ---------------------------------------------------
         # Differential timing, as in smoke/matmul.py: median T(hi steps) -
@@ -224,6 +269,9 @@ def run(
         "oracle_ok": oracle_ok,
         "transcript_ok": transcript_ok,
         "transcript_positions": int(oracle_decode),
+        "flash_kernel_rel_err": (
+            round(kernel_rel_err, 6) if kernel_rel_err is not None else None
+        ),
     }
 
 

@@ -23,6 +23,7 @@ import logging
 import os
 import socket
 import subprocess
+import sys
 import time
 import urllib.error
 import urllib.request
@@ -52,6 +53,30 @@ DEFAULT_SHOW_CMD = [
     "systemctl", "show", "tpu-runtime",
     "--property=ActiveState,ActiveEnterTimestampMonotonic",
 ]
+
+# The distroless container image ships no systemctl/nsenter; host commands
+# run through a Python chroot into the host rootfs mounted at this path
+# (deployments/manifests/daemonset.yaml mounts / as /host with
+# HostToContainer propagation; the pod is privileged, so CAP_SYS_CHROOT is
+# present). Unset = run commands directly (bare-metal / test usage).
+HOST_ROOT_ENV = "CC_HOST_ROOT"
+
+
+def host_wrap(cmd: list[str], host_root: str | None = None) -> list[str]:
+    """Wrap a command to execute inside the host rootfs when CC_HOST_ROOT
+    (or ``host_root``) is set; identity otherwise. The wrapper chroots,
+    then execs the command with inherited stdio so the caller's
+    capture/timeout semantics are unchanged."""
+    root = host_root if host_root is not None else os.environ.get(HOST_ROOT_ENV)
+    if not root or not cmd:
+        return list(cmd)
+    return [
+        sys.executable, "-c",
+        "import os,sys,subprocess;"
+        "os.chroot(sys.argv[1]);os.chdir('/');"
+        "raise SystemExit(subprocess.run(sys.argv[2:]).returncode)",
+        root, *cmd,
+    ]
 
 # chips per host by generation (v4/v5p: 4 chips/host; v5e/v6e: up to 8).
 _CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
@@ -90,14 +115,16 @@ class TpuVmBackend(TpuCcBackend):
         vfio_glob: str = "/dev/vfio/[0-9]*",
     ) -> None:
         self.state_dir = state_dir
-        self.reset_cmd = reset_cmd or list(DEFAULT_RESET_CMD)
-        self.health_probe_cmd = health_probe_cmd
+        self.reset_cmd = host_wrap(reset_cmd or list(DEFAULT_RESET_CMD))
+        self.health_probe_cmd = (
+            host_wrap(health_probe_cmd) if health_probe_cmd else health_probe_cmd
+        )
         # show_cmd=[] (or CC_RUNTIME_SHOW_CMD="") disables the systemd
         # cross-checks on non-systemd hosts; None means the default.
         if show_cmd is None:
             env = os.environ.get("CC_RUNTIME_SHOW_CMD")
             show_cmd = env.split() if env is not None else list(DEFAULT_SHOW_CMD)
-        self.show_cmd = show_cmd
+        self.show_cmd = host_wrap(show_cmd) if show_cmd else show_cmd
         if health_port is None:
             health_port = int(os.environ.get("CC_RUNTIME_HEALTH_PORT", "0")) or None
         self.health_port = health_port
