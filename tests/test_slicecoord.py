@@ -244,6 +244,66 @@ def test_leader_crash_after_commit_recovers(fake_kube):
     assert SLICE_COMMIT_LABEL not in labels
 
 
+def test_barrier_tolerates_transient_peer_listing_failures(fake_kube):
+    """A flaky list_nodes during the barrier poll must be retried, not
+    surfaced as a reconcile failure."""
+    from tpu_cc_manager.ccmanager.slicecoord import SliceBarrier
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+    from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+
+    flaky = {"n": 2}
+    orig = fake_kube.list_nodes
+
+    def flaky_list(selector=None):
+        if flaky["n"] > 0:
+            flaky["n"] -= 1
+            raise KubeApiError(503, "hiccup")
+        return orig(selector)
+
+    fake_kube.list_nodes = flaky_list  # type: ignore[method-assign]
+    fake_kube.add_node(node_name(0))
+    topo = FakeTpuBackend(
+        num_hosts=1, host_index=0, slice_id=SLICE
+    ).discover()
+    barrier = SliceBarrier(
+        fake_kube, node_name(0), topo, timeout_s=5.0, poll_interval_s=0.01
+    )
+    barrier.publish_staged(MODE_ON)
+    barrier.await_commit(MODE_ON)  # leader of a 1-host "slice": no peers
+    assert flaky["n"] == 0  # the failures were consumed, not fatal
+
+
+def test_leader_leaves_commit_marker_when_peer_never_finishes(fake_kube):
+    """complete() must NOT retire the commit marker while a peer is still
+    staged (a follower mid-poll would be stranded); it leaves the marker
+    for the next barrier entry to clear."""
+    from tpu_cc_manager.ccmanager.slicecoord import SliceBarrier
+    from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+
+    fake_kube.add_node(node_name(0))
+    fake_kube.add_node(node_name(1))
+    fake_kube.set_node_label(node_name(1), SLICE_ID_LABEL, SLICE)
+    fake_kube.set_node_label(node_name(1), SLICE_STAGED_LABEL, MODE_ON)
+
+    topo = FakeTpuBackend(
+        num_hosts=2, host_index=0, slice_id=SLICE
+    ).discover()
+    barrier = SliceBarrier(
+        fake_kube, node_name(0), topo,
+        timeout_s=5.0, poll_interval_s=0.01, complete_timeout_s=0.1,
+    )
+    barrier.publish_staged(MODE_ON)
+    barrier.await_commit(MODE_ON)  # leader commits (both staged)
+    barrier.complete(MODE_ON)  # peer still staged; completion window closes
+    labels = node_labels(fake_kube.get_node(node_name(0)))
+    assert SLICE_STAGED_LABEL not in labels  # own marker withdrawn
+    assert labels.get(SLICE_COMMIT_LABEL) == MODE_ON  # left for the peer
+    # The next barrier round on this node clears the stale marker.
+    barrier.publish_staged(MODE_ON)
+    labels = node_labels(fake_kube.get_node(node_name(0)))
+    assert SLICE_COMMIT_LABEL not in labels
+
+
 def test_single_host_topology_skips_barrier(fake_kube, fake_tpu):
     """Single-host nodes never publish barrier markers (no peers to wait
     for); the apply is the plain reference-shaped phase sequence."""

@@ -249,7 +249,6 @@ class TestHostWrap:
         this image): a command resolves against the fake host rootfs, with
         stdout captured by the outer subprocess as the backend expects."""
         import os
-        import shutil
         import subprocess
 
         from tpu_cc_manager.tpudev.tpuvm import host_wrap

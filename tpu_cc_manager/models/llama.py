@@ -105,6 +105,15 @@ class LlamaConfig:
         return cls(**{**dict(vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
                              n_kv_heads=8, hidden_dim=4096, max_seq_len=2048), **kw})
 
+    def resolved_use_flash(self) -> bool:
+        """The single resolution point for the use_flash default (None →
+        flash on TPU, einsum elsewhere). The model forward and the smoke's
+        flash-consistency oracle (smoke/llama_infer.py) must agree on this,
+        or the oracle checks a path the model doesn't run."""
+        if self.use_flash is not None:
+            return self.use_flash
+        return jax.default_backend() == "tpu"
+
     def param_count(self) -> int:
         head = self.head_dim
         attn = self.dim * (self.n_heads * head) * 2 + self.dim * (
@@ -214,10 +223,9 @@ class Attention(nn.Module):
             k, v = k_buf, v_buf
             layer_cache = (k_buf, v_buf)
 
-        use_flash = cfg.use_flash
-        if use_flash is None:
-            use_flash = jax.default_backend() == "tpu"
-        if layer_cache is None and (cfg.ring_mesh is not None or use_flash):
+        if layer_cache is None and (
+            cfg.ring_mesh is not None or cfg.resolved_use_flash()
+        ):
             # Kernel layout is (B, heads, S, D).
             qf = q.transpose(0, 2, 1, 3)
             kf = k.transpose(0, 2, 1, 3)

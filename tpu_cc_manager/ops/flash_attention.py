@@ -32,12 +32,14 @@ NEG_INF = -1e30
 
 
 def _block_for(requested: int, seq_len: int) -> int:
-    """Clamp a block size to the sequence, rounded up to a multiple of 8:
-    Mosaic requires sublane-dim block sizes divisible by 8 and dynamic-slice
-    offsets (``ki * block``) statically provable as multiples of 8. A block
-    may exceed the (padded/masked) array tail — an unaligned one may not
-    exist at all."""
-    return min(requested, (seq_len + 7) // 8 * 8)
+    """Clamp a block size to the sequence, with BOTH rounded up to a
+    multiple of 8: Mosaic requires sublane-dim block sizes divisible by 8
+    and dynamic-slice offsets (``ki * block``) statically provable as
+    multiples of 8 — a caller-supplied odd block must be aligned too. A
+    block may exceed the (padded/masked) array tail — an unaligned one may
+    not exist at all."""
+    rounded = (requested + 7) // 8 * 8
+    return min(rounded, (seq_len + 7) // 8 * 8)
 
 
 def reference_attention(q, k, v, causal: bool = True):

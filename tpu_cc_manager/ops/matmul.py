@@ -66,6 +66,12 @@ def tiled_matmul(
         out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        # M/N tiles are independent (parallel); the K walk carries the
+        # accumulator (arbitrary). Declaring this lets Mosaic pipeline the
+        # K steps and reorder/parallelize output tiles.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         cost_estimate=pl.CostEstimate(
             flops=2 * M * N * K,
             bytes_accessed=(M * K + K * N) * a.dtype.itemsize + M * N * 4,

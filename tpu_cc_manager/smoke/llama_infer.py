@@ -208,10 +208,7 @@ def run(
         # logits must agree with the einsum path within MXU precision —
         # a relative tolerance, not argmax equality.
         kernel_rel_err = None
-        uses_flash = cfg.use_flash
-        if uses_flash is None:
-            uses_flash = jax.default_backend() == "tpu"
-        if uses_flash:
+        if cfg.resolved_use_flash():
             flash_logits, _ = jax.jit(model.apply)(variables, x)
             scale = float(jnp.max(jnp.abs(nocache_logits))) + 1e-6
             kernel_rel_err = float(

@@ -64,17 +64,18 @@ HOST_ROOT_ENV = "CC_HOST_ROOT"
 
 def host_wrap(cmd: list[str], host_root: str | None = None) -> list[str]:
     """Wrap a command to execute inside the host rootfs when CC_HOST_ROOT
-    (or ``host_root``) is set; identity otherwise. The wrapper chroots,
-    then execs the command with inherited stdio so the caller's
-    capture/timeout semantics are unchanged."""
+    (or ``host_root``) is set; identity otherwise. The wrapper chroots and
+    then REPLACES itself with the command (execvp) — the wrapper process
+    IS the command, so the caller's capture/timeout/kill semantics reach
+    the real command instead of orphaning a grandchild."""
     root = host_root if host_root is not None else os.environ.get(HOST_ROOT_ENV)
     if not root or not cmd:
         return list(cmd)
     return [
         sys.executable, "-c",
-        "import os,sys,subprocess;"
+        "import os,sys;"
         "os.chroot(sys.argv[1]);os.chdir('/');"
-        "raise SystemExit(subprocess.run(sys.argv[2:]).returncode)",
+        "os.execvp(sys.argv[2], sys.argv[2:])",
         root, *cmd,
     ]
 
