@@ -244,6 +244,42 @@ def test_leader_crash_after_commit_recovers(fake_kube):
     assert SLICE_COMMIT_LABEL not in labels
 
 
+def test_stuck_drain_on_one_host_fails_the_slice_soft(fake_kube):
+    """Strict eviction + barrier interplay: host 1's drain never completes
+    (a stuck pod), so it withdraws before touching hardware; host 0 times
+    out at the barrier. NEITHER host resets, both fail soft with
+    components re-admitted — the fabric is never half-bounced."""
+    seq, seq_lock = [], threading.Lock()
+    mgrs, backends = [], []
+    for i in range(2):
+        fake_kube.add_node(node_name(i), labels={DP_LABEL: "true"})
+        fake_kube.add_pod(NS, f"dp-{i}", node_name(i), labels={"app": DP_APP})
+        mgr, be = make_host(
+            fake_kube, seq, seq_lock, i, evict=True,
+            slice_barrier_timeout_s=1.0, strict_eviction=True,
+        )
+        mgrs.append(mgr)
+        backends.append(be)
+
+    # The operator controller drains host 0's pod but host 1's pod is
+    # stuck (never deleted).
+    def reactor(name, node):
+        if name == node_name(0) and is_paused(node_labels(node).get(DP_LABEL, "")):
+            fake_kube.delete_pod(NS, "dp-0")
+
+    fake_kube.add_patch_reactor(reactor)
+
+    results = run_all(mgrs, MODE_SLICE)
+    assert results == {0: False, 1: False}
+    for i, be in enumerate(backends):
+        labels = node_labels(fake_kube.get_node(node_name(i)))
+        assert labels.get(CC_MODE_STATE_LABEL) == STATE_FAILED, i
+        assert SLICE_STAGED_LABEL not in labels, i
+        assert labels.get(DP_LABEL) == "true", i  # re-admitted
+        assert set(be.committed.values()) == {MODE_OFF}, i  # untouched
+    assert "reset" not in [op for _, op in seq]
+
+
 def test_barrier_tolerates_transient_peer_listing_failures(fake_kube):
     """A flaky list_nodes during the barrier poll must be retried, not
     surfaced as a reconcile failure."""
