@@ -67,6 +67,21 @@ def test_llama_oracle_catches_cache_position_off_by_one():
     assert result["ok"] is False
 
 
+def test_profile_dir_captures_a_trace(tmp_path):
+    """--profile-dir wraps the workload in a JAX profiler trace; the trace
+    artifacts must actually land on disk."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", "matmul",
+         "--size", "256", "--profile-dir", str(tmp_path / "trace")],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-400:]
+    assert list((tmp_path / "trace").rglob("*.xplane.pb")), "no trace written"
+
+
 def test_resnet_smoke_passes():
     result = runner.run_workload("resnet", steps=3)
     assert result["ok"] is True

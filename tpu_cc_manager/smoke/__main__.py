@@ -18,6 +18,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="matmul only: 'pallas' runs the Mosaic tiled kernel "
                    "(ops/matmul.py) to prove custom-kernel compilation on a "
                    "reconfigured slice")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a JAX profiler trace of the workload into "
+                   "this directory (open with tensorboard/xprof; the "
+                   "MFU-accounting companion when a number looks off)")
     args = p.parse_args(argv)
 
     # Before any jax import: persistent XLA cache makes every verify run
@@ -40,7 +44,13 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         kwargs["kernel"] = args.kernel
     try:
-        result = run_workload(args.workload, **kwargs)
+        if args.profile_dir:
+            import jax
+
+            with jax.profiler.trace(args.profile_dir):
+                result = run_workload(args.workload, **kwargs)
+        else:
+            result = run_workload(args.workload, **kwargs)
     except SmokeError as e:
         print(json.dumps({"ok": False, "workload": args.workload, "error": str(e)}))
         return 1
