@@ -12,7 +12,10 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
 import sys
+import threading
+import time
 
 from tpu_cc_manager.ccmanager.hostcaps import is_host_cc_enabled
 from tpu_cc_manager.ccmanager.manager import CCManager
@@ -112,8 +115,40 @@ def main(argv: list[str] | None = None) -> int:
     )
     if args.metrics_port:
         start_metrics_server(args.metrics_port, manager.metrics)
+    # Graceful shutdown: SIGTERM (kubelet pod stop) sets the stop event so
+    # the watch loop exits at the next event/timeout boundary and the
+    # readiness file is withdrawn. A blocked watch read auto-retries after
+    # the handler (PEP 475), so a hard-exit fallback thread guarantees the
+    # process still dies promptly — but only while NO reconcile is in
+    # flight: a half-applied hardware transition is never interrupted while
+    # grace time (CC_SHUTDOWN_GRACE_S, default 20 s — size it below the
+    # pod's terminationGracePeriod) remains. The preStop /bin/rm hook
+    # covers the readiness file on the hard-exit path as well.
+    stop = threading.Event()
+    grace_s = float(os.environ.get("CC_SHUTDOWN_GRACE_S", "20"))
+
+    def _force_exit_when_idle():
+        deadline = time.monotonic() + grace_s
+        time.sleep(2.0)  # give a non-blocked loop the chance to exit cleanly
+        while manager.reconciling and time.monotonic() < deadline:
+            time.sleep(1.0)
+        manager.remove_readiness_file()
+        os._exit(143)
+
+    def _on_stop(*_):
+        if stop.is_set():
+            os._exit(143)  # second signal: immediate
+        stop.set()
+        t = threading.Thread(target=_force_exit_when_idle, daemon=True)
+        t.start()
+
     try:
-        manager.run()
+        signal.signal(signal.SIGTERM, _on_stop)
+        signal.signal(signal.SIGINT, _on_stop)
+    except ValueError:
+        pass  # not the main thread (tests) — stop stays externally unset
+    try:
+        manager.run(stop)
     except Exception as e:  # noqa: BLE001 - crash-as-retry (reference main.py:757-759)
         log.error("manager terminated: %s", e, exc_info=True)
         return 1

@@ -178,6 +178,10 @@ class CCManager:
             )
         self.retry_backoff_max_s = retry_backoff_max_s
         self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
+        # True while a reconcile (set_cc_mode) is in flight; the CLI's
+        # shutdown path consults it so a hard exit never interrupts a
+        # half-applied hardware transition when grace time remains.
+        self.reconciling = False
 
     # ------------------------------------------------------------------
     # Label plumbing
@@ -215,6 +219,13 @@ class CCManager:
     # ------------------------------------------------------------------
 
     def set_cc_mode(self, mode: str) -> bool:
+        self.reconciling = True
+        try:
+            return self._set_cc_mode(mode)
+        finally:
+            self.reconciling = False
+
+    def _set_cc_mode(self, mode: str) -> bool:
         mode = canonical_mode(mode)
         if mode not in VALID_MODES:
             log.error(
@@ -650,11 +661,30 @@ class CCManager:
                 )
                 time.sleep(self.reconnect_delay_s)
 
+    def remove_readiness_file(self) -> None:
+        """Best-effort in-process counterpart of the preStop ``/bin/rm``
+        hook (reference Dockerfile.distroless:45-46): a gracefully stopping
+        agent withdraws its readiness signal itself, so the operator's
+        validation framework notices even when the preStop hook is skipped
+        (e.g. node shutdown)."""
+        try:
+            os.remove(self.readiness_file)
+            log.info("removed readiness file %s", self.readiness_file)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            log.warning("could not remove readiness file: %s", e)
+
     def run(self, stop: threading.Event | None = None) -> None:
-        """Entry point (reference main.py:693-695)."""
+        """Entry point (reference main.py:693-695). On a graceful stop the
+        readiness file is withdrawn before returning."""
         log.info(
             "starting tpu-cc-manager on node %s (default=%s evict=%s smoke=%s ns=%s)",
             self.node_name, self.default_mode, self.evict_components,
             self.smoke_workload, self.operator_namespace,
         )
-        self.watch_and_apply(stop)
+        try:
+            self.watch_and_apply(stop)
+        finally:
+            if stop is not None and stop.is_set():
+                self.remove_readiness_file()

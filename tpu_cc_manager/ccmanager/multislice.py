@@ -23,7 +23,6 @@ the reference carries all its state on node objects):
 
 from __future__ import annotations
 
-import json
 import logging
 import time
 
