@@ -70,6 +70,43 @@ def _smoke_subprocess(workload: str, timeout_s: float, force_cpu: bool) -> dict:
     )
 
 
+NS = "tpu-operator"
+
+
+def make_bench_kube(node_names: list[str], pod_delete_delay_s: float = 0.0):
+    """Fake apiserver with one pod per drain component per node and the
+    emulated operator controller: a component's pods are deleted when its
+    deploy label flips to paused (the external behavior the protocol
+    relies on; SURVEY.md §5) — after the configured termination delay in
+    the realistic scenario (pods have grace periods; deletion is not
+    instantaneous on a real cluster). Shared by every bench scenario so
+    the drain-protocol emulation cannot diverge between them."""
+    from tpu_cc_manager.drain.pause import is_paused
+    from tpu_cc_manager.kubeclient.api import node_labels
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+    from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS
+
+    kube = FakeKube()
+    for name in node_names:
+        kube.add_node(name, {key: "true" for key in DRAIN_COMPONENT_LABELS})
+        for key, app in DRAIN_COMPONENT_LABELS.items():
+            kube.add_pod(NS, f"{app}-{name}", name, labels={"app": app})
+
+    def reactor(name, patched):
+        for key, app in DRAIN_COMPONENT_LABELS.items():
+            if is_paused(node_labels(patched).get(key)):
+                if pod_delete_delay_s > 0:
+                    threading.Timer(
+                        pod_delete_delay_s,
+                        kube.delete_pod, (NS, f"{app}-{name}"),
+                    ).start()
+                else:
+                    kube.delete_pod(NS, f"{app}-{name}")
+
+    kube.add_patch_reactor(reactor)
+    return kube
+
+
 def run_scenario(
     tpu_usable: bool,
     reset_latency_s: float = 0.0,
@@ -79,40 +116,13 @@ def run_scenario(
     """One drain→CC-on→ready pass through the real pipeline; returns the
     measurement plus the smoke detail."""
     from tpu_cc_manager.ccmanager.manager import CCManager
-    from tpu_cc_manager.drain.pause import is_paused
     from tpu_cc_manager.kubeclient.api import node_labels
-    from tpu_cc_manager.kubeclient.fake import FakeKube
-    from tpu_cc_manager.labels import (
-        CC_MODE_STATE_LABEL,
-        DRAIN_COMPONENT_LABELS,
-    )
+    from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
     from tpu_cc_manager.tpudev.fake import FakeTpuBackend
     from tpu_cc_manager.utils.metrics import MetricsRegistry
 
-    node, ns = "bench-node-0", "tpu-operator"
-    kube = FakeKube()
-    labels = {key: "true" for key in DRAIN_COMPONENT_LABELS}
-    kube.add_node(node, labels)
-    for key, app in DRAIN_COMPONENT_LABELS.items():
-        kube.add_pod(ns, f"{app}-pod", node, labels={"app": app})
-
-    # Emulated operator controller: deletes a component's pods when its
-    # deploy label flips to paused (the external behavior the protocol
-    # relies on; SURVEY.md §5) — after the configured termination delay in
-    # the realistic scenario (pods have grace periods; deletion is not
-    # instantaneous on a real cluster).
-    def reactor(name, patched):
-        for key, app in DRAIN_COMPONENT_LABELS.items():
-            if is_paused(node_labels(patched).get(key)):
-                if pod_delete_delay_s > 0:
-                    threading.Timer(
-                        pod_delete_delay_s,
-                        kube.delete_pods_matching, (ns, f"app={app}"),
-                    ).start()
-                else:
-                    kube.delete_pods_matching(ns, f"app={app}")
-
-    kube.add_patch_reactor(reactor)
+    node, ns = "bench-node-0", NS
+    kube = make_bench_kube([node], pod_delete_delay_s)
 
     backend_used = {"backend": "unknown"}
     smoke_detail: dict = {}
@@ -166,6 +176,70 @@ def run_scenario(
     }
 
 
+def run_multihost_scenario() -> dict:
+    """Two agents of one 2-host slice transition to mode 'slice' through
+    the cross-host commit barrier (ccmanager/slicecoord.py) — the
+    fabric-atomicity evidence: wall time for the whole slice, plus each
+    host's time spent waiting at the barrier."""
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.kubeclient.api import node_labels
+    from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
+    from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    ns = NS
+    names = [f"bench-mh-{i}" for i in range(2)]
+    kube = make_bench_kube(names)
+
+    managers = []
+    for i, name in enumerate(names):
+        backend = FakeTpuBackend(
+            num_chips=4, accelerator_type="v5p-32",
+            num_hosts=2, host_index=i, slice_id="bench-slice",
+        )
+        managers.append(CCManager(
+            api=kube, backend=backend, node_name=name,
+            operator_namespace=ns, evict_components=True,
+            smoke_workload="none", metrics=MetricsRegistry(),
+            eviction_poll_interval_s=0.05,
+            slice_barrier_poll_interval_s=0.02,
+        ))
+
+    results = {}
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=lambda i=i, m=m: results.update({i: m.set_cc_mode("slice")}),
+            daemon=True,  # a wedged reconcile must not hold the bench open
+        )
+        for i, m in enumerate(managers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    dt = time.perf_counter() - t0
+    timed_out = any(t.is_alive() for t in threads)
+
+    states = [
+        node_labels(kube.get_node(n)).get(CC_MODE_STATE_LABEL) for n in names
+    ]
+    barrier_waits = [
+        round(m.metrics.last().phase_seconds("barrier"), 3)
+        if m.metrics.last() else None
+        for m in managers
+    ]
+    return {
+        "seconds": round(dt, 2),
+        "ok": (
+            not timed_out
+            and all(results.get(i) for i in range(2))
+            and states == ["slice"] * 2
+        ),
+        "barrier_wait_s": barrier_waits,
+    }
+
+
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import logging
@@ -181,6 +255,7 @@ def main() -> int:
         boot_latency_s=20.0,
         pod_delete_delay_s=3.0,
     )
+    multihost = run_multihost_scenario()
 
     dt = control["seconds"]
     smoke = control["smoke"]
@@ -202,7 +277,11 @@ def main() -> int:
             "under_target": realistic["seconds"] < 90.0,
             "phases": realistic["phases"],
         },
+        # Fabric atomicity evidence: both hosts of a 2-host slice through
+        # the cross-host commit barrier (ccmanager/slicecoord.py).
+        "multihost_slice": multihost,
     }
+    result["ok"] = bool(result["ok"] and multihost["ok"])
     print(json.dumps(result))
     return 0 if result["ok"] and result["realistic"]["under_target"] else 1
 

@@ -631,9 +631,12 @@ class CCManager:
                         maybe_retry()
                 else:
                     # Stream ended normally (server-side timeout): retry a
-                    # failed reconcile if due, then reconnect with the
+                    # failed reconcile if due — unless shutdown is in
+                    # progress (a retry started after SIGTERM would race
+                    # the hard-exit fallback) — then reconnect with the
                     # tracked rv.
-                    maybe_retry()
+                    if not (stop and stop.is_set()):
+                        maybe_retry()
                     continue
             except KubeApiError as e:
                 consecutive_errors += 1
