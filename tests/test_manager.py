@@ -144,7 +144,14 @@ def test_invalid_mode_rejected(fake_kube, fake_tpu):
     fake_kube.add_node(NODE)
     mgr = make_manager(fake_kube, fake_tpu)
     assert mgr.set_cc_mode("bogus") is False
-    assert state_of(fake_kube) == (None, None)  # state untouched
+    # Divergence from the reference (which refuses silently): the node
+    # reports failed + a machine-readable reason. Hardware untouched.
+    assert state_of(fake_kube) == (STATE_FAILED, "")
+    from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
+
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels.get(CC_FAILED_REASON_LABEL) == "invalid-mode"
+    assert "reset" not in [op for op, _ in fake_tpu.op_log]
 
 
 def test_reset_failure_labels_failed(fake_kube, fake_tpu):

@@ -202,6 +202,48 @@ def test_failed_reconcile_retries_without_label_change(kube, fake_tpu, tmp_path)
     assert ops.count("reset") == 1
 
 
+def test_stable_misconfiguration_retries_only_at_slow_cadence(kube, tmp_path):
+    """A ModeUnsupported failure skips the fast doubling ladder: it is
+    retried only at retry_backoff_max_s (so a later hardware/pool fix still
+    converges) — NOT every few seconds like a transient fault."""
+    import time
+
+    backend = FakeTpuBackend(slice_cc_supported=[True, True, True, False])
+    kube.set_node_label(NODE, CC_MODE_LABEL, "slice")
+
+    def idle():
+        time.sleep(0.08)
+        return []
+
+    kube.segments = [idle, idle, idle]
+    mgr = make_manager(
+        kube, backend,
+        readiness_file=str(tmp_path / "r"),
+        retry_backoff_s=0.02,   # fast cadence: would fire every window
+        retry_backoff_max_s=30,  # slow cadence: far beyond the test run
+    )
+    run_to_completion(mgr, kube)
+    from tpu_cc_manager.labels import STATE_FAILED
+
+    assert node_labels(kube.get_node(NODE))[CC_MODE_STATE_LABEL] == STATE_FAILED
+    # Exactly one reconcile attempt (the initial apply): the fast ladder
+    # never fired despite several idle watch windows past 0.02s.
+    assert [op for op, _ in backend.op_log].count("discover") == 1
+
+
+def test_invalid_mode_reports_failed_with_reason(kube, fake_tpu, tmp_path):
+    """A typo'd desired label is surfaced as failed + reason (the reference
+    refuses silently, leaving no outward signal)."""
+    from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL, STATE_FAILED
+
+    kube.set_node_label(NODE, CC_MODE_LABEL, "bogus")
+    mgr = make_manager(kube, fake_tpu, readiness_file=str(tmp_path / "r"))
+    run_to_completion(mgr, kube)
+    labels = node_labels(kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == STATE_FAILED
+    assert labels[CC_FAILED_REASON_LABEL] == "invalid-mode"
+
+
 def test_retry_backoff_disabled_keeps_reference_behavior(kube, fake_tpu, tmp_path):
     import time
 

@@ -182,6 +182,13 @@ class CCManager:
         # shutdown path consults it so a hard exit never interrupts a
         # half-applied hardware transition when grace time remains.
         self.reconciling = False
+        # Whether the most recent failure could plausibly clear on a fast
+        # retry. Stable misconfigurations (ModeUnsupported, invalid mode)
+        # set this False: they are retried only at the slow
+        # retry_backoff_max_s cadence — enough that a later hardware/pool
+        # fix still converges without a label edit, without re-failing an
+        # identical reconcile every few seconds.
+        self.retryable_failure = True
 
     # ------------------------------------------------------------------
     # Label plumbing
@@ -220,6 +227,7 @@ class CCManager:
 
     def set_cc_mode(self, mode: str) -> bool:
         self.reconciling = True
+        self.retryable_failure = True
         try:
             return self._set_cc_mode(mode)
         finally:
@@ -228,8 +236,15 @@ class CCManager:
     def _set_cc_mode(self, mode: str) -> bool:
         mode = canonical_mode(mode)
         if mode not in VALID_MODES:
+            # A typo'd label is as stable as unsupported hardware: report
+            # failed with a reason (the reference refuses silently, leaving
+            # no outward signal) and retry only at the slow cadence.
             log.error(
                 "invalid CC mode %r (valid: %s) — refusing to act", mode, VALID_MODES
+            )
+            self.retryable_failure = False
+            state.set_cc_state_label(
+                self.api, self.node_name, STATE_FAILED, reason="invalid-mode"
             )
             return False
         if not self.host_cc_capable and mode != MODE_OFF:
@@ -265,6 +280,7 @@ class CCManager:
             # Crash-as-retry stays only for mixed capability (reference
             # main.py:237-240), where a restart can genuinely re-enumerate.
             log.error("mode %s unsupported on this node: %s", mode, e)
+            self.retryable_failure = False  # only a label/pool edit helps
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason=e.reason
             )
@@ -559,10 +575,18 @@ class CCManager:
                 retry_at = None
                 backoff = self.retry_backoff_s
             else:
-                retry_at = time.monotonic() + backoff
+                # Stable misconfigurations skip the fast doubling ladder and
+                # go straight to the slow cadence: an identical re-fail
+                # every few seconds helps nobody, but a later hardware/pool
+                # fix should still converge without a label edit.
+                delay = (
+                    backoff if self.retryable_failure
+                    else self.retry_backoff_max_s
+                )
+                retry_at = time.monotonic() + delay
                 log.warning(
                     "reconcile failed; retrying in %.0fs without waiting for "
-                    "a label change", backoff,
+                    "a label change", delay,
                 )
                 backoff = min(backoff * 2, self.retry_backoff_max_s)
             return ok

@@ -138,9 +138,9 @@ class RollingReconfigurator:
     def rollout(self, mode: str) -> RolloutResult:
         mode = canonical_mode(mode)
         if mode not in VALID_MODES:
-            # Fail fast: a typo'd mode written pool-wide would make every
-            # node agent refuse (without a 'failed' state label) and the
-            # rollout would burn node_timeout_s per group before reporting.
+            # Fail fast: a typo'd mode written pool-wide would drive every
+            # node agent to 'failed' (reason=invalid-mode) and the rollout
+            # would still burn a full await per group before reporting.
             raise ValueError(
                 f"invalid CC mode {mode!r} (valid: {VALID_MODES})"
             )
