@@ -96,10 +96,15 @@ def make_bench_kube(node_names: list[str], pod_delete_delay_s: float = 0.0):
         for key, app in DRAIN_COMPONENT_LABELS.items():
             if is_paused(node_labels(patched).get(key)):
                 if pod_delete_delay_s > 0:
-                    threading.Timer(
+                    timer = threading.Timer(
                         pod_delete_delay_s,
                         kube.delete_pod, (NS, f"{app}-{name}"),
-                    ).start()
+                    )
+                    # Daemonize so a pending timer can't outlive its scenario
+                    # (delaying exit or firing into FakeKube after the
+                    # measurement window).
+                    timer.daemon = True
+                    timer.start()
                 else:
                     kube.delete_pod(NS, f"{app}-{name}")
 
@@ -257,10 +262,14 @@ def main() -> int:
     )
     multihost = run_multihost_scenario()
 
-    dt = control["seconds"]
+    dt = realistic["seconds"]
     smoke = control["smoke"]
     result = {
         "metric": "node_drain_cc_on_ready_sec",
+        # Headline is the REALISTIC scenario (simulated-real device
+        # latencies: 30 s reset, 20 s boot, 3 s pod termination) — the
+        # honest number for the <90 s target. The zero-device-latency
+        # control run rides along as `control`.
         "value": dt,
         "unit": "s",
         "vs_baseline": round(90.0 / dt, 2) if dt > 0 else 0.0,
@@ -269,9 +278,15 @@ def main() -> int:
         "chip_generation": smoke.get("generation"),
         "smoke_tflops": smoke.get("tflops"),
         "smoke_mfu": smoke.get("mfu"),
-        "phases": control["phases"],
-        # The <90 s claim against simulated-real device time (30 s reset,
-        # 20 s boot, 3 s pod termination), not zero-cost fakes.
+        "phases": realistic["phases"],
+        "under_target": dt < 90.0,
+        # Control-plane-only overhead (zero device latencies): what this
+        # framework itself costs, separated from simulated device time.
+        "control": {
+            "seconds": control["seconds"],
+            "phases": control["phases"],
+        },
+        # Kept for artifact-shape continuity with BENCH_r01–r03.
         "realistic": {
             "seconds": realistic["seconds"],
             "under_target": realistic["seconds"] < 90.0,

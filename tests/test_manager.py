@@ -75,6 +75,25 @@ def test_idempotent_apply_skips_reset(fake_kube):
     assert state_of(fake_kube) == (MODE_ON, "true")
 
 
+def test_idempotent_apply_clears_stale_staged_marker(fake_kube):
+    # A crash between barrier commit and clear_staged leaves the node's
+    # slice staged marker behind; the idempotent path after restart must
+    # retire it so ctl status stops advertising "mid-transition" (ADVICE r3).
+    from tpu_cc_manager.ccmanager.slicecoord import SLICE_STAGED_LABEL
+
+    backend = FakeTpuBackend(
+        initial_mode=MODE_SLICE, accelerator_type="v5p-32",
+        num_hosts=2, host_index=0, slice_id="slice-a",
+    )
+    fake_kube.add_node(NODE, {SLICE_STAGED_LABEL: MODE_SLICE})
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_SLICE) is True
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert SLICE_STAGED_LABEL not in labels
+    assert labels.get(CC_MODE_STATE_LABEL) == MODE_SLICE
+    assert "reset" not in [op for op, _ in backend.op_log]
+
+
 def test_mixed_capability_exits(fake_kube):
     backend = FakeTpuBackend(num_chips=4, cc_supported=[True, True, False, False])
     fake_kube.add_node(NODE)

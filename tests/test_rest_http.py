@@ -258,3 +258,31 @@ def test_client_errors_are_not_retried():
     with pytest.raises(KubeApiError):
         client.get_node(NODE)
     assert calls["n"] == 1  # a 404 will not improve with repetition
+
+
+def test_non_idempotent_verbs_are_never_retried():
+    """The retry loop is gated on method in (GET, PATCH) in code, not by
+    docstring convention (ADVICE r3): a future POST route must not inherit
+    retry-after-ambiguous-failure, where the first attempt may have taken
+    effect server-side."""
+    client = RestKube(
+        ClusterConfig(server="http://x"), retry_attempts=3,
+        retry_base_delay_s=0.01,
+    )
+    calls = {"n": 0}
+
+    def transient(method, path, query=None, body=None, content_type=None,
+                  read_timeout=30.0):
+        calls["n"] += 1
+        raise KubeApiError(503, "ambiguous failure")
+
+    client._open = transient  # type: ignore[method-assign]
+    with pytest.raises(KubeApiError):
+        client._request_json("POST", "/api/v1/namespaces/x/pods/y/eviction")
+    assert calls["n"] == 1  # exactly one attempt despite retry_attempts=3
+
+    # The same transient status IS retried for idempotent verbs.
+    calls["n"] = 0
+    with pytest.raises(KubeApiError):
+        client.get_node(NODE)
+    assert calls["n"] == 3

@@ -125,13 +125,24 @@ def main(argv: list[str] | None = None) -> int:
     # pod's terminationGracePeriod) remains. The preStop /bin/rm hook
     # covers the readiness file on the hard-exit path as well.
     stop = threading.Event()
+    run_returned = threading.Event()
     grace_s = float(os.environ.get("CC_SHUTDOWN_GRACE_S", "20"))
 
     def _force_exit_when_idle():
         deadline = time.monotonic() + grace_s
-        time.sleep(2.0)  # give a non-blocked loop the chance to exit cleanly
+        # Give a non-blocked loop the chance to exit cleanly; if run() has
+        # already returned, the main thread owns shutdown — hard-exiting
+        # here would race it and turn a clean stop into exit code 143.
+        if run_returned.wait(2.0):
+            return
         while manager.reconciling and time.monotonic() < deadline:
-            time.sleep(1.0)
+            if run_returned.wait(1.0):
+                return
+        # One final grace wait (not a bare is_set): if the reconcile just
+        # finished, the main thread is milliseconds from returning — give
+        # it that window so a clean stop doesn't report 143.
+        if run_returned.wait(1.0):
+            return
         manager.remove_readiness_file()
         os._exit(143)
 
@@ -152,6 +163,8 @@ def main(argv: list[str] | None = None) -> int:
     except Exception as e:  # noqa: BLE001 - crash-as-retry (reference main.py:757-759)
         log.error("manager terminated: %s", e, exc_info=True)
         return 1
+    finally:
+        run_returned.set()
     return 0
 
 

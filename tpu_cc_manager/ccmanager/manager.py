@@ -498,11 +498,9 @@ class CCManager:
             m.result = "failed"
             return False
         state.set_cc_state_label(self.api, self.node_name, mode)
-        if barrier is not None:
-            # Withdraw this host's staged marker now (it is no longer
-            # mid-transition); the leader's commit-marker retirement waits
-            # until set_cc_mode's post-readmit completion.
-            barrier.clear_staged()
+        # The publish patch below also withdraws this host's staged marker
+        # (it is no longer mid-transition); the leader's commit-marker
+        # retirement waits until set_cc_mode's post-readmit completion.
         self._publish_coordination_labels(topo, quote)
         m.result = "ok"
         log.info("CC mode %s applied and verified on %d chip(s)", mode, len(chips))
@@ -520,9 +518,16 @@ class CCManager:
 
             # One merge-patch for slice id + quote labels (or None-clears
             # when mode off): a single apiserver round trip, and no window
-            # where the slice label is visible with a stale quote.
+            # where the slice label is visible with a stale quote. On
+            # multi-host topologies the same patch retires the slice staged
+            # marker — the mode is set, so "mid-transition" no longer
+            # describes this host (covers both the normal apply path and a
+            # marker left by a crash between barrier commit and clear,
+            # which the idempotent path would otherwise never clean up).
             patch = {SLICE_ID_LABEL: label_safe(topo.slice_id)}
             patch.update(multislice.quote_label_patch(quote))
+            if topo.is_multi_host:
+                patch[slicecoord.SLICE_STAGED_LABEL] = None
             self.api.patch_node_labels(self.node_name, patch)
             if quote is not None:
                 log.info(

@@ -180,19 +180,23 @@ class RestKube(KubeApi):
     def _request_json(self, method: str, path: str, query: dict | None = None,
                       body: dict | None = None, content_type: str | None = None) -> dict:
         """One apiserver round trip with bounded retry on transient
-        failures (connection errors, 429, 5xx). All the verbs this client
-        retries are idempotent (GET, label merge-patch), so a retry after
-        an ambiguous failure is safe. Client-side errors (4xx) propagate
-        immediately — a 404/409 will not improve with repetition."""
+        failures (connection errors, 429, 5xx). Only idempotent verbs
+        (GET, label merge-patch) are retried — enforced here, not just
+        documented, so a future non-idempotent route (e.g. a POST eviction)
+        cannot silently inherit retry-after-ambiguous-failure. Client-side
+        errors (4xx) propagate immediately — a 404/409 will not improve
+        with repetition."""
         raw = json.dumps(body).encode() if body is not None else None
         delay = self.retry_base_delay_s
-        for attempt in range(self.retry_attempts):
+        retryable_verb = method in ("GET", "PATCH")
+        attempts = self.retry_attempts if retryable_verb else 1
+        for attempt in range(attempts):
             try:
                 with self._open(method, path, query, raw, content_type) as resp:
                     return json.loads(resp.read().decode("utf-8"))
             except KubeApiError as e:
                 transient = e.status is None or e.status in self.RETRYABLE_STATUS
-                if not transient or attempt == self.retry_attempts - 1:
+                if not transient or attempt == attempts - 1:
                     raise
                 log.warning(
                     "transient apiserver error (%s/%s) on %s %s: %s — "
