@@ -208,6 +208,10 @@ def test_devtools_mode_applies(fake_kube, fake_tpu):
     mgr = make_manager(fake_kube, fake_tpu)
     assert mgr.set_cc_mode(MODE_DEVTOOLS) is True
     assert state_of(fake_kube) == (MODE_DEVTOOLS, "debug")
+    # devtools is backend-visible, not just an attestation-policy flag:
+    # the committed runtime env carries the debug flags (labels.py).
+    assert fake_tpu.runtime_env.get("TPU_CC_MODE") == MODE_DEVTOOLS
+    assert fake_tpu.runtime_env.get("TPU_MIN_LOG_LEVEL") == "0"
 
 
 def test_eviction_wraps_reconfigure(fake_kube, fake_tpu):

@@ -10,10 +10,17 @@ Mode semantics (reference modes at main.py:214-296):
 =========  =====================================================================
 ``on``     CC enabled for the node's TPU chips (reference ``on``).
 ``off``    CC disabled (reference ``off``).
-``devtools``  CC enabled with a debug attestation policy: quotes are fetched
-           and logged but verification failures do not fail the reconcile.
-           (Reference ``devtools`` is a GPU debug mode; the TPU analogue is an
-           attestation-policy relaxation.)
+``devtools``  CC enabled with a debug attestation policy AND a debug runtime
+           configuration. Policy side: quotes are fetched and logged but
+           verification failures do not fail the reconcile. Backend side
+           (tpudev/tpuvm.py): the staged runtime environment file carries
+           debug/trace flags (``TPU_MIN_LOG_LEVEL=0``,
+           ``TPU_STDERR_LOG_LEVEL=0``, vmodule tracing), committed by the
+           runtime restart like any mode change — so a devtools runtime is
+           *measurably* different (the env file is on the measured-paths
+           list, hence a distinct attested runtime digest), mirroring the
+           reference where devtools is a real hardware mode, not a label
+           (main.py:214-263).
 ``slice``  Slice-wide CC across every host of a multi-host ICI domain, staged
            and committed with fabric atomicity. This is the TPU analogue of
            the reference's ``ppcie`` multi-GPU Protected-PCIe mode

@@ -108,6 +108,38 @@ _SIGNATURE_CHECKS = {
 }
 
 
+def _check_tsm_binding(quote: AttestationQuote, nonce: str) -> list[str]:
+    """When the quote claims a TEE guest report (measurements.tsm_provider
+    != "none"), the per-host evidence must carry the report and the report
+    itself must embed the nonce-derived challenge: both SEV-SNP attestation
+    reports and TDX quotes copy the configfs-tsm ``inblob`` verbatim into
+    their signed report_data field, so the 32 random challenge bytes must
+    appear inside the outblob. A producer-supplied hash would not do — it
+    is derivable from the public nonce alone, so a stale outblob could ride
+    along under a fresh JWT. Full certificate-chain validation of the
+    outblob signature (AMD/Intel roots) is the relying party's job; this
+    check decides what the manager can decide offline: presence + the
+    challenge being inside the signed blob."""
+    provider = quote.measurements.get("tsm_provider", "none")
+    if provider in ("none", "unavailable"):
+        return []
+    evidence = quote.host_evidence
+    outblob_b64 = evidence.get("tsm_outblob_b64")
+    if not outblob_b64:
+        return [f"tsm_provider={provider!r} claimed but no guest report attached"]
+    try:
+        outblob = base64.b64decode(outblob_b64, validate=True)
+    except Exception:  # noqa: BLE001 - undecodable evidence is the finding
+        return ["tsm guest report is not valid base64"]
+    expected_inblob = hashlib.sha256(f"tpu-cc-manager/{nonce}".encode()).digest()
+    if expected_inblob not in outblob:
+        return [
+            "tsm report is not bound to this nonce (nonce-derived challenge "
+            "not present in the signed report_data)"
+        ]
+    return []
+
+
 def verify_quote(
     quote: AttestationQuote,
     nonce: str,
@@ -143,6 +175,7 @@ def verify_quote(
     for key in REQUIRED_MEASUREMENTS:
         if key not in quote.measurements:
             problems.append(f"missing measurement {key!r}")
+    problems.extend(_check_tsm_binding(quote, nonce))
     checker = _SIGNATURE_CHECKS.get(quote.platform)
     if checker is None:
         problems.append(f"unknown quote platform {quote.platform!r}")

@@ -69,8 +69,16 @@ class SliceTopology:
 class AttestationQuote:
     """Evidence that the slice booted into the reported CC mode.
 
-    ``measurements`` carries the platform's claims (mode, slice id, runtime
-    digest…); ``signature`` binds them plus the caller's nonce.
+    ``measurements`` carries the platform's POOL-COMPARABLE claims (mode,
+    runtime digest, libtpu version…): every healthy host of one pool must
+    produce identical values, and :func:`attestation.quote_digest` hashes
+    them for the cross-slice equality check (ccmanager/multislice.py).
+
+    ``host_evidence`` carries PER-HOST facts (systemd activation stamp,
+    configfs-tsm guest report) that would break cross-host digest equality
+    — excluded from the digest, still available to the verifier.
+
+    ``signature`` binds the caller's nonce.
     """
 
     slice_id: str
@@ -79,6 +87,7 @@ class AttestationQuote:
     measurements: dict[str, str]
     signature: str
     platform: str  # "fake" | "tpuvm"
+    host_evidence: dict[str, str] = field(default_factory=dict)
 
 
 class TpuCcBackend(abc.ABC):

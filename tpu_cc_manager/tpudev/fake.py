@@ -90,6 +90,10 @@ class FakeTpuBackend(TpuCcBackend):
         self.fail: dict[str, int] = {}
         # Ordered op log for ordering assertions: (op, payload).
         self.op_log: list[tuple[str, object]] = []
+        # The committed runtime environment, mirroring TpuVmBackend's
+        # EnvironmentFile semantics (devtools commits debug flags): tests
+        # assert the backend-visible difference between modes here.
+        self.runtime_env: dict[str, str] = {}
 
     # ---- fault injection helpers ----------------------------------------
 
@@ -133,6 +137,18 @@ class FakeTpuBackend(TpuCcBackend):
                     self.committed[chip.index] = self.staged.pop(chip.index)
                 self.booted[chip.index] = False
                 self._boot_done_at[chip.index] = now + self.boot_latency_s
+            modes = sorted(set(self.committed.values()))
+            if len(modes) == 1:
+                from tpu_cc_manager.tpudev.tpuvm import runtime_env_for_mode
+
+                self.runtime_env = {
+                    k: v
+                    for k, _, v in (
+                        line.partition("=")
+                        for line in runtime_env_for_mode(modes[0]).splitlines()
+                        if "=" in line
+                    )
+                }
             self.op_log.append(("reset", tuple(c.index for c in chips)))
 
     def wait_ready(self, chips: tuple[TpuChip, ...], timeout_s: float) -> None:
