@@ -1,0 +1,88 @@
+"""Child process for the real 2-process ``jax.distributed`` test.
+
+Run as ``python tests/dcn_child.py <coordinator_port> <process_id>``. Each
+of the two processes brings 2 virtual CPU devices, so the pair forms a
+4-device global mesh with ``dcn=2`` crossing the process boundary — the
+same topology shape as two TPU slices over DCN (BASELINE.json configs[4]),
+executed with a REAL coordinator handshake instead of a single-process
+virtual mesh (VERDICT r3 item 3).
+
+Success protocol: print ``DCN_CHILD_OK`` and exit 0.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    port, pid = sys.argv[1], sys.argv[2]
+    # Env must be set before jax imports; this child is a fresh interpreter.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = pid
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_cc_manager.parallel.distributed import bootstrap, verify_dcn_mesh
+    from tpu_cc_manager.parallel.mesh import MeshSpec, make_mesh
+
+    info = bootstrap(timeout_s=90)
+    assert info["initialized"] is True and info["processes"] == 2, info
+    assert jax.process_count() == 2
+    assert jax.local_device_count() == 2
+    assert jax.device_count() == 4
+
+    mesh = make_mesh(MeshSpec(dcn=2, dp=2))
+    # The dcn axis must actually cross processes: each dcn row's devices
+    # belong to one process.
+    dcn_procs = [
+        {d.process_index for d in mesh.devices[i].flatten()}
+        for i in range(mesh.shape["dcn"])
+    ]
+    assert dcn_procs == [{0}, {1}], dcn_procs
+    assert verify_dcn_mesh(mesh)
+
+    # One cross-process train step: global batch sharded over the data
+    # axes, replicated params, gradient reduction crossing the process
+    # boundary. Both processes must read back identical results.
+    xs = np.arange(8, dtype=np.float32).reshape(8, 1) / 8.0
+    ys = 3.0 * xs[:, 0] + 1.0
+    data_sh = NamedSharding(mesh, P(("dcn", "dp", "fsdp")))
+    rep = NamedSharding(mesh, P())
+    xg = jax.make_array_from_callback(xs.shape, data_sh, lambda idx: xs[idx])
+    yg = jax.make_array_from_callback(ys.shape, data_sh, lambda idx: ys[idx])
+    w0 = jax.make_array_from_callback((), rep, lambda idx: np.float32(0.0))
+    b0 = jax.make_array_from_callback((), rep, lambda idx: np.float32(0.0))
+
+    def loss_fn(w, b, xb, yb):
+        pred = xb[:, 0] * w + b
+        return jnp.mean((pred - yb) ** 2)
+
+    @jax.jit
+    def step(w, b, xb, yb):
+        loss, (gw, gb) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            w, b, xb, yb
+        )
+        return loss, w - 0.5 * gw, b - 0.5 * gb
+
+    loss0, w, b = step(w0, b0, xg, yg)
+    loss1, w, b = step(w, b, xg, yg)
+    l0, l1 = float(loss0), float(loss1)
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0, (l0, l1)  # the cross-process gradient actually applied
+
+    jax.distributed.shutdown()
+    print(f"DCN_CHILD_OK pid={pid} losses={l0:.4f}->{l1:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Real 2-process ``jax.distributed`` execution (VERDICT r3 item 3).
+
+Everything else in the suite exercises multi-chip sharding on a
+single-process virtual mesh; this test spawns TWO OS processes that
+perform the actual coordinator handshake (``jax.distributed.initialize``
+via ``parallel.distributed.bootstrap``), build a ``dcn=2`` mesh whose dcn
+axis crosses the process boundary, pass ``verify_dcn_mesh``, and run one
+train step whose gradient reduction crosses processes (tests/dcn_child.py).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_bootstrap_dcn_mesh_and_train_step():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "dcn_child.py"),
+             str(port), str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed rc={rc}\nstdout:\n{out}\nstderr:\n{err}"
+        assert "DCN_CHILD_OK" in out
+    # Replicated results must agree across processes (same losses printed).
+    assert outs[0][1].split("losses=")[1] == outs[1][1].split("losses=")[1]

@@ -1,0 +1,233 @@
+"""Workload drain handshake (drain/handshake.py; VERDICT r3 item 4).
+
+The decisive property: the checkpoint is triggered BY the drain protocol —
+the manager requests, the job's DrainSubscriber checkpoints and acks, and
+only then does the component drain proceed — and training resumes bit-exact
+from that protocol-triggered snapshot. (test_rolling_training.py covers the
+checkpoint/restore math; here the trigger and the ordering are the system
+under test.)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.drain import handshake
+from tpu_cc_manager.drain.pause import is_paused
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    DRAIN_COMPONENT_LABELS,
+    MODE_ON,
+)
+from tpu_cc_manager.parallel.checkpoint import TrainCheckpointer
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "train-node-0"
+NS = "tpu-operator"
+DP_LABEL = "google.com/tpu.deploy.device-plugin"
+DP_APP = DRAIN_COMPONENT_LABELS[DP_LABEL]
+
+
+# ---------------------------------------------------------------------------
+# Protocol units
+# ---------------------------------------------------------------------------
+
+
+def test_request_drain_resets_stale_acks(fake_kube):
+    sub_label = handshake.subscriber_label("jobA")
+    fake_kube.add_node(NODE, {sub_label: handshake.ACKED})  # stale from r-1
+    subs = handshake.request_drain(fake_kube, NODE)
+    assert subs == [sub_label]
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[handshake.DRAIN_REQUESTED_LABEL] == handshake.DRAIN_REQUESTED
+    # The stale ack cannot satisfy this cycle's wait.
+    assert labels[sub_label] == handshake.ACTIVE
+    laggards = handshake.await_workload_acks(
+        fake_kube, NODE, timeout_s=0.05, poll_interval_s=0.01
+    )
+    assert laggards == [sub_label]
+
+
+def test_await_acks_returns_when_all_acked(fake_kube):
+    sub_label = handshake.subscriber_label("jobA")
+    fake_kube.add_node(NODE, {sub_label: handshake.ACKED})
+    assert handshake.await_workload_acks(fake_kube, NODE, timeout_s=1) == []
+
+
+def test_unregistered_subscriber_counts_as_done(fake_kube):
+    sub_label = handshake.subscriber_label("jobA")
+    fake_kube.add_node(NODE, {sub_label: handshake.ACTIVE})
+
+    def finish_job():
+        time.sleep(0.05)
+        fake_kube.patch_node_labels(NODE, {sub_label: None})
+
+    t = threading.Thread(target=finish_job)
+    t.start()
+    assert handshake.await_workload_acks(
+        fake_kube, NODE, timeout_s=5, poll_interval_s=0.01
+    ) == []
+    t.join()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: drain blocks until the job checkpoints; resume is bit-exact
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _train_step(state, batch):
+    w, step = state
+    grad = jax.grad(lambda w: jnp.mean((batch @ w - 1.0) ** 2))(w)
+    return (w - 0.1 * grad, step + 1), jnp.mean((batch @ w - 1.0) ** 2)
+
+
+def _make_state():
+    return (jnp.ones((4, 4), jnp.float32), jnp.int32(0))
+
+
+def test_drain_blocks_until_job_checkpoints_then_resumes_exactly(
+    fake_kube, tmp_path
+):
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "dp-pod", NODE, labels={"app": DP_APP})
+
+    events: list[str] = []
+
+    def reactor(name, patched):
+        labels = node_labels(patched)
+        if is_paused(labels.get(DP_LABEL)):
+            events.append("component-paused")
+            fake_kube.delete_pod(NS, "dp-pod")
+
+    fake_kube.add_patch_reactor(reactor)
+
+    # The "training job": steps in its own thread, checkpointing ONLY when
+    # the drain protocol asks it to.
+    batch = jnp.eye(4, dtype=jnp.float32)
+    job = {"state": _make_state(), "ckpt_step": None}
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+    resumed = threading.Event()
+
+    def on_drain():
+        # The handshake's whole point: at checkpoint time the component
+        # drain has NOT started (pods still present, label unpaused).
+        labels = node_labels(fake_kube.get_node(NODE))
+        assert not is_paused(labels.get(DP_LABEL))
+        assert fake_kube.list_pods(NS, label_selector=f"app={DP_APP}")
+        events.append("checkpointed")
+        step = int(job["state"][1])
+        ckpt.save(step, job["state"])
+        job["ckpt_step"] = step
+
+    sub = handshake.DrainSubscriber(
+        fake_kube, NODE, "sim-train", on_drain=on_drain,
+        on_resume=lambda: resumed.set(), poll_interval_s=0.01,
+    )
+
+    # A few steps before the bounce; record the uninterrupted reference.
+    for _ in range(3):
+        job["state"], _ = _train_step(job["state"], batch)
+    ref_state = job["state"]
+    ref_continue = _train_step(ref_state, batch)[0]
+
+    sub.start()
+    try:
+        mgr = CCManager(
+            api=fake_kube,
+            backend=FakeTpuBackend(),
+            node_name=NODE,
+            operator_namespace=NS,
+            evict_components=True,
+            smoke_workload="none",
+            metrics=MetricsRegistry(),
+            eviction_timeout_s=5,
+            eviction_poll_interval_s=0.01,
+            drain_ack_timeout_s=10,
+        )
+        assert mgr.set_cc_mode(MODE_ON) is True
+        # The subscriber observes the withdrawn request and resumes; only
+        # then stop it.
+        assert resumed.wait(5)
+    finally:
+        sub.stop()
+
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels.get(CC_MODE_STATE_LABEL) == MODE_ON
+    # Protocol-triggered: the checkpoint happened, and BEFORE the pause.
+    assert job["ckpt_step"] == 3
+    assert events.index("checkpointed") < events.index("component-paused")
+    # Cleanup: request withdrawn, component restored.
+    assert handshake.DRAIN_REQUESTED_LABEL not in labels
+    assert labels.get(DP_LABEL) == "true"
+
+    # Resume from the protocol-triggered snapshot: bit-exact.
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), ref_state
+    )
+    restored = ckpt.restore(abstract)
+    ckpt.close()
+    assert int(restored[1]) == 3
+    assert jnp.array_equal(restored[0], ref_state[0])
+    resumed_next = _train_step(restored, batch)[0]
+    assert jnp.array_equal(resumed_next[0], ref_continue[0])
+    assert int(resumed_next[1]) == int(ref_continue[1])
+
+
+def test_wedged_job_cannot_veto_the_drain(fake_kube):
+    """A registered subscriber that never acks delays the drain by at most
+    the bounded ack timeout (lenient policy, SURVEY.md §8.5)."""
+    sub_label = handshake.subscriber_label("wedged")
+    fake_kube.add_node(NODE, {DP_LABEL: "true", sub_label: handshake.ACTIVE})
+    mgr = CCManager(
+        api=fake_kube,
+        backend=FakeTpuBackend(),
+        node_name=NODE,
+        operator_namespace=NS,
+        evict_components=True,
+        smoke_workload="none",
+        metrics=MetricsRegistry(),
+        eviction_timeout_s=1,
+        eviction_poll_interval_s=0.01,
+        drain_ack_timeout_s=0.2,
+    )
+    t0 = time.monotonic()
+    assert mgr.set_cc_mode(MODE_ON) is True
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5  # bounded, not a veto
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels.get(CC_MODE_STATE_LABEL) == MODE_ON
+
+
+def test_handshake_disabled_by_default(fake_kube):
+    """drain_ack_timeout_s=0 (the default): no drain-request label is ever
+    published — the reference-shaped flow is unchanged."""
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    seen = []
+    fake_kube.add_patch_reactor(
+        lambda name, patched: seen.append(dict(node_labels(patched)))
+    )
+    mgr = CCManager(
+        api=fake_kube,
+        backend=FakeTpuBackend(),
+        node_name=NODE,
+        operator_namespace=NS,
+        evict_components=True,
+        smoke_workload="none",
+        metrics=MetricsRegistry(),
+        eviction_timeout_s=1,
+        eviction_poll_interval_s=0.01,
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert not any(
+        labels.get(handshake.DRAIN_REQUESTED_LABEL) == handshake.DRAIN_REQUESTED
+        for labels in seen
+    )

@@ -86,6 +86,7 @@ class CCManager:
         eviction_timeout_s: float | None = None,
         eviction_poll_interval_s: float = evict.DEFAULT_POLL_INTERVAL_S,
         strict_eviction: bool | None = None,
+        drain_ack_timeout_s: float | None = None,
         ready_timeout_s: float = DEFAULT_READY_TIMEOUT_S,
         slice_barrier_timeout_s: float | None = None,
         slice_barrier_poll_interval_s: float = 1.0,
@@ -127,6 +128,14 @@ class CCManager:
             )
         self.eviction_timeout_s = eviction_timeout_s
         self.eviction_poll_interval_s = eviction_poll_interval_s
+        # Workload drain handshake (drain/handshake.py): how long registered
+        # training jobs get to checkpoint+ack before components are paused.
+        # 0 disables (the reference has no workload protocol at all).
+        if drain_ack_timeout_s is None:
+            drain_ack_timeout_s = float(
+                os.environ.get("CC_DRAIN_ACK_TIMEOUT_S", "0")
+            )
+        self.drain_ack_timeout_s = drain_ack_timeout_s
         # The reference proceeds to the hardware phase on a drain timeout
         # (gpu_operator_eviction.py:205-207) — risky but deliberate; strict
         # mode (CC_STRICT_EVICTION=1) fails the reconcile instead
@@ -414,6 +423,7 @@ class CCManager:
                     timeout_s=self.eviction_timeout_s,
                     poll_interval_s=self.eviction_poll_interval_s,
                     proceed_on_timeout=not self.strict_eviction,
+                    workload_ack_timeout_s=self.drain_ack_timeout_s,
                 )
         except evict.EvictionTimeout as e:
             log.error("strict eviction failed: %s — not touching hardware", e)
@@ -527,6 +537,12 @@ class CCManager:
             patch = {SLICE_ID_LABEL: label_safe(topo.slice_id)}
             patch.update(multislice.quote_label_patch(quote))
             if topo.is_multi_host:
+                # Best-effort, like the rest of this patch (clear_staged
+                # always was — slicecoord.py:197 swallows KubeApiError). A
+                # clear lost to an outage is retried by barrier.complete()
+                # on the apply path and cleared at the next barrier entry
+                # otherwise; followers never act on a staged marker without
+                # re-verifying full staging.
                 patch[slicecoord.SLICE_STAGED_LABEL] = None
             self.api.patch_node_labels(self.node_name, patch)
             if quote is not None:

@@ -56,12 +56,29 @@ def evict_components(
     timeout_s: float = DEFAULT_EVICTION_TIMEOUT_S,
     poll_interval_s: float = DEFAULT_POLL_INTERVAL_S,
     proceed_on_timeout: bool = True,
+    workload_ack_timeout_s: float = 0.0,
 ) -> dict[str, str]:
     """Pause every drainable component and wait for its pods to leave the node.
+
+    When ``workload_ack_timeout_s`` > 0, the workload drain handshake runs
+    FIRST: the drain request label goes up and registered training jobs get
+    that long to checkpoint and ack before any component is paused
+    (drain/handshake.py). The wait is bounded and lenient — a wedged job
+    cannot veto a security transition — matching the reference's
+    lenient-drain policy (gpu_operator_eviction.py:205-207).
 
     Returns the original label values (pass them to ``readmit_components``).
     Reference: evict_gpu_operator_components (gpu_operator_eviction.py:131-214).
     """
+    if workload_ack_timeout_s > 0:
+        from tpu_cc_manager.drain import handshake
+
+        if handshake.request_drain(api, node_name):
+            handshake.await_workload_acks(
+                api, node_name,
+                timeout_s=workload_ack_timeout_s,
+                poll_interval_s=poll_interval_s,
+            )
     original = fetch_component_labels(api, node_name)
     patch = {}
     for key, value in original.items():
@@ -120,8 +137,17 @@ def readmit_components(api: KubeApi, node_name: str, original: dict[str, str]) -
     unpauses labels that are still in a paused state, so a concurrent
     user edit (e.g. disabling a component mid-drain) wins.
     """
-    current = fetch_component_labels(api, node_name)
-    patch = {}
+    from tpu_cc_manager.drain import handshake
+
+    labels = node_labels(api.get_node(node_name))
+    current = {k: labels[k] for k in DRAIN_COMPONENT_LABELS if k in labels}
+    patch: dict[str, str | None] = {}
+    # Withdraw the drain request in the same patch, so subscribers watching
+    # the label may resume as soon as components return — but only when one
+    # was actually published (the handshake is off by default, and the
+    # common path must not pay an extra write per reconcile).
+    if handshake.DRAIN_REQUESTED_LABEL in labels:
+        patch[handshake.DRAIN_REQUESTED_LABEL] = None
     for key in DRAIN_COMPONENT_LABELS:
         restored = unpause_value(current.get(key))
         if restored is not None:
@@ -135,8 +161,10 @@ def readmit_components(api: KubeApi, node_name: str, original: dict[str, str]) -
                 if remembered is not None and not is_paused(remembered)
                 else restored
             )
-    if patch:
-        log.info("unpausing components on %s: %s", node_name, sorted(patch))
-        api.patch_node_labels(node_name, patch)
+    components = sorted(k for k in patch if k != handshake.DRAIN_REQUESTED_LABEL)
+    if components:
+        log.info("unpausing components on %s: %s", node_name, components)
     else:
         log.info("no components to unpause on %s", node_name)
+    if patch:
+        api.patch_node_labels(node_name, patch)

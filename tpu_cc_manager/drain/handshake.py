@@ -1,0 +1,219 @@
+"""Workload drain handshake: let live training jobs checkpoint before the
+node's TPU runtime is bounced.
+
+No reference counterpart (the reference's drain only pauses operator
+components and waits for THEIR pods, gpu_operator_eviction.py:185-207; the
+workloads a reset disrupts are invisible to its protocol). On TPUs the gap
+bites harder: a CC transition restarts the runtime under every pod on the
+host, so a live training job loses unsaved state unless it snapshots first
+(BASELINE.json configs[3] — rolling reconfig under live ResNet-50 training).
+
+Protocol, carried on node labels like everything else in this system:
+
+1. A training job registers a subscriber label
+   ``drain-subscriber.tpu-cc.gke.io/<job> = active`` on its node
+   (:class:`DrainSubscriber`, typically from a sidecar thread).
+2. The manager, before pausing components, sets
+   ``cloud.google.com/tpu-cc.drain = requested`` and resets every
+   subscriber label to ``active`` in the same patch (stale acks from a
+   previous cycle can never satisfy this cycle's wait).
+3. The subscriber sees the request, runs its ``on_drain`` callback
+   (checkpoint via :class:`~tpu_cc_manager.parallel.checkpoint
+   .TrainCheckpointer`), then flips its label to ``acked``.
+4. The manager waits — bounded, CC_DRAIN_ACK_TIMEOUT_S — for every
+   subscriber to ack, then proceeds with the normal component drain.
+   Timeout proceeds with a warning (the reference's lenient-drain policy,
+   SURVEY.md §8.5): a wedged job must not be able to veto a security
+   transition forever.
+5. After re-admission the drain request label is cleared; subscribers see
+   that and may resume (restore + continue, or simply let the pod restart
+   and restore on boot).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, node_labels
+from tpu_cc_manager.labels import label_safe
+
+log = logging.getLogger(__name__)
+
+DRAIN_REQUESTED_LABEL = "cloud.google.com/tpu-cc.drain"
+DRAIN_REQUESTED = "requested"
+SUBSCRIBER_PREFIX = "drain-subscriber.tpu-cc.gke.io/"
+ACTIVE = "active"
+ACKED = "acked"
+
+DEFAULT_ACK_POLL_INTERVAL_S = 2.0
+
+
+def subscriber_label(job_name: str) -> str:
+    return SUBSCRIBER_PREFIX + label_safe(job_name)
+
+
+def subscriber_labels_of(labels: dict[str, str]) -> dict[str, str]:
+    """The subscriber entries among a node's labels."""
+    return {
+        k: v for k, v in labels.items() if k.startswith(SUBSCRIBER_PREFIX)
+    }
+
+
+# ---------------------------------------------------------------------------
+# Manager side
+# ---------------------------------------------------------------------------
+
+
+def request_drain(api: KubeApi, node_name: str) -> list[str]:
+    """Publish the drain request and reset every subscriber to ``active``.
+
+    Returns the subscriber label keys that must ack this cycle. One
+    merge-patch: no window where the request is visible with a stale ack.
+    """
+    subscribers = subscriber_labels_of(node_labels(api.get_node(node_name)))
+    patch: dict[str, str] = {DRAIN_REQUESTED_LABEL: DRAIN_REQUESTED}
+    patch.update({k: ACTIVE for k in subscribers})
+    api.patch_node_labels(node_name, patch)
+    if subscribers:
+        log.info(
+            "drain requested on %s; awaiting ack from %s",
+            node_name, sorted(subscribers),
+        )
+    return sorted(subscribers)
+
+
+def await_workload_acks(
+    api: KubeApi,
+    node_name: str,
+    timeout_s: float,
+    poll_interval_s: float = DEFAULT_ACK_POLL_INTERVAL_S,
+) -> list[str]:
+    """Wait (bounded) until every subscriber label reads ``acked``.
+
+    Returns the list of laggards (empty on full ack). Subscribers that
+    unregister mid-wait (their pod finished) count as done."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        labels = node_labels(api.get_node(node_name))
+        laggards = sorted(
+            k for k, v in subscriber_labels_of(labels).items() if v != ACKED
+        )
+        if not laggards:
+            return []
+        if time.monotonic() >= deadline:
+            log.warning(
+                "drain ack timeout on %s: %s did not checkpoint in %.0fs — "
+                "proceeding anyway", node_name, laggards, timeout_s,
+            )
+            return laggards
+        time.sleep(poll_interval_s)
+
+
+def clear_drain_request(api: KubeApi, node_name: str) -> None:
+    """Withdraw the drain request (after re-admission). Best-effort."""
+    try:
+        api.patch_node_labels(node_name, {DRAIN_REQUESTED_LABEL: None})
+    except KubeApiError as e:
+        log.warning("could not clear drain request on %s: %s", node_name, e)
+
+
+# ---------------------------------------------------------------------------
+# Workload side
+# ---------------------------------------------------------------------------
+
+
+class DrainSubscriber:
+    """The training job's side of the handshake.
+
+    Run :meth:`start` from the job process (a daemon thread polls the node);
+    ``on_drain`` is invoked — once per drain cycle — when the manager
+    requests a drain, and must return only after the job's state is durably
+    checkpointed. ``on_resume`` (optional) fires when the request clears.
+
+        sub = DrainSubscriber(api, node, "llama-train", on_drain=ckpt.save_now)
+        sub.start()
+        ...
+        sub.stop()      # unregisters
+    """
+
+    def __init__(
+        self,
+        api: KubeApi,
+        node_name: str,
+        job_name: str,
+        on_drain: Callable[[], None],
+        on_resume: Callable[[], None] | None = None,
+        poll_interval_s: float = DEFAULT_ACK_POLL_INTERVAL_S,
+    ) -> None:
+        self.api = api
+        self.node_name = node_name
+        self.label = subscriber_label(job_name)
+        self.on_drain = on_drain
+        self.on_resume = on_resume
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._acked_this_cycle = False
+
+    def register(self) -> None:
+        self.api.patch_node_labels(self.node_name, {self.label: ACTIVE})
+
+    def unregister(self) -> None:
+        try:
+            self.api.patch_node_labels(self.node_name, {self.label: None})
+        except KubeApiError as e:
+            log.warning("could not unregister %s: %s", self.label, e)
+
+    def check_once(self) -> bool:
+        """One poll step; returns True if this cycle is acked.
+
+        The manager resets our label to ``active`` when it opens a cycle,
+        so ``_acked_this_cycle`` tracks OUR work while the label tracks the
+        cycle: a second request after a crash-restart of the manager re-runs
+        the callback (checkpointing twice is safe; not checkpointing is not).
+        """
+        labels = node_labels(self.api.get_node(self.node_name))
+        requested = labels.get(DRAIN_REQUESTED_LABEL) == DRAIN_REQUESTED
+        ours = labels.get(self.label)
+        if not requested:
+            if self._acked_this_cycle:
+                self._acked_this_cycle = False
+                if self.on_resume is not None:
+                    self.on_resume()
+            return False
+        if ours == ACKED and self._acked_this_cycle:
+            return True
+        # Drain requested and we have not acked this cycle: checkpoint,
+        # then ack. A callback failure leaves us un-acked — the manager's
+        # bounded wait will proceed without us and the failure is loud here.
+        self.on_drain()
+        self.api.patch_node_labels(self.node_name, {self.label: ACKED})
+        self._acked_this_cycle = True
+        log.info("drain ack published for %s on %s", self.label, self.node_name)
+        return True
+
+    def run(self) -> None:
+        self.register()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self.check_once()
+                except KubeApiError as e:
+                    log.warning("drain subscriber poll failed: %s", e)
+                self._stop.wait(self.poll_interval_s)
+        finally:
+            self.unregister()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True, name=f"drain-sub-{self.label}"
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
