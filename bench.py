@@ -254,12 +254,25 @@ def main() -> int:
     tpu_usable = _tpu_preflight()
 
     control = run_scenario(tpu_usable)
-    realistic = run_scenario(
-        tpu_usable,
-        reset_latency_s=30.0,
-        boot_latency_s=20.0,
-        pod_delete_delay_s=3.0,
-    )
+    # The realistic scenario is the headline; on this rig the smoke's chip
+    # is reached through a shared remote tunnel whose dispatch latency is
+    # erratic (observed 12–75 s wall for identical work at identical
+    # chip-side throughput — a rig artifact production TPU VMs, with local
+    # libtpu, don't have). Median of N runs absorbs that noise honestly:
+    # every raw value is reported alongside.
+    runs = max(1, int(os.environ.get("CC_BENCH_REALISTIC_RUNS", "3")))
+    realistic_runs = [
+        run_scenario(
+            tpu_usable,
+            reset_latency_s=30.0,
+            boot_latency_s=20.0,
+            pod_delete_delay_s=3.0,
+        )
+        for _ in range(runs)
+    ]
+    realistic = sorted(realistic_runs, key=lambda r: r["seconds"])[
+        (len(realistic_runs) - 1) // 2
+    ]
     multihost = run_multihost_scenario()
 
     dt = realistic["seconds"]
@@ -273,7 +286,7 @@ def main() -> int:
         "value": dt,
         "unit": "s",
         "vs_baseline": round(90.0 / dt, 2) if dt > 0 else 0.0,
-        "ok": bool(control["ok"] and realistic["ok"]),
+        "ok": bool(control["ok"] and all(r["ok"] for r in realistic_runs)),
         "smoke_backend": control["backend"],
         "chip_generation": smoke.get("generation"),
         "smoke_tflops": smoke.get("tflops"),
@@ -286,11 +299,13 @@ def main() -> int:
             "seconds": control["seconds"],
             "phases": control["phases"],
         },
-        # Kept for artifact-shape continuity with BENCH_r01–r03.
+        # Kept for artifact-shape continuity with BENCH_r01–r03; the
+        # headline is the median run, raw values disclose the spread.
         "realistic": {
             "seconds": realistic["seconds"],
             "under_target": realistic["seconds"] < 90.0,
             "phases": realistic["phases"],
+            "runs_seconds": [r["seconds"] for r in realistic_runs],
         },
         # Fabric atomicity evidence: both hosts of a 2-host slice through
         # the cross-host commit barrier (ccmanager/slicecoord.py).

@@ -275,6 +275,21 @@ class Handler(BaseHTTPRequestHandler):
         u = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(length) or b"{}")
+        if u.path == "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews":
+            # SSAR for `tpu-cc-ctl rbac-check`: the mock allows exactly the
+            # verbs the DaemonSet ClusterRole grants
+            # (deployments/manifests/daemonset.yaml), so the check's
+            # pass/fail logic is exercised for real over HTTP.
+            attrs = ((body.get("spec") or {}).get("resourceAttributes")) or {}
+            allowed = (attrs.get("verb"), attrs.get("resource")) in {
+                ("get", "nodes"), ("list", "nodes"), ("watch", "nodes"),
+                ("patch", "nodes"), ("list", "pods"),
+            }
+            return self._json({
+                "kind": "SelfSubjectAccessReview",
+                "apiVersion": "authorization.k8s.io/v1",
+                "status": {"allowed": allowed},
+            }, 201)
         if u.path == "/_ctl/set-label":
             with lock:
                 node = nodes.get(body.get("node", DEFAULT_NODE))

@@ -106,6 +106,25 @@ class _MockApiserver:
                     return self._json({"kind": "PodList", "items": items})
                 return self._json({"kind": "Status", "code": 404}, 404)
 
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                self._record(body)
+                u = urlparse(self.path)
+                if u.path == (
+                    "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews"
+                ):
+                    attrs = (
+                        (body.get("spec") or {}).get("resourceAttributes")
+                    ) or {}
+                    allowed = (attrs.get("verb"), attrs.get("resource")) in {
+                        ("get", "nodes"), ("list", "nodes"),
+                        ("watch", "nodes"), ("patch", "nodes"),
+                        ("list", "pods"),
+                    }
+                    return self._json({"status": {"allowed": allowed}}, 201)
+                return self._json({"kind": "Status", "code": 404}, 404)
+
             def do_PATCH(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -258,6 +277,37 @@ def test_client_errors_are_not_retried():
     with pytest.raises(KubeApiError):
         client.get_node(NODE)
     assert calls["n"] == 1  # a 404 will not improve with repetition
+
+
+def test_self_subject_access_review(apiserver, client):
+    """SSAR over real HTTP: allowed verbs come back True, others False,
+    and the request carries the documented resourceAttributes shape."""
+    assert client.self_subject_access_review("get", "nodes") is True
+    assert client.self_subject_access_review("patch", "nodes") is True
+    assert client.self_subject_access_review("delete", "nodes") is False
+    assert client.self_subject_access_review(
+        "list", "pods", namespace="tpu-operator"
+    ) is True
+    post = [r for r in apiserver.requests if r["method"] == "POST"][-1]
+    attrs = post["body"]["spec"]["resourceAttributes"]
+    assert attrs == {
+        "verb": "list", "resource": "pods", "namespace": "tpu-operator"
+    }
+
+
+def test_rbac_check_command(apiserver, tmp_path):
+    """`tpu-cc-ctl rbac-check` end-to-end against the HTTP mock."""
+    from tpu_cc_manager import ctl
+
+    kubeconfig = tmp_path / "kc"
+    kubeconfig.write_text(json.dumps({
+        "clusters": [{"name": "m", "cluster": {"server": apiserver.url}}],
+        "users": [{"name": "u", "user": {"token": "sekret"}}],
+        "contexts": [{"name": "c",
+                      "context": {"cluster": "m", "user": "u"}}],
+        "current-context": "c",
+    }))
+    assert ctl.main(["--kubeconfig", str(kubeconfig), "rbac-check"]) == 0
 
 
 def test_non_idempotent_verbs_are_never_retried():

@@ -262,3 +262,65 @@ def test_summary_reports_failed_rollback(fake_kube):
     ).rollout("on")
     assert result.ok is False
     assert result.summary()["rolled_back"] == {"node/node-0": "failed"}
+
+
+def test_await_polls_with_one_listing_not_per_node_gets(fake_kube):
+    """Pool-scale polling (VERDICT r3 weak #7): awaiting a group costs one
+    selector listing per poll, not one GET per node per poll."""
+    add_pool(fake_kube, 4)
+    agent_simulator(fake_kube)
+    gets = []
+    real_get = fake_kube.get_node
+    fake_kube.get_node = lambda name: (gets.append(name), real_get(name))[1]
+    result = make_roller(fake_kube, max_unavailable=4).rollout("on")
+    assert result.ok is True
+    assert gets == []  # every state read rode a list_nodes call
+
+
+def test_interrupted_rollout_resumes_idempotently(fake_kube):
+    """A re-run after a halt skips already-converged groups: no label
+    rewrite, no second bounce (VERDICT r3 item 7)."""
+    add_pool(fake_kube, 2)
+    fails = {"node-1"}
+    converge_counts = {"node-0": 0, "node-1": 0}
+    in_flight = set()
+
+    def reactor(name, node):
+        # Like the real agent: reconcile whenever desired != state (the
+        # failed-reconcile backoff retry), one reconcile in flight at a
+        # time.
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired and name not in in_flight:
+            in_flight.add(name)
+            converge_counts[name] += 1
+
+            def fire():
+                target = STATE_FAILED if name in fails else desired
+                in_flight.discard(name)
+                fake_kube.set_node_label(name, CC_MODE_STATE_LABEL, target)
+
+            t = threading.Timer(0.05, fire)
+            t.daemon = True
+            t.start()
+
+    fake_kube.add_patch_reactor(reactor)
+
+    first = make_roller(fake_kube).rollout("on")
+    assert first.ok is False  # halted on node-1
+    assert [g.ok for g in first.groups] == [True, False]
+
+    # Operator fixes node-1; the re-run must not re-bounce node-0.
+    fails.clear()
+    second = make_roller(fake_kube).rollout("on")
+    assert second.ok is True
+    by_group = {g.group: g for g in second.groups}
+    assert by_group["node/node-0"].skipped is True
+    assert by_group["node/node-0"].seconds == 0.0
+    assert by_group["node/node-1"].skipped is False
+    # The decisive property: node-0 was reconciled exactly once across both
+    # rollouts — the resume never re-bounced it. (node-1's count depends on
+    # its retry cadence while failed; only its convergence matters.)
+    assert converge_counts["node-0"] == 1
+    assert converge_counts["node-1"] >= 2
+    assert second.summary()["skipped_groups"] == 1

@@ -44,6 +44,10 @@ class GroupResult:
     ok: bool
     seconds: float
     states: dict[str, str]
+    # Already at the target (desired AND reported state) when the rollout
+    # started — e.g. converged by an interrupted earlier rollout. Skipped
+    # idempotently: no label rewrite, no bounce, no await.
+    skipped: bool = False
 
 
 @dataclasses.dataclass
@@ -70,6 +74,7 @@ class RolloutResult:
             "mode": self.mode,
             "ok": self.ok,
             "groups": len(self.groups),
+            "skipped_groups": sum(1 for g in self.groups if g.skipped) or None,
             "nodes": sum(len(g.nodes) for g in self.groups),
             "total_seconds": round(self.seconds, 2),
             "max_group_seconds": round(
@@ -95,12 +100,16 @@ class RolloutResult:
         }
 
 
-def plan_groups(api: KubeApi, selector: str) -> list[tuple[str, tuple[str, ...]]]:
+def plan_groups(
+    api: KubeApi, selector: str, nodes: list[dict] | None = None
+) -> list[tuple[str, tuple[str, ...]]]:
     """Group matching nodes by slice id; single-host nodes group alone.
 
-    Groups are ordered by name for deterministic rollouts.
+    Groups are ordered by name for deterministic rollouts. ``nodes`` lets a
+    caller that already holds the listing avoid a second round trip.
     """
-    nodes = api.list_nodes(selector)
+    if nodes is None:
+        nodes = api.list_nodes(selector)
     groups: dict[str, list[str]] = {}
     for node in nodes:
         name = node["metadata"]["name"]
@@ -144,7 +153,8 @@ class RollingReconfigurator:
             raise ValueError(
                 f"invalid CC mode {mode!r} (valid: {VALID_MODES})"
             )
-        groups = plan_groups(self.api, self.selector)
+        listing = self.api.list_nodes(self.selector)
+        groups = plan_groups(self.api, self.selector, nodes=listing)
         log.info(
             "rolling %s over %d group(s) (%d node(s)), max_unavailable=%d",
             mode, len(groups),
@@ -152,6 +162,28 @@ class RollingReconfigurator:
         )
         results: list[GroupResult] = []
         window_seconds: list[float] = []
+        # Idempotent resume (an interrupted rollout re-run must not re-bounce
+        # what already converged): groups whose every node already carries
+        # BOTH desired=mode and state=mode are recorded as skipped — no
+        # label rewrite, no disruption, no await.
+        labels_by_name = {
+            n["metadata"]["name"]: node_labels(n) for n in listing
+        }
+        todo: list[tuple[str, tuple[str, ...]]] = []
+        for gid, names in groups:
+            if all(
+                labels_by_name.get(n, {}).get(CC_MODE_LABEL) == mode
+                and labels_by_name.get(n, {}).get(CC_MODE_STATE_LABEL) == mode
+                for n in names
+            ):
+                log.info("group %s already at %s; skipping", gid, mode)
+                results.append(GroupResult(
+                    group=gid, nodes=names, ok=True, seconds=0.0,
+                    states={n: mode for n in names}, skipped=True,
+                ))
+            else:
+                todo.append((gid, names))
+        groups = todo
         # Pre-rollout desired mode per node, for rollback_on_failure.
         prior: dict[str, str | None] = {}
         ok = True
@@ -247,19 +279,52 @@ class RollingReconfigurator:
             log.info("setting %s=%s on %s", CC_MODE_LABEL, mode, name)
             self.api.patch_node_labels(name, {CC_MODE_LABEL: mode})
 
+    def _pending_states(self, names: list[str]) -> dict[str, str | None]:
+        """Current state-label values for ``names`` from ONE selector
+        listing (per-node GETs are O(pool) round trips per poll; the
+        listing is a single one whatever the pool size). A node missing
+        from the listing — its selector label edited mid-rollout — falls
+        back to a direct GET rather than silently reading as pending."""
+        listed: dict[str, str | None] = {
+            n["metadata"]["name"]: node_labels(n).get(CC_MODE_STATE_LABEL)
+            for n in self.api.list_nodes(self.selector)
+        }
+        return {
+            name: (
+                listed[name]
+                if name in listed
+                else node_labels(self.api.get_node(name)).get(
+                    CC_MODE_STATE_LABEL
+                )
+            )
+            for name in names
+        }
+
     def _await_group(
         self, gid: str, names: tuple[str, ...], mode: str, started: float
     ) -> GroupResult:
         deadline = started + self.node_timeout_s
         pending = set(names)
         states: dict[str, str] = {}
+        # A 'failed' state that predates this await is STALE — a resumed
+        # rollout onto a previously-failed node would otherwise halt
+        # instantly on the leftover label instead of giving the agent its
+        # retry. Such nodes stay pending until the state changes (a node
+        # that leaves 'failed' and returns to it failed freshly); an agent
+        # that never reacts is caught by the normal timeout.
+        stale_failed = {
+            name
+            for name, state in self._pending_states(sorted(pending)).items()
+            if state == STATE_FAILED
+        }
         while pending and time.monotonic() < deadline:
-            for name in sorted(pending):
-                state = node_labels(self.api.get_node(name)).get(CC_MODE_STATE_LABEL)
+            for name, state in self._pending_states(sorted(pending)).items():
+                if state != STATE_FAILED:
+                    stale_failed.discard(name)
                 if state == mode:
                     states[name] = state
                     pending.discard(name)
-                elif state == STATE_FAILED:
+                elif state == STATE_FAILED and name not in stale_failed:
                     states[name] = state
                     pending.discard(name)
             if pending:

@@ -65,6 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("status", help="per-node CC state table")
     s.add_argument("--selector", required=True)
+
+    rb = sub.add_parser(
+        "rbac-check",
+        help="prove this identity holds every verb the agent needs "
+        "(SelfSubjectAccessReview)",
+    )
+    rb.add_argument(
+        "--namespace", default="tpu-operator",
+        help="operator namespace for the pod-list check",
+    )
     return p
 
 
@@ -101,6 +111,7 @@ def cmd_status(api, args) -> int:
         SLICE_COMMIT_LABEL,
         SLICE_STAGED_LABEL,
     )
+    from tpu_cc_manager.drain import handshake
     from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
 
     rows = [
@@ -118,6 +129,12 @@ def cmd_status(api, args) -> int:
             notes.append(f"barrier:commit={labels[SLICE_COMMIT_LABEL]}")
         if labels.get(CC_FAILED_REASON_LABEL):
             notes.append(f"reason={labels[CC_FAILED_REASON_LABEL]}")
+        if labels.get(handshake.DRAIN_REQUESTED_LABEL):
+            subs = handshake.subscriber_labels_of(labels)
+            pending = sum(1 for v in subs.values() if v != handshake.ACKED)
+            notes.append(
+                f"drain:requested({len(subs) - pending}/{len(subs)} acked)"
+            )
         rows.append(
             f"{node['metadata']['name']:<24} "
             f"{labels.get(SLICE_ID_LABEL, '-'):<20} "
@@ -128,6 +145,28 @@ def cmd_status(api, args) -> int:
         )
     print("\n".join(rows))
     return 0
+
+
+def cmd_rbac_check(api, args) -> int:
+    """Check every verb the agent uses (kubeclient/rest.py; the DaemonSet
+    ClusterRole in deployments/manifests/daemonset.yaml must grant exactly
+    these — including list nodes, which the slice barrier's peer discovery
+    and the rolling orchestrator depend on)."""
+    checks = [
+        ("get", "nodes", None),
+        ("list", "nodes", None),
+        ("patch", "nodes", None),
+        ("watch", "nodes", None),
+        ("list", "pods", args.namespace),
+    ]
+    ok = True
+    for verb, resource, ns in checks:
+        allowed = api.self_subject_access_review(verb, resource, namespace=ns)
+        ok = ok and allowed
+        scope = f" (ns={ns})" if ns else ""
+        print(f"{verb:<6} {resource}{scope}: {'allowed' if allowed else 'DENIED'}")
+    print("OK: RBAC sufficient" if ok else "FAIL: missing permissions")
+    return 0 if ok else 1
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -141,9 +180,12 @@ def main(argv: list[str] | None = None) -> int:
     from tpu_cc_manager.kubeclient.api import KubeApiError
 
     try:
-        return {"rollout": cmd_rollout, "attest": cmd_attest, "status": cmd_status}[
-            args.command
-        ](api, args)
+        return {
+            "rollout": cmd_rollout,
+            "attest": cmd_attest,
+            "status": cmd_status,
+            "rbac-check": cmd_rbac_check,
+        }[args.command](api, args)
     except ValueError as e:
         log.error("usage error: %s", e)
         return 2

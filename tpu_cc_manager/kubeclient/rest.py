@@ -237,6 +237,30 @@ class RestKube(KubeApi):
             "GET", f"/api/v1/namespaces/{namespace}/pods", query
         ).get("items", [])
 
+    def self_subject_access_review(
+        self, verb: str, resource: str, namespace: str | None = None
+    ) -> bool:
+        """Ask the apiserver whether THIS identity may perform verb on
+        resource (SelfSubjectAccessReview). Used by ``tpu-cc-ctl
+        rbac-check`` to prove the DaemonSet RBAC covers every verb the
+        agent needs before a rollout, instead of discovering a 403 mid-
+        drain. POST, so never retried (the idempotent-verb gate in
+        _request_json); SSAR is cheap and the caller just re-runs."""
+        attrs: dict = {"verb": verb, "resource": resource}
+        if namespace:
+            attrs["namespace"] = namespace
+        resp = self._request_json(
+            "POST",
+            "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+            body={
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SelfSubjectAccessReview",
+                "spec": {"resourceAttributes": attrs},
+            },
+            content_type="application/json",
+        )
+        return bool(resp.get("status", {}).get("allowed", False))
+
     def watch_nodes(self, name: str, resource_version: str | None = None,
                     timeout_seconds: int = 300) -> Iterator[WatchEvent]:
         query = {
