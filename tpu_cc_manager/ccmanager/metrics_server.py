@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import http.server
 import logging
+import os
 import threading
 
 from tpu_cc_manager.utils.metrics import MetricsRegistry
@@ -17,7 +18,18 @@ from tpu_cc_manager.utils.metrics import MetricsRegistry
 log = logging.getLogger(__name__)
 
 
-def start_metrics_server(port: int, registry: MetricsRegistry) -> http.server.ThreadingHTTPServer:
+def start_metrics_server(
+    port: int, registry: MetricsRegistry, bind: str | None = None
+) -> http.server.ThreadingHTTPServer:
+    """Serve /metrics and /healthz on ``bind``:``port``.
+
+    The endpoint is unauthenticated (Prometheus-style), so the default
+    bind is the pod IP's all-interfaces only when explicitly requested:
+    CC_METRICS_BIND defaults to 0.0.0.0 inside a pod (kubelet probes and
+    the scraper reach the pod IP), but operators running the agent on a
+    host network can restrict it (e.g. CC_METRICS_BIND=127.0.0.1)."""
+    if bind is None:
+        bind = os.environ.get("CC_METRICS_BIND", "0.0.0.0")
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - http.server API
             if self.path.rstrip("/") in ("", "/metrics"):
@@ -39,8 +51,10 @@ def start_metrics_server(port: int, registry: MetricsRegistry) -> http.server.Th
         def log_message(self, fmt, *fmtargs):  # quiet access logs
             log.debug("metrics http: " + fmt, *fmtargs)
 
-    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    server = http.server.ThreadingHTTPServer((bind, port), Handler)
     thread = threading.Thread(target=server.serve_forever, name="metrics", daemon=True)
     thread.start()
-    log.info("metrics server listening on :%d", port)
+    # server_address, not the requested port: port=0 binds an ephemeral
+    # one and the log is how it's discovered.
+    log.info("metrics server listening on %s:%d", bind, server.server_address[1])
     return server

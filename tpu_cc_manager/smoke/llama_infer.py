@@ -19,7 +19,11 @@ from __future__ import annotations
 
 import time
 
-from tpu_cc_manager.utils.tpu_info import generation_for
+from tpu_cc_manager.utils.tpu_info import (
+    generation_for,
+    peak_flops_per_chip,
+    peak_hbm_bytes_per_chip,
+)
 
 
 def _pick_config(size: str | None):
@@ -250,12 +254,28 @@ def run(
         dt = per_step * decode_len if timing_valid else None
 
     tokens_per_sec = batch * decode_len / dt if timing_valid else None
+
+    # Utilization accounting. Decode FLOPs/token ≈ 2·params (each weight
+    # participates in one MAC per token); MFU on decode is structurally low
+    # because the workload is BANDWIDTH-bound — every bf16 weight is read
+    # once per step whatever the batch — so the honest utilization metric
+    # is HBM bandwidth: bytes/step ≈ 2·params (bf16), vs the public peak
+    # (utils/tpu_info.py). Both ride along; only on-TPU numbers are
+    # meaningful, so CPU runs report None.
+    backend = jax.default_backend()
+    generation = generation_for(backend)
+    mfu = hbm_util = None
+    if timing_valid and generation is not None:
+        flops_per_sec = 2.0 * cfg.param_count() * tokens_per_sec
+        mfu = flops_per_sec / (peak_flops_per_chip() * n_dev)
+        bytes_per_sec = 2.0 * cfg.param_count() * (tokens_per_sec / batch)
+        hbm_util = bytes_per_sec / (peak_hbm_bytes_per_chip() * n_dev)
     return {
         "ok": oracle_ok,
         "workload": "llama",
         "model": size,
-        "backend": jax.default_backend(),
-        "generation": generation_for(jax.default_backend()),
+        "backend": backend,
+        "generation": generation,
         "devices": n_dev,
         "params": cfg.param_count(),
         "batch": batch,
@@ -263,6 +283,8 @@ def run(
         "timing_valid": bool(timing_valid),
         "tokens_per_sec": round(tokens_per_sec, 2) if timing_valid else None,
         "ms_per_token": round(1e3 * dt / decode_len, 3) if timing_valid else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "hbm_bw_util": round(hbm_util, 4) if hbm_util is not None else None,
         "oracle_ok": oracle_ok,
         "transcript_ok": transcript_ok,
         "transcript_positions": int(oracle_decode),

@@ -16,6 +16,16 @@ PEAK_BF16_TFLOPS = {
     "v6e": 918.0,
 }
 
+# Public peak HBM bandwidth GB/s per chip. Decode-style workloads are
+# bandwidth-bound (every weight is read once per token), so their honest
+# utilization metric is HBM-BW, not MFU.
+PEAK_HBM_GBPS = {
+    "v4": 1228.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+}
+
 
 def _normalize(gen: str) -> str | None:
     """Canonicalize a generation string ('v5litepod' → 'v5e', 'tpuv6lite'
@@ -58,3 +68,9 @@ def peak_flops_per_chip(default_tflops: float = 197.0) -> float:
     """Peak bf16 FLOP/s for MFU math; conservative default when unknown."""
     gen = tpu_generation()
     return PEAK_BF16_TFLOPS.get(gen, default_tflops) * 1e12
+
+
+def peak_hbm_bytes_per_chip(default_gbps: float = 819.0) -> float:
+    """Peak HBM bytes/s for bandwidth-utilization math."""
+    gen = tpu_generation()
+    return PEAK_HBM_GBPS.get(gen, default_gbps) * 1e9
