@@ -142,6 +142,9 @@ def main() -> int:
                 "throughput_field": field,
                 "throughput": best.get(field),
                 "mfu": best.get("mfu"),
+                # Bandwidth-bound workloads (llama decode) report their
+                # honest utilization here; None elsewhere.
+                "hbm_bw_util": best.get("hbm_bw_util"),
                 "backend": best.get("backend"),
                 "generation": best.get("generation"),
                 "reps": max(1, args.reps),

@@ -23,11 +23,11 @@ def start_metrics_server(
 ) -> http.server.ThreadingHTTPServer:
     """Serve /metrics and /healthz on ``bind``:``port``.
 
-    The endpoint is unauthenticated (Prometheus-style), so the default
-    bind is the pod IP's all-interfaces only when explicitly requested:
-    CC_METRICS_BIND defaults to 0.0.0.0 inside a pod (kubelet probes and
-    the scraper reach the pod IP), but operators running the agent on a
-    host network can restrict it (e.g. CC_METRICS_BIND=127.0.0.1)."""
+    The endpoint is unauthenticated (Prometheus-style). The default bind
+    IS all-interfaces (0.0.0.0) — inside a pod that is the pod IP, which
+    kubelet probes and the scraper must reach. Operators running the
+    agent on a host network should restrict it via CC_METRICS_BIND
+    (e.g. 127.0.0.1) or the ``bind`` argument."""
     if bind is None:
         bind = os.environ.get("CC_METRICS_BIND", "0.0.0.0")
     class Handler(http.server.BaseHTTPRequestHandler):

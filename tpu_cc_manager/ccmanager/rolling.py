@@ -306,19 +306,20 @@ class RollingReconfigurator:
         deadline = started + self.node_timeout_s
         pending = set(names)
         states: dict[str, str] = {}
-        # A 'failed' state that predates this await is STALE — a resumed
-        # rollout onto a previously-failed node would otherwise halt
-        # instantly on the leftover label instead of giving the agent its
-        # retry. Such nodes stay pending until the state changes (a node
-        # that leaves 'failed' and returns to it failed freshly); an agent
-        # that never reacts is caught by the normal timeout.
-        stale_failed = {
-            name
-            for name, state in self._pending_states(sorted(pending)).items()
-            if state == STATE_FAILED
-        }
+        # A 'failed' state already present at the FIRST poll is STALE — a
+        # resumed rollout onto a previously-failed node would otherwise
+        # halt instantly on the leftover label instead of giving the agent
+        # its retry. Such nodes stay pending until the state changes (a
+        # node that leaves 'failed' and returns to it failed freshly); an
+        # agent that never reacts is caught by the normal timeout.
+        stale_failed: set[str] | None = None
         while pending and time.monotonic() < deadline:
-            for name, state in self._pending_states(sorted(pending)).items():
+            polled = self._pending_states(sorted(pending))
+            if stale_failed is None:
+                stale_failed = {
+                    n for n, s in polled.items() if s == STATE_FAILED
+                }
+            for name, state in polled.items():
                 if state != STATE_FAILED:
                     stale_failed.discard(name)
                 if state == mode:

@@ -115,6 +115,13 @@ TSM_ROOT_ENV = "CC_TSM_ROOT"
 HOST_ROOT_ENV = "CC_HOST_ROOT"
 
 
+def _host_path(path: str) -> str:
+    """Prefix a host path with CC_HOST_ROOT when running containerized
+    (identity otherwise) — the one place the host/container path mapping
+    for file access lives (command execution maps via host_wrap)."""
+    return os.environ.get(HOST_ROOT_ENV, "") + path
+
+
 def host_wrap(cmd: list[str], host_root: str | None = None) -> list[str]:
     """Wrap a command to execute inside the host rootfs when CC_HOST_ROOT
     (or ``host_root``) is set; identity otherwise. The wrapper chroots and
@@ -201,10 +208,7 @@ class TpuVmBackend(TpuCcBackend):
         if tsm_root is None:
             # Like the measured files, the host's configfs is only visible
             # under CC_HOST_ROOT when running containerized.
-            tsm_root = (
-                os.environ.get(HOST_ROOT_ENV, "")
-                + os.environ.get(TSM_ROOT_ENV, DEFAULT_TSM_ROOT)
-            )
+            tsm_root = _host_path(os.environ.get(TSM_ROOT_ENV, DEFAULT_TSM_ROOT))
         self.tsm_root = tsm_root
         # (size, mtime_ns) -> sha256 memo per path: libtpu is O(100 MB) and
         # re-attestation happens on every idempotent sweep.
@@ -464,7 +468,7 @@ class TpuVmBackend(TpuCcBackend):
             return
         modes = sorted(set(pending.values()))
         mode = modes[0] if len(modes) == 1 else MODE_OFF
-        path = os.environ.get(HOST_ROOT_ENV, "") + self.runtime_env_file
+        path = _host_path(self.runtime_env_file)
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             tmp = path + ".tmp"
@@ -598,7 +602,7 @@ class TpuVmBackend(TpuCcBackend):
         for pattern in self.measure_globs:
             # Measured paths are host paths; inside the container the host
             # rootfs is mounted at CC_HOST_ROOT.
-            for path in sorted(glob.glob(root + pattern if root else pattern)):
+            for path in sorted(glob.glob(_host_path(pattern))):
                 digest = self._hash_file(path)
                 if digest is not None:
                     # Record under the host-visible path so digests compare
