@@ -100,6 +100,36 @@ def test_unchanged_label_does_not_reapply(kube, tmp_path):
     assert [op for op, _ in backend.op_log].count("discover") == 1
 
 
+def test_bookmark_tracks_rv_without_reconciling(kube, tmp_path):
+    """BOOKMARK events carry only metadata.resourceVersion — no labels.
+    They must advance the tracked rv (their whole purpose: quiet nodes
+    stop 410-expiring) and must NOT be misread as 'desired label absent',
+    which would fire a spurious reconcile to the default mode."""
+    backend = FakeTpuBackend(initial_mode=MODE_ON)
+    kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+    seen_rvs = []
+    real_watch = kube.watch_nodes
+
+    def recording_watch(name, resource_version=None, timeout_seconds=300):
+        seen_rvs.append(resource_version)
+        return real_watch(name, resource_version, timeout_seconds)
+
+    kube.watch_nodes = recording_watch
+    kube.segments = [
+        [WatchEvent(
+            "BOOKMARK",
+            {"metadata": {"name": NODE, "resourceVersion": "bm-777"}},
+        )],
+        [],  # one more connect so the bookmark rv is observable
+    ]
+    mgr = make_manager(kube, backend, readiness_file=str(tmp_path / "r"))
+    run_to_completion(mgr, kube)
+    # No second reconcile: the bookmark's empty labels were not misread.
+    assert [op for op, _ in backend.op_log].count("discover") == 1
+    # The reconnect after the bookmark used the bookmark's rv.
+    assert seen_rvs[-1] == "bm-777"
+
+
 def test_410_resyncs_via_get(kube, fake_tpu, tmp_path):
     kube.set_node_label(NODE, CC_MODE_LABEL, MODE_OFF)
 

@@ -655,6 +655,15 @@ class CCManager:
                         break
                     consecutive_errors = 0
                     rv = resource_version(event.object) or rv
+                    if event.type == "BOOKMARK":
+                        # Bookmarks carry ONLY metadata.resourceVersion — no
+                        # labels. Falling through would misread the desired
+                        # mode as absent and fire a spurious reconcile to
+                        # the default. Track the rv (that is their whole
+                        # point: a fresh rv on quiet nodes keeps reconnects
+                        # from 410-expiring) and move on.
+                        maybe_retry()
+                        continue
                     value = node_labels(event.object).get(CC_MODE_LABEL)
                     if value != last_label_value:
                         log.info(

@@ -267,7 +267,10 @@ class RestKube(KubeApi):
             "watch": "true",
             "fieldSelector": f"metadata.name={name}",
             "timeoutSeconds": str(timeout_seconds),
-            "allowWatchBookmarks": "false",
+            # Bookmarks keep the tracked resourceVersion fresh on quiet
+            # nodes, so reconnects don't 410-expire after etcd compaction;
+            # the manager's loop handles the BOOKMARK event type.
+            "allowWatchBookmarks": "true",
         }
         if resource_version:
             query["resourceVersion"] = resource_version
