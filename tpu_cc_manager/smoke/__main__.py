@@ -18,6 +18,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="matmul only: 'pallas' runs the Mosaic tiled kernel "
                    "(ops/matmul.py) to prove custom-kernel compilation on a "
                    "reconfigured slice")
+    p.add_argument("--pallas-blocks", default=None, metavar="M,N,K",
+                   help="matmul+pallas only: tiling override for one-command "
+                   "on-chip tuning sweeps (e.g. 512,512,1024)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of the workload into "
                    "this directory (open with tensorboard/xprof; the "
@@ -43,6 +46,22 @@ def main(argv: list[str] | None = None) -> int:
             }))
             return 1
         kwargs["kernel"] = args.kernel
+    if args.pallas_blocks is not None:
+        if args.kernel != "pallas" or args.workload != "matmul":
+            print(json.dumps({
+                "ok": False, "workload": args.workload,
+                "error": "--pallas-blocks requires --workload matmul --kernel pallas",
+            }))
+            return 1
+        try:
+            bm, bn, bk = (int(x) for x in args.pallas_blocks.split(","))
+        except ValueError:
+            print(json.dumps({
+                "ok": False, "workload": args.workload,
+                "error": f"unparseable --pallas-blocks {args.pallas_blocks!r}",
+            }))
+            return 1
+        kwargs["blocks"] = (bm, bn, bk)
     try:
         if args.profile_dir:
             import jax
@@ -51,7 +70,10 @@ def main(argv: list[str] | None = None) -> int:
                 result = run_workload(args.workload, **kwargs)
         else:
             result = run_workload(args.workload, **kwargs)
-    except SmokeError as e:
+    except (SmokeError, ValueError) as e:
+        # ValueError covers bad workload parameters (unknown size names,
+        # non-dividing pallas blocks): the one-JSON-line stdout contract
+        # holds even for misconfigured sweeps.
         print(json.dumps({"ok": False, "workload": args.workload, "error": str(e)}))
         return 1
     print(json.dumps(result))

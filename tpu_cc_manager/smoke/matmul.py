@@ -21,10 +21,12 @@ from functools import partial
 
 
 def run(size: int | None = None, iters: int | None = None, seed: int = 0,
-        kernel: str = "xla") -> dict:
+        kernel: str = "xla", blocks: tuple[int, int, int] | None = None) -> dict:
     """kernel='xla' uses jnp.matmul (stock compiler); kernel='pallas' uses
     the Mosaic tiled kernel (ops/matmul.py) — single-device only, used to
-    prove custom-kernel compilation works on a reconfigured slice."""
+    prove custom-kernel compilation works on a reconfigured slice.
+    ``blocks`` overrides the pallas (block_m, block_n, block_k) tiling for
+    one-command on-chip tuning sweeps."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -68,10 +70,23 @@ def run(size: int | None = None, iters: int | None = None, seed: int = 0,
     if kernel == "pallas":
         from tpu_cc_manager.ops.matmul import tiled_matmul
 
-        block = 512 if size % 512 == 0 else 128
+        if blocks is None:
+            block = 512 if size % 512 == 0 else 128
+            blocks = (block, block, block)
+        if any(b < 1 for b in blocks):
+            raise ValueError(f"pallas blocks {blocks} must be positive")
+        # Clamp to the (rounded) problem size — tiled_matmul does the same,
+        # and the result JSON must report the EFFECTIVE tiling or a sweep
+        # comparing clamped configs would mislabel identical kernels.
+        blocks = tuple(min(b, size) for b in blocks)
+        bm, bn, bk = blocks
+        if size % bm or size % bn or size % bk:
+            raise ValueError(
+                f"pallas blocks {blocks} must divide the problem size {size}"
+            )
 
         def product(x, y):
-            return tiled_matmul(x, y, block_m=block, block_n=block, block_k=block)
+            return tiled_matmul(x, y, block_m=bm, block_n=bn, block_k=bk)
 
     else:
 
@@ -178,6 +193,7 @@ def run(size: int | None = None, iters: int | None = None, seed: int = 0,
         "ok": bool(ok),
         "workload": "matmul",
         "kernel": kernel,
+        "blocks": list(blocks) if kernel == "pallas" else None,
         "backend": backend,
         "generation": generation_for(backend),
         "devices": n_dev,
