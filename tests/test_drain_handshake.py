@@ -182,6 +182,44 @@ def test_drain_blocks_until_job_checkpoints_then_resumes_exactly(
     assert int(resumed_next[1]) == int(ref_continue[1])
 
 
+def test_subscriber_survives_transient_api_errors(fake_kube):
+    """A poll that raises KubeApiError must not kill the subscriber
+    thread — the next poll still observes the request and acks."""
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    fake_kube.add_node(NODE)
+    fail_once = {"n": 1}
+    real_get = fake_kube.get_node
+
+    def flaky_get(name):
+        # Fail only the SUBSCRIBER thread's poll: the main thread also
+        # calls get_node (request_drain / await_workload_acks), and
+        # consuming the injected failure there would error the test
+        # instead of exercising the resilience path.
+        if fail_once["n"] and threading.current_thread().name.startswith(
+            "drain-sub-"
+        ):
+            fail_once["n"] -= 1
+            raise KubeApiError(503, "hiccup")
+        return real_get(name)
+
+    fake_kube.get_node = flaky_get
+    acked = threading.Event()
+    sub = handshake.DrainSubscriber(
+        fake_kube, NODE, "resilient", on_drain=lambda: acked.set(),
+        poll_interval_s=0.01,
+    )
+    sub.start()
+    try:
+        handshake.request_drain(fake_kube, NODE)
+        assert handshake.await_workload_acks(
+            fake_kube, NODE, timeout_s=5, poll_interval_s=0.01
+        ) == []
+        assert acked.is_set()
+    finally:
+        sub.stop()
+
+
 def test_wedged_job_cannot_veto_the_drain(fake_kube):
     """A registered subscriber that never acks delays the drain by at most
     the bounded ack timeout (lenient policy, SURVEY.md §8.5)."""

@@ -70,10 +70,11 @@ def main(argv: list[str] | None = None) -> int:
                 result = run_workload(args.workload, **kwargs)
         else:
             result = run_workload(args.workload, **kwargs)
-    except (SmokeError, ValueError) as e:
-        # ValueError covers bad workload parameters (unknown size names,
-        # non-dividing pallas blocks): the one-JSON-line stdout contract
-        # holds even for misconfigured sweeps.
+    except SmokeError as e:
+        # Covers workload failure AND bad parameters (SmokeConfigError:
+        # unknown sizes, non-dividing pallas blocks) — the one-JSON-line
+        # stdout contract holds for misconfigured sweeps, while genuine
+        # runtime defects (e.g. a JAX ValueError) keep their tracebacks.
         print(json.dumps({"ok": False, "workload": args.workload, "error": str(e)}))
         return 1
     print(json.dumps(result))

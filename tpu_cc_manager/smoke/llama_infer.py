@@ -42,7 +42,11 @@ def _pick_config(size: str | None):
         "llama3.1-8b": LlamaConfig.llama3_1_8b,
     }
     if size not in table:
-        raise ValueError(f"unknown llama smoke size {size!r} (have {sorted(table)})")
+        from tpu_cc_manager.smoke.runner import SmokeConfigError
+
+        raise SmokeConfigError(
+            f"unknown llama smoke size {size!r} (have {sorted(table)})"
+        )
     # Inference-only workload: bf16 parameter storage. Decode reads every
     # weight every step, so tokens/s is bounded by param bytes — bf16
     # doubles it and is what fits the 7B configs on one chip.

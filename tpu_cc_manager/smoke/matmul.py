@@ -73,15 +73,17 @@ def run(size: int | None = None, iters: int | None = None, seed: int = 0,
         if blocks is None:
             block = 512 if size % 512 == 0 else 128
             blocks = (block, block, block)
+        from tpu_cc_manager.smoke.runner import SmokeConfigError
+
         if any(b < 1 for b in blocks):
-            raise ValueError(f"pallas blocks {blocks} must be positive")
+            raise SmokeConfigError(f"pallas blocks {blocks} must be positive")
         # Clamp to the (rounded) problem size — tiled_matmul does the same,
         # and the result JSON must report the EFFECTIVE tiling or a sweep
         # comparing clamped configs would mislabel identical kernels.
         blocks = tuple(min(b, size) for b in blocks)
         bm, bn, bk = blocks
         if size % bm or size % bn or size % bk:
-            raise ValueError(
+            raise SmokeConfigError(
                 f"pallas blocks {blocks} must divide the problem size {size}"
             )
 

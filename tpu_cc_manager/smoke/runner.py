@@ -28,6 +28,12 @@ class SmokeError(Exception):
     """Workload failed — treated like a device verification failure."""
 
 
+class SmokeConfigError(SmokeError):
+    """Bad workload PARAMETERS (non-dividing pallas blocks, unknown size
+    name): a user misconfiguration, reported as the structured JSON error
+    line — distinct from runtime defects, whose tracebacks must survive."""
+
+
 def run_workload(name: str, **kwargs) -> dict:
     """Run a workload in-process (tests, bench)."""
     if name not in WORKLOADS:
