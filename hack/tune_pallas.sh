@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# One-command pallas matmul tiling sweep on the current accelerator.
+#
+# The Mosaic kernel (ops/matmul.py) defaults to 512^3 blocks (~76% MFU on
+# v5e, vs ~98% for the XLA path); this sweep measures a config ladder so
+# the default can be retuned per generation with evidence. Each config is
+# one smoke subprocess; results are written as JSON lines to $OUT (fresh
+# per sweep — mixing generations/sizes would mislabel the ranking).
+#
+# CAUTION on the shared bench rig: the TPU tunnel is single-client and a
+# killed mid-dispatch client wedges it (see .claude/skills/verify). Run
+# this only on a healthy chip you own, and give it time — no kill -9.
+set -u
+
+OUT=${OUT:-pallas_sweep.jsonl}
+ERRLOG=${ERRLOG:-pallas_sweep.stderr.log}
+SIZE=${SIZE:-4096}
+CONFIGS=${CONFIGS:-"512,512,512 1024,512,512 512,1024,512 512,512,1024 1024,1024,512 256,256,512 1024,1024,1024 512,512,2048"}
+
+: > "$OUT"
+: > "$ERRLOG"
+echo ">>> sweeping pallas tilings at size $SIZE -> $OUT (stderr -> $ERRLOG)"
+for cfg in $CONFIGS; do
+  echo ">>> blocks=$cfg"
+  # A failing config (non-dividing blocks, transient smoke error) records
+  # its JSON error line and the sweep continues — one bad rung must not
+  # cost the rest of an expensive on-chip ladder.
+  { echo "=== blocks=$cfg ==="; } >> "$ERRLOG"
+  python3 -m tpu_cc_manager.smoke --workload matmul --kernel pallas \
+    --size "$SIZE" --pallas-blocks "$cfg" 2>>"$ERRLOG" \
+    | tail -1 | tee -a "$OUT" || true
+done
+
+echo ">>> best configs:"
+python3 - "$OUT" <<'EOF'
+import json, sys
+rows = []
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        rows.append(json.loads(line))
+    except json.JSONDecodeError:
+        pass  # a crashed config left a non-JSON tail; details in ERRLOG
+ok = [r for r in rows if r.get("ok") and r.get("timing_valid")]
+for r in sorted(ok, key=lambda r: -(r.get("tflops") or 0))[:5]:
+    print(f"  blocks={r.get('blocks')}  {r.get('tflops')} TF/s  mfu={r.get('mfu')}")
+failed = [r for r in rows if not r.get("ok")]
+if failed:
+    print(f"  ({len(failed)} config(s) failed; see the error log)")
+EOF
