@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Failure-and-recovery demo: the real agent against hack/mock_apiserver.py,
+# driven through the FAIL-SOFT path the happy-path demo never touches.
+#
+#   1. desired mode -> "bogus"  => mode.state=failed +
+#      failed.reason=invalid-mode, and the agent KEEPS WATCHING (the
+#      reference would refuse silently; a crash loop can't be fixed by a
+#      label edit the agent never sees — ccmanager/manager.py).
+#   2. desired mode -> "on"     => full reconcile, reason label cleared,
+#      mode.state=on.
+set -euo pipefail
+
+PORT="${PORT:-18082}"
+source "$(dirname "${BASH_SOURCE[0]}")/demo_lib.sh"
+NODE=demo-node-0
+
+start_mock_apiserver
+
+echo ">>> starting tpu-cc-manager (fake backend, no smoke)"
+NODE_NAME="$NODE" \
+KUBECONFIG="$KUBECONFIG_FILE" \
+JAX_PLATFORMS=cpu \
+CC_READINESS_FILE="$WORK/readiness" \
+OPERATOR_NAMESPACE=tpu-operator \
+PYTHONPATH="$REPO_ROOT" \
+python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
+AGENT=$!
+track_pid $AGENT
+sleep 3
+
+echo ">>> desired mode -> bogus (fail-soft path)"
+set_label "$NODE" "cloud.google.com/tpu-cc.mode" '"bogus"'
+await_label "$NODE" "cloud.google.com/tpu-cc.mode.state" "failed"
+reason=$(get_label "$NODE" "cloud.google.com/tpu-cc.failed.reason")
+[ "$reason" = "invalid-mode" ] || { echo ">>> FAILED: reason='$reason'"; exit 1; }
+kill -0 "$AGENT" || { echo ">>> FAILED: agent died on bad input"; exit 1; }
+echo ">>> failed + reason=invalid-mode reported; agent still alive"
+
+echo ">>> desired mode -> on (recovery)"
+set_label "$NODE" "cloud.google.com/tpu-cc.mode" '"on"'
+await_label "$NODE" "cloud.google.com/tpu-cc.mode.state" "on"
+reason=$(get_label "$NODE" "cloud.google.com/tpu-cc.failed.reason")
+[ -z "$reason" ] || { echo ">>> FAILED: stale reason '$reason'"; exit 1; }
+echo ">>> recovered to mode.state=on, reason label cleared"
+echo ">>> failure demo OK"

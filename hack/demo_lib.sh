@@ -1,0 +1,68 @@
+# Shared scaffolding for the hack/demo_*.sh scripts. Source AFTER setting
+# PORT (and optionally MOCK_NODES); provides:
+#   $REPO_ROOT $WORK $KUBECONFIG_FILE  — paths (WORK auto-cleaned on exit)
+#   track_pid PID                      — register a child for exit cleanup
+#   start_mock_apiserver               — hack/mock_apiserver.py on $PORT
+#   set_label NODE KEY JSON_VALUE      — _ctl/set-label ('null' clears)
+#   get_label NODE KEY                 — one label value (multi-node aware)
+#   await_label NODE KEY WANT [TRIES]  — poll until equal (1 s period)
+#
+# One copy of the kubeconfig heredoc / trap / control-endpoint plumbing:
+# a mock-apiserver API change lands here, not in three demos.
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+WORK="$(mktemp -d)"
+KUBECONFIG_FILE="$WORK/kubeconfig.yaml"
+DEMO_PIDS=()
+trap 'kill "${DEMO_PIDS[@]}" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+
+track_pid() { DEMO_PIDS+=("$1"); }
+
+cat > "$KUBECONFIG_FILE" <<EOF
+apiVersion: v1
+kind: Config
+clusters:
+- cluster: {server: "http://127.0.0.1:$PORT"}
+  name: mock
+contexts:
+- context: {cluster: mock, user: mock}
+  name: mock
+current-context: mock
+users:
+- name: mock
+  user: {}
+EOF
+
+start_mock_apiserver() {
+  echo ">>> starting mock apiserver on :$PORT${MOCK_NODES:+ ($MOCK_NODES nodes)}"
+  PYTHONPATH="$REPO_ROOT" \
+    python3 "$REPO_ROOT/hack/mock_apiserver.py" "$PORT" ${MOCK_NODES:-} &
+  track_pid $!
+  sleep 1
+}
+
+set_label() { # NODE KEY JSON_VALUE
+  curl -fsS -X POST "localhost:$PORT/_ctl/set-label" \
+    -d "{\"node\":\"$1\",\"key\":\"$2\",\"value\":$3}" > /dev/null
+}
+
+get_label() { # NODE KEY  (handles both single- and multi-node state shapes)
+  curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' |
+    python3 -c "
+import json, sys
+state = json.load(sys.stdin)
+labels = state['labels'] if 'labels' in state else state['nodes']['$1']
+print(labels.get('$2', ''))"
+}
+
+await_label() { # NODE KEY WANT [TRIES]
+  want="$3"
+  got=""
+  for _ in $(seq 1 "${4:-30}"); do
+    got=$(get_label "$1" "$2")
+    [ "$got" = "$want" ] && return 0
+    sleep 1
+  done
+  echo ">>> FAILED: $2 on $1 never reached '$want' (got '$got')" >&2
+  return 1
+}

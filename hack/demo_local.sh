@@ -4,58 +4,32 @@
 # smoke -> re-admit cycle on a laptop (no cluster, no TPU).
 set -euo pipefail
 
-REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 PORT="${PORT:-18080}"
 METRICS_PORT="${METRICS_PORT:-19090}"
-WORK="$(mktemp -d)"
-PIDS=()
-trap 'kill "${PIDS[@]}" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+source "$(dirname "${BASH_SOURCE[0]}")/demo_lib.sh"
+NODE=demo-node-0
 
-cat > "$WORK/kubeconfig.yaml" <<EOF
-apiVersion: v1
-kind: Config
-clusters:
-- cluster: {server: "http://127.0.0.1:$PORT"}
-  name: mock
-contexts:
-- context: {cluster: mock, user: mock}
-  name: mock
-current-context: mock
-users:
-- name: mock
-  user: {}
-EOF
-
-echo ">>> starting mock apiserver on :$PORT"
-PYTHONPATH="$REPO_ROOT" python "$REPO_ROOT/hack/mock_apiserver.py" "$PORT" &
-PIDS+=($!)
-sleep 1
+start_mock_apiserver
 
 echo ">>> starting tpu-cc-manager (fake backend, CPU smoke)"
-NODE_NAME=demo-node-0 \
-KUBECONFIG="$WORK/kubeconfig.yaml" \
+NODE_NAME="$NODE" \
+KUBECONFIG="$KUBECONFIG_FILE" \
 JAX_PLATFORMS=cpu \
 CC_READINESS_FILE="$WORK/readiness" \
 OPERATOR_NAMESPACE=tpu-operator \
 PYTHONPATH="$REPO_ROOT" \
-python -m tpu_cc_manager --tpu-backend fake --smoke-workload matmul \
+python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload matmul \
   --debug --metrics-port "$METRICS_PORT" &
-PIDS+=($!)
+track_pid $!
 sleep 5
 
 echo ">>> desired mode -> on"
-curl -fsS -X POST "localhost:$PORT/_ctl/set-label" \
-  -d '{"key":"cloud.google.com/tpu-cc.mode","value":"on"}' > /dev/null
-
-for _ in $(seq 1 60); do
-  state=$(curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' |
-    python -c 'import json,sys; print(json.load(sys.stdin)["labels"].get("cloud.google.com/tpu-cc.mode.state",""))')
-  [ "$state" = on ] && break
-  sleep 2
-done
+set_label "$NODE" "cloud.google.com/tpu-cc.mode" '"on"'
+# The smoke's first JAX compile takes a few seconds; poll generously.
+await_label "$NODE" "cloud.google.com/tpu-cc.mode.state" "on" 120
 
 echo ">>> node state:"
-curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' | python -m json.tool
+curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' | python3 -m json.tool
 echo ">>> phase metrics:"
 curl -fsS "localhost:$METRICS_PORT/metrics" | grep -E '^tpu_cc_(phase|reconcile)'
-[ "$state" = on ] && echo ">>> demo OK" || { echo ">>> demo FAILED"; exit 1; }
+echo ">>> demo OK"

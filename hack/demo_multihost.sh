@@ -7,35 +7,15 @@
 # commit, and both hosts converge to mode.state=slice.
 set -euo pipefail
 
-REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 PORT="${PORT:-18081}"
-WORK="$(mktemp -d)"
-PIDS=()
-trap 'kill "${PIDS[@]}" 2>/dev/null || true; rm -rf "$WORK"' EXIT
+MOCK_NODES=2
+source "$(dirname "${BASH_SOURCE[0]}")/demo_lib.sh"
 
-cat > "$WORK/kubeconfig.yaml" <<EOF
-apiVersion: v1
-kind: Config
-clusters:
-- cluster: {server: "http://127.0.0.1:$PORT"}
-  name: mock
-contexts:
-- context: {cluster: mock, user: mock}
-  name: mock
-current-context: mock
-users:
-- name: mock
-  user: {}
-EOF
-
-echo ">>> starting mock apiserver on :$PORT (2 nodes)"
-PYTHONPATH="$REPO_ROOT" python "$REPO_ROOT/hack/mock_apiserver.py" "$PORT" 2 &
-PIDS+=($!)
-sleep 1
+start_mock_apiserver
 
 start_agent() { # $1 = host index
   NODE_NAME="demo-node-$1" \
-  KUBECONFIG="$WORK/kubeconfig.yaml" \
+  KUBECONFIG="$KUBECONFIG_FILE" \
   JAX_PLATFORMS=cpu \
   CC_READINESS_FILE="$WORK/readiness-$1" \
   OPERATOR_NAMESPACE=tpu-operator \
@@ -44,8 +24,8 @@ start_agent() { # $1 = host index
   TPU_CC_FAKE_SLICE_ID=demo-slice \
   CC_SLICE_BARRIER_TIMEOUT_S=120 \
   PYTHONPATH="$REPO_ROOT" \
-  python -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
-  PIDS+=($!)
+  python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
+  track_pid $!
 }
 
 echo ">>> starting two agents (hosts 0 and 1 of a 2-host slice)"
@@ -53,35 +33,20 @@ start_agent 0
 start_agent 1
 sleep 6
 
-state_of() { # $1 = node
-  curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' |
-    python -c "import json,sys; print(json.load(sys.stdin)['nodes']['demo-node-$1'].get('cloud.google.com/tpu-cc.mode.state',''))"
-}
-
 echo ">>> desired mode slice -> host 0 ONLY (must wait at the barrier)"
-curl -fsS -X POST "localhost:$PORT/_ctl/set-label" \
-  -d '{"node":"demo-node-0","key":"cloud.google.com/tpu-cc.mode","value":"slice"}' > /dev/null
+set_label demo-node-0 "cloud.google.com/tpu-cc.mode" '"slice"'
 sleep 6
-staged=$(curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' |
-  python -c "import json,sys; print(json.load(sys.stdin)['nodes']['demo-node-0'].get('cloud.google.com/tpu-cc.slice.staged',''))")
-s0=$(state_of 0)
+staged=$(get_label demo-node-0 "cloud.google.com/tpu-cc.slice.staged")
+s0=$(get_label demo-node-0 "cloud.google.com/tpu-cc.mode.state")
 echo "    host0 staged-marker=$staged state=$s0"
 [ "$staged" = slice ] || { echo ">>> FAILED: host 0 did not publish its staged marker"; exit 1; }
 [ "$s0" != slice ] || { echo ">>> FAILED: host 0 committed without its peer"; exit 1; }
 
 echo ">>> desired mode slice -> host 1 (barrier forms; leader commits)"
-curl -fsS -X POST "localhost:$PORT/_ctl/set-label" \
-  -d '{"node":"demo-node-1","key":"cloud.google.com/tpu-cc.mode","value":"slice"}' > /dev/null
+set_label demo-node-1 "cloud.google.com/tpu-cc.mode" '"slice"'
 
-for _ in $(seq 1 60); do
-  s0=$(state_of 0); s1=$(state_of 1)
-  [ "$s0" = slice ] && [ "$s1" = slice ] && break
-  sleep 2
-done
-echo ">>> final states: host0=$s0 host1=$s1"
-curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' | python -m json.tool
-if [ "$s0" = slice ] && [ "$s1" = slice ]; then
-  echo ">>> multi-host barrier demo OK"
-else
-  echo ">>> demo FAILED"; exit 1
-fi
+await_label demo-node-0 "cloud.google.com/tpu-cc.mode.state" "slice" 120
+await_label demo-node-1 "cloud.google.com/tpu-cc.mode.state" "slice" 120
+echo ">>> final states:"
+curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' | python3 -m json.tool
+echo ">>> multi-host barrier demo OK"

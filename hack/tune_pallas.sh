@@ -12,6 +12,8 @@
 # this only on a healthy chip you own, and give it time — no kill -9.
 set -u
 
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
 OUT=${OUT:-pallas_sweep.jsonl}
 ERRLOG=${ERRLOG:-pallas_sweep.stderr.log}
 SIZE=${SIZE:-4096}
@@ -32,7 +34,8 @@ for cfg in $CONFIGS; do
 done
 
 echo ">>> best configs:"
-python3 - "$OUT" <<'EOF'
+N_CONFIGS=$(echo "$CONFIGS" | wc -w)
+python3 - "$OUT" "$N_CONFIGS" <<'EOF'
 import json, sys
 rows = []
 for line in open(sys.argv[1]):
@@ -49,4 +52,10 @@ for r in sorted(ok, key=lambda r: -(r.get("tflops") or 0))[:5]:
 failed = [r for r in rows if not r.get("ok")]
 if failed:
     print(f"  ({len(failed)} config(s) failed; see the error log)")
+# Hard crashes (segfault, OOM, import error) leave NO row at all — a
+# sweep that silently lost rungs must not read as complete coverage.
+missing = int(sys.argv[2]) - len(rows)
+if missing > 0:
+    print(f"  WARNING: {missing} config(s) produced no result line at "
+          f"all (crashed?); see the error log")
 EOF
