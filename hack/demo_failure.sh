@@ -17,15 +17,8 @@ NODE=demo-node-0
 start_mock_apiserver
 
 echo ">>> starting tpu-cc-manager (fake backend, no smoke)"
-NODE_NAME="$NODE" \
-KUBECONFIG="$KUBECONFIG_FILE" \
-JAX_PLATFORMS=cpu \
-CC_READINESS_FILE="$WORK/readiness" \
-OPERATOR_NAMESPACE=tpu-operator \
-PYTHONPATH="$REPO_ROOT" \
-python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
-AGENT=$!
-track_pid $AGENT
+start_agent "$NODE" -- --smoke-workload none --debug
+AGENT=$AGENT_PID
 sleep 3
 
 echo ">>> desired mode -> bogus (fail-soft path)"

@@ -41,6 +41,26 @@ start_mock_apiserver() {
   sleep 1
 }
 
+start_agent() { # NODE [KEY=VAL ...] [-- EXTRA_AGENT_FLAGS...]
+  # One copy of the agent launch env; per-demo extras ride as KEY=VAL
+  # arguments and agent flags after --. The started PID is exported as
+  # AGENT_PID (and tracked for cleanup).
+  local node="$1"; shift
+  local extra_env=()
+  while [ $# -gt 0 ] && [ "$1" != "--" ]; do extra_env+=("$1"); shift; done
+  [ "${1:-}" = "--" ] && shift
+  env NODE_NAME="$node" \
+    KUBECONFIG="$KUBECONFIG_FILE" \
+    JAX_PLATFORMS=cpu \
+    CC_READINESS_FILE="$WORK/readiness-$node" \
+    OPERATOR_NAMESPACE=tpu-operator \
+    PYTHONPATH="$REPO_ROOT" \
+    ${extra_env[@]+"${extra_env[@]}"} \
+    python3 -m tpu_cc_manager --tpu-backend fake "$@" &
+  AGENT_PID=$!
+  track_pid "$AGENT_PID"
+}
+
 set_label() { # NODE KEY JSON_VALUE
   curl -fsS -X POST "localhost:$PORT/_ctl/set-label" \
     -d "{\"node\":\"$1\",\"key\":\"$2\",\"value\":$3}" > /dev/null
@@ -64,5 +84,9 @@ await_label() { # NODE KEY WANT [TRIES]
     sleep 1
   done
   echo ">>> FAILED: $2 on $1 never reached '$want' (got '$got')" >&2
+  # Full state dump for red-CI debugging — one label value is not enough
+  # to see where a reconcile wedged.
+  curl -fsS -X POST "localhost:$PORT/_ctl/state" -d '{}' |
+    python3 -m json.tool >&2 || true
   return 1
 }

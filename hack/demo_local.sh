@@ -12,15 +12,8 @@ NODE=demo-node-0
 start_mock_apiserver
 
 echo ">>> starting tpu-cc-manager (fake backend, CPU smoke)"
-NODE_NAME="$NODE" \
-KUBECONFIG="$KUBECONFIG_FILE" \
-JAX_PLATFORMS=cpu \
-CC_READINESS_FILE="$WORK/readiness" \
-OPERATOR_NAMESPACE=tpu-operator \
-PYTHONPATH="$REPO_ROOT" \
-python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload matmul \
-  --debug --metrics-port "$METRICS_PORT" &
-track_pid $!
+start_agent "$NODE" -- --smoke-workload matmul --debug \
+  --metrics-port "$METRICS_PORT"
 sleep 5
 
 echo ">>> desired mode -> on"

@@ -13,24 +13,18 @@ source "$(dirname "${BASH_SOURCE[0]}")/demo_lib.sh"
 
 start_mock_apiserver
 
-start_agent() { # $1 = host index
-  NODE_NAME="demo-node-$1" \
-  KUBECONFIG="$KUBECONFIG_FILE" \
-  JAX_PLATFORMS=cpu \
-  CC_READINESS_FILE="$WORK/readiness-$1" \
-  OPERATOR_NAMESPACE=tpu-operator \
-  TPU_CC_FAKE_NUM_HOSTS=2 \
-  TPU_CC_FAKE_HOST_INDEX="$1" \
-  TPU_CC_FAKE_SLICE_ID=demo-slice \
-  CC_SLICE_BARRIER_TIMEOUT_S=120 \
-  PYTHONPATH="$REPO_ROOT" \
-  python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
-  track_pid $!
+start_host() { # $1 = host index
+  start_agent "demo-node-$1" \
+    TPU_CC_FAKE_NUM_HOSTS=2 \
+    TPU_CC_FAKE_HOST_INDEX="$1" \
+    TPU_CC_FAKE_SLICE_ID=demo-slice \
+    CC_SLICE_BARRIER_TIMEOUT_S=120 \
+    -- --smoke-workload none --debug
 }
 
 echo ">>> starting two agents (hosts 0 and 1 of a 2-host slice)"
-start_agent 0
-start_agent 1
+start_host 0
+start_host 1
 sleep 6
 
 echo ">>> desired mode slice -> host 0 ONLY (must wait at the barrier)"

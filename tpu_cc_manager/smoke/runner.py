@@ -28,10 +28,12 @@ class SmokeError(Exception):
     """Workload failed — treated like a device verification failure."""
 
 
-class SmokeConfigError(SmokeError):
+class SmokeConfigError(SmokeError, ValueError):
     """Bad workload PARAMETERS (non-dividing pallas blocks, unknown size
     name): a user misconfiguration, reported as the structured JSON error
-    line — distinct from runtime defects, whose tracebacks must survive."""
+    line — distinct from runtime defects, whose tracebacks must survive.
+    Also a ValueError: in-process callers validating parameters (tests,
+    bench) keep the stdlib-idiomatic contract."""
 
 
 def run_workload(name: str, **kwargs) -> dict:
