@@ -124,6 +124,7 @@ def _match_label_selector(labels: dict, selector: str | None) -> bool:
 
 
 sticky_pods = set()  # pods the emulated operator refuses to delete
+events: list[dict] = []  # core/v1 Events POSTed by the agent
 
 
 def operator_reactor():
@@ -283,13 +284,18 @@ class Handler(BaseHTTPRequestHandler):
             attrs = ((body.get("spec") or {}).get("resourceAttributes")) or {}
             allowed = (attrs.get("verb"), attrs.get("resource")) in {
                 ("get", "nodes"), ("list", "nodes"), ("watch", "nodes"),
-                ("patch", "nodes"), ("list", "pods"),
+                ("patch", "nodes"), ("list", "pods"), ("create", "events"),
             }
             return self._json({
                 "kind": "SelfSubjectAccessReview",
                 "apiVersion": "authorization.k8s.io/v1",
                 "status": {"allowed": allowed},
             }, 201)
+        m = re.match(r"^/api/v1/namespaces/([^/]+)/events$", u.path)
+        if m:
+            with lock:
+                events.append(body)
+            return self._json(body, 201)
         if u.path == "/_ctl/set-label":
             with lock:
                 node = nodes.get(body.get("node", DEFAULT_NODE))
@@ -311,16 +317,22 @@ class Handler(BaseHTTPRequestHandler):
                 return self._json({"ok": True, "sticky": sorted(sticky_pods)})
         if u.path == "/_ctl/state":
             with lock:
+                evs = [
+                    f"{e.get('type', '?')}/{e.get('reason', '?')}"
+                    for e in events
+                ]
                 if len(nodes) == 1:
                     # Single-node shape kept for demo_local.sh compat.
                     (node,) = nodes.values()
                     return self._json({"labels": node["metadata"]["labels"],
-                                       "pods": sorted(pods)})
+                                       "pods": sorted(pods),
+                                       "events": evs})
                 return self._json({
                     "nodes": {
                         name: n["metadata"]["labels"] for name, n in nodes.items()
                     },
                     "pods": sorted(pods),
+                    "events": evs,
                 })
         self._json({"kind": "Status", "code": 404}, 404)
 

@@ -361,6 +361,63 @@ def test_lenient_eviction_timeout_proceeds(fake_kube, fake_tpu):
     assert state_of(fake_kube)[0] == "on"
 
 
+def test_events_emitted_on_success_and_failure(fake_kube, fake_tpu):
+    """Reconcile outcomes surface as core/v1 Events on the node (kubectl
+    describe node visibility; the reference's only outward signals are
+    labels and a file)."""
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert [(e["type"], e["reason"]) for e in fake_kube.events] == [
+        ("Normal", "CCModeApplied")
+    ]
+    ev = fake_kube.events[0]
+    assert ev["involvedObject"] == {
+        "kind": "Node", "name": NODE, "apiVersion": "v1"
+    }
+    # Cluster-scoped involvedObject => the apiserver only accepts events
+    # in the "default" namespace.
+    assert ev["namespace"] == "default"
+
+    fake_tpu.fail_next("reset")
+    assert mgr.set_cc_mode(MODE_OFF) is False
+    assert [(e["type"], e["reason"]) for e in fake_kube.events][-1] == (
+        "Warning", "CCModeFailed"
+    )
+
+
+def test_events_deduplicated_across_retries(fake_kube):
+    """A retry loop re-failing identically must not spam the event stream;
+    a CHANGED outcome emits again."""
+    backend = FakeTpuBackend(slice_cc_supported=False)
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_SLICE) is False
+    assert mgr.set_cc_mode(MODE_SLICE) is False  # identical re-fail
+    assert len(fake_kube.events) == 1
+    assert fake_kube.events[0]["reason"] == "CCModeUnsupported"
+    # Recovery is a different outcome: emitted.
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert [e["reason"] for e in fake_kube.events] == [
+        "CCModeUnsupported", "CCModeApplied"
+    ]
+
+
+def test_event_emission_failure_is_nonfatal(fake_kube, fake_tpu):
+    """A client without event support (KubeApi default raises) must not
+    fail the reconcile."""
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    def no_events(namespace, event):
+        raise KubeApiError(403, "events forbidden")
+
+    fake_kube.create_event = no_events
+    fake_kube.add_node(NODE)
+    mgr = make_manager(fake_kube, fake_tpu)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert state_of(fake_kube) == (MODE_ON, "true")
+
+
 def test_metrics_server_binds_configured_interface():
     """The unauthenticated metrics endpoint honors an explicit bind
     (VERDICT r3 weak #7: it previously hardcoded 0.0.0.0)."""

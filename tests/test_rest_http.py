@@ -120,9 +120,11 @@ class _MockApiserver:
                     allowed = (attrs.get("verb"), attrs.get("resource")) in {
                         ("get", "nodes"), ("list", "nodes"),
                         ("watch", "nodes"), ("patch", "nodes"),
-                        ("list", "pods"),
+                        ("list", "pods"), ("create", "events"),
                     }
                     return self._json({"status": {"allowed": allowed}}, 201)
+                if u.path.endswith("/events"):
+                    return self._json(body, 201)
                 return self._json({"kind": "Status", "code": 404}, 404)
 
             def do_PATCH(self):
@@ -293,6 +295,15 @@ def test_self_subject_access_review(apiserver, client):
     assert attrs == {
         "verb": "list", "resource": "pods", "namespace": "tpu-operator"
     }
+
+
+def test_create_event_posts_to_namespace(apiserver, client):
+    body = {"reason": "CCModeApplied", "type": "Normal",
+            "involvedObject": {"kind": "Node", "name": NODE}}
+    client.create_event("tpu-operator", body)
+    post = [r for r in apiserver.requests if r["method"] == "POST"][-1]
+    assert post["path"] == "/api/v1/namespaces/tpu-operator/events"
+    assert post["body"]["reason"] == "CCModeApplied"
 
 
 def test_rbac_check_command(apiserver, tmp_path):

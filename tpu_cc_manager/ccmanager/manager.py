@@ -198,10 +198,47 @@ class CCManager:
         # fix still converges without a label edit, without re-failing an
         # identical reconcile every few seconds.
         self.retryable_failure = True
+        # Event dedup state (see _emit_node_event).
+        self._last_event_key: tuple[str, str, str] | None = None
 
     # ------------------------------------------------------------------
     # Label plumbing
     # ------------------------------------------------------------------
+
+    def _emit_node_event(self, type_: str, reason: str, message: str) -> None:
+        """Best-effort core/v1 Event on this node (`kubectl describe node`
+        visibility — the reference's only outward signals are labels and a
+        file; SURVEY.md §5). Deduplicated on (type, reason, message) so
+        idempotent re-applies and retry loops don't spam the event stream;
+        never fails a reconcile. Not all clients support events — the
+        KubeApi default raises — hence the broad non-fatal handling."""
+        key = (type_, reason, message)
+        if key == self._last_event_key:
+            return
+        try:
+            now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            # Events for cluster-scoped objects (Node) must live in the
+            # "default" namespace — apiserver validation rejects any other
+            # when involvedObject.namespace is empty.
+            self.api.create_event("default", {
+                "metadata": {"generateName": "tpu-cc-manager."},
+                "involvedObject": {
+                    "kind": "Node", "name": self.node_name, "apiVersion": "v1",
+                },
+                "reason": reason,
+                "message": message[:1024],
+                "type": type_,
+                "source": {"component": "tpu-cc-manager", "host": self.node_name},
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "count": 1,
+            })
+            self._last_event_key = key
+        except Exception as e:  # noqa: BLE001 - "never fails a reconcile"
+            # must hold for ANY failure shape (a malformed 201 body raises
+            # JSONDecodeError, not KubeApiError) — a verified mode change
+            # must not be re-reported failed over a convenience signal.
+            log.debug("event emission failed (non-fatal): %s", e)
 
     def with_default(self, label_value: str | None) -> str:
         """Absent/empty desired label means the configured default
@@ -255,6 +292,9 @@ class CCManager:
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason="invalid-mode"
             )
+            self._emit_node_event(
+                "Warning", "CCModeInvalid", f"invalid desired CC mode {mode!r}"
+            )
             return False
         if not self.host_cc_capable and mode != MODE_OFF:
             # Warning only; the backend/attestation will produce the hard
@@ -270,6 +310,9 @@ class CCManager:
             log.error("TPU discovery failed: %s", e)
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason="discovery-failed"
+            )
+            self._emit_node_event(
+                "Warning", "CCModeFailed", f"TPU discovery failed: {e}"
             )
             return False
 
@@ -292,6 +335,10 @@ class CCManager:
             self.retryable_failure = False  # only a label/pool edit helps
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason=e.reason
+            )
+            self._emit_node_event(
+                "Warning", "CCModeUnsupported",
+                f"mode {mode} unsupported on this node: {e}",
             )
             return False
         if chips is None:  # nothing to reconfigure; state already reported
@@ -428,6 +475,10 @@ class CCManager:
         except evict.EvictionTimeout as e:
             log.error("strict eviction failed: %s — not touching hardware", e)
             m.result = "failed"
+            self._emit_node_event(
+                "Warning", "CCModeDrainTimeout",
+                f"strict eviction timed out before mode {mode}: {e}",
+            )
             try:
                 state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
             finally:
@@ -505,6 +556,9 @@ class CCManager:
                 # drained" no longer describes it: withdraw from the barrier.
                 barrier.abort()
             state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
+            self._emit_node_event(
+                "Warning", "CCModeFailed", f"CC mode change to {mode} failed: {e}"
+            )
             m.result = "failed"
             return False
         state.set_cc_state_label(self.api, self.node_name, mode)
@@ -514,6 +568,10 @@ class CCManager:
         self._publish_coordination_labels(topo, quote)
         m.result = "ok"
         log.info("CC mode %s applied and verified on %d chip(s)", mode, len(chips))
+        self._emit_node_event(
+            "Normal", "CCModeApplied",
+            f"CC mode {mode} applied and verified on {len(chips)} chip(s)",
+        )
         return True
 
     def _publish_coordination_labels(self, topo: SliceTopology, quote) -> None:

@@ -153,18 +153,25 @@ def cmd_rbac_check(api, args) -> int:
     these — including list nodes, which the slice barrier's peer discovery
     and the rolling orchestrator depend on)."""
     checks = [
-        ("get", "nodes", None),
-        ("list", "nodes", None),
-        ("patch", "nodes", None),
-        ("watch", "nodes", None),
-        ("list", "pods", args.namespace),
+        ("get", "nodes", None, True),
+        ("list", "nodes", None, True),
+        ("patch", "nodes", None, True),
+        ("watch", "nodes", None, True),
+        ("list", "pods", args.namespace, True),
+        # Events are best-effort (the agent degrades without them):
+        # reported, but a denial doesn't fail the check. Node events live
+        # in "default" (cluster-scoped involvedObject).
+        ("create", "events", "default", False),
     ]
     ok = True
-    for verb, resource, ns in checks:
+    for verb, resource, ns, required in checks:
         allowed = api.self_subject_access_review(verb, resource, namespace=ns)
-        ok = ok and allowed
+        ok = ok and (allowed or not required)
         scope = f" (ns={ns})" if ns else ""
-        print(f"{verb:<6} {resource}{scope}: {'allowed' if allowed else 'DENIED'}")
+        verdict = "allowed" if allowed else (
+            "DENIED" if required else "denied (optional)"
+        )
+        print(f"{verb:<6} {resource}{scope}: {verdict}")
     print("OK: RBAC sufficient" if ok else "FAIL: missing permissions")
     return 0 if ok else 1
 

@@ -87,3 +87,12 @@ class KubeApi(abc.ABC):
         returns. Transport errors raise KubeApiError; a stale
         resourceVersion raises KubeApiError(410) either immediately or as an
         ERROR event translated by the caller (reference main.py:622-638)."""
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        """POST a core/v1 Event (``kubectl describe node`` visibility).
+
+        Optional capability — the default raises, and callers must treat
+        emission as best-effort (events are operator convenience, never
+        control-plane state). Not retried on failure: POST is not
+        idempotent and a lost event is acceptable."""
+        raise KubeApiError(None, "event creation not supported by this client")

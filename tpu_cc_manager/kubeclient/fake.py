@@ -76,6 +76,8 @@ class FakeKube(KubeApi):
         # Counters some tests assert on.
         self.patch_calls = 0
         self.list_pod_calls = 0
+        # Events emitted via create_event, in order (tests assert on them).
+        self.events: list[dict] = []
 
     # ---- test harness helpers -------------------------------------------
 
@@ -209,6 +211,11 @@ class FakeKube(KubeApi):
                 and _match_label_selector(p["metadata"].get("labels") or {}, label_selector)
                 and _match_pod_field_selector(p, field_selector)
             ]
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        with self._lock:
+            self.events.append({"namespace": namespace, **copy.deepcopy(event)})
+            return copy.deepcopy(event)
 
     def watch_nodes(
         self,

@@ -237,6 +237,14 @@ class RestKube(KubeApi):
             "GET", f"/api/v1/namespaces/{namespace}/pods", query
         ).get("items", [])
 
+    def create_event(self, namespace: str, event: dict) -> dict:
+        return self._request_json(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/events",
+            body=event,
+            content_type="application/json",
+        )
+
     def self_subject_access_review(
         self, verb: str, resource: str, namespace: str | None = None
     ) -> bool:
