@@ -1,8 +1,15 @@
 """Pause/unpause label algebra (reference gpu_operator_eviction.py:43-95)."""
 
 import pytest
+from hypothesis import given, strategies as st
 
-from tpu_cc_manager.drain.pause import is_paused, pause_value, unpause_value
+from tpu_cc_manager.drain.pause import (
+    MAX_LABEL_LEN,
+    _MAX_CUSTOM,
+    is_paused,
+    pause_value,
+    unpause_value,
+)
 from tpu_cc_manager.labels import PAUSED_SUFFIX, PAUSED_VALUE
 
 
@@ -49,3 +56,69 @@ def test_is_paused():
     assert is_paused("x" + PAUSED_SUFFIX)
     assert not is_paused("true")
     assert not is_paused(None)
+
+
+# ---------------------------------------------------------------------------
+# Property-based coverage of the protocol core (the pause values are the
+# external operator's API; an algebra bug here strands components).
+# ---------------------------------------------------------------------------
+
+# Valid-ish k8s label values: alnum/-/_/. up to 63 chars. Embedded copies
+# of PAUSED_SUFFIX are deliberately reachable (st.text over these chars
+# plus the explicit composites below) — the truncation edge where a cut
+# exposes a suffix is exactly what the normalization must survive.
+label_values = st.one_of(
+    st.text(
+        alphabet=st.characters(
+            whitelist_categories=(), whitelist_characters=(
+                "abcdefghijklmnopqrstuvwxyz"
+                "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_."
+            ),
+        ),
+        min_size=1, max_size=MAX_LABEL_LEN,
+    ),
+    # Adversarial composites around the suffix and the cut point.
+    st.builds(
+        lambda pre, post: (pre + PAUSED_SUFFIX + post)[:MAX_LABEL_LEN],
+        st.text(alphabet="ab", max_size=6),
+        st.text(alphabet="xy", min_size=1, max_size=20),
+    ),
+)
+
+
+@given(label_values)
+def test_pause_invariants(value):
+    paused = pause_value(value)
+    if value in ("false",) or is_paused(value):
+        assert paused is None
+        return
+    # Pausing produces a recognized-paused, length-legal label value.
+    assert paused is not None
+    assert is_paused(paused)
+    assert len(paused) <= MAX_LABEL_LEN
+    # Pausing is idempotent: a paused value never re-pauses.
+    assert pause_value(paused) is None
+    # Unpausing a paused value NEVER yields something that still reads
+    # paused (a double-suffix bug would strand the component forever).
+    restored = unpause_value(paused)
+    assert restored is not None
+    assert not is_paused(restored)
+    # After one (possibly lossy, documented) normalization cycle, the
+    # algebra is a fixpoint: a second pause/unpause cycle is lossless.
+    if restored not in ("", "false"):
+        assert unpause_value(pause_value(restored)) == restored
+
+
+@given(label_values)
+def test_exact_roundtrip_for_values_that_fit(value):
+    """Values short enough to carry the suffix round-trip bit-exact."""
+    if value in ("true", "false") or is_paused(value):
+        return
+    if len(value) <= _MAX_CUSTOM:
+        assert unpause_value(pause_value(value)) == value
+
+
+@given(label_values)
+def test_unpause_never_touches_non_paused(value):
+    if not is_paused(value):
+        assert unpause_value(value) is None
