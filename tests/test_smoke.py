@@ -113,3 +113,14 @@ def test_llama_size_table_includes_all_family_members():
     assert cfg31.rope_scaling == (8.0, 1.0, 4.0, 8192)
     with pytest.raises(ValueError):
         _pick_config("gpt5")
+
+
+def test_resnet_batch_must_divide_devices():
+    """--batch tuning values that don't shard evenly fail as structured
+    config errors, not raw JAX sharding tracebacks (8 virtual devices via
+    conftest)."""
+    from tpu_cc_manager.smoke.resnet_train import run
+    from tpu_cc_manager.smoke.runner import SmokeConfigError
+
+    with pytest.raises(SmokeConfigError, match="divide evenly"):
+        run(size="tiny", batch=100)  # 100 % 8 != 0

@@ -21,6 +21,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--pallas-blocks", default=None, metavar="M,N,K",
                    help="matmul+pallas only: tiling override for one-command "
                    "on-chip tuning sweeps (e.g. 512,512,1024)")
+    p.add_argument("--batch", type=int, default=None,
+                   help="llama/resnet only: global batch override (MFU/"
+                   "throughput tuning; resnet MFU in particular scales "
+                   "with batch until HBM runs out)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a JAX profiler trace of the workload into "
                    "this directory (open with tensorboard/xprof; the "
@@ -46,6 +50,20 @@ def main(argv: list[str] | None = None) -> int:
             }))
             return 1
         kwargs["kernel"] = args.kernel
+    if args.batch is not None:
+        if args.workload not in ("llama", "resnet"):
+            print(json.dumps({
+                "ok": False, "workload": args.workload,
+                "error": "--batch only applies to the llama/resnet workloads",
+            }))
+            return 1
+        if args.batch < 1:
+            print(json.dumps({
+                "ok": False, "workload": args.workload,
+                "error": f"--batch must be positive (got {args.batch})",
+            }))
+            return 1
+        kwargs["batch"] = args.batch
     if args.pallas_blocks is not None:
         if args.kernel != "pallas" or args.workload != "matmul":
             print(json.dumps({

@@ -40,6 +40,12 @@ def run(size: str | None = None, batch: int | None = None, steps: int = 6,
         model, image_size, num_classes = ResNetTiny(), 32, 10
         default_batch = 8 * n_dev
     batch = batch or default_batch
+    if batch % n_dev:
+        from tpu_cc_manager.smoke.runner import SmokeConfigError
+
+        raise SmokeConfigError(
+            f"batch {batch} must divide evenly over {n_dev} device(s)"
+        )
 
     mesh = make_mesh(MeshSpec(dcn=1, dp=-1, fsdp=1, tp=1))
     repl = NamedSharding(mesh, P())
