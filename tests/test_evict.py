@@ -116,3 +116,23 @@ def test_readmit_respects_concurrent_user_disable(fake_kube):
     fake_kube.set_node_label(NODE, DP_LABEL, "false")  # concurrent user edit
     evict.readmit_components(fake_kube, NODE, original)
     assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == "false"
+
+
+def test_overlong_custom_value_drains_and_restores_exactly(fake_kube):
+    """A custom value too long to carry the paused suffix within the
+    63-char label limit: the drain still proceeds (truncated-but-valid
+    paused label; the suffix the operator reacts to is intact) and the
+    re-admit restores the UNTRUNCATED original from the remembered
+    pre-drain labels (drain/pause.py truncation contract)."""
+    long_value = "a-very-long-custom-component-flavor-beyond-the-budget"
+    assert len(long_value) > 33  # would exceed 63 chars with the suffix
+    fake_kube.add_node(NODE, {DP_LABEL: long_value})
+    operator_controller(fake_kube)
+    original = evict.evict_components(
+        fake_kube, NODE, NS, timeout_s=1, poll_interval_s=0.01
+    )
+    paused = node_labels(fake_kube.get_node(NODE))[DP_LABEL]
+    assert is_paused(paused)
+    assert len(paused) <= 63  # a real apiserver would accept the patch
+    evict.readmit_components(fake_kube, NODE, original)
+    assert node_labels(fake_kube.get_node(NODE))[DP_LABEL] == long_value
