@@ -124,8 +124,10 @@ def test_overlong_custom_value_drains_and_restores_exactly(fake_kube):
     paused label; the suffix the operator reacts to is intact) and the
     re-admit restores the UNTRUNCATED original from the remembered
     pre-drain labels (drain/pause.py truncation contract)."""
+    from tpu_cc_manager.drain.pause import _MAX_CUSTOM
+
     long_value = "a-very-long-custom-component-flavor-beyond-the-budget"
-    assert len(long_value) > 33  # would exceed 63 chars with the suffix
+    assert len(long_value) > _MAX_CUSTOM  # would exceed 63 chars with suffix
     fake_kube.add_node(NODE, {DP_LABEL: long_value})
     operator_controller(fake_kube)
     original = evict.evict_components(

@@ -39,46 +39,39 @@ def main(argv: list[str] | None = None) -> int:
 
     from tpu_cc_manager.smoke.runner import SmokeError, run_workload
 
+    def usage_error(message: str) -> int:
+        # Same one-JSON-line shape as SmokeConfigError failures.
+        print(json.dumps({
+            "ok": False, "workload": args.workload, "error": message,
+        }))
+        return 1
+
     kwargs = {}
     if args.size is not None:
         kwargs["size"] = int(args.size) if args.size.isdigit() else args.size
     if args.kernel is not None:
         if args.workload != "matmul":
-            print(json.dumps({
-                "ok": False, "workload": args.workload,
-                "error": "--kernel only applies to the matmul workload",
-            }))
-            return 1
+            return usage_error("--kernel only applies to the matmul workload")
         kwargs["kernel"] = args.kernel
     if args.batch is not None:
         if args.workload not in ("llama", "resnet"):
-            print(json.dumps({
-                "ok": False, "workload": args.workload,
-                "error": "--batch only applies to the llama/resnet workloads",
-            }))
-            return 1
+            return usage_error(
+                "--batch only applies to the llama/resnet workloads"
+            )
         if args.batch < 1:
-            print(json.dumps({
-                "ok": False, "workload": args.workload,
-                "error": f"--batch must be positive (got {args.batch})",
-            }))
-            return 1
+            return usage_error(f"--batch must be positive (got {args.batch})")
         kwargs["batch"] = args.batch
     if args.pallas_blocks is not None:
         if args.kernel != "pallas" or args.workload != "matmul":
-            print(json.dumps({
-                "ok": False, "workload": args.workload,
-                "error": "--pallas-blocks requires --workload matmul --kernel pallas",
-            }))
-            return 1
+            return usage_error(
+                "--pallas-blocks requires --workload matmul --kernel pallas"
+            )
         try:
             bm, bn, bk = (int(x) for x in args.pallas_blocks.split(","))
         except ValueError:
-            print(json.dumps({
-                "ok": False, "workload": args.workload,
-                "error": f"unparseable --pallas-blocks {args.pallas_blocks!r}",
-            }))
-            return 1
+            return usage_error(
+                f"unparseable --pallas-blocks {args.pallas_blocks!r}"
+            )
         kwargs["blocks"] = (bm, bn, bk)
     try:
         if args.profile_dir:
