@@ -1,5 +1,9 @@
 """Mode canonicalization and ready-state derivation (labels.py)."""
 
+import re
+
+from hypothesis import given, strategies as st
+
 from tpu_cc_manager.labels import (
     MODE_DEVTOOLS,
     MODE_OFF,
@@ -7,6 +11,7 @@ from tpu_cc_manager.labels import (
     MODE_SLICE,
     STATE_FAILED,
     canonical_mode,
+    label_safe,
     ready_state_for,
 )
 
@@ -30,3 +35,39 @@ def test_ready_state():
     assert ready_state_for("unknown") == ""
     # Deliberate divergence (SURVEY.md §8.4): devtools is explicit.
     assert ready_state_for(MODE_DEVTOOLS) == "debug"
+
+
+# ---------------------------------------------------------------------------
+# label_safe: the single shared sanitizer — every module writing derived
+# label values (slice ids, failure reasons) flows through it, so its
+# output must ALWAYS be a valid k8s label value.
+# ---------------------------------------------------------------------------
+
+# The apiserver's label-value regex (ASCII only — writing this property
+# surfaced that Python's isalnum admits unicode the apiserver rejects).
+K8S_LABEL_VALUE = re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
+
+
+@given(st.text(max_size=200))
+def test_label_safe_always_produces_valid_label_values(value):
+    out = label_safe(value)
+    assert 1 <= len(out) <= 63
+    assert K8S_LABEL_VALUE.match(out), out
+    # Idempotent: sanitizing a sanitized value changes nothing.
+    assert label_safe(out) == out
+
+
+@given(st.text(
+    alphabet="abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789",
+    min_size=1, max_size=63,
+))
+def test_label_safe_preserves_already_valid_values(value):
+    assert label_safe(value) == value
+
+
+def test_label_safe_rejects_unicode_alnum():
+    """'\u00c0' and '\u0663' are Python-alnum but NOT k8s-label-legal —
+    they must be replaced, not passed through."""
+    out = label_safe("slice-\u00c0-\u0663x")
+    assert "\u00c0" not in out and "\u0663" not in out
+    assert K8S_LABEL_VALUE.match(out)

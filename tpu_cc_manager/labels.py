@@ -32,6 +32,8 @@ Mode semantics (reference modes at main.py:214-296):
 
 from __future__ import annotations
 
+import re
+
 # --- Desired / actual / readiness labels (reference: nvidia.com/cc.mode,
 # nvidia.com/cc.mode.state, nvidia.com/cc.ready.state).
 CC_MODE_LABEL = "cloud.google.com/tpu-cc.mode"
@@ -92,13 +94,17 @@ def canonical_mode(mode: str) -> str:
     return MODE_ALIASES.get(mode, mode)
 
 
+_LABEL_ILLEGAL = re.compile(r"[^A-Za-z0-9_.-]")
+
+
 def label_safe(value: str, max_len: int = 63) -> str:
-    """Coerce a string into a valid k8s label value (alnum/-/_/. and at most
-    63 chars; must start and end alphanumeric). The single shared sanitizer
-    — every module writing derived label values (slice ids, failure
-    reasons) must produce identical output for identical input."""
-    cleaned = "".join(c if (c.isalnum() or c in "-_.") else "-" for c in value)
-    cleaned = cleaned[:max_len].strip("-_.")
+    """Coerce a string into a valid k8s label value (ASCII alnum/-/_/. and
+    at most 63 chars; must start and end alphanumeric). ASCII explicitly:
+    Python's ``isalnum`` admits unicode letters/digits ('À', '٣') that the
+    apiserver's label regex rejects. The single shared sanitizer — every
+    module writing derived label values (slice ids, failure reasons) must
+    produce identical output for identical input."""
+    cleaned = _LABEL_ILLEGAL.sub("-", value)[:max_len].strip("-_.")
     return cleaned or "unknown"
 
 
