@@ -492,3 +492,35 @@ def test_load_backend_factory(tmp_path):
     assert isinstance(load_backend("tpuvm", state_dir=str(tmp_path)), TpuVmBackend)
     with pytest.raises(ValueError):
         load_backend("gpu")
+
+
+# ---------------------------------------------------------------------------
+# Fuzzing the accelerator-type parser: whatever the metadata server or env
+# hands us, the parser either returns a sane topology or raises TpuError —
+# never an unhandled ValueError/ZeroDivisionError mid-discovery.
+# ---------------------------------------------------------------------------
+
+from hypothesis import given, strategies as st
+
+
+@given(st.text(max_size=24))
+def test_parse_accelerator_type_total(accel):
+    try:
+        gen, chips, hosts = parse_accelerator_type(accel)
+    except TpuError:
+        return  # the one sanctioned failure mode
+    assert chips >= 1
+    assert hosts >= 1
+    assert isinstance(gen, str)
+    # Chips never exceed per-host capacity times hosts.
+    assert chips <= hosts * 8
+
+
+@given(st.sampled_from(["v4", "v5e", "v5p", "v6e"]),
+       st.integers(min_value=1, max_value=512))
+def test_parse_accelerator_type_known_generations(gen, cores):
+    got_gen, chips, hosts = parse_accelerator_type(f"{gen}-{cores}")
+    assert got_gen == gen
+    assert 1 <= chips
+    assert 1 <= hosts
+    assert chips <= hosts * (8 if gen in ("v5e", "v6e") else 4)
