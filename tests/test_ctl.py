@@ -47,12 +47,57 @@ def test_attest_ok_and_fail(fake_kube, capsys):
     fake_kube.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
     publish_quote(fake_kube, "n0", quote)
     assert ctl.cmd_attest(
-        fake_kube, ns(selector="pool=tpu", mode="on", slices=None, max_age=3600)
+        fake_kube, ns(selector="pool=tpu", mode="on", slices=None,
+                      max_age=3600, allow_fake=True)
     ) == 0
+    # Without --allow-fake the same pool FAILS: fake-platform quotes are
+    # forgeries to a production verifier.
     assert ctl.cmd_attest(
-        fake_kube, ns(selector="pool=tpu", mode="off", slices=None, max_age=3600)
+        fake_kube, ns(selector="pool=tpu", mode="on", slices=None,
+                      max_age=3600)
+    ) == 1
+    assert ctl.cmd_attest(
+        fake_kube, ns(selector="pool=tpu", mode="off", slices=None,
+                      max_age=3600, allow_fake=True)
     ) == 1
     assert "FAIL" in capsys.readouterr().out
+
+
+def test_rbac_check_on_non_rest_client(fake_kube, capsys):
+    """self_subject_access_review is part of the KubeApi contract (ADVICE
+    r4 #2): rbac-check must run — not AttributeError — on any client."""
+    assert ctl.cmd_rbac_check(fake_kube, ns(namespace="tpu-operator")) == 0
+    assert "OK: RBAC sufficient" in capsys.readouterr().out
+    # Narrowed grants surface as failures, proving the fake consults them.
+    fake_kube.rbac_rules = {("get", "nodes"): True}  # everything else denied
+    assert ctl.cmd_rbac_check(fake_kube, ns(namespace="tpu-operator")) == 1
+    assert "DENIED" in capsys.readouterr().out
+
+
+def test_rbac_check_base_client_raises_cleanly():
+    """The ABC default raises KubeApiError, not AttributeError."""
+    import pytest
+
+    from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError
+
+    class Minimal(KubeApi):
+        def get_node(self, name):  # pragma: no cover - unused
+            raise NotImplementedError
+
+        def patch_node_labels(self, name, labels):  # pragma: no cover
+            raise NotImplementedError
+
+        def list_nodes(self, label_selector=None):  # pragma: no cover
+            raise NotImplementedError
+
+        def list_pods(self, *a, **kw):  # pragma: no cover
+            raise NotImplementedError
+
+        def watch_nodes(self, *a, **kw):  # pragma: no cover
+            raise NotImplementedError
+
+    with pytest.raises(KubeApiError):
+        Minimal().self_subject_access_review("get", "nodes")
 
 
 def test_rollout_command(fake_kube, capsys):

@@ -277,6 +277,36 @@ def test_await_polls_with_one_listing_not_per_node_gets(fake_kube):
     assert gets == []  # every state read rode a list_nodes call
 
 
+def test_stale_failed_with_dead_agent_fails_fast(fake_kube):
+    """A node carrying a leftover 'failed' label whose agent is DOWN must
+    fail the group after the bounded stale-failed grace, not consume the
+    full node timeout (ADVICE r4 #5)."""
+    import time as _time
+
+    fake_kube.add_node("node-0", {"pool": "tpu",
+                                  CC_MODE_STATE_LABEL: STATE_FAILED})
+    # No agent reactor at all: nothing will ever change the state label.
+    roller = make_roller(fake_kube, node_timeout_s=30)
+    t0 = _time.monotonic()
+    result = roller.rollout("on")
+    elapsed = _time.monotonic() - t0
+    assert result.ok is False
+    assert result.groups[0].states["node-0"] == STATE_FAILED  # not "timeout"
+    # Grace is a few polls (5 × 0.02 s); far under the 30 s node timeout.
+    assert elapsed < 5
+
+
+def test_stale_failed_still_gets_agent_retry_grace(fake_kube):
+    """The original stale-failed behavior survives the grace cap: a LIVE
+    agent that reacts within the grace gets its retry and converges."""
+    fake_kube.add_node("node-0", {"pool": "tpu",
+                                  CC_MODE_STATE_LABEL: STATE_FAILED})
+    agent_simulator(fake_kube)  # healthy agent: converges on desired
+    result = make_roller(fake_kube).rollout("on")
+    assert result.ok is True
+    assert result.groups[0].states["node-0"] == "on"
+
+
 def test_interrupted_rollout_resumes_idempotently(fake_kube):
     """A re-run after a halt skips already-converged groups: no label
     rewrite, no second bounce (VERDICT r3 item 7)."""

@@ -137,7 +137,8 @@ def test_rolling_bounce_under_training_resumes_exactly(
     # Every slice must attest to the same runtime digest before the DCN
     # mesh is re-formed (configs[4] invariant); raises on any divergence.
     slices = multislice.verify_pool_attestation(
-        fake_kube, selector="", expected_mode="on", expected_slices=2
+        fake_kube, selector="", expected_mode="on", expected_slices=2,
+        allow_fake=True,
     )
     assert set(slices) == {"slice-a", "slice-b"}
 

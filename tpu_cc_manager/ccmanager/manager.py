@@ -603,6 +603,12 @@ class CCManager:
                 # re-verifying full staging.
                 patch[slicecoord.SLICE_STAGED_LABEL] = None
             self.api.patch_node_labels(self.node_name, patch)
+            # The full signed quote (or a clear when there is none) rides
+            # in an annotation so PEERS can re-verify the signature instead
+            # of trusting the digest labels (multislice.py trust model).
+            multislice.publish_quote_annotation(
+                self.api, self.node_name, quote
+            )
             if quote is not None:
                 log.info(
                     "published attestation for %s: digest=%s mode=%s",

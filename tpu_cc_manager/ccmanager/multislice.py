@@ -8,17 +8,33 @@ Protocol (control-plane side — the label/annotation transport mirrors how
 the reference carries all its state on node objects):
 
 1. After a slice's CC transition verifies locally, its node agent publishes
-   the quote *digest* and mode as node annotations (``publish_quote``) —
-   digests, not quotes: annotations are world-readable, and the digest is
-   all a peer needs for the equality check.
+   (a) the quote *digest* and mode as node labels — the cheap operator-
+   visible summary — and (b) the FULL signed quote (platform JWT/HMAC,
+   measurements, nonce) as a node annotation (``publish_quote``).
 2. Before a training job re-forms its DCN mesh, it (or the rolling
    orchestrator) calls ``verify_pool_attestation``: every slice in the pool
-   must report (a) the expected mode, (b) a fresh-enough quote, and (c) the
-   SAME runtime digest — heterogeneous digests mean some slice runs a
-   different (possibly unmeasured) runtime and must not join the mesh.
+   must present (a) the expected mode, (b) a fresh-enough quote, (c) the
+   SAME runtime digest, and (d) a published quote whose PLATFORM SIGNATURE
+   verifies and matches the claimed digest. (c) alone would trust whatever
+   a label claims — any principal that can patch node labels could claim
+   any digest; (d) is the reference's read-truth-back principle
+   (/root/reference/main.py:524-528) applied across slices: the evidence is
+   re-verified by the consumer, not trusted from state. A node claiming
+   the right digest without a validly signed quote fails pool
+   verification.
 3. The data-plane side then runs
    :func:`tpu_cc_manager.parallel.distributed.verify_dcn_mesh` for the
    collective-path health check before the first real step.
+
+Trust model of (d): the peer re-checks the platform signature (RS256
+against Google's JWKS for tpuvm; fail-closed), the nonce binding inside
+the signed token, token expiry, and digest/mode consistency between the
+signed measurements and the advertised labels. What it cannot give is
+peer-chosen-challenge freshness — the nonce was chosen by the attesting
+host's own agent, so replay protection within the token's validity window
+rests on the token's ``exp``. A peer-challenge protocol would need an
+interactive round per verifier and is deliberately out of scope for a
+control-plane gate.
 """
 
 from __future__ import annotations
@@ -26,15 +42,33 @@ from __future__ import annotations
 import logging
 import time
 
-from tpu_cc_manager.kubeclient.api import KubeApi, node_labels
-from tpu_cc_manager.tpudev.attestation import quote_digest
+from tpu_cc_manager.kubeclient.api import (
+    KubeApi,
+    KubeApiError,
+    node_annotations,
+    node_labels,
+)
+from tpu_cc_manager.tpudev.attestation import (
+    AttestationError,
+    deserialize_quote,
+    quote_digest,
+    quote_problems,
+    serialize_quote,
+)
 from tpu_cc_manager.tpudev.contract import AttestationQuote
 
 log = logging.getLogger(__name__)
 
-from tpu_cc_manager.labels import SLICE_ID_LABEL  # noqa: E402 - shared constant
+from tpu_cc_manager.labels import (  # noqa: E402 - shared constants
+    SLICE_ID_LABEL,
+    label_safe,
+)
 
 QUOTE_ANNOTATION = "cloud.google.com/tpu-cc.attestation"
+# The full signed quote rides in a real annotation (values up to 256 KiB;
+# label values cap at 63 chars): peers re-verify its signature instead of
+# trusting the digest labels above.
+QUOTE_FULL_ANNOTATION = "cloud.google.com/tpu-cc.quote"
 
 
 class PoolAttestationError(Exception):
@@ -62,15 +96,30 @@ def quote_label_patch(quote: AttestationQuote | None) -> dict:
     }
 
 
-def publish_quote(api: KubeApi, node_name: str, quote: AttestationQuote) -> dict:
-    """Publish a quote's digest+mode on the node as an annotation payload.
+def publish_quote_annotation(
+    api: KubeApi, node_name: str, quote: AttestationQuote | None
+) -> None:
+    """Publish (or clear, for ``quote=None``) the full signed quote in the
+    node annotation peers verify. Best-effort on clients without
+    annotation support: the digest labels still work there, the pool
+    verifier just reports those nodes as signature-unverifiable."""
+    value = serialize_quote(quote) if quote is not None else None
+    try:
+        api.patch_node_annotations(node_name, {QUOTE_FULL_ANNOTATION: value})
+    except KubeApiError as e:
+        log.warning(
+            "could not publish signed quote annotation on %s: %s",
+            node_name, e,
+        )
 
-    Node annotations travel in metadata like labels, so the same
-    merge-patch endpoint carries them (the in-tree kubeclient patches
-    metadata.labels; annotations piggyback on a dedicated label-safe
-    JSON value here to keep the client surface minimal)."""
+
+def publish_quote(api: KubeApi, node_name: str, quote: AttestationQuote) -> dict:
+    """Publish a quote on the node: digest+mode as labels (the operator-
+    visible summary) and the full signed quote as an annotation (what
+    peers actually verify)."""
     patch = quote_label_patch(quote)
     api.patch_node_labels(node_name, patch)
+    publish_quote_annotation(api, node_name, quote)
     payload = {
         "slice": quote.slice_id,
         "mode": quote.mode,
@@ -96,7 +145,8 @@ def collect_pool_quotes(api: KubeApi, selector: str) -> dict[str, dict]:
         slice_id = labels.get(SLICE_ID_LABEL) or f"node/{name}"
         entry = slices.setdefault(
             slice_id,
-            {"digest": None, "mode": None, "ts": None, "nodes": [], "missing": []},
+            {"digest": None, "mode": None, "ts": None, "nodes": [],
+             "missing": [], "quotes": {}, "node_digests": {}},
         )
         if digest is None:
             entry["missing"].append(name)
@@ -107,8 +157,72 @@ def collect_pool_quotes(api: KubeApi, selector: str) -> dict[str, dict]:
         entry["digest"] = digest if entry["digest"] in (None, digest) else "MIXED"
         entry["mode"] = mode if entry["mode"] in (None, mode) else "MIXED"
         entry["ts"] = ts if entry["ts"] is None else min(entry["ts"], ts)
+        # The full signed quote, when published: None records "labels only"
+        # so the verifier can fail signature-required pools loudly.
+        raw = node_annotations(node).get(QUOTE_FULL_ANNOTATION)
+        quote = None
+        if raw is not None:
+            try:
+                quote = deserialize_quote(raw)
+            except AttestationError as e:
+                log.warning("unparseable quote annotation on %s: %s", name, e)
+        entry["quotes"][name] = quote
+        entry["node_digests"][name] = digest
     # Slices where no host attested at all keep digest None.
     return slices
+
+
+def _peer_verify_node_quote(
+    sid: str,
+    name: str,
+    quote: AttestationQuote | None,
+    label_digest: str,
+    expected_mode: str,
+    allow_fake: bool,
+) -> list[str]:
+    """Signature-grade checks for one node's published quote: present,
+    platform signature + nonce binding verify, the signed quote names THIS
+    node's slice, signed measurements match the advertised digest labels,
+    and the runtime was actually measured."""
+    where = f"slice {sid}: node {name}"
+    if quote is None:
+        return [
+            f"{where}: digest label without a verifiable signed quote "
+            f"(annotation {QUOTE_FULL_ANNOTATION} missing or unparseable)"
+        ]
+    problems = [
+        f"{where}: {p}"
+        for p in quote_problems(
+            quote, quote.nonce, expected_mode, allow_fake=allow_fake
+        )
+    ]
+    # Slice binding: without it, a node could replay ANOTHER slice's whole
+    # evidence (labels + annotation verbatim) and pass every signature
+    # check — the signed quote must name the slice this node advertises.
+    # Skipped for the node/<name> fallback grouping (no slice label to
+    # bind against; the label alphabet can't even contain "/").
+    if not sid.startswith("node/") and label_safe(quote.slice_id) != sid:
+        problems.append(
+            f"{where}: signed quote names slice "
+            f"{label_safe(quote.slice_id)!r}, node advertises {sid!r} — "
+            "replayed evidence from another slice"
+        )
+    if quote_digest(quote) != label_digest:
+        # The label is what digest-equality compares; a signed quote that
+        # doesn't hash to it means the label claims a runtime the platform
+        # never signed for.
+        problems.append(
+            f"{where}: advertised digest label does not match the signed "
+            "quote's measurements"
+        )
+    if quote.measurements.get("runtime_files") == "0":
+        # Without this, every unmeasured host hashes the same constant and
+        # cross-slice digest equality passes vacuously (ADVICE r4 #4).
+        problems.append(
+            f"{where}: runtime was never measured (runtime_files=0: no "
+            "measure glob matched; digest equality would be vacuous)"
+        )
+    return problems
 
 
 def verify_pool_attestation(
@@ -117,8 +231,19 @@ def verify_pool_attestation(
     expected_mode: str,
     expected_slices: int | None = None,
     max_age_s: float | None = 3600.0,
+    allow_fake: bool = False,
+    verify_signatures: bool = True,
 ) -> dict[str, dict]:
-    """Check every slice attests the expected mode with one common digest.
+    """Check every slice attests the expected mode with one common digest,
+    re-verifying each node's published quote SIGNATURE — not just the
+    self-published digest labels (which anyone with node-patch RBAC could
+    forge).
+
+    ``allow_fake`` admits fake-platform quotes (HMAC, shared test key) and
+    must only be set when the pool runs the fake device layer.
+    ``verify_signatures=False`` restores the r4 digest-labels-only check
+    for clients that cannot read annotations; it downgrades the guarantee
+    from platform-signed to RBAC-trust and logs accordingly.
 
     Returns the slice map on success; raises PoolAttestationError with the
     full discrepancy list otherwise."""
@@ -128,6 +253,11 @@ def verify_pool_attestation(
         problems.append("no slice published any attestation")
     if expected_slices is not None and len(slices) != expected_slices:
         problems.append(f"expected {expected_slices} slices, found {len(slices)}")
+    if not verify_signatures:
+        log.warning(
+            "pool attestation running digest-labels-only (signature "
+            "verification disabled): label forgery is NOT detected"
+        )
     now = time.time()
     digests = set()
     for sid, entry in sorted(slices.items()):
@@ -150,6 +280,12 @@ def verify_pool_attestation(
             )
         if max_age_s is not None and now - entry["ts"] > max_age_s:
             problems.append(f"slice {sid}: quote is stale ({int(now - entry['ts'])}s)")
+        if verify_signatures:
+            for name in sorted(entry["nodes"]):
+                problems.extend(_peer_verify_node_quote(
+                    sid, name, entry["quotes"].get(name),
+                    entry["node_digests"][name], expected_mode, allow_fake,
+                ))
     if len(digests) > 1:
         problems.append(
             f"slices report {len(digests)} distinct runtime digests: "
@@ -158,8 +294,10 @@ def verify_pool_attestation(
     if problems:
         raise PoolAttestationError("; ".join(problems))
     log.info(
-        "pool attestation verified: %d slice(s), digest=%s, mode=%s",
+        "pool attestation verified: %d slice(s), digest=%s, mode=%s, "
+        "signatures=%s",
         len(slices), next(iter(digests)), expected_mode,
+        "verified" if verify_signatures else "SKIPPED",
     )
     return slices
 

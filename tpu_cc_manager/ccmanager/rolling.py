@@ -119,6 +119,13 @@ def plan_groups(
 
 
 class RollingReconfigurator:
+    # How many poll intervals a first-poll 'failed' state is presumed stale
+    # (awaiting the agent's retry) before it is believed. Long enough for a
+    # live agent to begin its apply (state leaves 'failed' on the first
+    # reconcile), short enough that a dead agent fails the group in a few
+    # polls instead of the full node timeout.
+    STALE_FAILED_GRACE_POLLS = 5
+
     def __init__(
         self,
         api: KubeApi,
@@ -310,15 +317,30 @@ class RollingReconfigurator:
         # resumed rollout onto a previously-failed node would otherwise
         # halt instantly on the leftover label instead of giving the agent
         # its retry. Such nodes stay pending until the state changes (a
-        # node that leaves 'failed' and returns to it failed freshly); an
-        # agent that never reacts is caught by the normal timeout.
+        # node that leaves 'failed' and returns to it failed freshly) — but
+        # only for a bounded grace (a few polls): an agent that is down, or
+        # re-fails without the label ever leaving 'failed' between polls,
+        # is indistinguishable from stale, and letting it consume the full
+        # node timeout turns every genuine failure on such a node into a
+        # slow one (ADVICE r4 #5). After the grace, 'failed' is believed.
         stale_failed: set[str] | None = None
+        stale_grace_deadline = (
+            time.monotonic()
+            + self.STALE_FAILED_GRACE_POLLS * self.poll_interval_s
+        )
         while pending and time.monotonic() < deadline:
             polled = self._pending_states(sorted(pending))
             if stale_failed is None:
                 stale_failed = {
                     n for n, s in polled.items() if s == STATE_FAILED
                 }
+            elif stale_failed and time.monotonic() >= stale_grace_deadline:
+                log.warning(
+                    "node(s) %s still 'failed' after the stale-failed "
+                    "grace (%d polls) — treating as genuinely failed",
+                    sorted(stale_failed), self.STALE_FAILED_GRACE_POLLS,
+                )
+                stale_failed = set()
             for name, state in polled.items():
                 if state != STATE_FAILED:
                     stale_failed.discard(name)

@@ -62,6 +62,16 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--mode", required=True)
     a.add_argument("--slices", type=int, default=None, help="expected slice count")
     a.add_argument("--max-age", type=float, default=3600.0)
+    a.add_argument(
+        "--allow-fake", action="store_true",
+        help="admit fake-platform quotes (HMAC, shared test key) — only "
+        "for pools running the fake device layer",
+    )
+    a.add_argument(
+        "--no-verify-signatures", action="store_true",
+        help="digest-labels-only check (r4 behavior): trusts node-patch "
+        "RBAC instead of platform signatures",
+    )
 
     s = sub.add_parser("status", help="per-node CC state table")
     s.add_argument("--selector", required=True)
@@ -98,6 +108,8 @@ def cmd_attest(api, args) -> int:
         verify_pool_attestation(
             api, args.selector, args.mode,
             expected_slices=args.slices, max_age_s=args.max_age,
+            allow_fake=getattr(args, "allow_fake", False),
+            verify_signatures=not getattr(args, "no_verify_signatures", False),
         )
     except PoolAttestationError as e:
         print(f"FAIL: {e}")
@@ -129,9 +141,13 @@ def cmd_status(api, args) -> int:
             notes.append(f"barrier:commit={labels[SLICE_COMMIT_LABEL]}")
         if labels.get(CC_FAILED_REASON_LABEL):
             notes.append(f"reason={labels[CC_FAILED_REASON_LABEL]}")
-        if labels.get(handshake.DRAIN_REQUESTED_LABEL):
+        token = handshake.request_token(
+            labels.get(handshake.DRAIN_REQUESTED_LABEL)
+        )
+        if token is not None:
             subs = handshake.subscriber_labels_of(labels)
-            pending = sum(1 for v in subs.values() if v != handshake.ACKED)
+            expected = handshake.ack_value(token)
+            pending = sum(1 for v in subs.values() if v != expected)
             notes.append(
                 f"drain:requested({len(subs) - pending}/{len(subs)} acked)"
             )

@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import time
 
+from tpu_cc_manager.drain import handshake
 from tpu_cc_manager.drain.pause import is_paused, pause_value, unpause_value
 from tpu_cc_manager.kubeclient.api import KubeApi, node_labels
 from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS
@@ -70,15 +71,41 @@ def evict_components(
     Returns the original label values (pass them to ``readmit_components``).
     Reference: evict_gpu_operator_components (gpu_operator_eviction.py:131-214).
     """
+    cycle = None
     if workload_ack_timeout_s > 0:
-        from tpu_cc_manager.drain import handshake
+        cycle = handshake.request_drain(api, node_name)
+    try:
+        return _evict_components_inner(
+            api, node_name, namespace, timeout_s, poll_interval_s,
+            proceed_on_timeout, workload_ack_timeout_s, cycle,
+        )
+    except Exception:
+        # The drain-request label is up but this drain is being abandoned
+        # (transport error mid-pause, strict eviction timeout, …): clear it
+        # best-effort so subscribers don't stay parked until some later
+        # reconcile happens to reach readmit_components.
+        if cycle is not None:
+            handshake.clear_drain_request(api, node_name)
+        raise
 
-        if handshake.request_drain(api, node_name):
-            handshake.await_workload_acks(
-                api, node_name,
-                timeout_s=workload_ack_timeout_s,
-                poll_interval_s=poll_interval_s,
-            )
+
+def _evict_components_inner(
+    api: KubeApi,
+    node_name: str,
+    namespace: str,
+    timeout_s: float,
+    poll_interval_s: float,
+    proceed_on_timeout: bool,
+    workload_ack_timeout_s: float,
+    cycle,
+) -> dict[str, str]:
+    if cycle is not None and cycle.subscribers:
+        handshake.await_workload_acks(
+            api, node_name,
+            timeout_s=workload_ack_timeout_s,
+            poll_interval_s=poll_interval_s,
+            token=cycle.token,
+        )
     original = fetch_component_labels(api, node_name)
     patch = {}
     for key, value in original.items():
@@ -137,8 +164,6 @@ def readmit_components(api: KubeApi, node_name: str, original: dict[str, str]) -
     unpauses labels that are still in a paused state, so a concurrent
     user edit (e.g. disabling a component mid-drain) wins.
     """
-    from tpu_cc_manager.drain import handshake
-
     labels = node_labels(api.get_node(node_name))
     current = {k: labels[k] for k in DRAIN_COMPONENT_LABELS if k in labels}
     patch: dict[str, str | None] = {}

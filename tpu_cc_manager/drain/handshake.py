@@ -14,12 +14,15 @@ Protocol, carried on node labels like everything else in this system:
    ``drain-subscriber.tpu-cc.gke.io/<job> = active`` on its node
    (:class:`DrainSubscriber`, typically from a sidecar thread).
 2. The manager, before pausing components, sets
-   ``cloud.google.com/tpu-cc.drain = requested`` and resets every
-   subscriber label to ``active`` in the same patch (stale acks from a
-   previous cycle can never satisfy this cycle's wait).
+   ``cloud.google.com/tpu-cc.drain = requested-<cycle token>`` and resets
+   every subscriber label to ``active`` in the same patch, then re-reads
+   the subscriber set (so a job registering concurrently is still
+   awaited).
 3. The subscriber sees the request, runs its ``on_drain`` callback
    (checkpoint via :class:`~tpu_cc_manager.parallel.checkpoint
-   .TrainCheckpointer`), then flips its label to ``acked``.
+   .TrainCheckpointer`), then flips its label to ``acked-<cycle token>``.
+   Acks are cycle-scoped: an in-flight ack patch from a previous cycle
+   carries the previous token and can never satisfy this cycle's wait.
 4. The manager waits — bounded, CC_DRAIN_ACK_TIMEOUT_S — for every
    subscriber to ack, then proceeds with the normal component drain.
    Timeout proceeds with a warning (the reference's lenient-drain policy,
@@ -33,9 +36,10 @@ Protocol, carried on node labels like everything else in this system:
 from __future__ import annotations
 
 import logging
+import secrets
 import threading
 import time
-from typing import Callable
+from typing import Callable, NamedTuple
 
 from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, node_labels
 from tpu_cc_manager.labels import label_safe
@@ -43,12 +47,52 @@ from tpu_cc_manager.labels import label_safe
 log = logging.getLogger(__name__)
 
 DRAIN_REQUESTED_LABEL = "cloud.google.com/tpu-cc.drain"
-DRAIN_REQUESTED = "requested"
+DRAIN_REQUESTED = "requested"  # value prefix: "requested-<cycle token>"
 SUBSCRIBER_PREFIX = "drain-subscriber.tpu-cc.gke.io/"
 ACTIVE = "active"
-ACKED = "acked"
+ACKED = "acked"  # value prefix: "acked-<cycle token>"
 
 DEFAULT_ACK_POLL_INTERVAL_S = 2.0
+# When no drain is requested, subscribers poll this many times slower —
+# fleet-wide the idle GET load is N jobs × poll rate, and the only thing an
+# idle poll can discover is a new request, which tolerates seconds of lag
+# (the manager's ack wait is bounded in tens of seconds).
+IDLE_POLL_MULTIPLIER = 5
+
+
+def new_cycle_token() -> str:
+    """A fresh per-drain-cycle token (label-value-safe hex)."""
+    return secrets.token_hex(4)
+
+
+def request_value(token: str) -> str:
+    """Drain-request label value carrying the cycle token."""
+    return f"{DRAIN_REQUESTED}-{token}" if token else DRAIN_REQUESTED
+
+
+def ack_value(token: str) -> str:
+    """The only subscriber value that satisfies cycle ``token``'s wait.
+
+    Cycle-scoped so an in-flight ack patch from the PREVIOUS cycle landing
+    after this cycle's reset can never read as a fresh checkpoint (the r4
+    stale-ack race): the old ack carries the old token.
+    """
+    return f"{ACKED}-{token}" if token else ACKED
+
+
+def request_token(value: str | None) -> str | None:
+    """Cycle token of a drain-request label value; None when no drain is
+    requested. A bare legacy ``requested`` value maps to token ''."""
+    if value is None or not value.startswith(DRAIN_REQUESTED):
+        return None
+    return value[len(DRAIN_REQUESTED) + 1:]
+
+
+class DrainCycle(NamedTuple):
+    """One published drain request: its token and the subscribers to await."""
+
+    token: str
+    subscribers: list[str]
 
 
 def subscriber_label(job_name: str) -> str:
@@ -67,22 +111,38 @@ def subscriber_labels_of(labels: dict[str, str]) -> dict[str, str]:
 # ---------------------------------------------------------------------------
 
 
-def request_drain(api: KubeApi, node_name: str) -> list[str]:
-    """Publish the drain request and reset every subscriber to ``active``.
+def request_drain(api: KubeApi, node_name: str) -> DrainCycle:
+    """Publish the drain request (with a fresh cycle token) and reset every
+    known subscriber to ``active``, in one merge-patch.
 
-    Returns the subscriber label keys that must ack this cycle. One
-    merge-patch: no window where the request is visible with a stale ack.
+    Returns the cycle token plus the subscriber keys that must ack it. The
+    subscriber set is re-read AFTER the patch (the server's view), so a job
+    registering between our read and our patch is still awaited — and the
+    cycle token means a stale ack can never satisfy the wait regardless of
+    when it lands.
     """
+    token = new_cycle_token()
     subscribers = subscriber_labels_of(node_labels(api.get_node(node_name)))
-    patch: dict[str, str] = {DRAIN_REQUESTED_LABEL: DRAIN_REQUESTED}
+    patch: dict[str, str] = {DRAIN_REQUESTED_LABEL: request_value(token)}
     patch.update({k: ACTIVE for k in subscribers})
     api.patch_node_labels(node_name, patch)
+    try:
+        subscribers = subscriber_labels_of(
+            node_labels(api.get_node(node_name))
+        )
+    except KubeApiError as e:
+        # The request IS published; a transient re-read failure must not
+        # abandon the cycle. Fall back to the pre-patch set.
+        log.warning(
+            "could not re-read subscribers on %s after drain request: %s",
+            node_name, e,
+        )
     if subscribers:
         log.info(
-            "drain requested on %s; awaiting ack from %s",
-            node_name, sorted(subscribers),
+            "drain requested on %s (cycle %s); awaiting ack from %s",
+            node_name, token, sorted(subscribers),
         )
-    return sorted(subscribers)
+    return DrainCycle(token, sorted(subscribers))
 
 
 def await_workload_acks(
@@ -90,16 +150,33 @@ def await_workload_acks(
     node_name: str,
     timeout_s: float,
     poll_interval_s: float = DEFAULT_ACK_POLL_INTERVAL_S,
+    token: str = "",
 ) -> list[str]:
-    """Wait (bounded) until every subscriber label reads ``acked``.
+    """Wait (bounded) until every subscriber label carries THIS cycle's ack.
 
     Returns the list of laggards (empty on full ack). Subscribers that
-    unregister mid-wait (their pod finished) count as done."""
+    unregister mid-wait (their pod finished) count as done.
+
+    A bare legacy ``acked`` (pre-token subscriber, versioned with the
+    training image rather than the manager DaemonSet) is accepted too so a
+    manager upgrade doesn't turn every skewed job into a full-timeout
+    laggard; only those subscribers keep the r4-size stale-ack window, and
+    only until their image catches up."""
+    expected = ack_value(token)
     deadline = time.monotonic() + timeout_s
+    legacy_warned = False
     while True:
         labels = node_labels(api.get_node(node_name))
+        subs = subscriber_labels_of(labels)
+        if not legacy_warned and any(v == ACKED for v in subs.values()):
+            log.warning(
+                "subscriber(s) %s acked with the pre-token value — "
+                "upgrade their image for cycle-scoped acks",
+                sorted(k for k, v in subs.items() if v == ACKED),
+            )
+            legacy_warned = True
         laggards = sorted(
-            k for k, v in subscriber_labels_of(labels).items() if v != ACKED
+            k for k, v in subs.items() if v not in (expected, ACKED)
         )
         if not laggards:
             return []
@@ -147,6 +224,7 @@ class DrainSubscriber:
         on_drain: Callable[[], None],
         on_resume: Callable[[], None] | None = None,
         poll_interval_s: float = DEFAULT_ACK_POLL_INTERVAL_S,
+        idle_poll_interval_s: float | None = None,
     ) -> None:
         self.api = api
         self.node_name = node_name
@@ -154,9 +232,18 @@ class DrainSubscriber:
         self.on_drain = on_drain
         self.on_resume = on_resume
         self.poll_interval_s = poll_interval_s
+        # Idle polls only need to notice a NEW request, which tolerates
+        # seconds of lag — back off so a fleet of subscribers doesn't hit
+        # the apiserver at full drain-poll rate around the clock.
+        self.idle_poll_interval_s = (
+            idle_poll_interval_s
+            if idle_poll_interval_s is not None
+            else IDLE_POLL_MULTIPLIER * poll_interval_s
+        )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._acked_this_cycle = False
+        self._acked_token: str | None = None
+        self._drain_requested = False
 
     def register(self) -> None:
         self.api.patch_node_labels(self.node_name, {self.label: ACTIVE})
@@ -168,31 +255,36 @@ class DrainSubscriber:
             log.warning("could not unregister %s: %s", self.label, e)
 
     def check_once(self) -> bool:
-        """One poll step; returns True if this cycle is acked.
+        """One poll step; returns True if the current cycle is acked.
 
-        The manager resets our label to ``active`` when it opens a cycle,
-        so ``_acked_this_cycle`` tracks OUR work while the label tracks the
-        cycle: a second request after a crash-restart of the manager re-runs
-        the callback (checkpointing twice is safe; not checkpointing is not).
+        The cycle is identified by the token in the drain-request label:
+        ``_acked_token`` tracks which cycle OUR checkpoint served, so a new
+        request (fresh token — e.g. after a crash-restart of the manager)
+        re-runs the callback (checkpointing twice is safe; not
+        checkpointing is not), while re-polling one cycle is idempotent.
         """
         labels = node_labels(self.api.get_node(self.node_name))
-        requested = labels.get(DRAIN_REQUESTED_LABEL) == DRAIN_REQUESTED
-        ours = labels.get(self.label)
-        if not requested:
-            if self._acked_this_cycle:
-                self._acked_this_cycle = False
+        token = request_token(labels.get(DRAIN_REQUESTED_LABEL))
+        self._drain_requested = token is not None
+        if token is None:
+            if self._acked_token is not None:
+                self._acked_token = None
                 if self.on_resume is not None:
                     self.on_resume()
             return False
-        if ours == ACKED and self._acked_this_cycle:
+        if self._acked_token == token and labels.get(self.label) == ack_value(token):
             return True
         # Drain requested and we have not acked this cycle: checkpoint,
-        # then ack. A callback failure leaves us un-acked — the manager's
-        # bounded wait will proceed without us and the failure is loud here.
+        # then ack with the cycle's token. A callback failure leaves us
+        # un-acked — the manager's bounded wait will proceed without us and
+        # the failure is loud here.
         self.on_drain()
-        self.api.patch_node_labels(self.node_name, {self.label: ACKED})
-        self._acked_this_cycle = True
-        log.info("drain ack published for %s on %s", self.label, self.node_name)
+        self.api.patch_node_labels(self.node_name, {self.label: ack_value(token)})
+        self._acked_token = token
+        log.info(
+            "drain ack published for %s on %s (cycle %s)",
+            self.label, self.node_name, token,
+        )
         return True
 
     def run(self) -> None:
@@ -203,7 +295,11 @@ class DrainSubscriber:
                     self.check_once()
                 except KubeApiError as e:
                     log.warning("drain subscriber poll failed: %s", e)
-                self._stop.wait(self.poll_interval_s)
+                self._stop.wait(
+                    self.poll_interval_s
+                    if self._drain_requested
+                    else self.idle_poll_interval_s
+                )
         finally:
             self.unregister()
 

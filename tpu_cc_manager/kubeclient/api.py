@@ -32,6 +32,11 @@ class WatchEvent:
     object: dict
 
 
+def node_annotations(node: dict) -> dict:
+    """metadata.annotations of a node object (never None)."""
+    return (node.get("metadata") or {}).get("annotations") or {}
+
+
 def node_labels(node: dict) -> dict:
     """Labels of a node dict ({} if unset)."""
     return (node.get("metadata") or {}).get("labels") or {}
@@ -55,6 +60,19 @@ class KubeApi(abc.ABC):
         A ``None`` value deletes the label (merge-patch semantics). Returns
         the patched node. This deliberately never writes anything but labels
         (SURVEY.md §8.3)."""
+
+    def patch_node_annotations(
+        self, name: str, annotations: Mapping[str, str | None]
+    ) -> dict:
+        """JSON merge-patch {"metadata": {"annotations": ...}} onto the node.
+
+        Annotations carry payloads too large for label values (the signed
+        attestation quote, ccmanager/multislice.py); a ``None`` value
+        deletes. Optional capability — the default raises KubeApiError so
+        callers degrade cleanly on clients without it."""
+        raise KubeApiError(
+            None, "annotation patching not supported by this client"
+        )
 
     @abc.abstractmethod
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
@@ -96,3 +114,15 @@ class KubeApi(abc.ABC):
         control-plane state). Not retried on failure: POST is not
         idempotent and a lost event is acceptable."""
         raise KubeApiError(None, "event creation not supported by this client")
+
+    def self_subject_access_review(
+        self, verb: str, resource: str, namespace: str | None = None
+    ) -> bool:
+        """Whether THIS identity may perform verb on resource (SSAR).
+
+        Optional capability with a clean failure mode (``tpu-cc-ctl
+        rbac-check`` reports it instead of crashing on AttributeError);
+        RestKube implements the real apiserver call."""
+        raise KubeApiError(
+            None, "SelfSubjectAccessReview not supported by this client"
+        )

@@ -188,6 +188,24 @@ class FakeKube(KubeApi):
             reactor(name, snapshot)
         return snapshot
 
+    def patch_node_annotations(
+        self, name: str, annotations: Mapping[str, str | None]
+    ) -> dict:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise KubeApiError(404, f"node {name} not found")
+            current = node["metadata"].setdefault("annotations", {})
+            for k, v in annotations.items():
+                if v is None:
+                    current.pop(k, None)
+                else:
+                    current[k] = str(v)
+            self._rv += 1
+            node["metadata"]["resourceVersion"] = str(self._rv)
+            self._record_event("MODIFIED", node)
+            return copy.deepcopy(node)
+
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         with self._lock:
             return [
@@ -216,6 +234,16 @@ class FakeKube(KubeApi):
         with self._lock:
             self.events.append({"namespace": namespace, **copy.deepcopy(event)})
             return copy.deepcopy(event)
+
+    def self_subject_access_review(
+        self, verb: str, resource: str, namespace: str | None = None
+    ) -> bool:
+        """Grants everything unless the test narrows it via ``rbac_rules``
+        (a dict of (verb, resource) -> bool set on the instance)."""
+        rules = getattr(self, "rbac_rules", None)
+        if rules is None:
+            return True
+        return bool(rules.get((verb, resource), False))
 
     def watch_nodes(
         self,

@@ -220,6 +220,16 @@ class RestKube(KubeApi):
             content_type="application/merge-patch+json",
         )
 
+    def patch_node_annotations(
+        self, name: str, annotations: Mapping[str, str | None]
+    ) -> dict:
+        return self._request_json(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            body={"metadata": {"annotations": dict(annotations)}},
+            content_type="application/merge-patch+json",
+        )
+
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         query: dict = {}
         if label_selector:

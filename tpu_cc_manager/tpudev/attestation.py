@@ -140,24 +140,17 @@ def _check_tsm_binding(quote: AttestationQuote, nonce: str) -> list[str]:
     return []
 
 
-def verify_quote(
+def quote_problems(
     quote: AttestationQuote,
     nonce: str,
     expected_mode: str,
     expected_slice_id: str | None = None,
-    debug_policy: bool = False,
     allow_fake: bool = False,
 ) -> list[str]:
-    """Verify a quote; returns the (possibly empty) problem list.
-
-    Raises AttestationError on any problem unless ``debug_policy`` is set
-    (devtools mode), in which case problems are logged and returned.
-
-    ``allow_fake`` admits ``platform="fake"`` quotes (HMAC with the shared
-    test key). The manager enables it only when the operator explicitly
-    selected the fake device layer; everywhere else a fake-platform quote
-    is an attack, not a test.
-    """
+    """All the checks of :func:`verify_quote`, returned as a problem list
+    with no policy attached — the shared core for the local verify phase
+    (raise/log per devtools policy) and for pool peer-verification, which
+    aggregates problems across nodes (ccmanager/multislice.py)."""
     problems: list[str] = []
     if quote.platform == "fake" and not allow_fake:
         problems.append(
@@ -181,7 +174,31 @@ def verify_quote(
         problems.append(f"unknown quote platform {quote.platform!r}")
     else:
         problems.extend(checker(quote))
+    return problems
 
+
+def verify_quote(
+    quote: AttestationQuote,
+    nonce: str,
+    expected_mode: str,
+    expected_slice_id: str | None = None,
+    debug_policy: bool = False,
+    allow_fake: bool = False,
+) -> list[str]:
+    """Verify a quote; returns the (possibly empty) problem list.
+
+    Raises AttestationError on any problem unless ``debug_policy`` is set
+    (devtools mode), in which case problems are logged and returned.
+
+    ``allow_fake`` admits ``platform="fake"`` quotes (HMAC with the shared
+    test key). The manager enables it only when the operator explicitly
+    selected the fake device layer; everywhere else a fake-platform quote
+    is an attack, not a test.
+    """
+    problems = quote_problems(
+        quote, nonce, expected_mode,
+        expected_slice_id=expected_slice_id, allow_fake=allow_fake,
+    )
     if problems:
         if debug_policy:
             for p in problems:
@@ -196,6 +213,48 @@ def verify_quote(
             quote.measurements.get("runtime_digest", "")[:12],
         )
     return problems
+
+
+def serialize_quote(quote: AttestationQuote) -> str:
+    """Compact JSON of the full quote — signature included — for transport
+    in a node annotation, so PEERS can re-verify the platform signature
+    instead of trusting a self-published digest label
+    (ccmanager/multislice.py; the reference's read-truth-back principle,
+    /root/reference/main.py:524-528)."""
+    return json.dumps(
+        {
+            "slice_id": quote.slice_id,
+            "nonce": quote.nonce,
+            "mode": quote.mode,
+            "measurements": quote.measurements,
+            "signature": quote.signature,
+            "platform": quote.platform,
+            "host_evidence": quote.host_evidence,
+        },
+        sort_keys=True, separators=(",", ":"),
+    )
+
+
+def deserialize_quote(data: str) -> AttestationQuote:
+    """Inverse of :func:`serialize_quote`. Raises AttestationError on any
+    shape problem — an unparseable published quote is an attestation
+    failure, not a crash."""
+    try:
+        obj = json.loads(data)
+        return AttestationQuote(
+            slice_id=str(obj["slice_id"]),
+            nonce=str(obj["nonce"]),
+            mode=str(obj["mode"]),
+            measurements={str(k): str(v) for k, v in obj["measurements"].items()},
+            signature=str(obj["signature"]),
+            platform=str(obj["platform"]),
+            host_evidence={
+                str(k): str(v)
+                for k, v in (obj.get("host_evidence") or {}).items()
+            },
+        )
+    except (ValueError, KeyError, TypeError, AttributeError) as e:
+        raise AttestationError(f"undeserializable quote: {e}") from e
 
 
 def quote_digest(quote: AttestationQuote) -> str:

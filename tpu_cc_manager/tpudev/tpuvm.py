@@ -467,7 +467,18 @@ class TpuVmBackend(TpuCcBackend):
         if not self.runtime_env_file or not pending:
             return
         modes = sorted(set(pending.values()))
-        mode = modes[0] if len(modes) == 1 else MODE_OFF
+        if len(modes) != 1:
+            # The manager stages one mode per apply, so mixed pending modes
+            # mean a caller bug or corrupted pending state. The runtime env
+            # is host-global — silently writing one chip's mode (or 'off')
+            # would commit a runtime config that doesn't match what half the
+            # chips staged, then attest it. Refuse instead; pending markers
+            # stay and the reconcile retries from a clean stage.
+            raise TpuError(
+                f"mixed modes staged across chips: {modes}; refusing to "
+                "write a single host-global runtime env"
+            )
+        mode = modes[0]
         path = _host_path(self.runtime_env_file)
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
