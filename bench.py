@@ -37,26 +37,45 @@ import threading
 import time
 
 
-def _tpu_preflight(timeout_s: float = 90.0) -> bool:
-    """Probe the accelerator OUTSIDE the timed region.
+def _tpu_preflight(
+    timeout_s: float = 90.0, attempts: int = 3, backoff_s: float = 20.0
+) -> bool:
+    """Probe the accelerator OUTSIDE the timed region, with bounded retry.
 
     A wedged TPU transport hangs dispatches without erroring; discovering
     that inside the timed reconcile would charge the hang + CPU retry to
     the drain→ready metric. Probe in a child process first and pin the
     smoke to CPU when the chip isn't usable.
+
+    One failed probe is not proof the chip is gone — the tunnel's dispatch
+    latency is erratic (12-75 s observed for identical work) and a single
+    slow window at the wrong moment would silently degrade a whole round's
+    evidence to CPU (this happened to every driver-run bench r1-r4). Retry
+    with a pause between attempts; give up only when ``attempts`` probes
+    in a row failed. Each probe is its own child process, so a hung
+    attempt is abandoned, not killed mid-dispatch in-process.
     """
     probe = (
         "import jax, jax.numpy as jnp;"
         "print(float(jax.jit(lambda x: (x @ x).sum())(jnp.ones((128, 128)))))"
     )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", probe], capture_output=True,
-            timeout=timeout_s, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return proc.returncode == 0
+    for attempt in range(max(1, attempts)):
+        if attempt:
+            print(
+                f"# tpu preflight attempt {attempt} failed; retrying in "
+                f"{backoff_s:.0f}s", file=sys.stderr,
+            )
+            time.sleep(backoff_s)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", probe], capture_output=True,
+                timeout=timeout_s, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        if proc.returncode == 0:
+            return True
+    return False
 
 
 def _smoke_subprocess(workload: str, timeout_s: float, force_cpu: bool) -> dict:

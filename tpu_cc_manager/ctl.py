@@ -146,8 +146,10 @@ def cmd_status(api, args) -> int:
         )
         if token is not None:
             subs = handshake.subscriber_labels_of(labels)
-            expected = handshake.ack_value(token)
-            pending = sum(1 for v in subs.values() if v != expected)
+            # Same acceptance predicate as await_workload_acks: this
+            # cycle's token OR the legacy bare ack (version-skewed job).
+            accepted = (handshake.ack_value(token), handshake.ACKED)
+            pending = sum(1 for v in subs.values() if v not in accepted)
             notes.append(
                 f"drain:requested({len(subs) - pending}/{len(subs)} acked)"
             )

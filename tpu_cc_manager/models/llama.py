@@ -93,6 +93,29 @@ class LlamaConfig:
                                        rope_scaling=(8.0, 1.0, 4.0, 8192)), **kw})
 
     @classmethod
+    def llama3_2_1b(cls, **kw) -> "LlamaConfig":
+        """Llama-3.2-1B geometry (~1.2B params): bf16 fits any TPU chip
+        with room for cache and activations."""
+        return cls(**{**dict(vocab_size=128256, dim=2048, n_layers=16,
+                             n_heads=32, n_kv_heads=8, hidden_dim=8192,
+                             max_seq_len=131072, rope_theta=500000.0,
+                             rope_scaling=(32.0, 1.0, 4.0, 8192)), **kw})
+
+    @classmethod
+    def llama3_2_3b(cls, **kw) -> "LlamaConfig":
+        """Llama-3.2-3B geometry (~3.2B params): the largest family member
+        that fits single-chip v5e (16 GB HBM) in bf16 with real headroom —
+        ~6.4 GB of weights leaves ~9 GB for KV cache + activations.
+        (Llama-2-7B bf16 is ~13.5 GB of weights alone: it loads on v5e
+        only with a sliver of cache headroom, and Llama-3-8B's 128k vocab
+        pushes past 16 GB — the single-chip ceiling BASELINE configs[2]
+        runs into; multi-chip tp is the path for those.)"""
+        return cls(**{**dict(vocab_size=128256, dim=3072, n_layers=28,
+                             n_heads=24, n_kv_heads=8, hidden_dim=8192,
+                             max_seq_len=131072, rope_theta=500000.0,
+                             rope_scaling=(32.0, 1.0, 4.0, 8192)), **kw})
+
+    @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
         """CI/test config: ~1M params, same code paths."""
         return cls(**{**dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,

@@ -37,6 +37,8 @@ def _pick_config(size: str | None):
     table = {
         "tiny": LlamaConfig.tiny,
         "500m": LlamaConfig.smoke_500m,
+        "llama3.2-1b": LlamaConfig.llama3_2_1b,
+        "llama3.2-3b": LlamaConfig.llama3_2_3b,
         "llama2-7b": LlamaConfig.llama2_7b,
         "llama3-8b": LlamaConfig.llama3_8b,
         "llama3.1-8b": LlamaConfig.llama3_1_8b,
@@ -257,6 +259,49 @@ def run(
         per_step = diff / (hi - lo) if timing_valid else None
         dt = per_step * decode_len if timing_valid else None
 
+        # --- prefill throughput ------------------------------------------
+        # Decode is bandwidth-bound (every weight read per token); PREFILL
+        # is the MXU-bound half of inference — the whole prompt in one
+        # batched forward — so its utilization is reported as MFU, the
+        # honest denominator for "is the matmul path healthy". Same
+        # differential-timing trick: two prompt lengths, the difference
+        # cancels dispatch + readback overhead. Lengths are fixed (not the
+        # oracle's prompt_len) so the measurement has enough tokens to
+        # register against a fast MXU.
+        p_hi = min(512, cfg.max_seq_len // 2)
+        p_lo = max(16, p_hi // 4)
+        prefill_tokens_per_sec = None
+        if p_hi > p_lo:
+            pf_prompt = jax.random.randint(
+                key, (batch, p_hi), 0, cfg.vocab_size
+            )
+
+            @jax.jit  # no donation: caches are re-used across timed reps
+            def prefill_timed(variables, prompt, cache):
+                logits, _ = model.apply(
+                    variables, prompt, cache=cache, position=0
+                )
+                return jnp.argmax(logits[:, -1], axis=-1)
+
+            pf_cache_hi = model.init_cache(batch, p_hi)
+            pf_cache_lo = model.init_cache(batch, p_lo)
+
+            def _timed_prefill(prompt, cache, reps: int = 3) -> float:
+                _sync(prefill_timed(variables, prompt, cache))
+                times = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    _sync(prefill_timed(variables, prompt, cache))
+                    times.append(time.perf_counter() - t0)
+                return statistics.median(times)
+
+            pf_diff = (
+                _timed_prefill(pf_prompt, pf_cache_hi)
+                - _timed_prefill(pf_prompt[:, :p_lo], pf_cache_lo)
+            )
+            if pf_diff > 0:
+                prefill_tokens_per_sec = batch * (p_hi - p_lo) / pf_diff
+
     tokens_per_sec = batch * decode_len / dt if timing_valid else None
 
     # Utilization accounting. Decode FLOPs/token ≈ 2·params (each weight
@@ -268,12 +313,17 @@ def run(
     # meaningful, so CPU runs report None.
     backend = jax.default_backend()
     generation = generation_for(backend)
-    mfu = hbm_util = None
+    mfu = hbm_util = prefill_mfu = None
     if timing_valid and generation is not None:
         flops_per_sec = 2.0 * cfg.param_count() * tokens_per_sec
         mfu = flops_per_sec / (peak_flops_per_chip() * n_dev)
         bytes_per_sec = 2.0 * cfg.param_count() * (tokens_per_sec / batch)
         hbm_util = bytes_per_sec / (peak_hbm_bytes_per_chip() * n_dev)
+    if prefill_tokens_per_sec is not None and generation is not None:
+        prefill_mfu = (
+            2.0 * cfg.param_count() * prefill_tokens_per_sec
+            / (peak_flops_per_chip() * n_dev)
+        )
     return {
         "ok": oracle_ok,
         "workload": "llama",
@@ -289,6 +339,13 @@ def run(
         "ms_per_token": round(1e3 * dt / decode_len, 3) if timing_valid else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_bw_util": round(hbm_util, 4) if hbm_util is not None else None,
+        "prefill_tokens_per_sec": (
+            round(prefill_tokens_per_sec, 2)
+            if prefill_tokens_per_sec is not None else None
+        ),
+        "prefill_mfu": (
+            round(prefill_mfu, 4) if prefill_mfu is not None else None
+        ),
         "oracle_ok": oracle_ok,
         "transcript_ok": transcript_ok,
         "transcript_positions": int(oracle_decode),

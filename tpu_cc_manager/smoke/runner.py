@@ -52,6 +52,7 @@ def run_workload_subprocess(
     timeout_s: float = 900.0,
     force_cpu: bool = False,
     cwd: str | None = None,
+    extra_args: list[str] | None = None,
 ) -> dict:
     """Run a workload as ``python -m tpu_cc_manager.smoke`` and parse the
     final JSON line from its stdout.
@@ -72,6 +73,8 @@ def run_workload_subprocess(
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
     cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", name]
+    if extra_args:
+        cmd.extend(extra_args)
     log.info("running smoke workload: %s", " ".join(cmd))
     try:
         proc = subprocess.run(
