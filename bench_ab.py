@@ -166,6 +166,8 @@ def main() -> int:
     # BOTH arms instead of biasing whichever arm ran last. The median
     # across a mode's samples is compared (best-of rewards lucky outliers;
     # the median is what more reps actually stabilizes).
+    from tpu_cc_manager.smoke.runner import SmokeError
+
     samples: dict[str, dict[str, list]] = {
         w: {"off": [], "on": []} for w in workloads
     }
@@ -173,17 +175,37 @@ def main() -> int:
     wall: dict[str, dict[str, float]] = {
         w: {"off": 0.0, "on": 0.0} for w in workloads
     }
+    errors: dict[str, list[str]] = {w: [] for w in workloads}
+    # A rep that dies (timeout, wedged tunnel, crash) must not discard the
+    # samples already banked across earlier cycles — record the error and
+    # keep going. But a DEAD backend makes every further rep cost the full
+    # timeout, so a workload that fails twice in a row is retired for the
+    # rest of the run; its arms report whatever was measured.
+    MAX_CONSECUTIVE_FAILURES = 2
+    retired: set[str] = set()
+    consecutive_failures: dict[str, int] = {w: 0 for w in workloads}
     for _cycle in range(max(1, args.cycles)):
         for mode in ("off", "on"):
             drive_mode(mgr, kube, node, mode)
             for w in workloads:
+                if w in retired:
+                    continue
                 t0 = time.perf_counter()
                 field = THROUGHPUT_FIELD.get(w)
                 for _ in range(max(1, args.reps)):
-                    result = _smoke_subprocess(
-                        w, args.timeout_s, force_cpu=args.cpu,
-                        extra_args=extra_for.get(w) or None,
-                    )
+                    try:
+                        result = _smoke_subprocess(
+                            w, args.timeout_s, force_cpu=args.cpu,
+                            extra_args=extra_for.get(w) or None,
+                        )
+                    except SmokeError as e:
+                        errors[w].append(str(e))
+                        consecutive_failures[w] += 1
+                        if consecutive_failures[w] >= MAX_CONSECUTIVE_FAILURES:
+                            retired.add(w)
+                            break
+                        continue
+                    consecutive_failures[w] = 0
                     tp = result.get(field)
                     if tp:
                         samples[w][mode].append(
@@ -219,9 +241,15 @@ def main() -> int:
                 "hbm_bw_util": got[med_i][2] if got else None,
                 "backend": last.get("backend"),
                 "generation": last.get("generation"),
-                "reps": n_samples,
+                # Accepted samples, which is what the median is over —
+                # planned count rides along so shortfalls are visible.
+                "reps": len(got),
+                "planned_reps": n_samples,
                 "wall_seconds": round(wall[w][mode], 2),
             }
+        if errors[w]:
+            per_workload[w]["errors"] = errors[w]
+            per_workload[w]["retired_early"] = w in retired
 
     worst_loss_pct = 0.0
     measured_any = False

@@ -245,14 +245,26 @@ def run(
         def _sync(x):
             return float(jnp.sum(x[:1]))
 
-        def _timed(steps: int, reps: int = 3) -> float:
-            _sync(greedy_decode_n(variables, tok, cache, prompt_len, steps))
+        def _timed_call(thunk, reps: int = 3) -> float:
+            """Warmup + median-of-reps wall time of ``thunk`` (which must
+            sync via a host readback — on the tunnel backend
+            block_until_ready can return before the work retires). One
+            helper for decode AND prefill so the two numbers can never
+            follow different timing methodologies."""
+            thunk()
             times = []
             for _ in range(reps):
                 t0 = time.perf_counter()
-                _sync(greedy_decode_n(variables, tok, cache, prompt_len, steps))
+                thunk()
                 times.append(time.perf_counter() - t0)
             return statistics.median(times)
+
+        def _timed(steps: int) -> float:
+            return _timed_call(
+                lambda: _sync(
+                    greedy_decode_n(variables, tok, cache, prompt_len, steps)
+                )
+            )
 
         diff = _timed(hi) - _timed(lo)
         timing_valid = diff > 0 and hi > lo
@@ -285,19 +297,14 @@ def run(
 
             pf_cache_hi = model.init_cache(batch, p_hi)
             pf_cache_lo = model.init_cache(batch, p_lo)
-
-            def _timed_prefill(prompt, cache, reps: int = 3) -> float:
-                _sync(prefill_timed(variables, prompt, cache))
-                times = []
-                for _ in range(reps):
-                    t0 = time.perf_counter()
-                    _sync(prefill_timed(variables, prompt, cache))
-                    times.append(time.perf_counter() - t0)
-                return statistics.median(times)
-
+            pf_short = pf_prompt[:, :p_lo]
             pf_diff = (
-                _timed_prefill(pf_prompt, pf_cache_hi)
-                - _timed_prefill(pf_prompt[:, :p_lo], pf_cache_lo)
+                _timed_call(
+                    lambda: _sync(prefill_timed(variables, pf_prompt, pf_cache_hi))
+                )
+                - _timed_call(
+                    lambda: _sync(prefill_timed(variables, pf_short, pf_cache_lo))
+                )
             )
             if pf_diff > 0:
                 prefill_tokens_per_sec = batch * (p_hi - p_lo) / pf_diff
