@@ -1,7 +1,7 @@
 # Version pins for the image build (reference analogue: versions.mk).
 # Keep VERSION in lockstep with tpu_cc_manager/version.py.
 
-VERSION := 0.2.0
+VERSION := 0.3.0
 
 PYTHON_VERSION := 3.12
 JAX_VERSION := 0.9.0

@@ -3,4 +3,4 @@
 Makefile pins the same value in deployments/container/versions.mk, mirroring
 the reference's versions.mk:15)."""
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
