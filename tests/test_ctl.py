@@ -100,6 +100,68 @@ def test_rbac_check_base_client_raises_cleanly():
         Minimal().self_subject_access_review("get", "nodes")
 
 
+def test_drain_subscribe_sidecar(fake_kube, tmp_path):
+    """The code-free handshake sidecar: a drain request runs the
+    checkpoint command, acks with the cycle token, and the request
+    clearing runs the resume command; SIGTERM-equivalent stop
+    unregisters."""
+    import threading
+    import time
+
+    from tpu_cc_manager.drain import handshake
+    from tpu_cc_manager.kubeclient.api import node_labels
+
+    fake_kube.add_node("n0")
+    marker = tmp_path / "ckpt"
+    resume_marker = tmp_path / "resumed"
+    args = ns(
+        job="side-job", node="n0",
+        on_drain=f"touch {marker}",
+        on_resume=f"touch {resume_marker}",
+        poll_interval=0.01,
+    )
+    t = threading.Thread(
+        target=ctl.cmd_drain_subscribe, args=(fake_kube, args), daemon=True
+    )
+    t.start()
+    try:
+        sub_label = handshake.subscriber_label("side-job")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if sub_label in node_labels(fake_kube.get_node("n0")):
+                break
+            time.sleep(0.01)
+        cycle = handshake.request_drain(fake_kube, "n0")
+        assert handshake.await_workload_acks(
+            fake_kube, "n0", timeout_s=5, poll_interval_s=0.01,
+            token=cycle.token,
+        ) == []
+        assert marker.exists()  # the checkpoint command actually ran
+        handshake.clear_drain_request(fake_kube, "n0")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not resume_marker.exists():
+            time.sleep(0.01)
+        assert resume_marker.exists()
+    finally:
+        # What the SIGTERM handler does in a real pod shutdown.
+        args.subscriber.stop(timeout_s=0)
+        t.join(timeout=5)
+    assert not t.is_alive()
+    # Clean exit unregistered the subscriber: no ghost for the manager.
+    assert sub_label not in node_labels(fake_kube.get_node("n0"))
+
+
+def test_drain_subscribe_requires_node(fake_kube, monkeypatch):
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    import pytest
+
+    with pytest.raises(ValueError):
+        ctl.cmd_drain_subscribe(
+            fake_kube, ns(job="j", node=None, on_drain="true",
+                          on_resume=None, poll_interval=0.01)
+        )
+
+
 def test_rollout_command(fake_kube, capsys):
     fake_kube.add_node("n0", {"pool": "tpu"})
 

@@ -281,6 +281,65 @@ def test_subscriber_survives_transient_api_errors(fake_kube):
         sub.stop()
 
 
+def test_subscriber_survives_callback_failure(fake_kube):
+    """A failing checkpoint callback must not kill the subscriber thread:
+    it stays registered and un-acked, and the next poll retries (a disk
+    hiccup mid-checkpoint is transient; dying would also unregister and
+    silently drop the job from every future cycle)."""
+    fake_kube.add_node(NODE)
+    attempts = {"n": 0}
+    acked = threading.Event()
+
+    def flaky_checkpoint():
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise OSError("disk hiccup mid-checkpoint")
+        acked.set()
+
+    sub = handshake.DrainSubscriber(
+        fake_kube, NODE, "flaky-ckpt", on_drain=flaky_checkpoint,
+        poll_interval_s=0.01,
+    )
+    sub.start()
+    try:
+        cycle = handshake.request_drain(fake_kube, NODE)
+        assert handshake.await_workload_acks(
+            fake_kube, NODE, timeout_s=5, poll_interval_s=0.01,
+            token=cycle.token,
+        ) == []
+        assert acked.is_set()
+        assert attempts["n"] == 2  # first failed, retry succeeded
+    finally:
+        sub.stop()
+
+
+def test_failed_resume_callback_is_retried(fake_kube):
+    """A transiently-failing on_resume is retried on the next poll, not
+    silently dropped: the cycle memory clears only after resume succeeds."""
+    fake_kube.add_node(NODE)
+    resumed = {"attempts": 0}
+
+    def flaky_resume():
+        resumed["attempts"] += 1
+        if resumed["attempts"] == 1:
+            raise OSError("notify endpoint hiccup")
+
+    sub = handshake.DrainSubscriber(
+        fake_kube, NODE, "resume-retry", on_drain=lambda: None,
+        on_resume=flaky_resume, poll_interval_s=0.01,
+    )
+    sub.register()
+    cycle = handshake.request_drain(fake_kube, NODE)
+    assert sub.check_once() is True  # checkpoint + ack
+    handshake.clear_drain_request(fake_kube, NODE)
+    with pytest.raises(OSError):
+        sub.check_once()  # resume fails once...
+    assert sub._acked_token == cycle.token  # ...cycle NOT forgotten
+    sub.check_once()
+    assert resumed["attempts"] == 2  # ...so the next poll retried it
+    assert sub._acked_token is None
+
+
 def test_wedged_job_cannot_veto_the_drain(fake_kube):
     """A registered subscriber that never acks delays the drain by at most
     the bounded ack timeout (lenient policy, SURVEY.md §8.5)."""

@@ -480,7 +480,10 @@ class CCManager:
                 f"strict eviction timed out before mode {mode}: {e}",
             )
             try:
-                state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
+                state.set_cc_state_label(
+                    self.api, self.node_name, STATE_FAILED,
+                    reason="drain-timeout",
+                )
             finally:
                 # Re-admit even if the state-label patch itself fails —
                 # components must never stay paused behind a failed toggle.
@@ -555,7 +558,10 @@ class CCManager:
                 # This host is about to re-admit components, so "staged and
                 # drained" no longer describes it: withdraw from the barrier.
                 barrier.abort()
-            state.set_cc_state_label(self.api, self.node_name, STATE_FAILED)
+            state.set_cc_state_label(
+                self.api, self.node_name, STATE_FAILED,
+                reason=self._failure_reason(e),
+            )
             self._emit_node_event(
                 "Warning", "CCModeFailed", f"CC mode change to {mode} failed: {e}"
             )
@@ -573,6 +579,25 @@ class CCManager:
             f"CC mode {mode} applied and verified on {len(chips)} chip(s)",
         )
         return True
+
+    @staticmethod
+    def _failure_reason(e: Exception) -> str:
+        """Machine-readable failed.reason for an apply/verify failure.
+
+        Every ``failed`` state carries a reason (the stateful property
+        test's invariant — an operator staring at ``failed`` with no
+        reason has only the logs, which a label watcher never sees)."""
+        from tpu_cc_manager.smoke.runner import SmokeError
+
+        if isinstance(e, slicecoord.BarrierTimeout):
+            return "barrier-timeout"
+        if isinstance(e, attestation.AttestationError):
+            return "attestation-failed"
+        if isinstance(e, SmokeError):
+            return "smoke-failed"
+        if isinstance(e, KubeApiError):
+            return "apiserver-error"
+        return "apply-failed"
 
     def _publish_coordination_labels(self, topo: SliceTopology, quote) -> None:
         """Advertise slice membership + attestation digest on the node so the

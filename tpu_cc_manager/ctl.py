@@ -85,6 +85,39 @@ def build_parser() -> argparse.ArgumentParser:
         "--namespace", default="tpu-operator",
         help="operator namespace for the pod-list check",
     )
+
+    dsub = sub.add_parser(
+        "drain-subscribe",
+        help="sidecar: join the workload drain handshake without writing "
+        "code — runs a checkpoint command when the node's manager "
+        "requests a drain, then acks (drain/handshake.py)",
+    )
+    dsub.add_argument(
+        "--job", required=True,
+        help="job name for the subscriber label (label-sanitized)",
+    )
+    dsub.add_argument(
+        "--node", default=None,
+        help="node to watch (default: $NODE_NAME, the downward-API env "
+        "every pod spec can set)",
+    )
+    dsub.add_argument(
+        "--on-drain", required=True, metavar="CMD",
+        help="shell command that durably checkpoints the job; exit 0 "
+        "publishes the ack, non-zero is retried next poll",
+    )
+    dsub.add_argument(
+        "--on-resume", default=None, metavar="CMD",
+        help="optional shell command run when the drain request clears",
+    )
+    from tpu_cc_manager.drain.handshake import DEFAULT_ACK_POLL_INTERVAL_S
+
+    dsub.add_argument(
+        "--poll-interval", type=float,
+        default=DEFAULT_ACK_POLL_INTERVAL_S,
+        help="seconds between node polls during a drain "
+        "(idle polls back off 5x)",
+    )
     return p
 
 
@@ -194,6 +227,70 @@ def cmd_rbac_check(api, args) -> int:
     return 0 if ok else 1
 
 
+def cmd_drain_subscribe(api, args) -> int:
+    """Foreground sidecar process for the drain handshake: the pod's
+    checkpoint command becomes the on_drain callback. SIGTERM/SIGINT
+    unregister cleanly (pod shutdown must not leave a ghost subscriber
+    the manager would wait on)."""
+    import os
+    import signal
+    import subprocess
+
+    from tpu_cc_manager.drain.handshake import DrainSubscriber
+
+    node = args.node or os.environ.get("NODE_NAME")
+    if not node:
+        raise ValueError("--node or $NODE_NAME is required")
+
+    current: dict = {"proc": None}
+
+    def run_cmd(cmd: str) -> None:
+        log.info("running: %s", cmd)
+        proc = subprocess.Popen(cmd, shell=True)
+        current["proc"] = proc
+        try:
+            rc = proc.wait()
+        finally:
+            current["proc"] = None
+        if rc != 0:
+            raise subprocess.CalledProcessError(rc, cmd)
+
+    sub = DrainSubscriber(
+        api, node, args.job,
+        on_drain=lambda: run_cmd(args.on_drain),
+        on_resume=(
+            (lambda: run_cmd(args.on_resume)) if args.on_resume else None
+        ),
+        poll_interval_s=args.poll_interval,
+    )
+    args.subscriber = sub  # handle for callers/tests to stop() us
+
+    def _shutdown(*_):
+        # Also SIGTERM an in-flight checkpoint command: run() is blocked in
+        # its wait, and the pod's grace period is ticking — if we merely set
+        # the stop flag, kubelet SIGKILLs us before the unregister in
+        # run()'s finally, leaving a ghost subscriber every future drain
+        # would wait on.
+        sub.stop(timeout_s=0)
+        proc = current.get("proc")
+        if proc is not None:
+            proc.terminate()
+
+    import threading
+
+    if threading.current_thread() is threading.main_thread():
+        # Signal handlers only exist on the main thread (tests drive this
+        # command from a worker thread and stop via args.subscriber).
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _shutdown)
+    log.info(
+        "drain subscriber %s watching node %s (ctrl-c / SIGTERM to leave)",
+        sub.label, node,
+    )
+    sub.run()  # blocks; registers on entry, unregisters on the way out
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(debug=args.debug)
@@ -210,6 +307,7 @@ def main(argv: list[str] | None = None) -> int:
             "attest": cmd_attest,
             "status": cmd_status,
             "rbac-check": cmd_rbac_check,
+            "drain-subscribe": cmd_drain_subscribe,
         }[args.command](api, args)
     except ValueError as e:
         log.error("usage error: %s", e)

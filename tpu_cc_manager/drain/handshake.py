@@ -268,9 +268,12 @@ class DrainSubscriber:
         self._drain_requested = token is not None
         if token is None:
             if self._acked_token is not None:
-                self._acked_token = None
+                # Clear the cycle only AFTER on_resume succeeds: a failing
+                # resume callback leaves _acked_token set, so the next poll
+                # really does retry it (run()'s catch-all promises that).
                 if self.on_resume is not None:
                     self.on_resume()
+                self._acked_token = None
             return False
         if self._acked_token == token and labels.get(self.label) == ack_value(token):
             return True
@@ -295,6 +298,15 @@ class DrainSubscriber:
                     self.check_once()
                 except KubeApiError as e:
                     log.warning("drain subscriber poll failed: %s", e)
+                except Exception:  # noqa: BLE001 - callback failures
+                    # A failing on_drain (disk hiccup mid-checkpoint…) must
+                    # not kill the subscriber thread: we stay registered and
+                    # un-acked, and the next poll retries the checkpoint.
+                    # (Un-acked is safe — the manager's bounded wait
+                    # proceeds without us at worst.)
+                    log.exception(
+                        "drain callback failed; retrying next poll"
+                    )
                 self._stop.wait(
                     self.poll_interval_s
                     if self._drain_requested
