@@ -362,7 +362,32 @@ def main() -> int:
     handshake = run_handshake_scenario()
 
     dt = realistic["seconds"]
-    smoke = control["smoke"]
+    # Chip-side smoke metrics (tflops/mfu) are stable run-to-run even when
+    # tunnel wall time is not, but taking them from the control run ALONE
+    # (r1-r4 behavior) lets one noise-dominated run own the headline. Use
+    # the median across every run that reached the best backend seen
+    # (control + all realistic runs), and disclose the raw values.
+    smokes = [control["smoke"]] + [r["smoke"] for r in realistic_runs]
+    best_backend = "tpu" if any(
+        s.get("backend") == "tpu" for s in smokes
+    ) else control["backend"]
+    def _timed_on(backend: str) -> list[dict]:
+        return sorted(
+            (s for s in smokes
+             if s.get("backend") == backend and s.get("tflops") is not None),
+            key=lambda s: s["tflops"],
+        )
+
+    timed = _timed_on(best_backend)
+    if not timed:
+        # No timed smoke on the best backend (e.g. the one TPU run had
+        # timing_valid=false): fall back to the control run's OWN backend
+        # — never CPU numbers wearing the TPU label — and recompute the
+        # disclosure list for that backend so the raw values still back
+        # the headline in the degraded case.
+        best_backend = control["backend"]
+        timed = _timed_on(best_backend)
+    smoke = timed[(len(timed) - 1) // 2] if timed else control["smoke"]
     result = {
         "metric": "node_drain_cc_on_ready_sec",
         # Headline is the REALISTIC scenario (simulated-real device
@@ -373,10 +398,13 @@ def main() -> int:
         "unit": "s",
         "vs_baseline": round(90.0 / dt, 2) if dt > 0 else 0.0,
         "ok": bool(control["ok"] and all(r["ok"] for r in realistic_runs)),
-        "smoke_backend": control["backend"],
+        "smoke_backend": best_backend,
         "chip_generation": smoke.get("generation"),
         "smoke_tflops": smoke.get("tflops"),
         "smoke_mfu": smoke.get("mfu"),
+        # Raw chip-side values behind the median above, one per run that
+        # hit `smoke_backend` — the spread is the tunnel's, not the chip's.
+        "smoke_tflops_runs": [s["tflops"] for s in timed],
         "phases": realistic["phases"],
         "under_target": dt < 90.0,
         # Control-plane-only overhead (zero device latencies): what this

@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# One-command batch ladder for the resnet / llama smokes on the current
+# accelerator.
+#
+# VERDICT r4 asks for on-chip batch-scaling evidence: ResNet-50 MFU
+# scales with batch until HBM runs out (configs[3] had zero TPU evidence
+# through r4), and the llama decode smoke's HBM-BW utilization has a
+# batch knob nobody had measured. Each rung is one smoke subprocess;
+# results append as JSON lines to $OUT so the artifact carries the whole
+# ladder, not one cherry-picked point.
+#
+# Usage:
+#   WORKLOAD=resnet BATCHES="32 64 128 256" hack/batch_ladder.sh
+#   WORKLOAD=llama SIZE=llama3.2-1b BATCHES="1 4 8 16" hack/batch_ladder.sh
+#   RESUME=1 ... hack/batch_ladder.sh     # keep rungs captured pre-outage
+#
+# CAUTION on the shared bench rig: the TPU tunnel is single-client and a
+# killed mid-dispatch client wedges it (see .claude/skills/verify). The
+# resnet smoke in particular is compile-heavy (>9 min observed through
+# the tunnel's remote compile) — give it no deadline you're not willing
+# to have wedge the chip.
+set -u
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+. "$REPO_ROOT/hack/sweep_lib.sh"
+
+WORKLOAD=${WORKLOAD:-resnet}
+SIZE=${SIZE:-}
+BATCHES=${BATCHES:-"32 64 128 256"}
+OUT=${OUT:-${WORKLOAD}_ladder.jsonl}
+ERRLOG=${ERRLOG:-${WORKLOAD}_ladder.stderr.log}
+
+sweep_init "$OUT" "$ERRLOG"
+echo ">>> $WORKLOAD batch ladder${SIZE:+ (size=$SIZE)}: $BATCHES -> $OUT"
+for b in $BATCHES; do
+  if sweep_done "$OUT" "batch=$b"; then
+    echo ">>> batch=$b already recorded; skipping"
+    continue
+  fi
+  tunnel_gate || exit 3
+  echo ">>> batch=$b"
+  # One OOM/config-error rung records its JSON error line and the ladder
+  # continues — the HBM ceiling is itself a result worth capturing.
+  run_rung "$OUT" "$ERRLOG" "batch=$b" \
+    python3 -m tpu_cc_manager.smoke --workload "$WORKLOAD" \
+    ${SIZE:+--size "$SIZE"} --batch "$b"
+done
+
+echo ">>> ladder summary (throughput per rung):"
+python3 - "$OUT" <<'EOF'
+import json, sys
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        r = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if not r.get("ok"):
+        rung = r.get("batch") or r.get("rung")
+        print(f"  {rung}: FAILED ({r.get('error', '?')})")
+        continue
+    tp = r.get("images_per_sec") or r.get("tokens_per_sec")
+    extra = ""
+    for k in ("mfu", "hbm_bw_util", "prefill_tokens_per_sec", "prefill_mfu"):
+        if r.get(k) is not None:
+            extra += f"  {k}={r[k]}"
+    print(f"  batch={r.get('batch')}: {tp}{extra}")
+EOF

@@ -1,0 +1,84 @@
+# Shared helpers for the one-command on-chip sweep scripts
+# (tune_pallas.sh, batch_ladder.sh). Source, don't execute.
+#
+# Why these exist (r5 postmortem): on the axon-tunnel bench rig a dead
+# remote-compile terminal makes every smoke dispatch block FOREVER with
+# no error — r5's first pallas sweep hung on config 1 for the lifetime
+# of the outage. The fixes are (a) a free, TPU-state-untouching port
+# probe before each rung so a dead tunnel stops the sweep cleanly and
+# resumably instead of hanging it, and (b) resume support so the rungs
+# already captured before an outage are never re-bought — including
+# FAILED rungs (an OOM ceiling is itself a result): every recorded line
+# is tagged with its rung identity, so a smoke error line (which carries
+# no batch/blocks key of its own) still resume-matches.
+
+# tunnel_gate: succeed immediately off the tunnel rig; on it (detected
+# by PALLAS_AXON_POOL_IPS, the env the image's sitecustomize keys the
+# axon backend on), wait up to TUNNEL_WAIT_S (default 60) for the
+# remote-compile listener to appear. The listener's ports are rig
+# config; override TUNNEL_PORT_REGEX if anything unrelated listens in
+# the default 8080-8099 window (observed tunnel ports: 8083/8093).
+# Returns 1 when the budget expires — callers should stop the sweep and
+# point at RESUME=1.
+tunnel_gate() {
+  [ -n "${PALLAS_AXON_POOL_IPS:-}" ] || return 0
+  command -v ss >/dev/null 2>&1 || return 0
+  local port_re=${TUNNEL_PORT_REGEX:-':80[89][0-9][[:space:]]'}
+  local wait_s=${TUNNEL_WAIT_S:-60}
+  local deadline=$(( $(date +%s) + wait_s ))
+  while ! ss -tln 2>/dev/null | grep -qE "$port_re"; do
+    if [ "$(date +%s)" -ge "$deadline" ]; then
+      echo ">>> axon tunnel listener absent after ${wait_s}s; stopping" \
+           "the sweep (re-run with RESUME=1 to keep captured rungs)" >&2
+      return 1
+    fi
+    echo ">>> waiting for the axon tunnel listener..." >&2
+    sleep 10
+  done
+  return 0
+}
+
+# sweep_init OUT ERRLOG: truncate both for a fresh sweep, or keep OUT's
+# rows when RESUME=1 (mixing generations/sizes across resumes is the
+# caller's responsibility — resume only the same ladder).
+sweep_init() {
+  local out=$1 errlog=$2
+  if [ "${RESUME:-0}" = "1" ] && [ -s "$out" ]; then
+    echo ">>> RESUME=1: keeping $(grep -c . "$out") existing row(s) in $out"
+    # The error detail behind kept (possibly failed) rungs lives in
+    # ERRLOG — append across resumes, don't destroy it.
+    { echo "=== resume $(date -u +%FT%TZ) ==="; } >> "$errlog"
+  else
+    : > "$out"
+    : > "$errlog"
+  fi
+}
+
+# sweep_done OUT TAG: true when a prior (RESUME=1) run already recorded
+# this rung — success OR failure — via run_rung's "rung" tag.
+sweep_done() {
+  [ "${RESUME:-0}" = "1" ] && grep -qF "\"rung\": \"$2\"" "$1"
+}
+
+# run_rung OUT ERRLOG TAG CMD...: run one rung, append its last stdout
+# line to OUT with `"rung": TAG` injected (JSON lines only; a non-JSON
+# crash tail is preserved verbatim so the error log trail stays
+# honest). A failing rung records its line and returns 0 — one bad rung
+# must not cost the rest of an expensive on-chip ladder.
+run_rung() {
+  local out=$1 errlog=$2 tag=$3
+  shift 3
+  { echo "=== $tag ==="; } >> "$errlog"
+  "$@" 2>>"$errlog" | tail -1 | RUNG_TAG="$tag" python3 -c '
+import json, os, sys
+line = sys.stdin.read().strip()
+if line:
+    try:
+        obj = json.loads(line)
+        obj["rung"] = os.environ["RUNG_TAG"]
+        line = json.dumps(obj)
+    except ValueError:
+        pass
+    print(line)
+' | tee -a "$out" || true
+}

@@ -14,23 +14,28 @@ set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+. "$REPO_ROOT/hack/sweep_lib.sh"
 OUT=${OUT:-pallas_sweep.jsonl}
 ERRLOG=${ERRLOG:-pallas_sweep.stderr.log}
 SIZE=${SIZE:-4096}
 CONFIGS=${CONFIGS:-"512,512,512 1024,512,512 512,1024,512 512,512,1024 1024,1024,512 256,256,512 1024,1024,1024 512,512,2048"}
 
-: > "$OUT"
-: > "$ERRLOG"
+sweep_init "$OUT" "$ERRLOG"
 echo ">>> sweeping pallas tilings at size $SIZE -> $OUT (stderr -> $ERRLOG)"
 for cfg in $CONFIGS; do
+  # RESUME=1 skips rungs a pre-outage run already captured — success or
+  # recorded failure alike (run_rung tags every line with its rung).
+  if sweep_done "$OUT" "blocks=$cfg"; then
+    echo ">>> blocks=$cfg already recorded; skipping"
+    continue
+  fi
+  # A dead tunnel blocks a dispatch forever (no error); stop resumably
+  # instead of hanging an expensive ladder on one rung.
+  tunnel_gate || exit 3
   echo ">>> blocks=$cfg"
-  # A failing config (non-dividing blocks, transient smoke error) records
-  # its JSON error line and the sweep continues — one bad rung must not
-  # cost the rest of an expensive on-chip ladder.
-  { echo "=== blocks=$cfg ==="; } >> "$ERRLOG"
-  python3 -m tpu_cc_manager.smoke --workload matmul --kernel pallas \
-    --size "$SIZE" --pallas-blocks "$cfg" 2>>"$ERRLOG" \
-    | tail -1 | tee -a "$OUT" || true
+  run_rung "$OUT" "$ERRLOG" "blocks=$cfg" \
+    python3 -m tpu_cc_manager.smoke --workload matmul --kernel pallas \
+    --size "$SIZE" --pallas-blocks "$cfg"
 done
 
 echo ">>> best configs:"
