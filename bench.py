@@ -89,6 +89,45 @@ def _smoke_subprocess(workload: str, timeout_s: float, force_cpu: bool) -> dict:
     )
 
 
+def select_headline_smoke(
+    smokes: list[dict], control_backend: str
+) -> tuple[str, dict, list[dict]]:
+    """Pick the chip-side smoke metrics the bench reports as its headline.
+
+    Chip-side numbers (tflops/mfu) are stable run-to-run even when tunnel
+    wall time is not, but taking them from the control run ALONE (r1-r4
+    behavior) lets one noise-dominated run own the headline. Rule: prefer
+    the best backend any run reached ("tpu" over CPU fallback), take the
+    MEDIAN-by-tflops run on it, and return the full sorted list so the
+    caller can disclose every raw value. If no run on that backend carries
+    a timing (e.g. the one TPU run had timing_valid=false), fall back to
+    the control run's OWN backend — never CPU numbers wearing the TPU
+    label — and recompute the disclosure list for that backend.
+
+    Returns (backend_label, headline_smoke, timed_runs_sorted). The first
+    smoke in ``smokes`` must be the control run's.
+    """
+    control_smoke = smokes[0]
+    best_backend = (
+        "tpu" if any(s.get("backend") == "tpu" for s in smokes)
+        else control_backend
+    )
+
+    def _timed_on(backend: str) -> list[dict]:
+        return sorted(
+            (s for s in smokes
+             if s.get("backend") == backend and s.get("tflops") is not None),
+            key=lambda s: s["tflops"],
+        )
+
+    timed = _timed_on(best_backend)
+    if not timed:
+        best_backend = control_backend
+        timed = _timed_on(best_backend)
+    smoke = timed[(len(timed) - 1) // 2] if timed else control_smoke
+    return best_backend, smoke, timed
+
+
 NS = "tpu-operator"
 
 
@@ -367,27 +406,10 @@ def main() -> int:
     # (r1-r4 behavior) lets one noise-dominated run own the headline. Use
     # the median across every run that reached the best backend seen
     # (control + all realistic runs), and disclose the raw values.
-    smokes = [control["smoke"]] + [r["smoke"] for r in realistic_runs]
-    best_backend = "tpu" if any(
-        s.get("backend") == "tpu" for s in smokes
-    ) else control["backend"]
-    def _timed_on(backend: str) -> list[dict]:
-        return sorted(
-            (s for s in smokes
-             if s.get("backend") == backend and s.get("tflops") is not None),
-            key=lambda s: s["tflops"],
-        )
-
-    timed = _timed_on(best_backend)
-    if not timed:
-        # No timed smoke on the best backend (e.g. the one TPU run had
-        # timing_valid=false): fall back to the control run's OWN backend
-        # — never CPU numbers wearing the TPU label — and recompute the
-        # disclosure list for that backend so the raw values still back
-        # the headline in the degraded case.
-        best_backend = control["backend"]
-        timed = _timed_on(best_backend)
-    smoke = timed[(len(timed) - 1) // 2] if timed else control["smoke"]
+    best_backend, smoke, timed = select_headline_smoke(
+        [control["smoke"]] + [r["smoke"] for r in realistic_runs],
+        control_backend=control["backend"],
+    )
     result = {
         "metric": "node_drain_cc_on_ready_sec",
         # Headline is the REALISTIC scenario (simulated-real device
