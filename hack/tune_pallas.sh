@@ -18,7 +18,13 @@ export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
 OUT=${OUT:-pallas_sweep.jsonl}
 ERRLOG=${ERRLOG:-pallas_sweep.stderr.log}
 SIZE=${SIZE:-4096}
-CONFIGS=${CONFIGS:-"512,512,512 1024,512,512 512,1024,512 512,512,1024 1024,1024,512 256,256,512 1024,1024,1024 512,512,2048"}
+# Rung order is most-promising-first so an outage mid-sweep still
+# captures the valuable ones: the r4 default (512^3, the comparison
+# anchor) first, then FULL-K blocks — K=size makes k_steps=1, so the
+# accumulator walk disappears and each output tile is one MXU pass
+# (a 512x4096 bf16 block pair is ~8 MB, far under v5e's VMEM even
+# double-buffered) — then partial-K refinements.
+CONFIGS=${CONFIGS:-"512,512,512 512,512,4096 1024,1024,4096 1024,512,4096 512,1024,4096 1024,1024,2048 512,512,2048 1024,1024,1024 1024,512,512 512,1024,512 512,512,1024 1024,1024,512 256,256,512"}
 
 sweep_init "$OUT" "$ERRLOG"
 echo ">>> sweeping pallas tilings at size $SIZE -> $OUT (stderr -> $ERRLOG)"
