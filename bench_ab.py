@@ -56,6 +56,86 @@ def _smoke_subprocess(
     )
 
 
+def summarize_ab(
+    workloads: list[str],
+    samples: dict[str, dict[str, list]],
+    detail: dict[str, dict[str, dict]],
+    wall: dict[str, dict[str, float]],
+    errors: dict[str, list[str]],
+    retired: set[str],
+    planned_reps: int,
+    target_pct: float,
+) -> dict:
+    """Fold per-arm samples into the one-line A/B artifact.
+
+    Contract (tested in tests/test_bench_select.py):
+    - Per arm, the reported throughput/mfu/hbm triple is the median_low
+      sample — one REAL measurement (even-count medians would otherwise
+      average two runs into a number nobody observed).
+    - ``loss_pct`` is positive when CC-on is slower (the confidentiality
+      tax), computed off the medians; None when either arm has no
+      accepted samples.
+    - ``value`` is the WORST loss across workloads; ``ok`` requires at
+      least one measured pair AND worst loss <= target — an A/B that
+      measured nothing must not read as passing.
+    - Accepted sample counts ride along (`reps` vs `planned_reps`) so
+      shortfalls from retired/failed reps are visible in the artifact.
+    """
+    per_workload: dict[str, dict] = {}
+    for w in workloads:
+        field = THROUGHPUT_FIELD.get(w)
+        per_workload[w] = {}
+        for mode in ("off", "on"):
+            got = samples[w][mode]
+            med_i = (
+                sorted(range(len(got)), key=lambda i: got[i][0])[
+                    (len(got) - 1) // 2
+                ]
+                if got else None
+            )
+            med = got[med_i][0] if got else None
+            last = detail[w].get(mode, {})
+            per_workload[w][mode] = {
+                "throughput_field": field,
+                "throughput": med,
+                "throughput_samples": [round(s[0], 2) for s in got],
+                "mfu": got[med_i][1] if got else None,
+                # Bandwidth-bound workloads (llama decode) report their
+                # honest utilization here; None elsewhere.
+                "hbm_bw_util": got[med_i][2] if got else None,
+                "backend": last.get("backend"),
+                "generation": last.get("generation"),
+                "reps": len(got),
+                "planned_reps": planned_reps,
+                "wall_seconds": round(wall[w][mode], 2),
+            }
+        if errors[w]:
+            per_workload[w]["errors"] = errors[w]
+            per_workload[w]["retired_early"] = w in retired
+
+    worst_loss_pct = 0.0
+    measured_any = False
+    for w, modes in per_workload.items():
+        off_tp = (modes.get("off") or {}).get("throughput")
+        on_tp = (modes.get("on") or {}).get("throughput")
+        if off_tp and on_tp:
+            measured_any = True
+            loss_pct = round((off_tp - on_tp) / off_tp * 100.0, 2)
+            modes["loss_pct"] = loss_pct
+            worst_loss_pct = max(worst_loss_pct, loss_pct)
+        else:
+            modes["loss_pct"] = None
+
+    return {
+        "metric": "cc_on_off_mfu_loss_pct",
+        "value": round(worst_loss_pct, 2),
+        "unit": "%",
+        "target": target_pct,
+        "ok": bool(measured_any and worst_loss_pct <= target_pct),
+        "workloads": per_workload,
+    }
+
+
 def drive_mode(mgr, kube, node: str, mode: str) -> None:
     from tpu_cc_manager.kubeclient.api import node_labels
     from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
@@ -214,65 +294,16 @@ def main() -> int:
                     detail[w][mode] = result  # last full result per mode
                 wall[w][mode] += time.perf_counter() - t0
 
-    n_samples = max(1, args.reps) * max(1, args.cycles)
-    per_workload: dict[str, dict] = {}
-    for w in workloads:
-        field = THROUGHPUT_FIELD.get(w)
-        per_workload[w] = {}
-        for mode in ("off", "on"):
-            got = samples[w][mode]
-            # median_low: the reported throughput/mfu/hbm triple is one
-            # REAL sample (even-count medians would otherwise average two).
-            med_i = (
-                sorted(range(len(got)), key=lambda i: got[i][0])[
-                    (len(got) - 1) // 2
-                ]
-                if got else None
-            )
-            med = got[med_i][0] if got else None
-            last = detail[w].get(mode, {})
-            per_workload[w][mode] = {
-                "throughput_field": field,
-                "throughput": med,
-                "throughput_samples": [round(s[0], 2) for s in got],
-                "mfu": got[med_i][1] if got else None,
-                # Bandwidth-bound workloads (llama decode) report their
-                # honest utilization here; None elsewhere.
-                "hbm_bw_util": got[med_i][2] if got else None,
-                "backend": last.get("backend"),
-                "generation": last.get("generation"),
-                # Accepted samples, which is what the median is over —
-                # planned count rides along so shortfalls are visible.
-                "reps": len(got),
-                "planned_reps": n_samples,
-                "wall_seconds": round(wall[w][mode], 2),
-            }
-        if errors[w]:
-            per_workload[w]["errors"] = errors[w]
-            per_workload[w]["retired_early"] = w in retired
-
-    worst_loss_pct = 0.0
-    measured_any = False
-    for w, modes in per_workload.items():
-        off_tp = (modes.get("off") or {}).get("throughput")
-        on_tp = (modes.get("on") or {}).get("throughput")
-        if off_tp and on_tp:
-            measured_any = True
-            # Positive = CC-on is slower (the confidentiality tax).
-            loss_pct = round((off_tp - on_tp) / off_tp * 100.0, 2)
-            modes["loss_pct"] = loss_pct
-            worst_loss_pct = max(worst_loss_pct, loss_pct)
-        else:
-            modes["loss_pct"] = None
-
-    result = {
-        "metric": "cc_on_off_mfu_loss_pct",
-        "value": round(worst_loss_pct, 2),
-        "unit": "%",
-        "target": args.target_pct,
-        "ok": bool(measured_any and worst_loss_pct <= args.target_pct),
-        "workloads": per_workload,
-    }
+    result = summarize_ab(
+        workloads=workloads,
+        samples=samples,
+        detail=detail,
+        wall=wall,
+        errors=errors,
+        retired=retired,
+        planned_reps=max(1, args.reps) * max(1, args.cycles),
+        target_pct=args.target_pct,
+    )
     print(json.dumps(result))
     return 0 if result["ok"] else 1
 

@@ -1,11 +1,14 @@
-"""Unit tests for bench.py's headline-smoke selection.
+"""Unit tests for the bench harnesses' reporting rules.
 
-The rule under test (select_headline_smoke): prefer the best backend any
-run reached, report the median-by-tflops run on it with every raw value
-disclosed, and in the degraded no-timed-smoke case fall back to the
-control run's own backend — CPU numbers must never wear the TPU label
-(VERDICT r4 weak #7: the headline MFU must not come from one
-tunnel-noise-dominated run)."""
+- bench.select_headline_smoke: prefer the best backend any run reached,
+  report the median-by-tflops run on it with every raw value disclosed,
+  and in the degraded no-timed-smoke case fall back to the control run's
+  own backend — CPU numbers must never wear the TPU label (VERDICT r4
+  weak #7: the headline MFU must not come from one tunnel-noise-
+  dominated run).
+- bench_ab.summarize_ab: median_low per arm (a REAL sample), loss sign
+  convention, worst-across-workloads headline, and `ok` that can never
+  be true when nothing was measured."""
 
 import os
 import sys
@@ -13,6 +16,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import select_headline_smoke
+from bench_ab import summarize_ab
 
 
 def _smoke(backend, tflops, mfu=None):
@@ -71,3 +75,92 @@ class TestSelectHeadlineSmoke:
         assert backend == "cpu"
         assert smoke is control
         assert timed == []
+
+
+def _ab_inputs(workloads, off=(), on=()):
+    """Minimal summarize_ab inputs: one workload's sample triples."""
+    w = workloads[0]
+    samples = {w: {"off": list(off), "on": list(on)}}
+    detail = {w: {m: {"backend": "cpu", "generation": None}
+                  for m in ("off", "on")}}
+    wall = {w: {"off": 1.0, "on": 1.0}}
+    errors = {w: []}
+    return dict(
+        workloads=workloads, samples=samples, detail=detail, wall=wall,
+        errors=errors, retired=set(), planned_reps=3, target_pct=3.0,
+    )
+
+
+class TestSummarizeAb:
+    def test_loss_positive_when_cc_on_slower(self):
+        r = summarize_ab(**_ab_inputs(
+            ["matmul"],
+            off=[(100.0, 0.9, None)], on=[(98.0, 0.88, None)],
+        ))
+        assert r["workloads"]["matmul"]["loss_pct"] == 2.0
+        assert r["value"] == 2.0
+        assert r["ok"] is True  # 2% <= 3% target
+
+    def test_loss_over_target_fails(self):
+        r = summarize_ab(**_ab_inputs(
+            ["matmul"],
+            off=[(100.0, 0.9, None)], on=[(90.0, 0.8, None)],
+        ))
+        assert r["value"] == 10.0
+        assert r["ok"] is False
+
+    def test_median_low_is_a_real_sample(self):
+        # Even count: the LOWER median sample's whole triple is reported,
+        # never an average of two runs nobody observed.
+        r = summarize_ab(**_ab_inputs(
+            ["matmul"],
+            off=[(100.0, 0.90, None), (104.0, 0.94, None)],
+            on=[(99.0, 0.89, None)],
+        ))
+        arm = r["workloads"]["matmul"]["off"]
+        assert arm["throughput"] == 100.0
+        assert arm["mfu"] == 0.90
+        assert arm["throughput_samples"] == [100.0, 104.0]
+        assert arm["reps"] == 2 and arm["planned_reps"] == 3
+
+    def test_empty_arm_yields_no_loss_and_not_ok(self):
+        # An A/B that measured nothing must never read as passing.
+        r = summarize_ab(**_ab_inputs(["matmul"], off=[], on=[]))
+        assert r["workloads"]["matmul"]["loss_pct"] is None
+        assert r["ok"] is False
+
+    def test_worst_loss_across_workloads_wins(self):
+        base = _ab_inputs(["matmul"], off=[(100.0, None, None)],
+                          on=[(99.5, None, None)])
+        extra = _ab_inputs(["llama"], off=[(3300.0, 0.01, 0.66)],
+                           on=[(3100.0, 0.009, 0.62)])
+        base["workloads"] = ["matmul", "llama"]
+        base["samples"].update(extra["samples"])
+        base["detail"].update(extra["detail"])
+        base["wall"].update(extra["wall"])
+        base["errors"].update(extra["errors"])
+        r = summarize_ab(**base)
+        assert r["workloads"]["matmul"]["loss_pct"] == 0.5
+        assert r["workloads"]["llama"]["loss_pct"] == 6.06
+        assert r["value"] == 6.06
+        assert r["ok"] is False
+
+    def test_negative_loss_clamps_headline_at_zero(self):
+        # CC-on measured FASTER (noise): per-workload discloses the
+        # negative loss, but the headline never goes below 0.
+        r = summarize_ab(**_ab_inputs(
+            ["matmul"],
+            off=[(100.0, None, None)], on=[(101.0, None, None)],
+        ))
+        assert r["workloads"]["matmul"]["loss_pct"] == -1.0
+        assert r["value"] == 0.0
+        assert r["ok"] is True
+
+    def test_errors_and_retirement_ride_along(self):
+        inputs = _ab_inputs(["matmul"], off=[(100.0, None, None)],
+                            on=[(99.0, None, None)])
+        inputs["errors"] = {"matmul": ["boom", "boom again"]}
+        inputs["retired"] = {"matmul"}
+        r = summarize_ab(**inputs)
+        assert r["workloads"]["matmul"]["errors"] == ["boom", "boom again"]
+        assert r["workloads"]["matmul"]["retired_early"] is True
