@@ -157,6 +157,42 @@ class TestTiledMatmul:
         with pytest.raises(ValueError):
             tiled_matmul(a, b, block_m=64, block_n=64, block_k=64)
 
+    def test_full_k_single_step(self):
+        # k_steps=1 (full-K block): zero-init and writeback fire on the
+        # same (only) grid step — the path the sweep's full-K rungs use.
+        a = jax.random.normal(jax.random.PRNGKey(0), (256, 512)).astype(jnp.bfloat16)
+        b = jax.random.normal(jax.random.PRNGKey(1), (512, 128)).astype(jnp.bfloat16)
+        out = tiled_matmul(a, b, block_m=128, block_n=128, block_k=512)
+        ref = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+class TestDefaultBlocks:
+    def test_known_generation_table(self, monkeypatch):
+        # A synthetic entry distinct from the fallback, so this actually
+        # proves the per-generation dispatch (today's v5e entry happens
+        # to equal the fallback, which would make the assertion vacuous).
+        from tpu_cc_manager.ops import matmul
+
+        monkeypatch.setitem(matmul.DEFAULT_BLOCKS, "vtest", (1024, 512, 2048))
+        assert matmul.default_blocks("vtest", 4096) == (1024, 512, 2048)
+
+    def test_unknown_generation_inherits_fallback(self):
+        from tpu_cc_manager.ops import matmul
+
+        assert matmul.default_blocks(None, 4096) == matmul._FALLBACK_BLOCKS
+        assert matmul.default_blocks("v99x", 4096) == matmul._FALLBACK_BLOCKS
+
+    def test_clamped_to_divide_size(self):
+        from tpu_cc_manager.ops.matmul import default_blocks
+
+        # 256 < 512: clamp; every returned dim divides the size.
+        assert default_blocks("v5e", 256) == (256, 256, 256)
+        # Non-power-of-two multiple of a small power of two: halve until
+        # dividing (384 = 3 * 128 -> clamp 512 -> 384 divides).
+        for dim in default_blocks("v5e", 384):
+            assert 384 % dim == 0 and dim >= 1
+
 
 class TestRingAttention:
     def test_matches_reference_on_ring(self):

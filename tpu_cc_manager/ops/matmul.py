@@ -18,6 +18,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+# Measured per-generation default tilings for ``tiled_matmul``. Retuned
+# from `hack/tune_pallas.sh` sweep artifacts, not guesswork: v5e's entry
+# is the r4-measured 512^3 (76.0 % MFU, artifacts/smoke_pallas_tpu_r04
+# .json) pending the r5 full-K sweep; unknown generations inherit it.
+DEFAULT_BLOCKS: dict[str, tuple[int, int, int]] = {
+    "v5e": (512, 512, 512),
+}
+_FALLBACK_BLOCKS = (512, 512, 512)
+
+
+def default_blocks(generation: str | None, size: int) -> tuple[int, int, int]:
+    """Best-known (block_m, block_n, block_k) for a square bf16 matmul of
+    ``size`` on ``generation`` (None → CPU/interpret). Entries are clamped
+    to divide ``size``: a non-dividing dimension halves until it does, so
+    callers always get a legal tiling for any size that is a multiple of a
+    small power of two."""
+    blocks = DEFAULT_BLOCKS.get(generation or "", _FALLBACK_BLOCKS)
+    out = []
+    for b in blocks:
+        b = max(1, min(b, size))
+        while size % b:
+            b //= 2
+        out.append(b)
+    return tuple(out)
+
+
 def _mm_kernel(a_ref, b_ref, o_ref, acc_ref, *, k_steps: int):
     @pl.when(pl.program_id(2) == 0)
     def _():

@@ -68,11 +68,14 @@ def run(size: int | None = None, iters: int | None = None, seed: int = 0,
     # One product definition shared by the numerics path and the timed
     # chain, so kernel dispatch and block sizing can't diverge.
     if kernel == "pallas":
-        from tpu_cc_manager.ops.matmul import tiled_matmul
+        from tpu_cc_manager.ops.matmul import default_blocks, tiled_matmul
 
         if blocks is None:
-            block = 512 if size % 512 == 0 else 128
-            blocks = (block, block, block)
+            # The measured per-generation table (ops/matmul.py), clamped
+            # to divide this problem size.
+            from tpu_cc_manager.utils.tpu_info import generation_for
+
+            blocks = default_blocks(generation_for(backend), size)
         from tpu_cc_manager.smoke.runner import SmokeConfigError
 
         if any(b < 1 for b in blocks):
