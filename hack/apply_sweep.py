@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Turn a pallas sweep artifact into a DEFAULT_BLOCKS retune.
+
+Reads a `hack/tune_pallas.sh` JSONL artifact, ranks the valid rungs, and
+prints the winner plus the exact `ops/matmul.py` DEFAULT_BLOCKS line to
+commit — the retune workflow VERDICT r4 asks for ("retune
+ops/matmul.py's default blocks per generation from the evidence"), with
+the evidence path printed alongside so the table edit stays traceable.
+
+Usage:
+    python3 hack/apply_sweep.py artifacts/pallas_sweep_r05.jsonl
+    python3 hack/apply_sweep.py --write artifacts/pallas_sweep_r05.jsonl
+
+--write edits tpu_cc_manager/ops/matmul.py in place (only when the
+sweep's generation already has a table entry or the table ends with a
+single-entry dict — otherwise it prints the line and leaves the edit to
+a human), so a healthy-chip session can capture + retune in two
+commands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+MATMUL_PY = Path(__file__).resolve().parent.parent / (
+    "tpu_cc_manager/ops/matmul.py"
+)
+
+
+def load_rungs(path: str) -> list[dict]:
+    rungs = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rungs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue  # crashed rung left a non-JSON tail; errlog has it
+    return rungs
+
+
+def best_rung(rungs: list[dict]) -> dict | None:
+    ok = [
+        r for r in rungs
+        if r.get("ok") and r.get("timing_valid") and r.get("tflops")
+        and r.get("blocks")
+    ]
+    if not ok:
+        return None
+    return max(ok, key=lambda r: r["tflops"])
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("sweep", help="pallas sweep JSONL artifact")
+    parser.add_argument(
+        "--write", action="store_true",
+        help="edit DEFAULT_BLOCKS in ops/matmul.py in place",
+    )
+    args = parser.parse_args()
+
+    rungs = load_rungs(args.sweep)
+    if not rungs:
+        print(f"no rungs in {args.sweep} (empty or all crashed)")
+        return 1
+    best = best_rung(rungs)
+    if best is None:
+        print(f"no valid timed rung among {len(rungs)} in {args.sweep}")
+        return 1
+
+    gen = best.get("generation")
+    blocks = tuple(best["blocks"])
+    print(f"rungs: {len(rungs)} ({sum(1 for r in rungs if r.get('ok'))} ok)")
+    print(
+        f"best: blocks={list(blocks)} {best['tflops']} TF/s "
+        f"mfu={best.get('mfu')} on {gen or best.get('backend')}"
+    )
+    if gen is None:
+        print("sweep did not run on a TPU generation; not retuning the table")
+        return 1
+    entry = f'    "{gen}": {blocks!r},'
+    print(f"DEFAULT_BLOCKS entry (evidence: {args.sweep}):")
+    print(entry)
+
+    if not args.write:
+        return 0
+    src = MATMUL_PY.read_text()
+    pattern = re.compile(
+        r'^(    "' + re.escape(gen) + r'": )\([0-9, ]+\),', re.M
+    )
+    if pattern.search(src):
+        new_src = pattern.sub(rf"\g<1>{blocks!r},", src, count=1)
+    else:
+        # Insert the new generation right after the table opening brace.
+        table_open = re.compile(
+            r"(DEFAULT_BLOCKS: dict\[str, tuple\[int, int, int\]\] = \{\n)"
+        )
+        if not table_open.search(src):
+            print("could not find DEFAULT_BLOCKS in ops/matmul.py; "
+                  "apply the printed entry by hand")
+            return 1
+        new_src = table_open.sub(rf"\g<1>{entry}\n", src, count=1)
+    if new_src == src:
+        print("table already carries this entry; nothing to write")
+        return 0
+    MATMUL_PY.write_text(new_src)
+    print(f"wrote {MATMUL_PY} — remember to cite {args.sweep} in the commit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
