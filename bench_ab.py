@@ -126,12 +126,15 @@ def summarize_ab(
         else:
             modes["loss_pct"] = None
 
+    value = round(worst_loss_pct, 2)
     return {
         "metric": "cc_on_off_mfu_loss_pct",
-        "value": round(worst_loss_pct, 2),
+        "value": value,
         "unit": "%",
         "target": target_pct,
-        "ok": bool(measured_any and worst_loss_pct <= target_pct),
+        # ok is judged on the REPORTED value so the artifact is
+        # self-consistent (value <= target in the JSON must match ok).
+        "ok": bool(measured_any and value <= target_pct),
         "workloads": per_workload,
     }
 

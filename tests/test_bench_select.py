@@ -164,3 +164,63 @@ class TestSummarizeAb:
         r = summarize_ab(**inputs)
         assert r["workloads"]["matmul"]["errors"] == ["boom", "boom again"]
         assert r["workloads"]["matmul"]["retired_early"] is True
+
+
+# ---- property coverage: the invariants the A/B claims rest on ----------
+
+from hypothesis import given, strategies as st  # noqa: E402
+
+_tflops = st.one_of(st.none(), st.floats(0.01, 1e4, allow_nan=False))
+_smokes = st.lists(
+    st.tuples(st.sampled_from(["cpu", "tpu"]), _tflops), min_size=1,
+    max_size=8,
+).map(lambda rows: [_smoke(b, t) for b, t in rows])
+
+
+class TestSelectHeadlineSmokeProperties:
+    @given(smokes=_smokes)
+    def test_invariants(self, smokes):
+        backend, smoke, timed = select_headline_smoke(smokes, smokes[0]["backend"])
+        # The headline smoke is always a REAL measurement from the input.
+        assert smoke in smokes
+        # Disclosure list: sorted, non-None, single-backend, and when
+        # non-empty the headline is its median_low element.
+        tf = [s["tflops"] for s in timed]
+        assert tf == sorted(tf) and None not in tf
+        assert all(s["backend"] == backend for s in timed)
+        if timed:
+            assert smoke is timed[(len(timed) - 1) // 2]
+        # TPU evidence wins whenever any TPU run carried a timing.
+        if any(s["backend"] == "tpu" and s["tflops"] is not None
+               for s in smokes):
+            assert backend == "tpu"
+
+
+_arm = st.lists(
+    st.tuples(st.floats(0.1, 1e4, allow_nan=False), st.none(), st.none()),
+    max_size=5,
+)
+
+
+class TestSummarizeAbProperties:
+    @given(off=_arm, on=_arm, target=st.floats(0.0, 50.0))
+    def test_invariants(self, off, on, target):
+        inputs = _ab_inputs(["matmul"], off=off, on=on)
+        inputs["target_pct"] = target
+        r = summarize_ab(**inputs)
+        modes = r["workloads"]["matmul"]
+        # Headline never negative; per-arm medians are real samples.
+        assert r["value"] >= 0.0
+        for mode, got in (("off", off), ("on", on)):
+            arm = modes[mode]
+            if got:
+                assert arm["throughput"] in [s[0] for s in got]
+            else:
+                assert arm["throughput"] is None
+        # ok demands a measured pair within target; an empty A/B never
+        # passes.
+        if not off or not on:
+            assert modes["loss_pct"] is None
+            assert r["ok"] is False
+        else:
+            assert r["ok"] == (r["value"] <= target)
