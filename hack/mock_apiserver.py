@@ -184,8 +184,25 @@ def add_node(name: str) -> None:
         }
 
 
-# watchers: list of (chunk_writer, node_name_filter or None)
+# watchers: list of (chunk_writer, node_name_filter or None,
+# wants_bookmarks)
 watchers = []
+
+# Real apiservers send periodic BOOKMARK events (metadata-only, fresh
+# resourceVersion) to watchers that asked via allowWatchBookmarks=true —
+# that is what keeps quiet nodes from 410-expiring after etcd compaction,
+# and the manager's watch loop has a dedicated branch for them
+# (ccmanager/manager.py). Emit them faithfully so the demos exercise that
+# branch over real HTTP. Interval is short (real servers use ~1/min;
+# demos want coverage, not realism) and env-tunable for tests.
+BOOKMARK_INTERVAL_S = float(os.environ.get("MOCK_BOOKMARK_INTERVAL_S", "5"))
+_BOOKMARK = object()  # queue sentinel: broadcast a bookmark frame
+
+
+def _bookmark_ticker():
+    while True:
+        time.sleep(BOOKMARK_INTERVAL_S)
+        _event_queue.put((_BOOKMARK, b""))
 
 
 def bump_rv(node: dict) -> None:
@@ -193,7 +210,8 @@ def bump_rv(node: dict) -> None:
     node["metadata"]["resourceVersion"] = str(rv[0])
 
 
-_event_queue: "queue.Queue[tuple[str, bytes]]" = queue.Queue()
+# name is a node name (str) or the _BOOKMARK sentinel object.
+_event_queue: "queue.Queue[tuple[object, bytes]]" = queue.Queue()
 
 
 def emit_watch_event(node: dict) -> None:
@@ -209,12 +227,21 @@ def emit_watch_event(node: dict) -> None:
 def _watch_writer():
     while True:
         name, ev = _event_queue.get()
-        with lock:
-            targets = [
-                (wf, flt) for wf, flt in watchers if flt is None or flt == name
-            ]
+        if name is _BOOKMARK:
+            with lock:
+                targets = [wf for wf, _, bm in watchers if bm]
+                ev = (json.dumps({
+                    "type": "BOOKMARK",
+                    "object": {"metadata": {"resourceVersion": str(rv[0])}},
+                }) + "\n").encode()
+        else:
+            with lock:
+                targets = [
+                    wf for wf, flt, _ in watchers
+                    if flt is None or flt == name
+                ]
         dead = []
-        for wf, _ in targets:
+        for wf in targets:
             try:
                 wf.write(ev)
                 wf.flush()
@@ -222,7 +249,9 @@ def _watch_writer():
                 dead.append(wf)
         if dead:
             with lock:
-                watchers[:] = [(wf, flt) for wf, flt in watchers if wf not in dead]
+                watchers[:] = [
+                    w for w in watchers if w[0] not in dead
+                ]
 
 
 def is_paused(v):
@@ -362,7 +391,8 @@ class Handler(BaseHTTPRequestHandler):
                         ev = json.dumps({"type": "ADDED", "object": node}) + "\n"
                         cw.write(ev.encode())
                 cw.flush()
-                watchers.append((cw, flt))
+                wants_bookmarks = q.get("allowWatchBookmarks") == ["true"]
+                watchers.append((cw, flt, wants_bookmarks))
             # Hold the connection open; events pushed by emit_watch_event.
             timeout = float(q.get("timeoutSeconds", ["300"])[0])
             time.sleep(timeout)
@@ -371,7 +401,7 @@ class Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
             with lock:
-                watchers[:] = [(wf, f) for wf, f in watchers if wf is not cw]
+                watchers[:] = [w for w in watchers if w[0] is not cw]
             return
         if u.path == "/api/v1/nodes":
             if not self._authorized("list", "nodes"):
@@ -515,6 +545,7 @@ if __name__ == "__main__":
         add_node(f"demo-node-{i}")
     threading.Thread(target=operator_reactor, daemon=True).start()
     threading.Thread(target=_watch_writer, daemon=True).start()
+    threading.Thread(target=_bookmark_ticker, daemon=True).start()
     srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     print(f"mock apiserver on :{port} ({n_nodes} node(s))", flush=True)
     srv.serve_forever()

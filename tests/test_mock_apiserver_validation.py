@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 from http.server import ThreadingHTTPServer
 
 import pytest
@@ -159,3 +160,69 @@ def test_label_safe_always_passes_apiserver_validation(raw):
     from tpu_cc_manager.labels import label_safe
 
     assert mock_apiserver.validate_label_patch({"k": label_safe(raw)}) is None
+
+
+def test_watch_carries_bookmark_events(server, client):
+    """The manager's BOOKMARK branch (ccmanager/manager.py watch loop) is
+    exercised over real HTTP: the mock, like a real apiserver, sends
+    metadata-only BOOKMARK frames to watchers that asked via
+    allowWatchBookmarks=true (which RestKube.watch_nodes always does)."""
+    # The module-scope fixture starts only the HTTP server; run the
+    # writer thread and inject the ticker's sentinel directly instead of
+    # waiting out a wall-clock interval.
+    threading.Thread(target=mock_apiserver._watch_writer, daemon=True).start()
+
+    seen = {}
+
+    def consume():
+        for ev in client.watch_nodes(NODE, timeout_seconds=5):
+            if ev.type == "BOOKMARK":
+                seen["event"] = ev
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while "event" not in seen and time.monotonic() < deadline:
+        mock_apiserver._event_queue.put((mock_apiserver._BOOKMARK, b""))
+        time.sleep(0.1)
+    t.join(timeout=5)
+    assert "event" in seen, "no BOOKMARK event reached the watch client"
+    ev = seen["event"]
+    # Bookmarks are metadata-only: a fresh resourceVersion, no labels —
+    # exactly the shape the manager's branch exists to not misread.
+    md = ev.object.get("metadata", {})
+    assert md.get("resourceVersion")
+    assert "labels" not in md
+
+
+def test_watch_without_optin_gets_no_bookmarks(server):
+    """The gating half of the contract: a watcher that did NOT send
+    allowWatchBookmarks=true (RestKube always does, so go below it to
+    raw HTTP) must never receive BOOKMARK frames, no matter how many the
+    ticker broadcasts."""
+    import json as _json
+    import urllib.request
+
+    threading.Thread(target=mock_apiserver._watch_writer, daemon=True).start()
+
+    url = (
+        f"http://127.0.0.1:{server.server_port}/api/v1/nodes"
+        f"?watch=true&fieldSelector=metadata.name={NODE}&timeoutSeconds=2"
+    )
+    types = []
+
+    def consume():
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    types.append(_json.loads(line)["type"])
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    for _ in range(10):
+        mock_apiserver._event_queue.put((mock_apiserver._BOOKMARK, b""))
+        time.sleep(0.05)
+    t.join(timeout=10)
+    assert types and "BOOKMARK" not in types, types
