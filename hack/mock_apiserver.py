@@ -27,7 +27,8 @@ Includes an "operator reaction" thread — the external behavior the drain
 protocol relies on (SURVEY.md §5): deletes component pods ~0.5 s after
 their google.com/tpu.deploy.* label becomes paused, restores them on
 unpause. Control endpoints (not part of k8s): POST /_ctl/set-label
-(optional "node"), POST /_ctl/stick-pod, POST /_ctl/state.
+(optional "node"), POST /_ctl/stick-pod, POST /_ctl/state,
+POST /_ctl/compact (410-expire watches resuming below a rv floor).
 """
 import json
 import os
@@ -159,6 +160,9 @@ GRANTS = _load_cluster_role_grants()
 
 lock = threading.Lock()
 rv = [1]
+# Watch resumes below this resourceVersion answer 410 Gone, like a real
+# apiserver after etcd compaction. Raised via POST /_ctl/compact.
+compacted_below = [0]
 nodes: dict[str, dict] = {}
 pods: dict[str, dict] = {}  # pod name -> pod dict
 
@@ -357,6 +361,23 @@ class Handler(BaseHTTPRequestHandler):
         if u.path == "/api/v1/nodes" and q.get("watch") == ["true"]:
             if not self._authorized("watch", "nodes"):
                 return self._forbid("watch", "nodes")
+            # Real apiservers 410-Gone a watch resuming from a
+            # resourceVersion older than the compaction floor; the
+            # manager's resync path (re-GET + conditional re-apply,
+            # ccmanager/manager.py) exists for exactly this answer.
+            rv_param = q.get("resourceVersion", [None])[0]
+            if rv_param is not None:
+                try:
+                    too_old = int(rv_param) < compacted_below[0]
+                except ValueError:
+                    too_old = False
+                if too_old:
+                    return self._json(
+                        {"kind": "Status", "code": 410, "reason": "Expired",
+                         "message":
+                         f"too old resource version: {rv_param}"},
+                        410,
+                    )
             # Field selector metadata.name=<n> scopes the stream to one node
             # (the agent's watch); absent means all nodes.
             flt = None
@@ -508,6 +529,14 @@ class Handler(BaseHTTPRequestHandler):
                 bump_rv(node)
                 emit_watch_event(node)
                 return self._json({"ok": True, "labels": node["metadata"]["labels"]})
+        if u.path == "/_ctl/compact":
+            # Emulate etcd compaction: watches resuming below the floor
+            # (default: the current rv) get 410 Gone.
+            with lock:
+                compacted_below[0] = int(body.get("below_rv", rv[0]))
+                return self._json(
+                    {"ok": True, "compacted_below": compacted_below[0]}
+                )
         if u.path == "/_ctl/stick-pod":
             with lock:
                 if body.get("stuck", True):

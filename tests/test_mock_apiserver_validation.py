@@ -9,6 +9,7 @@ with the real ClusterRole manifest as the authz source of truth.
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import threading
@@ -226,3 +227,33 @@ def test_watch_without_optin_gets_no_bookmarks(server):
         time.sleep(0.05)
     t.join(timeout=10)
     assert types and "BOOKMARK" not in types, types
+
+
+def test_compacted_watch_resume_is_410(server, client):
+    """The manager's 410-resync path gets its wire-level answer: after
+    /_ctl/compact, a watch resuming from an older resourceVersion is
+    refused with HTTP 410 (KubeApiError.status == 410 — exactly what
+    watch_and_apply catches to re-GET and resync), while a fresh watch
+    (no resourceVersion) still opens."""
+    import urllib.request
+
+    url = f"http://127.0.0.1:{server.server_port}/_ctl/compact"
+    req = urllib.request.Request(url, data=b"{}", method="POST")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        floor = json.loads(resp.read())["compacted_below"]
+    assert floor >= 1
+
+    try:
+        with pytest.raises(KubeApiError) as exc:
+            next(iter(client.watch_nodes(NODE, resource_version="0",
+                                         timeout_seconds=2)))
+        assert exc.value.status == 410
+
+        # No resourceVersion → fresh watch, replays current state as
+        # ADDED.
+        ev = next(iter(client.watch_nodes(NODE, timeout_seconds=2)))
+        assert ev.type == "ADDED"
+    finally:
+        # The module-scope server is shared; don't leave the floor up for
+        # whichever test runs next.
+        mock_apiserver.compacted_below[0] = 0
