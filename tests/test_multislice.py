@@ -141,6 +141,22 @@ def test_verify_detects_stale_quote(fake_kube, monkeypatch):
     assert "stale" in str(exc.value)
 
 
+def test_forged_ts_label_degrades_to_stale_not_crash(fake_kube):
+    """A non-numeric .ts label (anything with node-patch RBAC could write
+    one) must surface as the staleness problem inside the verifier's
+    PoolAttestationError contract — never escape as a ValueError."""
+    q = make_quote("s1")
+    add_attested_node(fake_kube, "n0", "s1", q)
+    fake_kube.patch_node_labels(
+        "n0", {f"{multislice.QUOTE_ANNOTATION}.ts": "yesterday-ish"}
+    )
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(
+            fake_kube, POOL, "on", max_age_s=3600, allow_fake=True
+        )
+    assert "stale" in str(exc.value)
+
+
 def test_expected_slice_count(fake_kube):
     q = make_quote("s1")
     add_attested_node(fake_kube, "n0", "s1", q)

@@ -152,7 +152,13 @@ def collect_pool_quotes(api: KubeApi, selector: str) -> dict[str, dict]:
             entry["missing"].append(name)
             continue
         mode = labels.get(f"{QUOTE_ANNOTATION}.mode", "")
-        ts = int(labels.get(f"{QUOTE_ANNOTATION}.ts", "0") or 0)
+        try:
+            ts = int(labels.get(f"{QUOTE_ANNOTATION}.ts", "0") or 0)
+        except ValueError:
+            # A forged/corrupt ts label must degrade to "maximally stale"
+            # (epoch 0 → the staleness problem fires), not crash the
+            # verifier outside its PoolAttestationError contract.
+            ts = 0
         entry["nodes"].append(name)
         entry["digest"] = digest if entry["digest"] in (None, digest) else "MIXED"
         entry["mode"] = mode if entry["mode"] in (None, mode) else "MIXED"
