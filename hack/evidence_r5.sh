@@ -119,9 +119,13 @@ stage "llama-ladder" artifacts/llama_ladder_r05.jsonl \
       OUT=artifacts/llama_ladder_r05.jsonl ERRLOG=artifacts/llama_ladder_r05.stderr.log \
   bash hack/batch_ladder.sh
 
+# --timeout-s 1200: the resnet smoke's tunnel remote compile has exceeded
+# 9 min; the default 300 s would timeout-kill it MID-DISPATCH — the known
+# r4 wedge trigger (.claude/skills/verify). A generous deadline trades a
+# slower worst case for never killing a live dispatch.
 stage "ab" AB_r05.json \
   capture_to AB_r05.json \
-  python3 bench_ab.py --cycles 3 --reps 2 \
+  python3 bench_ab.py --cycles 3 --reps 2 --timeout-s 1200 \
     --workloads matmul,llama,resnet --llama-size llama3.2-1b
 
 finish
