@@ -324,7 +324,21 @@ def run(
     if timing_valid and generation is not None:
         flops_per_sec = 2.0 * cfg.param_count() * tokens_per_sec
         mfu = flops_per_sec / (peak_flops_per_chip() * n_dev)
-        bytes_per_sec = 2.0 * cfg.param_count() * (tokens_per_sec / batch)
+        # HBM traffic per decode STEP: the full bf16 weight set once
+        # (shared by the whole batch) plus each sequence's KV-cache read
+        # at its current context length. Counting weights alone (the r4
+        # accounting) under-reports traffic — and so over-states the
+        # remaining headroom — as batch or context grows; the KV term is
+        # what the batch ladder trades against weight amortization.
+        steps_per_sec = tokens_per_sec / batch
+        weight_bytes = 2.0 * cfg.param_count()
+        avg_ctx = prompt_len + (lo + hi) / 2.0  # timed window midpoint
+        kv_bytes_per_seq = (
+            cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim * avg_ctx * 2.0
+        )
+        bytes_per_sec = steps_per_sec * (
+            weight_bytes + batch * kv_bytes_per_seq
+        )
         hbm_util = bytes_per_sec / (peak_hbm_bytes_per_chip() * n_dev)
     if prefill_tokens_per_sec is not None and generation is not None:
         prefill_mfu = (
