@@ -401,11 +401,7 @@ def main() -> int:
     handshake = run_handshake_scenario()
 
     dt = realistic["seconds"]
-    # Chip-side smoke metrics (tflops/mfu) are stable run-to-run even when
-    # tunnel wall time is not, but taking them from the control run ALONE
-    # (r1-r4 behavior) lets one noise-dominated run own the headline. Use
-    # the median across every run that reached the best backend seen
-    # (control + all realistic runs), and disclose the raw values.
+    # Median chip-side metrics across all runs; rationale in the helper.
     best_backend, smoke, timed = select_headline_smoke(
         [control["smoke"]] + [r["smoke"] for r in realistic_runs],
         control_backend=control["backend"],

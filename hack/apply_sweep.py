@@ -11,11 +11,10 @@ Usage:
     python3 hack/apply_sweep.py artifacts/pallas_sweep_r05.jsonl
     python3 hack/apply_sweep.py --write artifacts/pallas_sweep_r05.jsonl
 
---write edits tpu_cc_manager/ops/matmul.py in place (only when the
-sweep's generation already has a table entry or the table ends with a
-single-entry dict — otherwise it prints the line and leaves the edit to
-a human), so a healthy-chip session can capture + retune in two
-commands.
+--write edits tpu_cc_manager/ops/matmul.py in place — replacing the
+generation's existing entry, or inserting a new one at the top of the
+table — so a healthy-chip session can capture + retune in two commands.
+A sweep that ran off-TPU (generation null) never touches the table.
 """
 
 from __future__ import annotations
