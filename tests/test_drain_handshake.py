@@ -41,6 +41,20 @@ DP_APP = DRAIN_COMPONENT_LABELS[DP_LABEL]
 # ---------------------------------------------------------------------------
 
 
+def test_request_token_parsing_is_strict():
+    """Tokens parse only from 'requested' (legacy, '') or
+    'requested-<token>': a malformed value must read as no-drain, not as
+    a garbage token a subscriber would checkpoint against."""
+    assert handshake.request_token(None) is None
+    assert handshake.request_token("requested") == ""
+    assert handshake.request_token("requested-abc12") == "abc12"
+    assert handshake.request_token("requestedabc") is None
+    assert handshake.request_token("draining") is None
+    # Round-trips with the writer side.
+    assert handshake.request_token(handshake.request_value("tok")) == "tok"
+    assert handshake.request_token(handshake.request_value("")) == ""
+
+
 def test_request_drain_resets_stale_acks(fake_kube):
     sub_label = handshake.subscriber_label("jobA")
     fake_kube.add_node(NODE, {sub_label: handshake.ACKED})  # stale from r-1

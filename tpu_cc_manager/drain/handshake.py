@@ -82,10 +82,17 @@ def ack_value(token: str) -> str:
 
 def request_token(value: str | None) -> str | None:
     """Cycle token of a drain-request label value; None when no drain is
-    requested. A bare legacy ``requested`` value maps to token ''."""
-    if value is None or not value.startswith(DRAIN_REQUESTED):
+    requested. A bare legacy ``requested`` value maps to token ''; any
+    other value that is not ``requested-<token>`` is NOT a drain request
+    (a malformed value must not yield a garbage token that subscribers
+    would checkpoint against)."""
+    if value is None:
         return None
-    return value[len(DRAIN_REQUESTED) + 1:]
+    if value == DRAIN_REQUESTED:
+        return ""
+    if value.startswith(DRAIN_REQUESTED + "-"):
+        return value[len(DRAIN_REQUESTED) + 1:]
+    return None
 
 
 class DrainCycle(NamedTuple):
