@@ -130,6 +130,42 @@ def select_headline_smoke(
 
 NS = "tpu-operator"
 
+def phase_names() -> tuple[str, ...]:
+    """Reconcile phases the per-phase histograms aggregate over, from the
+    canonical constants (the journal also carries sub-spans like
+    drain.await_pods; the headline sticks to the pipeline phases so
+    rounds stay comparable). Imported lazily so the module parses before
+    sys.path setup."""
+    from tpu_cc_manager.utils import metrics as m
+
+    return (
+        m.PHASE_DRAIN, m.PHASE_STAGE, m.PHASE_BARRIER, m.PHASE_RESET,
+        m.PHASE_WAIT_READY, m.PHASE_ATTEST, m.PHASE_SMOKE, m.PHASE_READMIT,
+    )
+
+
+def phase_histograms(runs: list[dict]) -> dict:
+    """Aggregate each run's journal-derived phase durations into a
+    per-phase summary: the BENCH artifact reports distributions, not one
+    run's totals (a single noisy drain should read as tail, not truth)."""
+    merged: dict[str, list[float]] = {}
+    for run in runs:
+        for phase, secs in (run.get("phase_durations") or {}).items():
+            merged.setdefault(phase, []).extend(secs)
+    out = {}
+    for phase in phase_names():
+        vals = sorted(merged.get(phase, ()))
+        if not vals:
+            continue
+        out[phase] = {
+            "count": len(vals),
+            "min": round(vals[0], 3),
+            "p50": round(vals[(len(vals) - 1) // 2], 3),
+            "max": round(vals[-1], 3),
+            "sum": round(sum(vals), 3),
+        }
+    return out
+
 
 def make_bench_kube(node_names: list[str], pod_delete_delay_s: float = 0.0):
     """Fake apiserver with one pod per drain component per node and the
@@ -181,6 +217,7 @@ def run_scenario(
     from tpu_cc_manager.ccmanager.manager import CCManager
     from tpu_cc_manager.kubeclient.api import node_labels
     from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
+    from tpu_cc_manager.obs.journal import Journal
     from tpu_cc_manager.tpudev.fake import FakeTpuBackend
     from tpu_cc_manager.utils.metrics import MetricsRegistry
 
@@ -206,6 +243,10 @@ def run_scenario(
         return result
 
     registry = MetricsRegistry()
+    # Per-scenario journal (file sink off): the bench reads the span
+    # stream back to report per-phase distributions, not just one run's
+    # totals, and must not inherit a CC_TRACE_FILE from the environment.
+    journal = Journal(trace_file="")
     backend = FakeTpuBackend(
         num_chips=4,
         accelerator_type="v5p-8",
@@ -222,6 +263,7 @@ def run_scenario(
         smoke_runner=smoke_runner,
         eviction_poll_interval_s=0.1,
         metrics=registry,
+        journal=journal,
     )
 
     t0 = time.perf_counter()
@@ -234,6 +276,8 @@ def run_scenario(
         "seconds": round(dt, 2),
         "ok": bool(ok and state == "on"),
         "phases": {p.name: round(p.seconds, 3) for p in (m.phases if m else [])},
+        "trace_id": m.trace_id if m else None,
+        "phase_durations": journal.phase_durations(phase_names()),
         "smoke": smoke_detail,
         "backend": backend_used["backend"],
     }
@@ -247,6 +291,7 @@ def run_multihost_scenario() -> dict:
     from tpu_cc_manager.ccmanager.manager import CCManager
     from tpu_cc_manager.kubeclient.api import node_labels
     from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
+    from tpu_cc_manager.obs.journal import Journal
     from tpu_cc_manager.tpudev.fake import FakeTpuBackend
     from tpu_cc_manager.utils.metrics import MetricsRegistry
 
@@ -264,6 +309,8 @@ def run_multihost_scenario() -> dict:
             api=kube, backend=backend, node_name=name,
             operator_namespace=ns, evict_components=True,
             smoke_workload="none", metrics=MetricsRegistry(),
+            # Bench spans must not land in an operator's CC_TRACE_FILE.
+            journal=Journal(trace_file=""),
             eviction_poll_interval_s=0.05,
             slice_barrier_poll_interval_s=0.02,
         ))
@@ -314,6 +361,7 @@ def run_handshake_scenario(checkpoint_s: float = 0.5) -> dict:
     from tpu_cc_manager.drain.pause import is_paused
     from tpu_cc_manager.kubeclient.api import node_labels
     from tpu_cc_manager.labels import CC_MODE_STATE_LABEL, DRAIN_COMPONENT_LABELS
+    from tpu_cc_manager.obs.journal import Journal
     from tpu_cc_manager.tpudev.fake import FakeTpuBackend
     from tpu_cc_manager.utils.metrics import MetricsRegistry
 
@@ -351,6 +399,8 @@ def run_handshake_scenario(checkpoint_s: float = 0.5) -> dict:
         evict_components=True,
         smoke_workload="none",
         metrics=MetricsRegistry(),
+        # Bench spans must not land in an operator's CC_TRACE_FILE.
+        journal=Journal(trace_file=""),
         eviction_poll_interval_s=0.05,
         drain_ack_timeout_s=30,
     )
@@ -424,6 +474,10 @@ def main() -> int:
         # hit `smoke_backend` — the spread is the tunnel's, not the chip's.
         "smoke_tflops_runs": [s["tflops"] for s in timed],
         "phases": realistic["phases"],
+        # Journal-derived per-phase distributions across every realistic
+        # run (obs/journal.py): which phase owns the tail, not just the
+        # median run's totals.
+        "phase_histograms": phase_histograms(realistic_runs),
         "under_target": dt < 90.0,
         # Control-plane-only overhead (zero device latencies): what this
         # framework itself costs, separated from simulated device time.

@@ -17,10 +17,15 @@
 # Each stage is gated on the tunnel listener (hack/sweep_lib.sh) so an
 # outage stops the ladder at the next stage boundary (a rung already
 # mid-dispatch when the transport dies still blocks — the gate can only
-# probe between dispatches), and skipped when its artifact already
-# exists and is non-empty, so re-running resumes where it stopped.
-# RESUME=1 is exported for the jsonl ladders' per-rung resume. The exit
-# code is honest: 0 only when every artifact exists.
+# probe between dispatches). Single-point .json stages are skipped when
+# their artifact already exists and is non-empty (capture_to only ever
+# promotes an ok:true result, so non-empty == complete); .jsonl ladder
+# stages are ALWAYS re-invoked — a partial ladder is non-empty too, and
+# only the ladder script's own RESUME=1 sweep_done logic knows which
+# rungs are still missing (ADVICE.md round 5). A stage command exiting
+# non-zero stops the ladder at that boundary instead of falling through
+# with an incomplete artifact. The exit code is honest: 0 only when
+# every artifact exists.
 #
 # CAUTION: single-client tunnel — make sure nothing else TPU-touching is
 # running first (pgrep -f "tpu_cc_manager.smoke|bench.py"). No kills.
@@ -45,15 +50,31 @@ ARTIFACTS=(
 )
 
 stage() {  # stage NAME ARTIFACT CMD...
-  local name=$1 artifact=$2
+  local name=$1 artifact=$2 rc
   shift 2
   echo "=== stage: $name ==="
-  if [ -s "$artifact" ]; then
-    echo ">>> $artifact already captured; skipping"
-    return 0
-  fi
+  case "$artifact" in
+    *.jsonl)
+      # Ladder artifacts are appended rung by rung: non-empty does NOT
+      # mean complete. Always re-invoke; the ladder script's RESUME=1
+      # sweep_done logic skips rungs already captured.
+      ;;
+    *)
+      if [ -s "$artifact" ]; then
+        echo ">>> $artifact already captured; skipping"
+        return 0
+      fi
+      ;;
+  esac
   tunnel_gate || { echo ">>> tunnel down; stopping at stage '$name' (re-run to resume)"; finish; }
   "$@"
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    # A ladder that aborted (e.g. tunnel died mid-sweep, exit 3) must not
+    # fall through to later stages with its artifact silently incomplete.
+    echo ">>> stage '$name' exited rc=$rc; stopping ladder (re-run to resume)"
+    finish
+  fi
 }
 
 # capture_to ARTIFACT CMD...: run CMD, keep its LAST stdout line, and

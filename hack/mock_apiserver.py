@@ -365,8 +365,11 @@ class Handler(BaseHTTPRequestHandler):
             # resourceVersion older than the compaction floor; the
             # manager's resync path (re-GET + conditional re-apply,
             # ccmanager/manager.py) exists for exactly this answer.
+            # resourceVersion="0" is exempt: real apiservers define it as
+            # "any version / serve from cache" and never 410 it
+            # (ADVICE.md round 5).
             rv_param = q.get("resourceVersion", [None])[0]
-            if rv_param is not None:
+            if rv_param is not None and rv_param != "0":
                 try:
                     too_old = int(rv_param) < compacted_below[0]
                 except ValueError:

@@ -15,6 +15,9 @@ import json
 import time
 
 import pytest
+
+pytest.importorskip("cryptography")  # optional dep: RSA key generation for the JWKS fixtures
+
 from cryptography.hazmat.primitives import hashes
 from cryptography.hazmat.primitives.asymmetric import padding, rsa
 

@@ -168,7 +168,7 @@ class TestSummarizeAb:
 
 # ---- property coverage: the invariants the A/B claims rest on ----------
 
-from hypothesis import given, strategies as st  # noqa: E402
+from _hypothesis_compat import given, st  # noqa: E402
 
 _tflops = st.one_of(st.none(), st.floats(0.01, 1e4, allow_nan=False))
 _smokes = st.lists(

@@ -2,7 +2,7 @@
 
 import re
 
-from hypothesis import given, strategies as st
+from _hypothesis_compat import given, st
 
 from tpu_cc_manager.labels import (
     MODE_DEVTOOLS,

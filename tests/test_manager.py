@@ -315,14 +315,18 @@ def test_phase_metrics_recorded(fake_kube, fake_tpu):
     text = registry.render_prometheus()
     assert "tpu_cc_reconcile_seconds" in text
     assert 'phase="reset"' in text
-    # Cumulative counters survive the bounded history: a scraper that
-    # misses a reconcile still sees its latency in the totals.
-    assert 'tpu_cc_phase_seconds_total{mode="on",phase="reset"}' in text
-    assert 'tpu_cc_phase_runs_total{mode="on",phase="reset"} 1' in text
+    # Cumulative histogram series survive the bounded history: a scraper
+    # that misses a reconcile still sees its latency in the totals.
+    assert 'tpu_cc_phase_seconds_sum{mode="on",phase="reset"}' in text
+    assert 'tpu_cc_phase_seconds_count{mode="on",phase="reset"} 1' in text
+    assert (
+        'tpu_cc_phase_seconds_bucket{mode="on",phase="reset",le="+Inf"} 1'
+        in text
+    )
     assert 'tpu_cc_reconciles_total{result="ok"} 1' in text
     mgr.set_cc_mode(MODE_OFF)
     text = registry.render_prometheus()
-    assert 'tpu_cc_phase_runs_total{mode="off",phase="reset"} 1' in text
+    assert 'tpu_cc_phase_seconds_count{mode="off",phase="reset"} 1' in text
     assert 'tpu_cc_reconciles_total{result="ok"} 2' in text
 
 

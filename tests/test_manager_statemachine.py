@@ -24,6 +24,10 @@ would enumerate.
 
 from __future__ import annotations
 
+import pytest
+
+pytest.importorskip("hypothesis")  # optional dep; skip the whole stateful module without it
+
 import hypothesis.strategies as st
 from hypothesis import settings
 from hypothesis.stateful import (

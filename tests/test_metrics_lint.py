@@ -1,0 +1,84 @@
+"""Fast tier-1 wiring of hack/check_metrics_lint.py: a live registry
+render (exercised through the real phase/finish path, hostile label
+values included) must pass the Prometheus exposition lint, and the lint
+itself must catch each class of regression it exists for."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
+)
+import check_metrics_lint  # noqa: E402
+
+from tpu_cc_manager.utils.metrics import MetricsRegistry  # noqa: E402
+
+
+def test_live_registry_render_passes_lint():
+    registry = MetricsRegistry()
+    for mode in ("on", "slice", 'odd"mode\nwith\\escapes'):
+        m = registry.start(mode)
+        for phase in ("drain", "stage", "reset", "wait_ready", "attest"):
+            with m.phase(phase):
+                pass
+        m.finish("ok")
+    m = registry.start("off")
+    m.result = "failed"
+    m.finish("failed")
+    registry.record_failure("attestation-failed")
+    registry.record_failure('hostile"reason\nhere')
+    problems = check_metrics_lint.lint(registry.render_prometheus())
+    assert problems == [], problems
+
+
+def test_empty_registry_render_passes_lint():
+    problems = check_metrics_lint.lint(MetricsRegistry().render_prometheus())
+    assert problems == [], problems
+
+
+def test_lint_catches_missing_help_and_type():
+    problems = check_metrics_lint.lint('x{a="b"} 1\n')
+    assert any("no # HELP" in p for p in problems)
+    assert any("no # TYPE" in p for p in problems)
+
+
+def test_lint_catches_type_after_sample():
+    text = "# HELP x h\nx 1\n# TYPE x gauge\n"
+    problems = check_metrics_lint.lint(text)
+    assert any("after its first sample" in p for p in problems)
+
+
+def test_lint_catches_illegal_escape_and_raw_garbage():
+    text = '# HELP g h\n# TYPE g gauge\ng{v="a\\q"} 1\n'
+    assert any("escape" in p for p in check_metrics_lint.lint(text))
+    assert check_metrics_lint.lint("!!! not exposition\n")
+
+
+def test_lint_catches_non_cumulative_buckets():
+    text = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+        'h_bucket{le="+Inf"} 9\nh_sum 1\nh_count 9\n'
+    )
+    problems = check_metrics_lint.lint(text)
+    assert any("cumulative" in p for p in problems), problems
+
+
+def test_lint_catches_missing_inf_bucket_and_count_mismatch():
+    no_inf = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="2"} 6\n'
+    )
+    assert any("+Inf" in p for p in check_metrics_lint.lint(no_inf))
+    mismatch = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 9\nh_count 8\nh_sum 0\n'
+    )
+    assert any("_count" in p for p in check_metrics_lint.lint(mismatch))
+
+
+def test_lint_main_selftest_mode():
+    """The CLI default (no args) lints a seeded live registry and exits 0."""
+    assert check_metrics_lint.main([]) == 0

@@ -17,8 +17,8 @@ import time
 from http.server import ThreadingHTTPServer
 
 import pytest
-from hypothesis import given
-from hypothesis import strategies as st
+
+from _hypothesis_compat import given, st
 
 from tpu_cc_manager.kubeclient.api import KubeApiError, node_annotations, node_labels
 from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
@@ -231,23 +231,35 @@ def test_watch_without_optin_gets_no_bookmarks(server):
 
 def test_compacted_watch_resume_is_410(server, client):
     """The manager's 410-resync path gets its wire-level answer: after
-    /_ctl/compact, a watch resuming from an older resourceVersion is
-    refused with HTTP 410 (KubeApiError.status == 410 — exactly what
+    /_ctl/compact, a watch resuming from a genuinely stale resourceVersion
+    is refused with HTTP 410 (KubeApiError.status == 410 — exactly what
     watch_and_apply catches to re-GET and resync), while a fresh watch
-    (no resourceVersion) still opens."""
+    (no resourceVersion) still opens. resourceVersion="0" is the
+    documented exception: real apiservers define it as "any version /
+    serve from cache" and never 410 it (ADVICE.md round 5), so the mock
+    must not either."""
     import urllib.request
 
+    # Advance the server's rv past 1 so "1" is genuinely stale once the
+    # compaction floor rises to the current rv.
+    client.patch_node_labels(NODE, {"compaction-test": "bump"})
     url = f"http://127.0.0.1:{server.server_port}/_ctl/compact"
     req = urllib.request.Request(url, data=b"{}", method="POST")
     with urllib.request.urlopen(req, timeout=5) as resp:
         floor = json.loads(resp.read())["compacted_below"]
-    assert floor >= 1
+    assert floor > 1
 
     try:
         with pytest.raises(KubeApiError) as exc:
-            next(iter(client.watch_nodes(NODE, resource_version="0",
+            next(iter(client.watch_nodes(NODE, resource_version="1",
                                          timeout_seconds=2)))
         assert exc.value.status == 410
+
+        # rv="0" means "any version" on a real apiserver — it must open
+        # (replaying current state as ADDED), compaction notwithstanding.
+        ev = next(iter(client.watch_nodes(NODE, resource_version="0",
+                                          timeout_seconds=2)))
+        assert ev.type == "ADDED"
 
         # No resourceVersion → fresh watch, replays current state as
         # ADDED.

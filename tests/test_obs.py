@@ -1,0 +1,210 @@
+"""Unit tests for the tracing subsystem (tpu_cc_manager/obs/): span
+nesting, contextvar propagation across threads, journal ring bounding,
+and JSONL sink rotation."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from tpu_cc_manager.obs import journal as journal_mod
+from tpu_cc_manager.obs import trace
+
+
+@pytest.fixture()
+def journal():
+    return journal_mod.Journal(capacity=64, trace_file="")
+
+
+def test_span_nesting_shares_trace_and_links_parents(journal):
+    with trace.root_span("reconcile", journal=journal, mode="on") as root:
+        assert trace.current_span() is root
+        with trace.span("drain") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            with trace.span("drain.await_pods") as grandchild:
+                assert grandchild.trace_id == root.trace_id
+                assert grandchild.parent_id == child.span_id
+    assert trace.current_span() is None
+    spans = journal.spans()
+    # Finish order is innermost-first.
+    assert [s["name"] for s in spans] == [
+        "drain.await_pods", "drain", "reconcile",
+    ]
+    assert len({s["trace_id"] for s in spans}) == 1
+    assert all(s["status"] == "ok" for s in spans)
+    assert spans[2]["attributes"]["mode"] == "on"
+
+
+def test_root_span_ignores_ambient_span(journal):
+    with trace.root_span("outer", journal=journal) as outer:
+        with trace.root_span("inner", journal=journal) as inner:
+            assert inner.trace_id != outer.trace_id
+            assert inner.parent_id is None
+
+
+def test_escaping_exception_marks_span_error(journal):
+    with pytest.raises(ValueError):
+        with trace.root_span("reconcile", journal=journal):
+            with trace.span("reset"):
+                raise ValueError("chip gone")
+    reset, reconcile = journal.spans()
+    assert reset["name"] == "reset"
+    assert reset["status"] == "error"
+    assert "chip gone" in reset["error"]
+    assert reconcile["status"] == "error"
+
+
+def test_child_inherits_parent_journal(journal):
+    """A child span must land in the root's journal, not the global one,
+    even when the opener never names a journal (the drain/barrier/smoke
+    layers never do)."""
+    before = len(journal_mod.JOURNAL.spans())
+    with trace.root_span("reconcile", journal=journal):
+        with trace.span("drain"):
+            pass
+    assert len(journal.spans()) == 2
+    assert len(journal_mod.JOURNAL.spans()) == before
+
+
+def test_contextvar_does_not_leak_to_bare_threads(journal):
+    """threading.Thread targets start with a fresh context: without the
+    propagation helper a span opened in the thread is a new root."""
+    seen = {}
+
+    def worker():
+        with trace.span("inner", journal=journal) as sp:
+            seen["trace_id"] = sp.trace_id
+            seen["parent_id"] = sp.parent_id
+
+    with trace.root_span("outer", journal=journal) as outer:
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=5)
+    assert seen["trace_id"] != outer.trace_id
+    assert seen["parent_id"] is None
+
+
+def test_in_current_context_propagates_across_threads(journal):
+    seen = {}
+
+    def worker():
+        with trace.span("inner") as sp:
+            seen["trace_id"] = sp.trace_id
+            seen["parent_id"] = sp.parent_id
+
+    with trace.root_span("outer", journal=journal) as outer:
+        t = threading.Thread(target=trace.in_current_context(worker))
+        t.start()
+        t.join(timeout=5)
+    assert seen["trace_id"] == outer.trace_id
+    assert seen["parent_id"] == outer.span_id
+    # And the inner span landed in the root's journal via inheritance.
+    assert "inner" in [s["name"] for s in journal.spans()]
+
+
+def test_journal_ring_is_bounded():
+    j = journal_mod.Journal(capacity=4, trace_file="")
+    for i in range(10):
+        with trace.root_span(f"span-{i}", journal=j):
+            pass
+    spans = j.spans()
+    assert len(spans) == 4
+    assert [s["name"] for s in spans] == [
+        "span-6", "span-7", "span-8", "span-9",
+    ]
+
+
+def test_journal_filters_and_trees(journal):
+    with trace.root_span("a", journal=journal) as a:
+        with trace.span("a.child"):
+            pass
+    with trace.root_span("b", journal=journal) as b:
+        pass
+    assert set(journal.trace_ids()) == {a.trace_id, b.trace_id}
+    only_a = journal.spans(trace_id=a.trace_id)
+    assert [s["name"] for s in only_a] == ["a.child", "a"]
+    tree = journal.span_tree(only_a)
+    assert len(tree) == 1
+    assert tree[0]["name"] == "a"
+    assert [c["name"] for c in tree[0]["children"]] == ["a.child"]
+    assert journal.spans(limit=1)[-1]["name"] == "b"
+
+
+def test_active_spans_visible_in_flight(journal):
+    with trace.root_span("reconcile", journal=journal):
+        with trace.span("drain"):
+            live = {s["name"] for s in journal.active_spans()}
+            assert live == {"reconcile", "drain"}
+    assert journal.active_spans() == []
+
+
+def test_jsonl_sink_writes_one_object_per_line(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    j = journal_mod.Journal(capacity=16, trace_file=str(path))
+    with trace.root_span("reconcile", journal=j, mode="on"):
+        with trace.span("drain"):
+            pass
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    parsed = [json.loads(line) for line in lines]
+    assert [p["name"] for p in parsed] == ["drain", "reconcile"]
+    assert len({p["trace_id"] for p in parsed}) == 1
+
+
+def test_jsonl_sink_rotates_at_size_cap(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    j = journal_mod.Journal(
+        capacity=1024, trace_file=str(path), max_file_bytes=2048
+    )
+    for i in range(64):
+        with trace.root_span(f"span-{i}", journal=j, filler="x" * 64):
+            pass
+    rotated = tmp_path / "trace.jsonl.1"
+    assert rotated.exists(), "no rotation happened"
+    assert path.stat().st_size <= 2048 + 512  # one line of slack
+    assert rotated.stat().st_size <= 2048 + 512
+    # Both files still parse line-by-line (rotation never splits a line).
+    for f in (path, rotated):
+        for line in f.read_text().strip().splitlines():
+            json.loads(line)
+
+
+def test_json_log_lines_carry_trace_ids(journal):
+    """JsonFormatter picks trace_id/span_id up from the contextvar, so
+    every log line emitted inside a reconcile correlates with its span
+    tree; outside any span the fields are absent."""
+    import logging
+
+    from tpu_cc_manager.utils.logging import JsonFormatter
+
+    fmt = JsonFormatter()
+
+    def record(msg):
+        return logging.LogRecord(
+            "test", logging.INFO, __file__, 1, msg, (), None
+        )
+
+    with trace.root_span("reconcile", journal=journal) as root:
+        with trace.span("drain") as child:
+            line = json.loads(fmt.format(record("pausing components")))
+    assert line["trace_id"] == root.trace_id
+    assert line["span_id"] == child.span_id
+    outside = json.loads(fmt.format(record("idle")))
+    assert "trace_id" not in outside
+
+
+def test_journal_phase_durations(journal):
+    with trace.root_span("reconcile", journal=journal):
+        with trace.span("drain"):
+            pass
+        with trace.span("drain"):
+            pass
+        with trace.span("reset"):
+            pass
+    durations = journal.phase_durations(("drain", "reset"))
+    assert len(durations["drain"]) == 2
+    assert len(durations["reset"]) == 1
+    assert "reconcile" not in durations

@@ -1,7 +1,7 @@
 """Pause/unpause label algebra (reference gpu_operator_eviction.py:43-95)."""
 
 import pytest
-from hypothesis import given, strategies as st
+from _hypothesis_compat import given, st
 
 from tpu_cc_manager.drain.pause import (
     MAX_LABEL_LEN,

@@ -529,7 +529,7 @@ def test_load_backend_factory(tmp_path):
 # never an unhandled ValueError/ZeroDivisionError mid-discovery.
 # ---------------------------------------------------------------------------
 
-from hypothesis import given, strategies as st
+from _hypothesis_compat import given, st
 
 
 @given(st.text(max_size=24))

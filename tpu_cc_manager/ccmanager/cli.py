@@ -114,7 +114,11 @@ def main(argv: list[str] | None = None) -> int:
         smoke_workload=args.smoke_workload,
     )
     if args.metrics_port:
-        start_metrics_server(args.metrics_port, manager.metrics)
+        # Same journal the manager records to, so /tracez and /statusz
+        # serve the live reconcile traces.
+        start_metrics_server(
+            args.metrics_port, manager.metrics, journal=manager.journal
+        )
     # Graceful shutdown: SIGTERM (kubelet pod stop) sets the stop event so
     # the watch loop exits at the next event/timeout boundary and the
     # readiness file is withdrawn. A blocked watch read auto-retries after

@@ -46,6 +46,8 @@ from tpu_cc_manager.labels import (
     canonical_mode,
     label_safe,
 )
+from tpu_cc_manager.obs import journal as journal_mod
+from tpu_cc_manager.obs import trace as trace_mod
 from tpu_cc_manager.tpudev import attestation
 from tpu_cc_manager.tpudev.contract import SliceTopology, TpuCcBackend, TpuChip, TpuError
 from tpu_cc_manager.utils import metrics as metrics_mod
@@ -98,6 +100,7 @@ class CCManager:
         retry_backoff_s: float | None = None,
         retry_backoff_max_s: float | None = None,
         metrics: metrics_mod.MetricsRegistry | None = None,
+        journal: journal_mod.Journal | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -187,6 +190,11 @@ class CCManager:
             )
         self.retry_backoff_max_s = retry_backoff_max_s
         self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
+        # Span journal for the reconcile trace (obs/): every phase, drain
+        # step, barrier wait, attestation and smoke run of one reconcile
+        # shares one trace_id, served at /tracez and (optionally,
+        # CC_TRACE_FILE) written as JSONL.
+        self.journal = journal if journal is not None else journal_mod.JOURNAL
         # True while a reconcile (set_cc_mode) is in flight; the CLI's
         # shutdown path consults it so a hard exit never interrupts a
         # half-applied hardware transition when grace time remains.
@@ -220,8 +228,16 @@ class CCManager:
             # Events for cluster-scoped objects (Node) must live in the
             # "default" namespace — apiserver validation rejects any other
             # when involvedObject.namespace is empty.
+            metadata: dict = {"generateName": "tpu-cc-manager."}
+            trace_id = trace_mod.current_trace_id()
+            if trace_id is not None:
+                # kubectl-describe readers can jump from the event to the
+                # reconcile's span tree (/tracez?trace_id=...).
+                metadata["annotations"] = {
+                    "tpu-cc.gke.io/trace-id": trace_id
+                }
             self.api.create_event("default", {
-                "metadata": {"generateName": "tpu-cc-manager."},
+                "metadata": metadata,
                 "involvedObject": {
                     "kind": "Node", "name": self.node_name, "apiVersion": "v1",
                 },
@@ -275,7 +291,17 @@ class CCManager:
         self.reconciling = True
         self.retryable_failure = True
         try:
-            return self._set_cc_mode(mode)
+            # One reconcile = one trace: every phase span, drain step,
+            # barrier wait and log line below nests under this root.
+            with trace_mod.root_span(
+                "reconcile", journal=self.journal,
+                mode=mode, node=self.node_name,
+            ) as sp:
+                ok = self._set_cc_mode(mode)
+                sp.set_attribute("ok", ok)
+                if not ok:
+                    sp.status = trace_mod.STATUS_ERROR
+                return ok
         finally:
             self.reconciling = False
 
@@ -289,6 +315,7 @@ class CCManager:
                 "invalid CC mode %r (valid: %s) — refusing to act", mode, VALID_MODES
             )
             self.retryable_failure = False
+            self.metrics.record_failure("invalid-mode")
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason="invalid-mode"
             )
@@ -308,6 +335,7 @@ class CCManager:
             topo = self.backend.discover()
         except TpuError as e:
             log.error("TPU discovery failed: %s", e)
+            self.metrics.record_failure("discovery-failed")
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason="discovery-failed"
             )
@@ -333,6 +361,7 @@ class CCManager:
             # main.py:237-240), where a restart can genuinely re-enumerate.
             log.error("mode %s unsupported on this node: %s", mode, e)
             self.retryable_failure = False  # only a label/pool edit helps
+            self.metrics.record_failure(e.reason)
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason=e.reason
             )
@@ -475,6 +504,7 @@ class CCManager:
         except evict.EvictionTimeout as e:
             log.error("strict eviction failed: %s — not touching hardware", e)
             m.result = "failed"
+            self.metrics.record_failure("drain-timeout")
             self._emit_node_event(
                 "Warning", "CCModeDrainTimeout",
                 f"strict eviction timed out before mode {mode}: {e}",
@@ -558,9 +588,10 @@ class CCManager:
                 # This host is about to re-admit components, so "staged and
                 # drained" no longer describes it: withdraw from the barrier.
                 barrier.abort()
+            reason = self._failure_reason(e)
+            self.metrics.record_failure(reason)
             state.set_cc_state_label(
-                self.api, self.node_name, STATE_FAILED,
-                reason=self._failure_reason(e),
+                self.api, self.node_name, STATE_FAILED, reason=reason,
             )
             self._emit_node_event(
                 "Warning", "CCModeFailed", f"CC mode change to {mode} failed: {e}"

@@ -48,6 +48,7 @@ from tpu_cc_manager.kubeclient.api import (
     node_annotations,
     node_labels,
 )
+from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.tpudev.attestation import (
     AttestationError,
     deserialize_quote,
@@ -253,6 +254,26 @@ def verify_pool_attestation(
 
     Returns the slice map on success; raises PoolAttestationError with the
     full discrepancy list otherwise."""
+    with obs_trace.span(
+        "pool_attest.verify", selector=selector, expected_mode=expected_mode
+    ) as sp:
+        slices = _verify_pool_attestation(
+            api, selector, expected_mode, expected_slices, max_age_s,
+            allow_fake, verify_signatures,
+        )
+        sp.set_attribute("slices", len(slices))
+        return slices
+
+
+def _verify_pool_attestation(
+    api: KubeApi,
+    selector: str,
+    expected_mode: str,
+    expected_slices: int | None,
+    max_age_s: float | None,
+    allow_fake: bool,
+    verify_signatures: bool,
+) -> dict[str, dict]:
     slices = collect_pool_quotes(api, selector)
     problems: list[str] = []
     if not any(e["nodes"] for e in slices.values()):

@@ -33,6 +33,7 @@ from tpu_cc_manager.labels import (
 )
 
 from tpu_cc_manager.labels import SLICE_ID_LABEL  # noqa: F401 - re-export
+from tpu_cc_manager.obs import trace as obs_trace
 
 log = logging.getLogger(__name__)
 
@@ -160,6 +161,21 @@ class RollingReconfigurator:
             raise ValueError(
                 f"invalid CC mode {mode!r} (valid: {VALID_MODES})"
             )
+        # One rollout = one trace (the per-node agents run their own
+        # reconcile traces in their own processes; this trace covers the
+        # orchestrator's window/await structure).
+        with obs_trace.root_span(
+            "rollout", mode=mode, selector=self.selector,
+            max_unavailable=self.max_unavailable,
+        ) as sp:
+            result = self._rollout(mode)
+            sp.set_attribute("ok", result.ok)
+            sp.set_attribute("groups", len(result.groups))
+            if not result.ok:
+                sp.status = obs_trace.STATUS_ERROR
+            return result
+
+    def _rollout(self, mode: str) -> RolloutResult:
         listing = self.api.list_nodes(self.selector)
         groups = plan_groups(self.api, self.selector, nodes=listing)
         log.info(
@@ -308,6 +324,19 @@ class RollingReconfigurator:
         }
 
     def _await_group(
+        self, gid: str, names: tuple[str, ...], mode: str, started: float
+    ) -> GroupResult:
+        with obs_trace.span(
+            "rollout.group", group=gid, nodes=list(names), mode=mode
+        ) as sp:
+            gres = self._await_group_inner(gid, names, mode, started)
+            sp.set_attribute("ok", gres.ok)
+            sp.set_attribute("states", gres.states)
+            if not gres.ok:
+                sp.status = obs_trace.STATUS_ERROR
+            return gres
+
+    def _await_group_inner(
         self, gid: str, names: tuple[str, ...], mode: str, started: float
     ) -> GroupResult:
         deadline = started + self.node_timeout_s

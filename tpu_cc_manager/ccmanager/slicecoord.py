@@ -54,6 +54,7 @@ from tpu_cc_manager.labels import (
     SLICE_ID_LABEL,
     label_safe,
 )
+from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.tpudev.contract import SliceTopology, TpuError
 
 log = logging.getLogger(__name__)
@@ -137,6 +138,16 @@ class SliceBarrier:
         have ALL already committed proceeds without a marker (the fabric
         transition was decided in the round it missed).
         """
+        with obs_trace.span(
+            "barrier.await_commit",
+            slice=self.topo.slice_id,
+            host_index=self.topo.host_index,
+            num_hosts=self.topo.num_hosts,
+            leader=self.is_leader,
+        ):
+            self._await_commit(mode)
+
+    def _await_commit(self, mode: str) -> None:
         deadline = time.monotonic() + self.timeout_s
         committed_seen = False
         ready: list[str] = []
@@ -221,6 +232,12 @@ class SliceBarrier:
         self.clear_staged()  # idempotent; normally already cleared
         if not self.is_leader:
             return
+        with obs_trace.span(
+            "barrier.complete", slice=self.topo.slice_id, leader=True
+        ):
+            self._complete_as_leader(mode)
+
+    def _complete_as_leader(self, mode: str) -> None:
         deadline = time.monotonic() + self.complete_timeout_s
         while time.monotonic() < deadline:
             try:

@@ -21,6 +21,7 @@ from tpu_cc_manager.drain import handshake
 from tpu_cc_manager.drain.pause import is_paused, pause_value, unpause_value
 from tpu_cc_manager.kubeclient.api import KubeApi, node_labels
 from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS
+from tpu_cc_manager.obs import trace as obs_trace
 
 log = logging.getLogger(__name__)
 
@@ -100,23 +101,32 @@ def _evict_components_inner(
     cycle,
 ) -> dict[str, str]:
     if cycle is not None and cycle.subscribers:
-        handshake.await_workload_acks(
-            api, node_name,
-            timeout_s=workload_ack_timeout_s,
-            poll_interval_s=poll_interval_s,
-            token=cycle.token,
-        )
-    original = fetch_component_labels(api, node_name)
-    patch = {}
-    for key, value in original.items():
-        paused = pause_value(value)
-        if paused is not None:
-            patch[key] = paused
-    if patch:
-        log.info("pausing components on %s: %s", node_name, sorted(patch))
-        api.patch_node_labels(node_name, patch)
-    else:
-        log.info("no components to pause on %s", node_name)
+        # Its own span: the handshake is the part of the drain window a
+        # slow-checkpointing training job owns, and the first question
+        # after a blown budget is "handshake or pod eviction?".
+        with obs_trace.span(
+            "drain.handshake", node=node_name,
+            subscribers=len(cycle.subscribers),
+        ):
+            handshake.await_workload_acks(
+                api, node_name,
+                timeout_s=workload_ack_timeout_s,
+                poll_interval_s=poll_interval_s,
+                token=cycle.token,
+            )
+    with obs_trace.span("drain.pause_components", node=node_name) as sp:
+        original = fetch_component_labels(api, node_name)
+        patch = {}
+        for key, value in original.items():
+            paused = pause_value(value)
+            if paused is not None:
+                patch[key] = paused
+        sp.set_attribute("paused", sorted(patch))
+        if patch:
+            log.info("pausing components on %s: %s", node_name, sorted(patch))
+            api.patch_node_labels(node_name, patch)
+        else:
+            log.info("no components to pause on %s", node_name)
 
     # Wait for the operator controller to delete each paused component's
     # pods. Components already paused by a previous (crashed) run must be
@@ -130,29 +140,36 @@ def _evict_components_inner(
     if not paused_now:
         return original
     deadline = time.monotonic() + timeout_s
-    for key in paused_now:
-        app = DRAIN_COMPONENT_LABELS[key]
-        while True:
-            pods = api.list_pods(
-                namespace,
-                label_selector=f"app={app}",
-                field_selector=f"spec.nodeName={node_name}",
-            )
-            if not pods:
-                log.info("component %s drained from %s", app, node_name)
-                break
-            if time.monotonic() >= deadline:
-                msg = (
-                    f"timed out waiting for {len(pods)} pod(s) of component "
-                    f"{app} to leave node {node_name}"
+    with obs_trace.span(
+        "drain.await_pods", node=node_name, components=len(paused_now)
+    ) as sp:
+        timed_out = []
+        for key in paused_now:
+            app = DRAIN_COMPONENT_LABELS[key]
+            while True:
+                pods = api.list_pods(
+                    namespace,
+                    label_selector=f"app={app}",
+                    field_selector=f"spec.nodeName={node_name}",
                 )
-                if proceed_on_timeout:
-                    # Reference behavior: warn and continue to the hardware
-                    # phase anyway (gpu_operator_eviction.py:205-207).
-                    log.warning("%s — continuing anyway", msg)
+                if not pods:
+                    log.info("component %s drained from %s", app, node_name)
                     break
-                raise EvictionTimeout(msg, original)
-            time.sleep(poll_interval_s)
+                if time.monotonic() >= deadline:
+                    msg = (
+                        f"timed out waiting for {len(pods)} pod(s) of component "
+                        f"{app} to leave node {node_name}"
+                    )
+                    if proceed_on_timeout:
+                        # Reference behavior: warn and continue to the hardware
+                        # phase anyway (gpu_operator_eviction.py:205-207).
+                        log.warning("%s — continuing anyway", msg)
+                        timed_out.append(app)
+                        break
+                    raise EvictionTimeout(msg, original)
+                time.sleep(poll_interval_s)
+        if timed_out:
+            sp.set_attribute("timed_out", timed_out)
     return original
 
 
@@ -164,6 +181,13 @@ def readmit_components(api: KubeApi, node_name: str, original: dict[str, str]) -
     unpauses labels that are still in a paused state, so a concurrent
     user edit (e.g. disabling a component mid-drain) wins.
     """
+    with obs_trace.span("readmit.unpause", node=node_name):
+        _readmit_components(api, node_name, original)
+
+
+def _readmit_components(
+    api: KubeApi, node_name: str, original: dict[str, str]
+) -> None:
     labels = node_labels(api.get_node(node_name))
     current = {k: labels[k] for k in DRAIN_COMPONENT_LABELS if k in labels}
     patch: dict[str, str | None] = {}

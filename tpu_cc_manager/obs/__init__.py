@@ -1,0 +1,30 @@
+"""Observability: reconcile tracing (spans + journal).
+
+``obs.trace`` mints trace/span ids and nests spans through a contextvar;
+``obs.journal`` records finished spans to a bounded ring and an optional
+JSONL file (``CC_TRACE_FILE``). The metrics endpoint layer
+(ccmanager/metrics_server.py) serves both at ``/tracez`` and ``/statusz``.
+"""
+
+from tpu_cc_manager.obs.journal import JOURNAL, Journal
+from tpu_cc_manager.obs.trace import (
+    Span,
+    current_span,
+    current_span_id,
+    current_trace_id,
+    in_current_context,
+    root_span,
+    span,
+)
+
+__all__ = [
+    "JOURNAL",
+    "Journal",
+    "Span",
+    "current_span",
+    "current_span_id",
+    "current_trace_id",
+    "in_current_context",
+    "root_span",
+    "span",
+]

@@ -15,6 +15,8 @@ import logging
 import subprocess
 import sys
 
+from tpu_cc_manager.obs import trace as obs_trace
+
 log = logging.getLogger(__name__)
 
 WORKLOADS = {
@@ -40,10 +42,12 @@ def run_workload(name: str, **kwargs) -> dict:
     """Run a workload in-process (tests, bench)."""
     if name not in WORKLOADS:
         raise SmokeError(f"unknown smoke workload {name!r} (have {sorted(WORKLOADS)})")
-    mod = importlib.import_module(WORKLOADS[name])
-    result = mod.run(**kwargs)
-    if not result.get("ok"):
-        raise SmokeError(f"workload {name} reported failure: {result}")
+    with obs_trace.span("smoke.run", workload=name) as sp:
+        mod = importlib.import_module(WORKLOADS[name])
+        result = mod.run(**kwargs)
+        sp.set_attribute("backend", result.get("backend"))
+        if not result.get("ok"):
+            raise SmokeError(f"workload {name} reported failure: {result}")
     return result
 
 
@@ -76,27 +80,31 @@ def run_workload_subprocess(
     if extra_args:
         cmd.extend(extra_args)
     log.info("running smoke workload: %s", " ".join(cmd))
-    try:
-        proc = subprocess.run(
-            cmd, capture_output=True, timeout=timeout_s, text=True,
-            env=env, cwd=cwd,
-        )
-    except subprocess.TimeoutExpired as e:
-        raise SmokeError(f"workload {name} timed out after {timeout_s:.0f}s") from e
-    last_json = None
-    for line in proc.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                last_json = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    if proc.returncode != 0:
-        raise SmokeError(
-            f"workload {name} exited rc={proc.returncode}: "
-            f"{(proc.stderr or '')[-512:]}"
-        )
-    if not last_json or not last_json.get("ok"):
-        raise SmokeError(f"workload {name} produced no passing result: {last_json}")
+    with obs_trace.span(
+        "smoke.subprocess", workload=name, force_cpu=force_cpu
+    ) as sp:
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, timeout=timeout_s, text=True,
+                env=env, cwd=cwd,
+            )
+        except subprocess.TimeoutExpired as e:
+            raise SmokeError(f"workload {name} timed out after {timeout_s:.0f}s") from e
+        last_json = None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    last_json = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        if proc.returncode != 0:
+            raise SmokeError(
+                f"workload {name} exited rc={proc.returncode}: "
+                f"{(proc.stderr or '')[-512:]}"
+            )
+        if not last_json or not last_json.get("ok"):
+            raise SmokeError(f"workload {name} produced no passing result: {last_json}")
+        sp.set_attribute("backend", last_json.get("backend"))
     log.info("smoke workload %s passed: %s", name, last_json)
     return last_json

@@ -29,6 +29,7 @@ import logging
 import secrets
 import time
 
+from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.tpudev import jwks
 from tpu_cc_manager.tpudev.contract import AttestationQuote, TpuError
 
@@ -195,10 +196,15 @@ def verify_quote(
     selected the fake device layer; everywhere else a fake-platform quote
     is an attack, not a test.
     """
-    problems = quote_problems(
-        quote, nonce, expected_mode,
-        expected_slice_id=expected_slice_id, allow_fake=allow_fake,
-    )
+    with obs_trace.span(
+        "attest.verify",
+        platform=quote.platform, mode=quote.mode, slice=quote.slice_id,
+    ) as sp:
+        problems = quote_problems(
+            quote, nonce, expected_mode,
+            expected_slice_id=expected_slice_id, allow_fake=allow_fake,
+        )
+        sp.set_attribute("problems", len(problems))
     if problems:
         if debug_policy:
             for p in problems:

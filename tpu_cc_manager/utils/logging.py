@@ -2,7 +2,9 @@
 
 Reference analogue: main.py:53-58 (stdout logging with asctime/name/level).
 Improvement: optional JSON log lines (one object per line) so GKE's logging
-agent ingests structured fields without a parser config.
+agent ingests structured fields without a parser config. Every line emitted
+inside a trace (obs/trace.py) carries ``trace_id``/``span_id``, so log
+search correlates a drain handshake with the reset/attest it triggered.
 """
 
 from __future__ import annotations
@@ -11,6 +13,8 @@ import json
 import logging
 import sys
 import time
+
+from tpu_cc_manager.obs import trace as obs_trace
 
 
 class JsonFormatter(logging.Formatter):
@@ -23,6 +27,12 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
         }
+        # format() runs on the emitting thread, so the contextvar still
+        # names the span the log call happened under.
+        span = obs_trace.current_span()
+        if span is not None:
+            out["trace_id"] = span.trace_id
+            out["span_id"] = span.span_id
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
         extra = getattr(record, "fields", None)

@@ -5,6 +5,13 @@ north-star metric for this build is a latency — per-node drain→CC-on→ready
 < 90 s (BASELINE.md) — so every reconcile phase is timed here and the timings
 are exported both as structured log lines and programmatically (bench.py and
 the Prometheus text endpoint read them).
+
+Each phase is also traced: :meth:`ReconcileMetrics.phase` opens a span
+(obs/trace.py) named after the phase, so the phase record, the log line,
+and the journal entry all carry the reconcile's ``trace_id``. Latencies
+accumulate into fixed-bucket histograms (``tpu_cc_phase_seconds_bucket``)
+rather than only sum/count pairs, because the <90 s SLO is a tail question
+— a mean cannot say whether one in ten drains blows the budget.
 """
 
 from __future__ import annotations
@@ -14,6 +21,8 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
+
+from tpu_cc_manager.obs import trace as obs_trace
 
 log = logging.getLogger(__name__)
 
@@ -27,6 +36,40 @@ PHASE_ATTEST = "attest"
 PHASE_SMOKE = "smoke"
 PHASE_READMIT = "readmit"
 
+# Fixed histogram buckets (seconds), chosen around the <90 s SLO: fine
+# resolution under a second for the control-plane-only phases, then the
+# decision points an operator actually asks about (30 s reset, 60 s, the
+# 90 s budget itself, and the 300 s timeouts). +Inf is implicit.
+HISTOGRAM_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 45.0,
+    60.0, 90.0, 120.0, 300.0,
+)
+
+
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash, double
+    quote, and newline must be escaped or a hostile/odd mode or phase
+    string corrupts the whole scrape."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def _labels(**kv: str) -> str:
+    """Render a label set with escaped values, keys in given order."""
+    return (
+        "{"
+        + ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in kv.items())
+        + "}"
+    )
+
+
+def _bucket_le(bound: float) -> str:
+    return "%g" % bound
+
 
 @dataclass
 class PhaseRecord:
@@ -34,6 +77,10 @@ class PhaseRecord:
     start: float
     end: float = 0.0
     ok: bool = True
+    # Correlation with the reconcile's span tree (obs/trace.py); set by
+    # ReconcileMetrics.phase from the span it opens.
+    trace_id: str | None = None
+    span_id: str | None = None
 
     @property
     def seconds(self) -> float:
@@ -49,6 +96,7 @@ class ReconcileMetrics:
     end: float = 0.0
     phases: list[PhaseRecord] = field(default_factory=list)
     result: str = "pending"  # pending | ok | failed | noop
+    trace_id: str | None = None
     # Set by MetricsRegistry.start(); finish() folds this reconcile into the
     # registry's cumulative counters (which survive the bounded history).
     registry: "MetricsRegistry | None" = field(
@@ -58,21 +106,26 @@ class ReconcileMetrics:
     @contextlib.contextmanager
     def phase(self, name: str):
         rec = PhaseRecord(name=name, start=time.monotonic())
-        try:
-            yield rec
-        except BaseException:
-            rec.ok = False
-            raise
-        finally:
-            rec.end = time.monotonic()
-            self.phases.append(rec)
-            log.info(
-                "phase %s finished in %.2fs (ok=%s)",
-                name,
-                rec.seconds,
-                rec.ok,
-                extra={"fields": {"phase": name, "seconds": round(rec.seconds, 3), "ok": rec.ok}},
-            )
+        with obs_trace.span(name, phase=name, mode=self.mode) as sp:
+            rec.trace_id, rec.span_id = sp.trace_id, sp.span_id
+            if self.trace_id is None:
+                self.trace_id = sp.trace_id
+            try:
+                yield rec
+            except BaseException:
+                rec.ok = False
+                raise
+            finally:
+                rec.end = time.monotonic()
+                sp.set_attribute("ok", rec.ok)
+                self.phases.append(rec)
+                log.info(
+                    "phase %s finished in %.2fs (ok=%s)",
+                    name,
+                    rec.seconds,
+                    rec.ok,
+                    extra={"fields": {"phase": name, "seconds": round(rec.seconds, 3), "ok": rec.ok}},
+                )
 
     def finish(self, result: str) -> None:
         self.end = time.monotonic()
@@ -118,6 +171,13 @@ class MetricsRegistry:
         # totals — last-reconcile gauges alone lose data between scrapes.
         self._result_totals: dict[str, int] = {}
         self._phase_totals: dict[tuple[str, str], list[float]] = {}
+        # (mode, phase) -> per-bucket cumulative-style counts; index i is
+        # observations <= HISTOGRAM_BUCKETS[i], the final slot is +Inf.
+        self._phase_hist: dict[tuple[str, str], list[int]] = {}
+        # Machine-readable failure reasons (CCManager._failure_reason and
+        # the pre-apply failure paths), keyed exactly as the failed.reason
+        # node label is.
+        self._failure_totals: dict[str, int] = {}
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -128,6 +188,23 @@ class MetricsRegistry:
                 del self._history[: len(self._history) - 256]
         return m
 
+    def observe_phase(self, mode: str, phase: str, seconds: float) -> None:
+        """Fold one phase latency into the cumulative histogram."""
+        with self._lock:
+            hist = self._phase_hist.setdefault(
+                (mode, phase), [0] * (len(HISTOGRAM_BUCKETS) + 1)
+            )
+            for i, bound in enumerate(HISTOGRAM_BUCKETS):
+                if seconds <= bound:
+                    hist[i] += 1
+            hist[-1] += 1  # +Inf
+
+    def record_failure(self, reason: str) -> None:
+        """Count a failed reconcile by machine-readable reason (the same
+        string the failed.reason node label carries)."""
+        with self._lock:
+            self._failure_totals[reason] = self._failure_totals.get(reason, 0) + 1
+
     def _accumulate(self, m: ReconcileMetrics) -> None:
         with self._lock:
             self._result_totals[m.result] = self._result_totals.get(m.result, 0) + 1
@@ -135,6 +212,16 @@ class MetricsRegistry:
                 tot = self._phase_totals.setdefault((m.mode, p.name), [0.0, 0])
                 tot[0] += p.seconds
                 tot[1] += 1
+        for p in m.phases:
+            self.observe_phase(m.mode, p.name, p.seconds)
+
+    def result_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._result_totals)
+
+    def failure_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._failure_totals)
 
     @property
     def history(self) -> list[ReconcileMetrics]:
@@ -154,44 +241,72 @@ class MetricsRegistry:
         last = self.last()
         if last is not None:
             lines.append(
-                'tpu_cc_reconcile_seconds{mode="%s",result="%s"} %.3f'
-                % (last.mode, last.result, last.total_seconds)
+                "tpu_cc_reconcile_seconds%s %.3f"
+                % (_labels(mode=last.mode, result=last.result), last.total_seconds)
             )
-            lines.append("# HELP tpu_cc_phase_seconds Seconds per phase of the most recent reconcile.")
-            lines.append("# TYPE tpu_cc_phase_seconds gauge")
+            lines.append("# HELP tpu_cc_last_phase_seconds Seconds per phase of the most recent reconcile.")
+            lines.append("# TYPE tpu_cc_last_phase_seconds gauge")
             for p in last.phases:
                 lines.append(
-                    'tpu_cc_phase_seconds{mode="%s",phase="%s",ok="%s"} %.3f'
-                    % (last.mode, p.name, str(p.ok).lower(), p.seconds)
+                    "tpu_cc_last_phase_seconds%s %.3f"
+                    % (
+                        _labels(mode=last.mode, phase=p.name, ok=str(p.ok).lower()),
+                        p.seconds,
+                    )
                 )
         lines.append("# HELP tpu_cc_reconciles_total Reconciles since process start.")
         lines.append("# TYPE tpu_cc_reconciles_total counter")
         with self._lock:
             result_totals = dict(self._result_totals)
             phase_totals = {k: list(v) for k, v in self._phase_totals.items()}
+            phase_hist = {k: list(v) for k, v in self._phase_hist.items()}
+            failure_totals = dict(self._failure_totals)
         for result in ("ok", "failed", "noop"):
             lines.append(
-                'tpu_cc_reconciles_total{result="%s"} %d'
-                % (result, result_totals.get(result, 0))
+                "tpu_cc_reconciles_total%s %d"
+                % (_labels(result=result), result_totals.get(result, 0))
             )
         lines.append(
-            "# HELP tpu_cc_phase_seconds_total Cumulative seconds spent per "
-            "phase since process start."
+            "# HELP tpu_cc_failures_total Failed reconciles by machine-"
+            "readable reason (the failed.reason node label)."
         )
-        lines.append("# TYPE tpu_cc_phase_seconds_total counter")
-        lines.append(
-            "# HELP tpu_cc_phase_runs_total Cumulative phase executions "
-            "since process start."
-        )
-        lines.append("# TYPE tpu_cc_phase_runs_total counter")
-        for (mode, phase), (seconds, count) in sorted(phase_totals.items()):
+        lines.append("# TYPE tpu_cc_failures_total counter")
+        for reason in sorted(failure_totals):
             lines.append(
-                'tpu_cc_phase_seconds_total{mode="%s",phase="%s"} %.3f'
-                % (mode, phase, seconds)
+                "tpu_cc_failures_total%s %d"
+                % (_labels(reason=reason), failure_totals[reason])
+            )
+        # The cumulative per-phase sums/counts are served exclusively as
+        # the histogram's _sum/_count series below — separate
+        # tpu_cc_phase_seconds_total/_runs_total counters would duplicate
+        # them AND collide with the histogram family name under
+        # OpenMetrics (where a counter named X_total belongs to family X).
+        lines.append(
+            "# HELP tpu_cc_phase_seconds Per-phase latency histogram "
+            "(fixed buckets around the 90 s SLO)."
+        )
+        lines.append("# TYPE tpu_cc_phase_seconds histogram")
+        for (mode, phase), hist in sorted(phase_hist.items()):
+            total_s = phase_totals.get((mode, phase), [0.0, 0])[0]
+            for i, bound in enumerate(HISTOGRAM_BUCKETS):
+                lines.append(
+                    "tpu_cc_phase_seconds_bucket%s %d"
+                    % (
+                        _labels(mode=mode, phase=phase, le=_bucket_le(bound)),
+                        hist[i],
+                    )
+                )
+            lines.append(
+                "tpu_cc_phase_seconds_bucket%s %d"
+                % (_labels(mode=mode, phase=phase, le="+Inf"), hist[-1])
             )
             lines.append(
-                'tpu_cc_phase_runs_total{mode="%s",phase="%s"} %d'
-                % (mode, phase, count)
+                "tpu_cc_phase_seconds_sum%s %.3f"
+                % (_labels(mode=mode, phase=phase), total_s)
+            )
+            lines.append(
+                "tpu_cc_phase_seconds_count%s %d"
+                % (_labels(mode=mode, phase=phase), hist[-1])
             )
         return "\n".join(lines) + "\n"
 
