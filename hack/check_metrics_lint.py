@@ -230,6 +230,11 @@ def _seeded_registry_text() -> str:
     m.finish("failed")
     registry.record_failure("attestation-failed")
     registry.record_failure('weird"reason')
+    registry.record_retry("kube.get", "throttled")
+    registry.record_retry("tpuvm.reset", 'odd"reason\nhere')
+    registry.set_breaker_state("apiserver", "half_open")
+    registry.set_breaker_state("device-cmd", "closed")
+    registry.set_health_tier("device-node", 1, healthy=False)
     return registry.render_prometheus()
 
 

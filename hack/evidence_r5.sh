@@ -41,6 +41,7 @@ mkdir -p artifacts
 # The full artifact set, declared upfront so finish() reports honestly
 # even when the ladder stops at an early stage.
 ARTIFACTS=(
+  artifacts/chaos_soak.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
   artifacts/resnet_ladder_r05.jsonl
@@ -117,6 +118,27 @@ finish() {  # honest exit: 0 only when every artifact exists non-empty
   echo "=== evidence ladder INCOMPLETE: $missing artifact(s) missing (re-run to resume) ==="
   exit 3
 }
+
+# Robustness evidence first: the seeded chaos soak is CPU-only (fake
+# backend + in-memory apiserver), needs no tunnel, and is the cheapest
+# stage — so it runs before the gated on-chip ladder and its artifact is
+# captured even when the tunnel never comes up. Skipped only when the
+# artifact records ok:true — chaos_soak.sh writes the summary even on a
+# failed soak (for inspection), so non-empty alone must NOT read as
+# captured or a failed soak would silently pass on re-run.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("artifacts/chaos_soak.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> artifacts/chaos_soak.json already captured (ok:true); skipping"
+else
+  echo "=== stage: chaos-soak (local, no tunnel) ==="
+  bash hack/chaos_soak.sh || {
+    # Park the failed summary where finish()'s exists-non-empty check
+    # cannot mistake it for captured evidence.
+    [ -s artifacts/chaos_soak.json ] && \
+      mv artifacts/chaos_soak.json artifacts/chaos_soak.failed.json
+    echo ">>> chaos soak FAILED; stopping ladder (robustness evidence gates the rest; summary in artifacts/chaos_soak.failed.json)"
+    finish
+  }
+fi
 
 stage "pallas-sweep" artifacts/pallas_sweep_r05.jsonl \
   env OUT=artifacts/pallas_sweep_r05.jsonl ERRLOG=artifacts/pallas_sweep_r05.stderr.log \

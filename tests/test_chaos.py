@@ -1,0 +1,334 @@
+"""Chaos fault injection (tpu_cc_manager/faults/) and the seeded soak.
+
+The fast deterministic subset here runs in tier-1 under the ``chaos``
+marker; hack/chaos_soak.sh re-runs the soak with more rounds
+(CC_CHAOS_ROUNDS) and any seed (CC_CHAOS_SEED). The soak's contract is the
+robustness acceptance bar: drive the REAL manager loop (watch, drain,
+stage/reset, attest, readmit) through a seeded schedule of apiserver and
+device faults plus a watchdog demote→restore cycle, then prove
+convergence — correct final mode labels, no stuck pause labels, retries
+within budget.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.watchdog import RuntimeHealthWatchdog
+from tpu_cc_manager.drain.pause import is_paused
+from tpu_cc_manager.faults import FaultPlan, FaultyKubeClient
+from tpu_cc_manager.kubeclient.api import KubeApiError, node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    CC_READY_STATE_LABEL,
+    DRAIN_COMPONENT_LABELS,
+    MODE_DEVTOOLS,
+    MODE_OFF,
+    MODE_ON,
+)
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "chaos-node-0"
+NS = "tpu-operator"
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# Determinism: same seed -> same fault schedule
+# ---------------------------------------------------------------------------
+
+
+def drive_fixed_sequence(seed: int) -> list[tuple]:
+    """A fixed, thread-free call sequence through the faulty client; the
+    returned schedule must be a pure function of the seed."""
+    kube = FakeKube()
+    kube.add_node(NODE, {"pool": "tpu"})
+    plan = FaultPlan(seed=seed, rate=0.35, watch_rate=0.5,
+                     retry_after_s=0.0, slow_s=0.0)
+    api = FaultyKubeClient(kube, plan, sleep=lambda s: None)
+    for i in range(40):
+        try:
+            if i % 4 == 0:
+                api.get_node(NODE)
+            elif i % 4 == 1:
+                api.list_nodes("pool=tpu")
+            elif i % 4 == 2:
+                api.patch_node_labels(NODE, {"x": str(i)})
+            else:
+                list(api.watch_nodes(NODE, None, 0))
+        except KubeApiError:
+            pass
+    return [(f.kind, f.op, f.seq, f.status) for f in plan.injected]
+
+
+def test_same_seed_reproduces_the_fault_schedule():
+    assert drive_fixed_sequence(1234) == drive_fixed_sequence(1234)
+
+
+def test_different_seeds_produce_different_schedules():
+    assert drive_fixed_sequence(1234) != drive_fixed_sequence(4321)
+
+
+def test_fault_budget_does_not_skew_the_rng_stream():
+    """max_faults caps injections but must not change WHICH calls would
+    have been faulted — the schedule prefix is identical."""
+    full = drive_fixed_sequence(99)
+
+    kube = FakeKube()
+    kube.add_node(NODE, {"pool": "tpu"})
+    plan = FaultPlan(seed=99, rate=0.35, watch_rate=0.5, max_faults=3,
+                     retry_after_s=0.0, slow_s=0.0)
+    api = FaultyKubeClient(kube, plan, sleep=lambda s: None)
+    for i in range(40):
+        try:
+            if i % 4 == 0:
+                api.get_node(NODE)
+            elif i % 4 == 1:
+                api.list_nodes("pool=tpu")
+            elif i % 4 == 2:
+                api.patch_node_labels(NODE, {"x": str(i)})
+            else:
+                list(api.watch_nodes(NODE, None, 0))
+        except KubeApiError:
+            pass
+    capped = [(f.kind, f.op, f.seq, f.status) for f in plan.injected]
+    assert capped == full[:3]
+
+
+# ---------------------------------------------------------------------------
+# Fault kinds behave as advertised
+# ---------------------------------------------------------------------------
+
+
+def test_fault_kinds_map_to_the_right_errors():
+    kube = FakeKube()
+    kube.add_node(NODE)
+    plan = FaultPlan(seed=5, rate=1.0, retry_after_s=1.5)
+    api = FaultyKubeClient(kube, plan, sleep=lambda s: None)
+    seen: dict[str, KubeApiError] = {}
+    for _ in range(60):
+        try:
+            api.get_node(NODE)
+        except KubeApiError as e:
+            seen[plan.injected[-1].kind] = e
+    assert set(seen) >= {"http-429", "http-5xx", "conn-reset"}
+    assert seen["http-429"].status == 429
+    assert seen["http-429"].retry_after_s == 1.5
+    assert seen["http-5xx"].status in (500, 502, 503, 504)
+    assert seen["conn-reset"].status is None
+
+
+def test_watch_faults_hang_up_and_expire():
+    kube = FakeKube()
+    kube.add_node(NODE)
+    plan = FaultPlan(seed=2, watch_rate=1.0)
+    api = FaultyKubeClient(kube, plan, sleep=lambda s: None)
+    kinds = set()
+    for _ in range(20):
+        try:
+            list(api.watch_nodes(NODE, None, 0))
+        except KubeApiError as e:
+            kinds.add((plan.injected[-1].kind, e.status))
+    assert ("stale-rv", 410) in kinds
+    assert ("watch-hangup", None) in kinds
+
+
+# ---------------------------------------------------------------------------
+# The seeded chaos soak
+# ---------------------------------------------------------------------------
+
+
+def operator_controller(kube: FakeKube) -> None:
+    """Emulate the operator: paused component labels delete the pods,
+    unpaused labels bring them back (so every drain has real pods to wait
+    out and every readmit is observable)."""
+
+    def reactor(name, node):
+        labels = node_labels(node)
+        for key, app in DRAIN_COMPONENT_LABELS.items():
+            if key not in labels:
+                continue
+            if is_paused(labels.get(key)):
+                kube.delete_pods_matching(NS, f"app={app}")
+            elif not kube.list_pods(NS, f"app={app}"):
+                kube.add_pod(NS, f"{app}-pod", name, labels={"app": app})
+
+    kube.add_patch_reactor(reactor)
+
+
+def await_state(kube, desired: str, timeout_s: float = 20.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        labels = node_labels(kube.get_node(NODE))
+        if labels.get(CC_MODE_STATE_LABEL) == desired:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"node never converged to {desired}; labels="
+        f"{node_labels(kube.get_node(NODE))}"
+    )
+
+
+def test_chaos_soak_converges_with_bounded_retries(fake_kube, tmp_path):
+    """The acceptance-bar soak: seeded apiserver faults (429/5xx/resets/
+    watch hangups/410s) + seeded device faults + a watchdog demote→restore
+    cycle, against the REAL watch loop with drains enabled. After the
+    fault budget dries up the node must converge to every driven mode, no
+    pause label may stay stuck, and total retries stay within budget."""
+    rounds = int(os.environ.get("CC_CHAOS_ROUNDS", "2"))
+    plan = FaultPlan.from_env(
+        rate=0.15, watch_rate=0.25,
+        max_faults=30 * rounds, retry_after_s=0.005, slow_s=0.002,
+    )
+    api = FaultyKubeClient(fake_kube, plan)
+    dp_label = "google.com/tpu.deploy.device-plugin"
+    fake_kube.add_node(NODE, {dp_label: "true"})
+    operator_controller(fake_kube)
+    fake_kube.add_pod(
+        NS, "dp-pod", NODE, labels={"app": DRAIN_COMPONENT_LABELS[dp_label]}
+    )
+
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    mgr = CCManager(
+        api=api,
+        backend=backend,
+        node_name=NODE,
+        default_mode=MODE_OFF,
+        operator_namespace=NS,
+        evict_components=True,
+        smoke_workload="none",
+        metrics=registry,
+        eviction_timeout_s=2.0,
+        eviction_poll_interval_s=0.01,
+        watch_timeout_s=1,
+        reconnect_delay_s=0.01,
+        retry_backoff_s=0.02,
+        retry_backoff_max_s=0.2,
+        readiness_file=str(tmp_path / "ready"),
+    )
+    watchdog = RuntimeHealthWatchdog(
+        api, backend, NODE,
+        demote_after=2, restore_after=2,
+        is_busy=lambda: mgr.reconciling,
+        emit_event=mgr._emit_node_event,
+        metrics=registry,
+    )
+    stop = threading.Event()
+
+    def agent():
+        """The per-node agent with DaemonSet semantics: a startup apiserver
+        fault or an exhausted watch-error cap crashes the process and the
+        kubelet restarts it — crash-as-retry, exactly as deployed."""
+        while not stop.is_set():
+            try:
+                mgr.watch_and_apply(stop)
+                return
+            except (KubeApiError, RuntimeError):
+                time.sleep(0.01)  # pod restart latency
+
+    thread = threading.Thread(target=agent, daemon=True)
+    thread.start()
+    try:
+        modes = ([MODE_ON, MODE_OFF, MODE_DEVTOOLS] * rounds) + [MODE_ON]
+        for mode in modes:
+            # Device-layer chaos from the same seed, armed between drives.
+            plan.schedule_backend_fault(
+                backend, ops=("stage", "reset", "wait_ready", "attest")
+            )
+            fake_kube.set_node_label(NODE, CC_MODE_LABEL, mode)
+            await_state(fake_kube, mode)
+
+        # Watchdog demote→restore cycle mid-soak, with faults still flying.
+        backend.healthy = False
+        for _ in range(200):
+            watchdog.tick()
+            if watchdog.degraded:
+                break
+            time.sleep(0.005)
+        assert watchdog.degraded
+        assert node_labels(fake_kube.get_node(NODE))[
+            CC_READY_STATE_LABEL
+        ] == "false"
+        backend.healthy = True
+        for _ in range(200):
+            watchdog.tick()
+            if not watchdog.degraded:
+                break
+            time.sleep(0.005)
+        assert not watchdog.degraded
+        assert node_labels(fake_kube.get_node(NODE))[
+            CC_READY_STATE_LABEL
+        ] == "true"
+
+        # Final convergence: not just the state label (which lands BEFORE
+        # re-admission) but the whole node — components unpaused and their
+        # pods back. A readmit lost to a fault is retried by the agent's
+        # backoff ladder, so with the agent still running this must settle.
+        fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+
+        def fully_converged() -> bool:
+            labels = node_labels(fake_kube.get_node(NODE))
+            return (
+                labels.get(CC_MODE_STATE_LABEL) == MODE_ON
+                and labels.get(CC_READY_STATE_LABEL) == "true"
+                and not is_paused(labels.get(dp_label))
+                and bool(fake_kube.list_pods(
+                    NS, f"app={DRAIN_COMPONENT_LABELS[dp_label]}"
+                ))
+            )
+
+        deadline = time.monotonic() + 20.0
+        while not fully_converged():
+            assert time.monotonic() < deadline, (
+                "node never fully converged (state+ready+unpaused+pods); "
+                f"labels={node_labels(fake_kube.get_node(NODE))}"
+            )
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    assert not thread.is_alive()
+
+    labels = node_labels(fake_kube.get_node(NODE))
+    # Converged to the labeled mode with readiness restored.
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON
+    assert labels[CC_READY_STATE_LABEL] == "true"
+    # No stuck pause labels: the component label is back to an unpaused
+    # value and its pods are back.
+    assert not is_paused(labels.get(dp_label))
+    assert fake_kube.list_pods(
+        NS, f"app={DRAIN_COMPONENT_LABELS[dp_label]}"
+    ), "component pods never re-admitted"
+    # No lingering barrier markers on this single-host topology.
+    from tpu_cc_manager.ccmanager.slicecoord import (
+        SLICE_COMMIT_LABEL,
+        SLICE_STAGED_LABEL,
+    )
+
+    assert SLICE_STAGED_LABEL not in labels
+    assert SLICE_COMMIT_LABEL not in labels
+    # Bounded recovery cost: every injected fault is worth at most a few
+    # classified retries (policy ladders are <=3 deep) plus the watch
+    # reconnects the hangups force.
+    total_retries = sum(registry.retry_totals().values())
+    budget = 4 * len(plan.injected) + 40
+    assert total_retries <= budget, (
+        f"retry storm: {total_retries} retries for {len(plan.injected)} "
+        f"injected faults (budget {budget}); "
+        f"totals={registry.retry_totals()}"
+    )
+    print(
+        "CHAOS_SOAK_SUMMARY "
+        f"seed={plan.seed} rounds={rounds} faults={len(plan.injected)} "
+        f"retries={total_retries} budget={budget}"
+    )

@@ -1,0 +1,533 @@
+"""utils/retry.py edges: Retry-After parsing, jitter bounds, classified
+retries, deadline budgets, poll_until, and circuit-breaker transitions —
+plus the REST client riding the shared policy (Retry-After honored,
+breaker fail-fast)."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_cc_manager.kubeclient.api import KubeApiError, classify_kube_error
+from tpu_cc_manager.utils import retry
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+
+def make_policy(**kwargs):
+    kwargs.setdefault("rng", random.Random(42))
+    kwargs.setdefault("sleep", lambda s: None)
+    kwargs.setdefault("metrics", MetricsRegistry())
+    return retry.RetryPolicy(**kwargs)
+
+
+class TestRetryAfterParsing:
+    def test_delta_seconds(self):
+        assert retry.parse_retry_after("120") == 120.0
+        assert retry.parse_retry_after(" 2.5 ") == 2.5
+
+    def test_negative_clamps_to_zero(self):
+        assert retry.parse_retry_after("-3") == 0.0
+
+    def test_http_date(self):
+        import email.utils
+        import time as _time
+
+        future = email.utils.formatdate(_time.time() + 60, usegmt=True)
+        parsed = retry.parse_retry_after(future)
+        assert parsed is not None and 50 < parsed <= 61
+
+    def test_past_http_date_clamps_to_zero(self):
+        import email.utils
+        import time as _time
+
+        past = email.utils.formatdate(_time.time() - 3600, usegmt=True)
+        assert retry.parse_retry_after(past) == 0.0
+
+    def test_garbage_and_absent_degrade_to_none(self):
+        assert retry.parse_retry_after(None) is None
+        assert retry.parse_retry_after("") is None
+        assert retry.parse_retry_after("soon-ish") is None
+
+
+class TestJitter:
+    def test_full_jitter_stays_within_exponential_cap(self):
+        policy = make_policy(base_delay_s=1.0, max_delay_s=8.0)
+        for attempt, cap in ((0, 1.0), (1, 2.0), (2, 4.0), (3, 8.0), (9, 8.0)):
+            for _ in range(200):
+                d = policy.delay_for(attempt)
+                assert 0.0 <= d <= cap, (attempt, d)
+
+    def test_seeded_rng_reproduces_schedule(self):
+        a = make_policy(rng=random.Random(7))
+        b = make_policy(rng=random.Random(7))
+        assert [a.delay_for(i) for i in range(6)] == [
+            b.delay_for(i) for i in range(6)
+        ]
+
+    def test_retry_after_is_a_floor_not_a_suggestion(self):
+        policy = make_policy(base_delay_s=0.001, max_delay_s=0.002)
+        for _ in range(50):
+            assert policy.delay_for(0, retry_after_s=5.0) >= 5.0
+
+    def test_jitter_off_returns_the_cap(self):
+        policy = make_policy(jitter=False, base_delay_s=1.0, max_delay_s=30.0)
+        assert policy.delay_for(2) == 4.0
+
+
+class TestClassifiedCall:
+    def test_transient_then_success(self):
+        policy = make_policy(base_delay_s=0.001)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise KubeApiError(503, "hiccup")
+            return "ok"
+
+        assert policy.call(flaky, op="t", classify=classify_kube_error) == "ok"
+        assert calls["n"] == 3
+
+    def test_permanent_raises_immediately(self):
+        policy = make_policy()
+        calls = {"n": 0}
+
+        def nope():
+            calls["n"] += 1
+            raise KubeApiError(404, "gone for good")
+
+        with pytest.raises(KubeApiError):
+            policy.call(nope, op="t", classify=classify_kube_error)
+        assert calls["n"] == 1
+
+    def test_exhaustion_reraises_original_error(self):
+        policy = make_policy(max_attempts=2, base_delay_s=0.001)
+
+        def always():
+            raise KubeApiError(503, "still down")
+
+        with pytest.raises(KubeApiError) as exc:
+            policy.call(always, op="t", classify=classify_kube_error)
+        assert exc.value.status == 503
+
+    def test_deadline_budget_stops_retrying(self):
+        """A retry whose backoff would cross the operation deadline raises
+        instead of sleeping past the budget."""
+        clock = {"now": 0.0}
+        sleeps = []
+
+        policy = retry.RetryPolicy(
+            max_attempts=10,
+            base_delay_s=1.0,
+            max_delay_s=1.0,
+            deadline_s=2.5,
+            jitter=False,
+            rng=random.Random(0),
+            sleep=lambda s: (sleeps.append(s), clock.__setitem__("now", clock["now"] + s)),
+            clock=lambda: clock["now"],
+            metrics=MetricsRegistry(),
+        )
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise KubeApiError(None, "reset")
+
+        with pytest.raises(KubeApiError):
+            policy.call(always, op="t", classify=classify_kube_error)
+        # 1 s + 1 s fits in the 2.5 s budget; the third sleep would land at
+        # 3 s > 2.5 s, so exactly 3 attempts ran.
+        assert calls["n"] == 3
+        assert sleeps == [1.0, 1.0]
+
+    def test_retries_are_counted_per_op_and_reason(self):
+        registry = MetricsRegistry()
+        policy = make_policy(base_delay_s=0.001, metrics=registry)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise KubeApiError(429, "slow down")
+            return "ok"
+
+        policy.call(flaky, op="kube.get", classify=classify_kube_error)
+        assert registry.retry_totals() == {("kube.get", "throttled"): 2}
+        text = registry.render_prometheus()
+        assert 'tpu_cc_retries_total{op="kube.get",reason="throttled"} 2' in text
+
+    def test_retry_annotates_current_span(self):
+        from tpu_cc_manager.obs import journal as journal_mod
+        from tpu_cc_manager.obs import trace as trace_mod
+
+        policy = make_policy(base_delay_s=0.001)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise KubeApiError(503, "hiccup")
+            return "ok"
+
+        with trace_mod.root_span("t", journal=journal_mod.Journal()) as sp:
+            policy.call(flaky, op="kube.get", classify=classify_kube_error)
+        assert sp.attributes["retries"][0]["op"] == "kube.get"
+        assert sp.attributes["retries"][0]["reason"] == "http-503"
+
+
+class TestPollUntil:
+    def test_converges(self):
+        state = {"n": 0}
+
+        def pred():
+            state["n"] += 1
+            return state["n"] >= 3
+
+        assert retry.poll_until(pred, 10.0, 0.001) is True
+        assert state["n"] == 3
+
+    def test_timeout_returns_false_after_at_least_one_poll(self):
+        polls = {"n": 0}
+
+        def pred():
+            polls["n"] += 1
+            return False
+
+        assert retry.poll_until(pred, 0.0, 0.001) is False
+        assert polls["n"] == 1
+
+    def test_never_sleeps_past_the_deadline(self):
+        clock = {"now": 0.0}
+        sleeps = []
+
+        def sleep(s):
+            sleeps.append(s)
+            clock["now"] += s
+
+        assert (
+            retry.poll_until(
+                lambda: False, 1.0, 0.4,
+                sleep=sleep, clock=lambda: clock["now"],
+            )
+            is False
+        )
+        assert sum(sleeps) <= 1.0 + 1e-9
+        assert sleeps[-1] <= 0.4
+
+
+class TestCircuitBreaker:
+    def make(self, **kwargs):
+        self.clock = {"now": 0.0}
+        kwargs.setdefault("failure_threshold", 3)
+        kwargs.setdefault("recovery_time_s", 10.0)
+        kwargs.setdefault("clock", lambda: self.clock["now"])
+        kwargs.setdefault("metrics", MetricsRegistry())
+        return retry.CircuitBreaker("dep", **kwargs)
+
+    def test_opens_after_threshold_and_fails_fast(self):
+        br = self.make()
+        for _ in range(3):
+            br.before_call()
+            br.record_failure()
+        assert br.state == retry.BREAKER_OPEN
+        with pytest.raises(retry.CircuitOpenError):
+            br.before_call()
+
+    def test_success_resets_the_failure_count(self):
+        br = self.make()
+        for _ in range(2):
+            br.record_failure()
+        br.record_success()
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == retry.BREAKER_CLOSED
+
+    def test_half_open_probe_success_closes(self):
+        br = self.make()
+        for _ in range(3):
+            br.record_failure()
+        self.clock["now"] = 10.0  # recovery window elapsed
+        assert br.state == retry.BREAKER_HALF_OPEN
+        br.before_call()  # the single probe
+        # A second caller during the probe is still rejected.
+        with pytest.raises(retry.CircuitOpenError):
+            br.before_call()
+        br.record_success()
+        assert br.state == retry.BREAKER_CLOSED
+        br.before_call()  # closed again: calls flow
+
+    def test_half_open_probe_failure_reopens(self):
+        br = self.make()
+        for _ in range(3):
+            br.record_failure()
+        self.clock["now"] = 10.0
+        br.before_call()
+        br.record_failure()
+        assert br.state == retry.BREAKER_OPEN
+        with pytest.raises(retry.CircuitOpenError):
+            br.before_call()
+        # ...until another recovery window passes.
+        self.clock["now"] = 20.0
+        br.before_call()
+        br.record_success()
+        assert br.state == retry.BREAKER_CLOSED
+
+    def test_state_exported_to_metrics(self):
+        registry = MetricsRegistry()
+        br = self.make(metrics=registry)
+        assert registry.breaker_states()["dep"] == "closed"
+        for _ in range(3):
+            br.record_failure()
+        assert registry.breaker_states()["dep"] == "open"
+        assert 'tpu_cc_breaker_state{path="dep"} 2' in registry.render_prometheus()
+
+
+class TestRestClientPolicy:
+    """The REST client rides the shared policy: Retry-After honored,
+    breaker opens after sustained transport failure."""
+
+    def make_client(self, **kwargs):
+        from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+        sleeps = []
+        policy = retry.RetryPolicy(
+            max_attempts=3,
+            base_delay_s=0.001,
+            max_delay_s=0.01,
+            rng=random.Random(1),
+            sleep=sleeps.append,
+            metrics=MetricsRegistry(),
+        )
+        client = RestKube(
+            ClusterConfig(server="http://x"), retry_policy=policy, **kwargs
+        )
+        return client, sleeps
+
+    def test_retry_after_header_is_honored(self):
+        client, sleeps = self.make_client()
+        calls = {"n": 0}
+
+        def throttled(method, path, query=None, body=None, content_type=None,
+                      read_timeout=30.0):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise KubeApiError(429, "slow down", retry_after_s=3.5)
+            import io
+            import json as _json
+
+            return io.BytesIO(_json.dumps({"metadata": {}}).encode())
+
+        client._open = throttled  # type: ignore[method-assign]
+        client.get_node("n")
+        assert calls["n"] == 2
+        # The jittered backoff cap is 0.001 s; only the header explains 3.5.
+        assert sleeps == [3.5]
+
+    def test_breaker_opens_and_fails_fast(self):
+        client, _ = self.make_client(
+            breaker=retry.CircuitBreaker(
+                "apiserver", failure_threshold=2, recovery_time_s=60.0,
+                metrics=MetricsRegistry(),
+            )
+        )
+        calls = {"n": 0}
+
+        def down(method, path, query=None, body=None, content_type=None,
+                 read_timeout=30.0):
+            calls["n"] += 1
+            raise KubeApiError(None, "connection refused")
+
+        client._open = down  # type: ignore[method-assign]
+        with pytest.raises(KubeApiError):
+            client.get_node("n")
+        assert calls["n"] == 2  # third attempt was rejected by the breaker
+        # Subsequent calls fail fast without touching the network.
+        with pytest.raises(KubeApiError):
+            client.get_node("n")
+        assert calls["n"] == 2
+
+    def test_definitive_4xx_resets_the_breaker(self):
+        client, _ = self.make_client(
+            breaker=retry.CircuitBreaker(
+                "apiserver", failure_threshold=2, recovery_time_s=60.0,
+                metrics=MetricsRegistry(),
+            )
+        )
+
+        def not_found(method, path, query=None, body=None, content_type=None,
+                      read_timeout=30.0):
+            raise KubeApiError(404, "no such node")
+
+        client._open = not_found  # type: ignore[method-assign]
+        for _ in range(5):
+            with pytest.raises(KubeApiError):
+                client.get_node("n")
+        assert client.breaker.state == retry.BREAKER_CLOSED
+
+
+class TestBreakerProbeRecovery:
+    """Half-open probe slots must never wedge the breaker (review finding:
+    a probe ending in a permanent/unclassified failure used to leak
+    _probe_in_flight forever)."""
+
+    def make(self):
+        self.clock = {"now": 0.0}
+        return retry.CircuitBreaker(
+            "dep", failure_threshold=2, recovery_time_s=10.0,
+            clock=lambda: self.clock["now"], metrics=MetricsRegistry(),
+        )
+
+    def trip(self, br):
+        for _ in range(2):
+            br.record_failure()
+        self.clock["now"] += 10.0
+
+    def test_record_permanent_releases_the_probe_slot(self):
+        br = self.make()
+        self.trip(br)
+        br.before_call()           # probe granted
+        br.record_permanent()      # probe failed for a health-unrelated reason
+        br.before_call()           # next caller can probe immediately
+        br.record_success()
+        assert br.state == retry.BREAKER_CLOSED
+
+    def test_unrecorded_probe_lease_expires(self):
+        br = self.make()
+        self.trip(br)
+        br.before_call()  # probe granted, then its caller dies silently
+        with pytest.raises(retry.CircuitOpenError):
+            br.before_call()
+        self.clock["now"] += 10.0  # lease expired
+        br.before_call()           # a new probe takes over
+        br.record_success()
+        assert br.state == retry.BREAKER_CLOSED
+
+
+class TestRestClientBreakerEdges:
+    def make_client(self, breaker):
+        from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+        sleeps = []
+        policy = retry.RetryPolicy(
+            max_attempts=3, base_delay_s=0.001, max_delay_s=0.01,
+            rng=random.Random(1), sleep=sleeps.append,
+            metrics=MetricsRegistry(),
+        )
+        return RestKube(
+            ClusterConfig(server="http://x"),
+            retry_policy=policy, breaker=breaker,
+        ), sleeps
+
+    def test_open_circuit_fails_fast_without_retry_sleeps(self):
+        """A rejected call must not sleep through the retry ladder against
+        a known-open circuit (review finding: CircuitOpenError was wrapped
+        as a transient KubeApiError)."""
+        br = retry.CircuitBreaker(
+            "apiserver", failure_threshold=1, recovery_time_s=60.0,
+            metrics=MetricsRegistry(),
+        )
+        client, sleeps = self.make_client(br)
+
+        def down(method, path, query=None, body=None, content_type=None,
+                 read_timeout=30.0):
+            raise KubeApiError(None, "refused")
+
+        client._open = down  # type: ignore[method-assign]
+        with pytest.raises(KubeApiError):
+            client.get_node("n")  # trips the breaker
+        sleeps.clear()
+        with pytest.raises(KubeApiError):
+            client.get_node("n")  # rejected by the open breaker
+        assert sleeps == []  # fail-fast: zero backoff sleeps
+
+    def test_body_read_failure_is_retried_and_counted(self):
+        """OSError/JSONDecodeError after the connection opened ride the
+        same retry/breaker bracket as connect-time failures (review
+        finding: they used to escape both)."""
+        import io
+
+        br = retry.CircuitBreaker(
+            "apiserver", failure_threshold=10, recovery_time_s=60.0,
+            metrics=MetricsRegistry(),
+        )
+        client, _ = self.make_client(br)
+        calls = {"n": 0}
+
+        class Garbled(io.BytesIO):
+            def read(self, *a):
+                raise OSError("connection reset mid-body")
+
+        def flaky(method, path, query=None, body=None, content_type=None,
+                  read_timeout=30.0):
+            import json as _json
+
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return Garbled()
+            return io.BytesIO(_json.dumps({"metadata": {}}).encode())
+
+        client._open = flaky  # type: ignore[method-assign]
+        client.get_node("n")  # retried transparently
+        assert calls["n"] == 2
+
+
+def test_retry_after_is_clamped_to_its_ceiling():
+    """A proxy saying 'come back in an hour' must not park a control-plane
+    thread: Retry-After is a floor only up to retry_after_cap_s."""
+    policy = make_policy(base_delay_s=0.001, max_delay_s=0.01,
+                         retry_after_cap_s=2.0)
+    for _ in range(20):
+        assert policy.delay_for(0, retry_after_s=3600.0) <= 2.0
+    # Below the ceiling it stays an exact floor.
+    assert policy.delay_for(0, retry_after_s=1.5) >= 1.5
+
+
+def test_incomplete_read_wraps_into_kube_api_error():
+    """http.client.IncompleteRead (truncated body) is neither OSError nor
+    ValueError; it must still ride the retry/breaker bracket instead of
+    escaping raw to callers that only handle KubeApiError."""
+    import http.client
+    import io
+    import json as _json
+
+    from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+    client = RestKube(
+        ClusterConfig(server="http://x"),
+        retry_policy=retry.RetryPolicy(
+            max_attempts=3, base_delay_s=0.001, sleep=lambda s: None,
+            rng=random.Random(3), metrics=MetricsRegistry(),
+        ),
+    )
+    calls = {"n": 0}
+
+    class Truncated(io.BytesIO):
+        def read(self, *a):
+            raise http.client.IncompleteRead(b"partial")
+
+    def flaky(method, path, query=None, body=None, content_type=None,
+              read_timeout=30.0):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return Truncated()
+        return io.BytesIO(_json.dumps({"metadata": {}}).encode())
+
+    client._open = flaky  # type: ignore[method-assign]
+    client.get_node("n")  # wrapped, classified transient, retried
+    assert calls["n"] == 2
+
+
+def test_faulty_client_forwards_retries_internally_flag():
+    """Wrapping must not change the retry layering decision."""
+    from tpu_cc_manager.faults import FaultPlan, FaultyKubeClient
+    from tpu_cc_manager.kubeclient.api import caller_retry_attempts
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+    from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+    fake_wrapped = FaultyKubeClient(FakeKube(), FaultPlan(seed=1))
+    assert caller_retry_attempts(fake_wrapped) == 3
+    rest_wrapped = FaultyKubeClient(
+        RestKube(ClusterConfig(server="http://x")), FaultPlan(seed=1)
+    )
+    assert caller_retry_attempts(rest_wrapped) == 1

@@ -553,3 +553,115 @@ def test_parse_accelerator_type_known_generations(gen, cores):
     assert 1 <= chips
     assert 1 <= hosts
     assert chips <= hosts * (8 if gen in ("v5e", "v6e") else 4)
+
+
+class TestRuntimeEnvDigest:
+    """The daemonset stages CC_RUNTIME_ENV_FILE in the state dir and puts
+    it on the measured-path list, so ``on`` vs ``devtools`` — which commit
+    different runtime env content (devtools adds debug/trace flags) —
+    provably attest DIFFERENT runtime digests (VERDICT #4)."""
+
+    def make_backend(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        monkeypatch.delenv("TPU_SLICE_ID", raising=False)
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        for i in range(4):
+            (devdir / f"accel{i}").touch()
+        state = tmp_path / "state"
+        env_file = state / "tpu-runtime.env"
+        return TpuVmBackend(
+            state_dir=str(state),
+            reset_cmd=["true"],
+            show_cmd=[],
+            metadata_url="http://127.0.0.1:1",
+            device_glob=str(devdir / "accel*"),
+            # The env file is measured alongside a runtime library, exactly
+            # like the daemonset's CC_RUNTIME_MEASURE_PATHS wiring.
+            measure_globs=[str(state / "tpu-runtime.env")],
+            tsm_root="",
+            runtime_env_file=str(env_file),
+        )
+
+    def commit(self, backend, mode):
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, mode)
+        backend.reset(topo.chips)
+        return backend._runtime_digest()
+
+    def test_on_vs_devtools_attest_different_digests(self, tmp_path, monkeypatch):
+        from tpu_cc_manager.labels import MODE_DEVTOOLS
+
+        backend = self.make_backend(tmp_path, monkeypatch)
+        d_on = self.commit(backend, MODE_ON)
+        d_devtools = self.commit(backend, MODE_DEVTOOLS)
+        assert d_on != d_devtools
+        # The difference is the committed env content: devtools carries the
+        # debug flags, on does not.
+        env = (tmp_path / "state" / "tpu-runtime.env").read_text()
+        assert "TPU_CC_MODE=devtools" in env
+        assert "TPU_MIN_LOG_LEVEL=0" in env
+        # And the same mode commits reproduce the same digest.
+        assert self.commit(backend, MODE_ON) == d_on
+
+    def test_env_write_failure_fails_the_reset(self, tmp_path, monkeypatch):
+        """A mode whose runtime config didn't land must not commit: pending
+        markers stay and query reports 'resetting' (crash-as-retry)."""
+        backend = self.make_backend(tmp_path, monkeypatch)
+        topo = backend.discover()
+        # Unwritable env path: a DIRECTORY where the file should go.
+        backend.runtime_env_file = str(tmp_path / "state")
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        with pytest.raises(TpuError):
+            backend.reset(topo.chips)
+        assert backend.query_cc_mode(topo.chips[0]) == "resetting"
+
+
+class TestDeviceCmdBreaker:
+    """The device-command circuit breaker fails fast mid-ladder: a circuit
+    opened by attempt 1 must stop attempt 2 from running another (up to
+    120 s) command against the known-bad path."""
+
+    def make_backend(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        monkeypatch.delenv("TPU_SLICE_ID", raising=False)
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        (devdir / "accel0").touch()
+        return TpuVmBackend(
+            state_dir=str(tmp_path / "state"),
+            reset_cmd=["false"],
+            show_cmd=[],
+            metadata_url="http://127.0.0.1:1",
+            device_glob=str(devdir / "accel*"),
+        )
+
+    def test_circuit_opened_mid_ladder_stops_the_retry(self, tmp_path, monkeypatch):
+        from tpu_cc_manager.utils import retry as retry_mod
+        from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+        backend = self.make_backend(tmp_path, monkeypatch)
+        backend.retry_policy.sleep = lambda s: None
+        backend.breaker = retry_mod.CircuitBreaker(
+            "device-cmd", failure_threshold=1, recovery_time_s=60.0,
+            metrics=MetricsRegistry(),
+        )
+        runs = {"n": 0}
+        real_run = __import__("subprocess").run
+
+        def counting_run(*a, **k):
+            runs["n"] += 1
+            return real_run(*a, **k)
+
+        monkeypatch.setattr("subprocess.run", counting_run)
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        with pytest.raises(TpuError, match="unavailable|circuit"):
+            backend.reset(topo.chips)
+        # Attempt 1 ran and opened the circuit; attempt 2 was rejected
+        # before spawning a process.
+        assert runs["n"] == 1
+        # Crash-as-retry: pending markers stayed behind.
+        assert backend.query_cc_mode(topo.chips[0]) == "resetting"

@@ -1,0 +1,266 @@
+"""Runtime-health watchdog: tier export, demote/restore hysteresis, busy
+standdown — and the tpuvm backend's probe-tier selection."""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_cc_manager.ccmanager.watchdog import RuntimeHealthWatchdog
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    CC_READY_STATE_LABEL,
+)
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "node-0"
+
+
+@pytest.fixture()
+def rig(fake_kube, fake_tpu):
+    fake_kube.add_node(NODE, {
+        CC_MODE_STATE_LABEL: "on", CC_READY_STATE_LABEL: "true",
+    })
+    events = []
+    registry = MetricsRegistry()
+    watchdog = RuntimeHealthWatchdog(
+        fake_kube, fake_tpu, NODE,
+        demote_after=3, restore_after=2,
+        emit_event=lambda t, r, m: events.append((t, r, m)),
+        metrics=registry,
+    )
+    return watchdog, fake_kube, fake_tpu, events, registry
+
+
+def ready(fake_kube):
+    return node_labels(fake_kube.get_node(NODE)).get(CC_READY_STATE_LABEL)
+
+
+def test_healthy_ticks_touch_nothing(rig):
+    watchdog, kube, _, events, registry = rig
+    for _ in range(5):
+        probe = watchdog.tick()
+        assert probe.healthy
+    assert ready(kube) == "true"
+    assert events == []
+    assert registry.health_tier() == ("probe-cmd", 3)
+
+
+def test_sustained_degradation_demotes_then_recovers(rig):
+    watchdog, kube, tpu, events, registry = rig
+    tpu.healthy = False
+    # Hysteresis: two unhealthy probes are not enough.
+    watchdog.tick(); watchdog.tick()
+    assert ready(kube) == "true" and not watchdog.degraded
+    watchdog.tick()  # third consecutive -> demote
+    assert watchdog.degraded
+    assert ready(kube) == "false"
+    # mode.state untouched: the mode is still committed.
+    assert node_labels(kube.get_node(NODE))[CC_MODE_STATE_LABEL] == "on"
+    assert events[-1][1] == "CCRuntimeUnhealthy"
+    assert registry.failure_totals().get("runtime-unhealthy") == 1
+
+    tpu.healthy = True
+    watchdog.tick()
+    assert ready(kube) == "false"  # one healthy probe is not recovery
+    watchdog.tick()  # second consecutive -> restore
+    assert not watchdog.degraded
+    # Restored from the CURRENT mode.state, not a cached value.
+    assert ready(kube) == "true"
+    assert events[-1][1] == "CCRuntimeRecovered"
+
+
+def test_restore_derives_ready_from_current_state(rig):
+    """If the mode.state changed while degraded (e.g. an operator drove a
+    reconcile), recovery restores THAT state's ready value."""
+    watchdog, kube, tpu, _, _ = rig
+    tpu.healthy = False
+    for _ in range(3):
+        watchdog.tick()
+    kube.set_node_label(NODE, CC_MODE_STATE_LABEL, "devtools")
+    tpu.healthy = True
+    watchdog.tick(); watchdog.tick()
+    assert ready(kube) == "debug"
+
+
+def test_flapping_probe_never_demotes(rig):
+    watchdog, kube, tpu, events, _ = rig
+    for i in range(12):
+        tpu.healthy = i % 2 == 0  # alternate: never 3 consecutive failures
+        watchdog.tick()
+    assert ready(kube) == "true"
+    assert events == []
+
+
+def test_busy_standdown_skips_the_probe(rig):
+    watchdog, kube, tpu, events, _ = rig
+    watchdog.is_busy = lambda: True
+    tpu.healthy = False
+    for _ in range(10):
+        assert watchdog.tick() is None
+    assert ready(kube) == "true" and not watchdog.degraded
+
+
+def test_probe_exception_counts_as_unhealthy(rig):
+    watchdog, kube, tpu, events, registry = rig
+    tpu.fail["probe"] = -1  # probe raises TpuError forever
+    for _ in range(3):
+        probe = watchdog.tick()
+        assert probe is not None and not probe.healthy and probe.tier == "none"
+    assert watchdog.degraded
+    assert ready(kube) == "false"
+
+
+def test_demote_survives_apiserver_flake_and_retries_next_tick(rig):
+    """A patch failure during demote must not wedge the state machine:
+    the watchdog stays un-degraded and the next tick retries."""
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    watchdog, kube, tpu, events, _ = rig
+    watchdog.retry_policy.max_attempts = 1
+    tpu.healthy = False
+    real_patch = kube.patch_node_labels
+    kube.patch_node_labels = lambda *a, **k: (_ for _ in ()).throw(
+        KubeApiError(503, "down")
+    )
+    for _ in range(3):
+        watchdog.tick()
+    assert not watchdog.degraded
+    kube.patch_node_labels = real_patch
+    watchdog.tick()  # still unhealthy; demote retries and lands
+    assert watchdog.degraded and ready(kube) == "false"
+
+
+class TestTpuVmProbeTiers:
+    """Tier selection: health port > probe cmd > systemd > device node,
+    strongest AVAILABLE wins; the tier rides the HealthProbe so the
+    watchdog can export it."""
+
+    def make_backend(self, tmp_path, **kwargs):
+        from tpu_cc_manager.tpudev.tpuvm import TpuVmBackend
+
+        devdir = tmp_path / "dev"
+        devdir.mkdir(exist_ok=True)
+        (devdir / "accel0").touch()
+        kwargs.setdefault("state_dir", str(tmp_path / "state"))
+        kwargs.setdefault("reset_cmd", ["true"])
+        kwargs.setdefault("show_cmd", [])
+        kwargs.setdefault("metadata_url", "http://127.0.0.1:1")
+        kwargs.setdefault("device_glob", str(devdir / "accel*"))
+        kwargs.setdefault("health_port", 0)
+        return TpuVmBackend(**kwargs)
+
+    def test_health_port_is_the_strongest_tier(self, tmp_path):
+        import socket
+
+        srv = socket.socket()
+        try:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(8)  # several probes connect without being accepted
+            backend = self.make_backend(
+                tmp_path, health_port=srv.getsockname()[1]
+            )
+            probe = backend.probe_runtime_health()
+            assert (probe.tier, probe.healthy) == ("health-port", True)
+            # A configured probe command still runs as the app-level second
+            # opinion: a kernel-backlog TCP accept must not mask a wedge
+            # the command catches — both must pass.
+            backend.health_probe_cmd = ["false"]
+            probe = backend.probe_runtime_health()
+            assert (probe.tier, probe.healthy) == ("health-port", False)
+            backend.health_probe_cmd = ["true"]
+            probe = backend.probe_runtime_health()
+            assert (probe.tier, probe.healthy) == ("health-port", True)
+        finally:
+            srv.close()
+        backend.health_probe_cmd = None
+        probe = backend.probe_runtime_health()
+        assert (probe.tier, probe.healthy) == ("health-port", False)
+
+    def test_probe_cmd_tier(self, tmp_path):
+        backend = self.make_backend(tmp_path, health_probe_cmd=["true"])
+        probe = backend.probe_runtime_health()
+        assert (probe.tier, probe.healthy) == ("probe-cmd", True)
+        backend.health_probe_cmd = ["false"]
+        assert backend.probe_runtime_health().healthy is False
+
+    def test_systemd_tier(self, tmp_path):
+        show = tmp_path / "show.txt"
+        show.write_text("ActiveState=active\nActiveEnterTimestampMonotonic=1\n")
+        backend = self.make_backend(tmp_path, show_cmd=["cat", str(show)])
+        backend.stamp_cache_ttl_s = 0.0
+        probe = backend.probe_runtime_health()
+        assert (probe.tier, probe.healthy) == ("systemd", True)
+        show.write_text("ActiveState=failed\nActiveEnterTimestampMonotonic=1\n")
+        probe = backend.probe_runtime_health()
+        assert (probe.tier, probe.healthy) == ("systemd", False)
+
+    def test_device_node_is_the_weakest_fallback(self, tmp_path):
+        backend = self.make_backend(tmp_path)  # no port, no cmd, no systemd
+        probe = backend.probe_runtime_health()
+        assert (probe.tier, probe.healthy) == ("device-node", True)
+        assert probe.strength == 1  # exported rank: bottom tier
+        backend.device_glob = str(tmp_path / "nope*")
+        backend.vfio_glob = str(tmp_path / "nope*")
+        assert backend.probe_runtime_health().healthy is False
+
+
+def test_re_demotes_after_reconcile_rewrote_ready(rig):
+    """A reconcile that rewrites ready=true while the runtime is STILL
+    unhealthy must not stick: the watchdog re-asserts not-ready on the
+    next sustained-unhealthy tick (no in-memory latch), without emitting
+    a second transition event."""
+    watchdog, kube, tpu, events, _ = rig
+    tpu.healthy = False
+    for _ in range(3):
+        watchdog.tick()
+    assert ready(kube) == "false"
+    n_events = len(events)
+    # A reconcile (e.g. label edit) rewrites the ready label...
+    kube.set_node_label(NODE, CC_READY_STATE_LABEL, "true")
+    # ...but the runtime is still wedged: next tick re-demotes.
+    watchdog.tick()
+    assert ready(kube) == "false"
+    assert len(events) == n_events  # transition event not re-emitted
+
+
+def test_unanswered_health_port_falls_through_not_fail_closed(tmp_path):
+    """The manifest defaults CC_RUNTIME_HEALTH_PORT on; a runtime build
+    with no liveness port must read as tier-unavailable (fall through to
+    the next tier), not fleet-wide unhealthy. Once the port HAS answered,
+    refusal means the runtime is down."""
+    import socket
+
+    from tpu_cc_manager.tpudev.tpuvm import TpuVmBackend
+
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    (devdir / "accel0").touch()
+    # Grab a port nothing listens on.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    backend = TpuVmBackend(
+        state_dir=str(tmp_path / "state"), reset_cmd=["true"], show_cmd=[],
+        metadata_url="http://127.0.0.1:1",
+        device_glob=str(devdir / "accel*"),
+        health_port=dead_port, health_probe_cmd=["true"],
+    )
+    probe = backend.probe_runtime_health()
+    assert (probe.tier, probe.healthy) == ("probe-cmd", True)
+    # Same backend with no weaker tiers at all: device-node fallback.
+    backend.health_probe_cmd = None
+    probe = backend.probe_runtime_health()
+    assert (probe.tier, probe.healthy) == ("device-node", True)
+    # After the port answers once, refusal fails closed at the port tier.
+    srv = socket.socket()
+    try:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        backend.health_port = srv.getsockname()[1]
+        assert backend.probe_runtime_health().tier == "health-port"
+    finally:
+        srv.close()
+    probe = backend.probe_runtime_health()
+    assert (probe.tier, probe.healthy) == ("health-port", False)

@@ -20,6 +20,7 @@ import time
 from tpu_cc_manager.ccmanager.hostcaps import is_host_cc_enabled
 from tpu_cc_manager.ccmanager.manager import CCManager
 from tpu_cc_manager.ccmanager.metrics_server import start_metrics_server
+from tpu_cc_manager.ccmanager.watchdog import start_from_env as start_watchdog
 from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
 from tpu_cc_manager.labels import MODE_OFF, VALID_MODES
 from tpu_cc_manager.tpudev import load_backend
@@ -131,6 +132,18 @@ def main(argv: list[str] | None = None) -> int:
     stop = threading.Event()
     run_returned = threading.Event()
     grace_s = float(os.environ.get("CC_SHUTDOWN_GRACE_S", "20"))
+    # Runtime-health watchdog (ccmanager/watchdog.py): probes the runtime
+    # BETWEEN reconciles and demotes/restores cc.ready.state on sustained
+    # degradation. Stands down while a reconcile is in flight.
+    start_watchdog(
+        api,
+        backend,
+        args.node_name,
+        stop,
+        is_busy=lambda: manager.reconciling,
+        emit_event=manager._emit_node_event,
+        metrics=manager.metrics,
+    )
 
     def _force_exit_when_idle():
         deadline = time.monotonic() + grace_s

@@ -51,6 +51,7 @@ from tpu_cc_manager.obs import trace as trace_mod
 from tpu_cc_manager.tpudev import attestation
 from tpu_cc_manager.tpudev.contract import SliceTopology, TpuCcBackend, TpuChip, TpuError
 from tpu_cc_manager.utils import metrics as metrics_mod
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -177,6 +178,14 @@ class CCManager:
         self.watch_timeout_s = watch_timeout_s
         self.reconnect_delay_s = reconnect_delay_s
         self.max_watch_errors = max_watch_errors
+        # Watch-reconnect backoff through the shared policy: full jitter
+        # under an exponential cap keyed on the consecutive-error count, so
+        # a pool of agents doesn't reconnect to a flapping apiserver in
+        # lockstep every reconnect_delay_s (the reference's fixed 5 s).
+        self._reconnect_policy = retry_mod.RetryPolicy(
+            base_delay_s=max(0.001, reconnect_delay_s),
+            max_delay_s=max(reconnect_delay_s, 60.0),
+        )
         # Failed-reconcile retry with exponential backoff: the reference
         # leaves a transiently-failed node 'failed' until the label is
         # touched again (main.py only re-applies on label *change*); a
@@ -399,6 +408,14 @@ class CCManager:
                     quote = None
             if mode == MODE_OFF or quote is not None:
                 log.info("CC mode %s already set on all %d chip(s)", mode, len(chips))
+                # A crash (or apiserver failure) BETWEEN the mode landing
+                # and re-admission leaves components paused; the next
+                # reconcile takes this idempotent path — which skips the
+                # drain/readmit bracket — so it must restore them (found
+                # by the chaos soak). BEFORE the state labels: a node must
+                # not advertise ready while its components are known to
+                # still be paused.
+                self._readmit_leftover_paused()
                 state.set_cc_state_label(self.api, self.node_name, mode)
                 self._publish_coordination_labels(topo, quote)
                 return True
@@ -433,6 +450,17 @@ class CCManager:
             # only the leader's own watch loop lingers, not the drain window.
             barrier.complete(mode)
         return ok
+
+    def _readmit_leftover_paused(self) -> None:
+        """Unpause components a previous run left paused (it died between
+        committing the mode and re-admitting). ``original={}`` means the
+        restore derives purely from the current label values — exactly the
+        crash-recovery semantics readmit_components documents. An apiserver
+        failure here propagates: the reconcile is noted failed and the
+        backoff retry re-attempts the restore — reporting success over
+        still-stranded components would end the retry ladder with the node
+        not serving."""
+        evict.readmit_components(self.api, self.node_name, {})
 
     def _cc_mode_chips(
         self, topo: SliceTopology, mode: str
@@ -700,7 +728,10 @@ class CCManager:
         Divergence from the reference (deliberate): a FAILED reconcile is
         retried with exponential backoff (retry_backoff_s, doubling to
         retry_backoff_max_s) without requiring the label to change — the
-        reference leaves the node 'failed' until the next label edit.
+        reference leaves the node 'failed' until the next label edit. That
+        includes a reconcile ABORTED by an apiserver error escaping the
+        apply (the failed-state patch itself failing): it is noted failed
+        and retried, not lost until the next label edit.
         """
         last_label_value: str | None = None
         consecutive_errors = 0
@@ -732,10 +763,31 @@ class CCManager:
                 backoff = min(backoff * 2, self.retry_backoff_max_s)
             return ok
 
+        def apply_noted(value: str | None) -> bool:
+            """In-watch reconcile: an apiserver error ESCAPING the apply
+            (e.g. the failed-state patch itself exhausted its retries) is
+            noted as a failed reconcile so the backoff retry still fires —
+            before this, the exception unwound to the reconnect handler and
+            the reconcile was silently lost until the next label edit.
+            Device-layer crash-as-retry (sys.exit on mixed capability) and
+            the fatal startup GET are unaffected."""
+            try:
+                return note_result(self.set_cc_mode(self.with_default(value)))
+            except KubeApiError as e:
+                log.warning(
+                    "reconcile aborted by apiserver error (%s); scheduling "
+                    "backoff retry", e,
+                )
+                # No record_failure here: most escape paths already counted
+                # their reason before the state patch raised, and a second
+                # count would make sum(tpu_cc_failures_total) exceed the
+                # failed-reconcile total during every apiserver incident.
+                return note_result(False)
+
         def maybe_retry() -> None:
             if retry_at is not None and time.monotonic() >= retry_at:
                 log.info("retrying failed reconcile")
-                note_result(self.set_cc_mode(self.with_default(last_label_value)))
+                apply_noted(last_label_value)
 
         label, rv = self.get_node_cc_mode_label()
         note_result(self.set_cc_mode(self.with_default(label)))
@@ -791,9 +843,7 @@ class CCManager:
                             CC_MODE_LABEL, last_label_value, value,
                         )
                         last_label_value = value
-                        if not note_result(
-                            self.set_cc_mode(self.with_default(value))
-                        ):
+                        if not apply_noted(value):
                             # The already-open stream keeps its original
                             # (up to 300 s) server-side timeout; on a quiet
                             # node that would delay the backoff retry far
@@ -819,24 +869,28 @@ class CCManager:
                         f"{consecutive_errors} consecutive watch errors; giving "
                         f"up (pod restart acts as recovery)"
                     ) from e
+                delay = self._reconnect_policy.delay_for(
+                    max(0, consecutive_errors - 1)
+                )
                 if e.status == 410:
                     log.info("watch resourceVersion expired; resyncing")
                     try:
                         value, rv = self.get_node_cc_mode_label()
                     except KubeApiError as e2:
                         log.warning("resync GET failed: %s", e2)
-                        time.sleep(self.reconnect_delay_s)
+                        self.metrics.record_retry("watch.resync", "apiserver")
+                        time.sleep(delay)
                         continue
                     if value != last_label_value:
                         last_label_value = value
-                        note_result(self.set_cc_mode(self.with_default(value)))
+                        apply_noted(value)
                     continue
                 log.warning(
-                    "watch error (%s/%s): %s — reconnecting in %.0fs",
-                    consecutive_errors, self.max_watch_errors, e,
-                    self.reconnect_delay_s,
+                    "watch error (%s/%s): %s — reconnecting in %.1fs",
+                    consecutive_errors, self.max_watch_errors, e, delay,
                 )
-                time.sleep(self.reconnect_delay_s)
+                self.metrics.record_retry("watch.reconnect", "watch-error")
+                time.sleep(delay)
 
     def remove_readiness_file(self) -> None:
         """Best-effort in-process counterpart of the preStop ``/bin/rm``

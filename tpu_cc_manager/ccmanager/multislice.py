@@ -45,10 +45,13 @@ import time
 from tpu_cc_manager.kubeclient.api import (
     KubeApi,
     KubeApiError,
+    caller_retry_attempts,
+    classify_kube_error,
     node_annotations,
     node_labels,
 )
 from tpu_cc_manager.obs import trace as obs_trace
+from tpu_cc_manager.utils import retry as retry_mod
 from tpu_cc_manager.tpudev.attestation import (
     AttestationError,
     deserialize_quote,
@@ -138,8 +141,19 @@ def collect_pool_quotes(api: KubeApi, selector: str) -> dict[str, dict]:
     no quote are recorded in ``missing`` (not silently skipped), modes must
     agree across hosts (else ``mode`` becomes "MIXED"), and ``ts`` is the
     OLDEST host's timestamp so staleness checks see the worst host."""
+    # Transient apiserver failures ride the shared jittered backoff; a pool
+    # verification gating a DCN mesh re-form should not fail on one flaky
+    # listing. One attempt when the client retries internally (RestKube).
+    policy = retry_mod.RetryPolicy(
+        max_attempts=caller_retry_attempts(api), base_delay_s=0.5
+    )
+    nodes = policy.call(
+        lambda: api.list_nodes(selector),
+        op="pool_attest.list_nodes",
+        classify=classify_kube_error,
+    )
     slices: dict[str, dict] = {}
-    for node in api.list_nodes(selector):
+    for node in nodes:
         labels = node_labels(node)
         name = node["metadata"]["name"]
         digest = labels.get(f"{QUOTE_ANNOTATION}.digest")

@@ -46,9 +46,14 @@ Failure semantics:
 from __future__ import annotations
 
 import logging
-import time
 
-from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, node_labels
+from tpu_cc_manager.kubeclient.api import (
+    KubeApi,
+    KubeApiError,
+    caller_retry_attempts,
+    classify_kube_error,
+    node_labels,
+)
 from tpu_cc_manager.labels import (
     CC_MODE_STATE_LABEL,
     SLICE_ID_LABEL,
@@ -56,6 +61,7 @@ from tpu_cc_manager.labels import (
 )
 from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.tpudev.contract import SliceTopology, TpuError
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -91,6 +97,16 @@ class SliceBarrier:
         self.poll_interval_s = poll_interval_s
         self.complete_timeout_s = complete_timeout_s
         self.slice_label_value = label_safe(topo.slice_id)
+        # Transient-failure policy for the peer listing: short ladder (the
+        # outer barrier deadline is authoritative) through the shared
+        # jittered backoff instead of the old warn-and-poll-again. One
+        # attempt when the client already retries internally (RestKube) —
+        # exactly one ladder per logical call.
+        self.retry_policy = retry_mod.RetryPolicy(
+            max_attempts=caller_retry_attempts(api),
+            base_delay_s=min(1.0, max(0.01, poll_interval_s)),
+            max_delay_s=max(1.0, poll_interval_s * 4),
+        )
 
     @property
     def is_leader(self) -> bool:
@@ -119,7 +135,13 @@ class SliceBarrier:
         )
 
     def _slice_nodes(self) -> list[dict]:
-        return self.api.list_nodes(f"{SLICE_ID_LABEL}={self.slice_label_value}")
+        return self.retry_policy.call(
+            lambda: self.api.list_nodes(
+                f"{SLICE_ID_LABEL}={self.slice_label_value}"
+            ),
+            op="barrier.list_peers",
+            classify=classify_kube_error,
+        )
 
     def await_commit(self, mode: str) -> None:
         """Block until this host may reset.
@@ -148,62 +170,71 @@ class SliceBarrier:
             self._await_commit(mode)
 
     def _await_commit(self, mode: str) -> None:
-        deadline = time.monotonic() + self.timeout_s
-        committed_seen = False
-        ready: list[str] = []
-        while True:
+        # Closure state across polls: the commit marker may be observed on
+        # an earlier poll than the one where all hosts read ready, and the
+        # timeout message reports the last observed readiness.
+        state = {"committed_seen": False, "ready": None}
+
+        def barrier_formed() -> bool:
             try:
                 nodes = self._slice_nodes()
             except KubeApiError as e:
+                # The retry policy already burned its short ladder; keep
+                # polling — the barrier deadline is authoritative.
                 log.warning("slice barrier: peer listing failed (%s); retrying", e)
-                nodes = None
-            if nodes is not None:
-                ready, peers_committed = [], []
-                for n in nodes:
-                    labels = node_labels(n)
-                    name = n["metadata"]["name"]
-                    already = labels.get(CC_MODE_STATE_LABEL) == mode
-                    if labels.get(SLICE_STAGED_LABEL) == mode or already:
-                        ready.append(name)
-                    if already and name != self.node_name:
-                        peers_committed.append(name)
-                committed_seen = committed_seen or any(
-                    node_labels(n).get(SLICE_COMMIT_LABEL) == mode for n in nodes
+                return False
+            ready, peers_committed = [], []
+            for n in nodes:
+                labels = node_labels(n)
+                name = n["metadata"]["name"]
+                already = labels.get(CC_MODE_STATE_LABEL) == mode
+                if labels.get(SLICE_STAGED_LABEL) == mode or already:
+                    ready.append(name)
+                if already and name != self.node_name:
+                    peers_committed.append(name)
+            state["ready"] = ready
+            state["committed_seen"] = state["committed_seen"] or any(
+                node_labels(n).get(SLICE_COMMIT_LABEL) == mode for n in nodes
+            )
+            all_ready = len(ready) >= self.topo.num_hosts
+            if all_ready and self.is_leader:
+                self.api.patch_node_labels(
+                    self.node_name, {SLICE_COMMIT_LABEL: mode}
                 )
-                all_ready = len(ready) >= self.topo.num_hosts
-                if all_ready and self.is_leader:
-                    self.api.patch_node_labels(
-                        self.node_name, {SLICE_COMMIT_LABEL: mode}
-                    )
-                    log.info(
-                        "slice %s: all %d host(s) ready; leader committing mode=%s",
-                        self.topo.slice_id, self.topo.num_hosts, mode,
-                    )
-                    return
-                if all_ready and (
-                    committed_seen
-                    or len(peers_committed) >= self.topo.num_hosts - 1
-                ):
-                    log.info(
-                        "slice %s host %d: all ready (%s); committing mode=%s",
-                        self.topo.slice_id, self.topo.host_index,
-                        "leader marker" if committed_seen else "peers already committed",
-                        mode,
-                    )
-                    return
-                log.debug(
-                    "slice %s barrier: %d/%d ready, commit=%s",
-                    self.topo.slice_id, len(ready), self.topo.num_hosts,
-                    committed_seen,
+                log.info(
+                    "slice %s: all %d host(s) ready; leader committing mode=%s",
+                    self.topo.slice_id, self.topo.num_hosts, mode,
                 )
-            if time.monotonic() >= deadline:
-                raise BarrierTimeout(
-                    f"slice {self.topo.slice_id}: barrier for mode {mode} did "
-                    f"not form within {self.timeout_s:.0f}s "
-                    f"({len(ready) if nodes is not None else '?'}"
-                    f"/{self.topo.num_hosts} hosts ready)"
+                return True
+            if all_ready and (
+                state["committed_seen"]
+                or len(peers_committed) >= self.topo.num_hosts - 1
+            ):
+                log.info(
+                    "slice %s host %d: all ready (%s); committing mode=%s",
+                    self.topo.slice_id, self.topo.host_index,
+                    "leader marker" if state["committed_seen"]
+                    else "peers already committed",
+                    mode,
                 )
-            time.sleep(self.poll_interval_s)
+                return True
+            log.debug(
+                "slice %s barrier: %d/%d ready, commit=%s",
+                self.topo.slice_id, len(ready), self.topo.num_hosts,
+                state["committed_seen"],
+            )
+            return False
+
+        if not retry_mod.poll_until(
+            barrier_formed, self.timeout_s, self.poll_interval_s
+        ):
+            ready = state["ready"]
+            raise BarrierTimeout(
+                f"slice {self.topo.slice_id}: barrier for mode {mode} did "
+                f"not form within {self.timeout_s:.0f}s "
+                f"({len(ready) if ready is not None else '?'}"
+                f"/{self.topo.num_hosts} hosts ready)"
+            )
 
     def clear_staged(self) -> None:
         """Withdraw this host's staged marker (it is either done or about
@@ -238,22 +269,18 @@ class SliceBarrier:
             self._complete_as_leader(mode)
 
     def _complete_as_leader(self, mode: str) -> None:
-        deadline = time.monotonic() + self.complete_timeout_s
-        while time.monotonic() < deadline:
+        def peers_cleared() -> bool:
             try:
                 nodes = self._slice_nodes()
             except KubeApiError:
-                time.sleep(self.poll_interval_s)
-                continue
-            still_staged = [
-                n["metadata"]["name"]
-                for n in nodes
-                if node_labels(n).get(SLICE_STAGED_LABEL) == mode
-            ]
-            if not still_staged:
-                break
-            time.sleep(self.poll_interval_s)
-        else:
+                return False
+            return not any(
+                node_labels(n).get(SLICE_STAGED_LABEL) == mode for n in nodes
+            )
+
+        if not retry_mod.poll_until(
+            peers_cleared, self.complete_timeout_s, self.poll_interval_s
+        ):
             log.warning(
                 "slice %s: peers still staged after %.0fs; leaving commit "
                 "marker for the next round to clear",

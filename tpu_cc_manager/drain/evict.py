@@ -22,6 +22,7 @@ from tpu_cc_manager.drain.pause import is_paused, pause_value, unpause_value
 from tpu_cc_manager.kubeclient.api import KubeApi, node_labels
 from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS
 from tpu_cc_manager.obs import trace as obs_trace
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -146,28 +147,37 @@ def _evict_components_inner(
         timed_out = []
         for key in paused_now:
             app = DRAIN_COMPONENT_LABELS[key]
-            while True:
+            remaining = {"pods": 0}
+
+            def component_gone(app=app, remaining=remaining) -> bool:
                 pods = api.list_pods(
                     namespace,
                     label_selector=f"app={app}",
                     field_selector=f"spec.nodeName={node_name}",
                 )
-                if not pods:
-                    log.info("component %s drained from %s", app, node_name)
-                    break
-                if time.monotonic() >= deadline:
-                    msg = (
-                        f"timed out waiting for {len(pods)} pod(s) of component "
-                        f"{app} to leave node {node_name}"
-                    )
-                    if proceed_on_timeout:
-                        # Reference behavior: warn and continue to the hardware
-                        # phase anyway (gpu_operator_eviction.py:205-207).
-                        log.warning("%s — continuing anyway", msg)
-                        timed_out.append(app)
-                        break
-                    raise EvictionTimeout(msg, original)
-                time.sleep(poll_interval_s)
+                remaining["pods"] = len(pods)
+                return not pods
+
+            # One shared deadline across all components (unchanged policy);
+            # the per-component wait is whatever budget is left.
+            if retry_mod.poll_until(
+                component_gone,
+                max(0.0, deadline - time.monotonic()),
+                poll_interval_s,
+            ):
+                log.info("component %s drained from %s", app, node_name)
+                continue
+            msg = (
+                f"timed out waiting for {remaining['pods']} pod(s) of "
+                f"component {app} to leave node {node_name}"
+            )
+            if proceed_on_timeout:
+                # Reference behavior: warn and continue to the hardware
+                # phase anyway (gpu_operator_eviction.py:205-207).
+                log.warning("%s — continuing anyway", msg)
+                timed_out.append(app)
+                continue
+            raise EvictionTimeout(msg, original)
         if timed_out:
             sp.set_attribute("timed_out", timed_out)
     return original

@@ -1,0 +1,137 @@
+"""FaultyKubeClient: chaos in front of any KubeApi.
+
+Wraps a real or fake client and consults a seeded
+:class:`~tpu_cc_manager.faults.plan.FaultPlan` before each call:
+
+- unary verbs may be throttled (429 + Retry-After), 5xx'd, connection-
+  reset, or slowed — all injected BEFORE the inner call runs, modeling a
+  request that never reached (or never returned from) the apiserver;
+- watch connects may 410 immediately (stale rv → resync path) or hang up
+  after a bounded number of events (transport death mid-stream).
+
+Being a plain KubeApi, it composes anywhere: under the manager's watch
+loop, under the rolling orchestrator, under pool attestation — and the
+retry totals in utils/metrics.py show exactly what the faults cost.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Iterator, Mapping
+
+from tpu_cc_manager.faults.plan import Fault, FaultPlan
+from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, WatchEvent
+
+log = logging.getLogger(__name__)
+
+
+class FaultyKubeClient(KubeApi):
+    def __init__(
+        self,
+        inner: KubeApi,
+        plan: FaultPlan,
+        sleep=time.sleep,
+        # How many events a hung-up watch yields before dying (decided per
+        # hangup from the plan's rng via randrange, so it stays seeded).
+        watch_hangup_after: int = 2,
+    ) -> None:
+        self.inner = inner
+        self.plan = plan
+        self.sleep = sleep
+        self.watch_hangup_after = watch_hangup_after
+        # Transparent to retry layering: wrapping RestKube must not
+        # re-enable the caller-side ladder caller_retry_attempts collapses
+        # (nested 3x3 amplification), and wrapping a fake must not disable
+        # it.
+        self.retries_internally = getattr(inner, "retries_internally", False)
+
+    # ---- fault application ----------------------------------------------
+
+    def _maybe_fault(self, op: str) -> None:
+        fault = self.plan.decide(op)
+        if fault is None:
+            return
+        log.info("chaos: injecting %s", fault.describe())
+        self._raise_or_delay(fault)
+
+    def _raise_or_delay(self, fault: Fault) -> None:
+        if fault.kind == "slow":
+            self.sleep(fault.slow_s or 0.0)
+            return
+        if fault.kind == "http-429":
+            raise KubeApiError(
+                429, f"chaos: {fault.describe()}",
+                retry_after_s=fault.retry_after_s,
+            )
+        if fault.kind in ("http-5xx", "stale-rv"):
+            raise KubeApiError(fault.status, f"chaos: {fault.describe()}")
+        # conn-reset / watch-hangup: transport-level failure.
+        raise KubeApiError(None, f"chaos: {fault.describe()}")
+
+    # ---- KubeApi ---------------------------------------------------------
+
+    def get_node(self, name: str) -> dict:
+        self._maybe_fault("get_node")
+        return self.inner.get_node(name)
+
+    def patch_node_labels(self, name: str, labels: Mapping[str, str | None]) -> dict:
+        self._maybe_fault("patch_node_labels")
+        return self.inner.patch_node_labels(name, labels)
+
+    def patch_node_annotations(
+        self, name: str, annotations: Mapping[str, str | None]
+    ) -> dict:
+        self._maybe_fault("patch_node_annotations")
+        return self.inner.patch_node_annotations(name, annotations)
+
+    def list_nodes(self, label_selector: str | None = None) -> list[dict]:
+        self._maybe_fault("list_nodes")
+        return self.inner.list_nodes(label_selector)
+
+    def list_pods(
+        self,
+        namespace: str,
+        label_selector: str | None = None,
+        field_selector: str | None = None,
+    ) -> list[dict]:
+        self._maybe_fault("list_pods")
+        return self.inner.list_pods(namespace, label_selector, field_selector)
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        # Events are best-effort by contract; still fault them — a caller
+        # that lets an event failure break a reconcile is a bug the soak
+        # should catch.
+        self._maybe_fault("create_event")
+        return self.inner.create_event(namespace, event)
+
+    def self_subject_access_review(
+        self, verb: str, resource: str, namespace: str | None = None
+    ) -> bool:
+        self._maybe_fault("ssar")
+        return self.inner.self_subject_access_review(verb, resource, namespace)
+
+    def watch_nodes(
+        self,
+        name: str,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        fault = self.plan.decide_watch()
+        if fault is not None and fault.kind == "stale-rv":
+            log.info("chaos: injecting %s", fault.describe())
+            raise KubeApiError(410, f"chaos: {fault.describe()}")
+        stream = self.inner.watch_nodes(name, resource_version, timeout_seconds)
+        if fault is None:
+            yield from stream
+            return
+        # watch-hangup: pass through a bounded number of events, then die
+        # with a transport error (the stream the server closed mid-read).
+        log.info("chaos: injecting %s", fault.describe())
+        yielded = 0
+        for event in stream:
+            yield event
+            yielded += 1
+            if yielded >= self.watch_hangup_after:
+                break
+        raise KubeApiError(None, f"chaos: {fault.describe()} after {yielded} event(s)")

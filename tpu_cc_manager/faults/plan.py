@@ -1,0 +1,132 @@
+"""Seeded fault plans: reproducible chaos schedules.
+
+A fault plan is a pure function of (seed, call sequence): every decision
+draws from one ``random.Random(seed)``, so running the same operations
+against the same plan yields the same injected faults — the property the
+chaos-seed reproduction test (tests/test_chaos.py) locks in. The seed
+comes from ``CC_CHAOS_SEED`` so a soak failure in CI is replayable on a
+laptop with one env var.
+
+``max_faults`` bounds the total injections; a converging system must
+eventually see clean weather, and the soak asserts convergence AFTER the
+fault budget runs dry.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass, field
+
+CHAOS_SEED_ENV = "CC_CHAOS_SEED"
+
+#: Fault kinds the kube wrapper understands.
+KINDS = (
+    "http-429",      # throttled, with a Retry-After header
+    "http-5xx",      # transient server error (500/502/503/504)
+    "conn-reset",    # transport-level failure (status=None)
+    "slow",          # response delayed by ``slow_s``
+)
+WATCH_KINDS = (
+    "watch-hangup",  # stream dies mid-flight with a transport error
+    "stale-rv",      # 410 Gone on connect (forces the resync path)
+)
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str
+    op: str
+    seq: int                      # decision index within the plan
+    status: int | None = None
+    retry_after_s: float | None = None
+    slow_s: float | None = None
+
+    def describe(self) -> str:
+        extra = ""
+        if self.status is not None:
+            extra = f" status={self.status}"
+        if self.retry_after_s is not None:
+            extra += f" retry_after={self.retry_after_s}"
+        return f"{self.kind} on {self.op} (seq={self.seq}{extra})"
+
+
+@dataclass
+class FaultPlan:
+    """Draws one decision per API call; deterministic given the seed."""
+
+    seed: int = 0
+    # Probability an eligible call gets a fault (split evenly over kinds).
+    rate: float = 0.2
+    watch_rate: float = 0.3
+    max_faults: int | None = None
+    retry_after_s: float = 0.05
+    slow_s: float = 0.02
+    rng: random.Random = field(init=False, repr=False)
+    injected: list[Fault] = field(init=False, repr=False)
+    _seq: int = field(init=False, repr=False)
+
+    def __post_init__(self) -> None:
+        self.rng = random.Random(self.seed)
+        self.injected = []
+        self._seq = 0
+
+    @classmethod
+    def from_env(cls, default_seed: int = 20260803, **kwargs) -> "FaultPlan":
+        seed = int(os.environ.get(CHAOS_SEED_ENV, str(default_seed)))
+        return cls(seed=seed, **kwargs)
+
+    @property
+    def exhausted(self) -> bool:
+        return (
+            self.max_faults is not None
+            and len(self.injected) >= self.max_faults
+        )
+
+    def _draw(self, op: str, rate: float, kinds: tuple[str, ...]) -> Fault | None:
+        # ALWAYS advance the rng, even when the budget is exhausted — the
+        # schedule must stay a pure function of (seed, call sequence), not
+        # of how many faults earlier calls happened to absorb.
+        self._seq += 1
+        roll = self.rng.random()
+        kind = kinds[self.rng.randrange(len(kinds))]
+        status_5xx = self.rng.choice((500, 502, 503, 504))
+        if roll >= rate or self.exhausted:
+            return None
+        fault = Fault(
+            kind=kind,
+            op=op,
+            seq=self._seq,
+            status=(
+                429 if kind == "http-429"
+                else status_5xx if kind == "http-5xx"
+                else 410 if kind == "stale-rv"
+                else None
+            ),
+            retry_after_s=self.retry_after_s if kind == "http-429" else None,
+            slow_s=self.slow_s if kind == "slow" else None,
+        )
+        self.injected.append(fault)
+        return fault
+
+    def decide(self, op: str) -> Fault | None:
+        """One decision for a unary API call."""
+        return self._draw(op, self.rate, KINDS)
+
+    def decide_watch(self, op: str = "watch") -> Fault | None:
+        """One decision for a watch-stream connect."""
+        return self._draw(op, self.watch_rate, WATCH_KINDS)
+
+    def schedule_backend_fault(self, backend, ops: tuple[str, ...]) -> str | None:
+        """Optionally arm ONE fault on a fake device backend
+        (tpudev/fake.py ``fail_next``), drawn from the same seeded stream —
+        device-layer chaos composes with apiserver chaos under one seed.
+        Returns the op armed, or None."""
+        self._seq += 1
+        roll = self.rng.random()
+        op = ops[self.rng.randrange(len(ops))]
+        if roll >= self.rate or self.exhausted:
+            return None
+        self.injected.append(Fault(kind="backend", op=op, seq=self._seq))
+        backend.fail_next(op)
+        return op

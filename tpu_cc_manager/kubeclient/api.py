@@ -15,12 +15,50 @@ from typing import Iterator, Mapping
 
 class KubeApiError(Exception):
     """Apiserver error with an HTTP status, mirroring ApiException.status
-    (the reference branches on 410 Gone at main.py:670)."""
+    (the reference branches on 410 Gone at main.py:670).
 
-    def __init__(self, status: int | None, reason: str = ""):
+    ``retry_after_s`` carries a server-directed minimum backoff (a 429's
+    ``Retry-After`` header) for the shared retry policy to honor."""
+
+    def __init__(
+        self,
+        status: int | None,
+        reason: str = "",
+        retry_after_s: float | None = None,
+    ):
         super().__init__(f"kube api error status={status} reason={reason}")
         self.status = status
         self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+# Transient statuses worth another try on idempotent verbs; status=None is
+# a transport-level failure (connection reset, timeout) and equally
+# transient. 410 Gone is NOT here: it is a protocol signal (resync), not a
+# flake.
+RETRYABLE_STATUS = (429, 500, 502, 503, 504)
+
+
+def classify_kube_error(e: BaseException) -> "retry_mod.Classification | None":
+    """Shared transient-vs-permanent verdict for apiserver failures, used
+    by every call site that retries through utils/retry.py. A 4xx (other
+    than 429) will not improve with repetition; anything transport-level
+    or throttling/5xx-shaped will plausibly clear."""
+    from tpu_cc_manager.utils import retry as retry_mod
+
+    if not isinstance(e, KubeApiError):
+        return None
+    if getattr(e, "circuit_open", False):
+        # The client's breaker is open: retrying cannot help until the
+        # recovery window passes — fail fast, as the breaker intends.
+        return retry_mod.Classification(False, "circuit-open")
+    if e.status is None:
+        return retry_mod.Classification(True, "connection", e.retry_after_s)
+    if e.status == 429:
+        return retry_mod.Classification(True, "throttled", e.retry_after_s)
+    if e.status in RETRYABLE_STATUS:
+        return retry_mod.Classification(True, f"http-{e.status}", e.retry_after_s)
+    return retry_mod.Classification(False, f"http-{e.status}")
 
 
 @dataclass
@@ -46,8 +84,23 @@ def resource_version(obj: dict) -> str:
     return str((obj.get("metadata") or {}).get("resourceVersion") or "")
 
 
+def caller_retry_attempts(api: "KubeApi", default: int = 3) -> int:
+    """How many attempts a CALLER-side retry policy should make against
+    ``api``: 1 when the client already retries transients internally
+    (RestKube), ``default`` otherwise (fakes, chaos wrappers). Prevents the
+    nested-ladder amplification where a caller's 3 attempts each expand
+    into the client's 3 — up to 9 HTTP requests per logical call against
+    an apiserver that is already degraded."""
+    return 1 if getattr(api, "retries_internally", False) else default
+
+
 class KubeApi(abc.ABC):
     """Typed facade over the apiserver operations the control plane performs."""
+
+    #: True when this client retries transient failures internally; caller-
+    #: side policies consult caller_retry_attempts() so exactly ONE backoff
+    #: ladder runs per logical call.
+    retries_internally = False
 
     @abc.abstractmethod
     def get_node(self, name: str) -> dict:

@@ -15,18 +15,25 @@ reference's ``watch.Watch().stream(..., timeout_seconds=300)`` behavior
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import logging
 import os
 import ssl
 import tempfile
-import time
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass
 from typing import Iterator, Mapping
 
-from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, WatchEvent
+from tpu_cc_manager.kubeclient.api import (
+    RETRYABLE_STATUS,
+    KubeApi,
+    KubeApiError,
+    WatchEvent,
+    classify_kube_error,
+)
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -125,18 +132,39 @@ class ClusterConfig:
 class RestKube(KubeApi):
     # Transient statuses worth one more try on the non-watch verbs; a watch
     # stream has its own reconnect loop in the caller (manager.py) and is
-    # never retried here.
-    RETRYABLE_STATUS = (429, 500, 502, 503, 504)
+    # never retried here. (Kept as a class attribute for compatibility;
+    # classification itself lives in kubeclient.api.classify_kube_error.)
+    RETRYABLE_STATUS = RETRYABLE_STATUS
+    # Caller-side policies collapse to one attempt against this client
+    # (kubeclient.api.caller_retry_attempts): the ladder lives HERE.
+    retries_internally = True
 
     def __init__(
         self,
         config: ClusterConfig,
         retry_attempts: int = 3,
         retry_base_delay_s: float = 0.5,
+        retry_policy: retry_mod.RetryPolicy | None = None,
+        breaker: retry_mod.CircuitBreaker | None = None,
     ):
         self.config = config
         self.retry_attempts = max(1, retry_attempts)
         self.retry_base_delay_s = retry_base_delay_s
+        # The shared backoff policy (full jitter, Retry-After honoring);
+        # injectable for tests/chaos. max_attempts rides per-call so the
+        # legacy retry_attempts knob keeps working.
+        self.retry_policy = retry_policy or retry_mod.RetryPolicy(
+            max_attempts=self.retry_attempts,
+            base_delay_s=retry_base_delay_s,
+            max_delay_s=30.0,
+        )
+        # One breaker per client instance: a flapping apiserver fails fast
+        # after the threshold instead of absorbing every caller's full
+        # retry ladder. Generous threshold — the watch loop's own
+        # consecutive-error cap (10) should normally fire first.
+        self.breaker = breaker or retry_mod.CircuitBreaker(
+            "apiserver", failure_threshold=12, recovery_time_s=15.0
+        )
         self._ssl_ctx = self._build_ssl_context(config)
 
     @staticmethod
@@ -173,39 +201,71 @@ class RestKube(KubeApi):
                 detail = e.read().decode("utf-8", "replace")[:512]
             except Exception:
                 pass
-            raise KubeApiError(e.code, f"{method} {path}: {detail or e.reason}") from e
+            raise KubeApiError(
+                e.code,
+                f"{method} {path}: {detail or e.reason}",
+                retry_after_s=retry_mod.parse_retry_after(
+                    e.headers.get("Retry-After") if e.headers else None
+                ),
+            ) from e
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             raise KubeApiError(None, f"{method} {path}: {e}") from e
 
     def _request_json(self, method: str, path: str, query: dict | None = None,
                       body: dict | None = None, content_type: str | None = None) -> dict:
-        """One apiserver round trip with bounded retry on transient
-        failures (connection errors, 429, 5xx). Only idempotent verbs
-        (GET, label merge-patch) are retried — enforced here, not just
-        documented, so a future non-idempotent route (e.g. a POST eviction)
-        cannot silently inherit retry-after-ambiguous-failure. Client-side
-        errors (4xx) propagate immediately — a 404/409 will not improve
-        with repetition."""
+        """One apiserver round trip through the shared retry policy
+        (utils/retry.py: full jitter, Retry-After honoring) behind the
+        apiserver circuit breaker. Only idempotent verbs (GET, label
+        merge-patch) are retried — enforced here, not just documented, so a
+        future non-idempotent route (e.g. a POST eviction) cannot silently
+        inherit retry-after-ambiguous-failure. Client-side errors (4xx)
+        propagate immediately — a 404/409 will not improve with
+        repetition."""
         raw = json.dumps(body).encode() if body is not None else None
-        delay = self.retry_base_delay_s
         retryable_verb = method in ("GET", "PATCH")
-        attempts = self.retry_attempts if retryable_verb else 1
-        for attempt in range(attempts):
+
+        def attempt() -> dict:
+            try:
+                self.breaker.before_call()
+            except retry_mod.CircuitOpenError as e:
+                # Same exception surface as any other transport failure
+                # (callers already handle KubeApiError(None)) — but marked
+                # so the classifier treats it as PERMANENT: sleeping
+                # through a retry ladder against a known-open circuit
+                # would defeat the fail-fast the breaker exists for.
+                err = KubeApiError(None, str(e))
+                err.circuit_open = True
+                raise err from e
             try:
                 with self._open(method, path, query, raw, content_type) as resp:
-                    return json.loads(resp.read().decode("utf-8"))
+                    result = json.loads(resp.read().decode("utf-8"))
             except KubeApiError as e:
-                transient = e.status is None or e.status in self.RETRYABLE_STATUS
-                if not transient or attempt == attempts - 1:
-                    raise
-                log.warning(
-                    "transient apiserver error (%s/%s) on %s %s: %s — "
-                    "retrying in %.1fs",
-                    attempt + 1, self.retry_attempts, method, path, e, delay,
-                )
-                time.sleep(delay)
-                delay *= 2
-        raise AssertionError("unreachable")  # loop always returns or raises
+                verdict = classify_kube_error(e)
+                if verdict is not None and verdict.transient:
+                    self.breaker.record_failure()
+                else:
+                    # A definitive 4xx proves the apiserver is answering.
+                    self.breaker.record_success()
+                raise
+            except (OSError, ValueError, http.client.HTTPException) as e:
+                # Failures AFTER the connection opened (reset mid-body,
+                # IncompleteRead on a truncated stream, garbled JSON) are
+                # transport flakes too: wrap them so the retry policy and
+                # breaker see them instead of a raw exception escaping
+                # both.
+                self.breaker.record_failure()
+                raise KubeApiError(
+                    None, f"{method} {path}: response read failed: {e}"
+                ) from e
+            self.breaker.record_success()
+            return result
+
+        return self.retry_policy.call(
+            attempt,
+            op=f"kube.{method.lower()}",
+            classify=classify_kube_error,
+            max_attempts=self.retry_attempts if retryable_verb else 1,
+        )
 
     # ---- KubeApi ---------------------------------------------------------
 

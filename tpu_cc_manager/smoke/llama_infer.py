@@ -332,9 +332,17 @@ def run(
         # what the batch ladder trades against weight amortization.
         steps_per_sec = tokens_per_sec / batch
         weight_bytes = 2.0 * cfg.param_count()
-        avg_ctx = prompt_len + (lo + hi) / 2.0  # timed window midpoint
+        # The KV read is the FULL ALLOCATED cache, not the logical context:
+        # the cache buffer is allocated at prompt_len + hi up front and the
+        # padded-buffer attention streams the whole buffer (masked) every
+        # step. Counting the logical-midpoint context (the r5 accounting)
+        # under-reported traffic and so over-stated remaining headroom;
+        # with the allocated length, hbm_bw_util reflects the bytes the
+        # HBM actually moves (useful-traffic utilization is bounded above
+        # by it).
+        alloc_ctx = prompt_len + hi
         kv_bytes_per_seq = (
-            cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim * avg_ctx * 2.0
+            cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim * alloc_ctx * 2.0
         )
         bytes_per_sec = steps_per_sec * (
             weight_bytes + batch * kv_bytes_per_seq
@@ -360,6 +368,9 @@ def run(
         "ms_per_token": round(1e3 * dt / decode_len, 3) if timing_valid else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_bw_util": round(hbm_util, 4) if hbm_util is not None else None,
+        # How the KV term was counted, recorded in the artifact so ladder
+        # rows from different accounting eras can't be compared blindly.
+        "hbm_bw_accounting": "weights+allocated-kv",
         "prefill_tokens_per_sec": (
             round(prefill_tokens_per_sec, 2)
             if prefill_tokens_per_sec is not None else None

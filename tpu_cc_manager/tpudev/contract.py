@@ -29,6 +29,33 @@ class TpuError(Exception):
     watching (reference main.py:531-538)."""
 
 
+# Runtime-health probe tiers, strongest signal first. The rank (value) is
+# exported as a metric by the watchdog so a fleet silently degraded to the
+# weakest probe (bare device-node existence — nodes persist across a wedged
+# runtime) is visible, not implicit.
+HEALTH_TIER_STRENGTH = {
+    "health-port": 4,   # the runtime's own liveness port answers
+    "probe-cmd": 3,     # operator-supplied probe command exits 0
+    "systemd": 2,       # the runtime unit reports ActiveState=active
+    "device-node": 1,   # the device nodes merely exist
+    "none": 0,          # no probe available at all
+}
+
+
+@dataclass(frozen=True)
+class HealthProbe:
+    """One runtime-health probe result: which tier answered, its verdict,
+    and a human-readable detail for events/logs."""
+
+    tier: str
+    healthy: bool
+    detail: str = ""
+
+    @property
+    def strength(self) -> int:
+        return HEALTH_TIER_STRENGTH.get(self.tier, 0)
+
+
 @dataclass(frozen=True)
 class TpuChip:
     """One TPU chip as seen from this host."""
@@ -136,3 +163,11 @@ class TpuCcBackend(abc.ABC):
     def fetch_attestation(self, nonce: str) -> AttestationQuote:
         """Produce a quote for the slice's current state bound to ``nonce``.
         New capability — no reference counterpart (SURVEY.md §0(b))."""
+
+    def probe_runtime_health(self) -> HealthProbe:
+        """One health probe using the strongest tier this backend has
+        available (see HEALTH_TIER_STRENGTH). Consumed by the runtime-health
+        watchdog between reconciles; ``wait_ready`` implementations should
+        share the same probe so "ready" and "still healthy" can never
+        disagree on methodology. Default: no probe capability."""
+        return HealthProbe(tier="none", healthy=True, detail="no probe available")

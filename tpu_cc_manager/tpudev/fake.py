@@ -24,6 +24,7 @@ import time
 from tpu_cc_manager.labels import MODE_OFF
 from tpu_cc_manager.tpudev.contract import (
     AttestationQuote,
+    HealthProbe,
     SliceTopology,
     TpuCcBackend,
     TpuChip,
@@ -94,6 +95,11 @@ class FakeTpuBackend(TpuCcBackend):
         # EnvironmentFile semantics (devtools commits debug flags): tests
         # assert the backend-visible difference between modes here.
         self.runtime_env: dict[str, str] = {}
+        # Runtime-health watchdog controls: tests (and the chaos soak) flip
+        # ``healthy`` to drive demote→restore cycles; ``health_tier``
+        # mimics whichever probe tier the scenario wants reported.
+        self.healthy = True
+        self.health_tier = "probe-cmd"
 
     # ---- fault injection helpers ----------------------------------------
 
@@ -165,6 +171,14 @@ class FakeTpuBackend(TpuCcBackend):
                     raise TpuError(f"chip {chip.index} did not become ready")
                 time.sleep(0.01)
         self.op_log.append(("wait_ready", tuple(c.index for c in chips)))
+
+    def probe_runtime_health(self) -> HealthProbe:
+        self._maybe_fail("probe")
+        with self._lock:
+            return HealthProbe(
+                self.health_tier, self.healthy,
+                "fake probe " + ("healthy" if self.healthy else "unhealthy"),
+            )
 
     def fetch_attestation(self, nonce: str) -> AttestationQuote:
         self._maybe_fail("attest")

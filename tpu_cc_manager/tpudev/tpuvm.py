@@ -31,11 +31,13 @@ import urllib.request
 from tpu_cc_manager.labels import MODE_OFF, VALID_MODES
 from tpu_cc_manager.tpudev.contract import (
     AttestationQuote,
+    HealthProbe,
     SliceTopology,
     TpuCcBackend,
     TpuChip,
     TpuError,
 )
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -139,6 +141,24 @@ def host_wrap(cmd: list[str], host_root: str | None = None) -> list[str]:
         root, *cmd,
     ]
 
+
+def classify_subprocess_error(e: BaseException) -> retry_mod.Classification | None:
+    """Transient-vs-permanent verdict for host device commands (systemctl
+    restart & co). A missing binary never improves with repetition; a
+    non-zero exit or a timeout plausibly does (dbus hiccup, a unit mid-
+    restart), and gets exactly the one classified retry the policy allows."""
+    if isinstance(e, retry_mod.CircuitOpenError):
+        return retry_mod.Classification(False, "circuit-open")
+    if isinstance(e, FileNotFoundError):
+        return retry_mod.Classification(False, "not-found")
+    if isinstance(e, subprocess.TimeoutExpired):
+        return retry_mod.Classification(True, "timeout")
+    if isinstance(e, subprocess.CalledProcessError):
+        return retry_mod.Classification(True, f"rc-{e.returncode}")
+    if isinstance(e, OSError):
+        return retry_mod.Classification(True, "os-error")
+    return None
+
 # chips per host by generation (v4/v5p: 4 chips/host; v5e/v6e: up to 8).
 _CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5e": 8, "v6e": 8}
 # cores per chip: megacore generations report 1 core/chip to accelerator-type
@@ -217,6 +237,22 @@ class TpuVmBackend(TpuCcBackend):
             runtime_env_file = os.environ.get(RUNTIME_ENV_FILE_ENV) or None
         # A HOST path (CC_HOST_ROOT-prefixed at write time); None disables.
         self.runtime_env_file = runtime_env_file
+        # Device-command path protection: one classified retry per command
+        # (utils/retry.py; a dbus hiccup should not fail a whole reconcile)
+        # behind a breaker so a host whose systemctl keeps failing fails
+        # fast instead of stacking 120 s command timeouts every reconcile.
+        self.retry_policy = retry_mod.RetryPolicy(
+            max_attempts=2, base_delay_s=1.0, max_delay_s=5.0
+        )
+        self.breaker = retry_mod.CircuitBreaker(
+            "device-cmd", failure_threshold=4, recovery_time_s=60.0
+        )
+        # Whether the configured health port has EVER answered: until it
+        # has, a refused connection means "this runtime build has no
+        # liveness port" (the manifest defaults the env on) and the probe
+        # falls through to the next tier instead of failing the whole
+        # fleet closed; once seen, refusal means the runtime is down.
+        self._health_port_seen = False
 
     # ---- metadata / persistence helpers ---------------------------------
 
@@ -248,6 +284,43 @@ class TpuVmBackend(TpuCcBackend):
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f)
         os.replace(tmp, self._state_path(name))
+
+    # ---- device-command path --------------------------------------------
+
+    def _run_device_cmd(
+        self, cmd: list[str], *, op: str, timeout: float
+    ) -> subprocess.CompletedProcess:
+        """Run a host command with one classified retry (transient rc /
+        timeout / OS error) behind the device-command breaker. Permanent
+        failures (missing binary) and exhausted retries propagate the
+        original subprocess exception so callers keep their error mapping.
+        """
+        def attempt() -> subprocess.CompletedProcess:
+            # Gated PER ATTEMPT: a transient failure on attempt 1 can open
+            # the circuit mid-ladder, and attempt 2 must then fail fast
+            # instead of running another (up to 120 s) command against the
+            # known-bad path. CircuitOpenError classifies permanent.
+            self.breaker.before_call()
+            try:
+                return subprocess.run(
+                    cmd, check=True, capture_output=True, timeout=timeout
+                )
+            except BaseException as e:
+                verdict = classify_subprocess_error(e)
+                if verdict is not None and verdict.transient:
+                    self.breaker.record_failure()
+                else:
+                    # Permanent (missing binary) says nothing about the
+                    # command path's health — release a held half-open
+                    # probe slot so the breaker can't wedge on it.
+                    self.breaker.record_permanent()
+                raise
+
+        result = self.retry_policy.call(
+            attempt, op=op, classify=classify_subprocess_error
+        )
+        self.breaker.record_success()
+        return result
 
     # ---- runtime ground truth (systemd) ---------------------------------
 
@@ -410,9 +483,7 @@ class TpuVmBackend(TpuCcBackend):
         pre_stamp = self._runtime_stamp(fresh=True)
         log.info("restarting TPU runtime: %s", " ".join(self.reset_cmd))
         try:
-            subprocess.run(
-                self.reset_cmd, check=True, capture_output=True, timeout=120
-            )
+            self._run_device_cmd(self.reset_cmd, op="tpuvm.reset", timeout=120)
         except FileNotFoundError as e:
             raise TpuError(f"reset command not found: {e}") from e
         except subprocess.TimeoutExpired as e:
@@ -422,6 +493,11 @@ class TpuVmBackend(TpuCcBackend):
                 f"reset command failed rc={e.returncode}: "
                 f"{(e.stderr or b'').decode('utf-8', 'replace')[:256]}"
             ) from e
+        except retry_mod.CircuitOpenError as e:
+            # Crash-as-retry semantics preserved: pending markers stay,
+            # query reports 'resetting', the retrying reconcile re-applies
+            # once the breaker's recovery window passes.
+            raise TpuError(f"device-command path unavailable: {e}") from e
         # Cross-check the restart actually happened: a reset command that
         # exits 0 without bouncing the runtime (wrong unit name, masked
         # unit, no-op wrapper) must not promote pending -> committed. The
@@ -491,42 +567,104 @@ class TpuVmBackend(TpuCcBackend):
         log.info("runtime env staged for mode=%s at %s", mode, path)
 
     def wait_ready(self, chips: tuple[TpuChip, ...], timeout_s: float) -> None:
-        deadline = time.monotonic() + timeout_s
-        while True:
-            if self._probe_healthy(chips):
-                return
-            if time.monotonic() >= deadline:
-                raise TpuError(
-                    f"TPU runtime not healthy after {timeout_s:.0f}s"
-                )
-            time.sleep(1.0)
+        if not retry_mod.poll_until(
+            lambda: self._probe_healthy(chips), timeout_s, 1.0
+        ):
+            raise TpuError(f"TPU runtime not healthy after {timeout_s:.0f}s")
 
     def _probe_healthy(self, chips: tuple[TpuChip, ...]) -> bool:
-        """Layered health probe, strongest available signal first:
-        explicit probe command > runtime health port (TCP) > systemd
-        ActiveState + device nodes > device nodes alone. Bare device-node
-        existence is the weakest signal (nodes persist across a wedged
-        runtime) and is only the last resort."""
-        if self.health_probe_cmd is not None:
-            try:
-                rc = subprocess.run(
-                    self.health_probe_cmd, capture_output=True, timeout=10
-                ).returncode
-                return rc == 0
-            except (OSError, subprocess.TimeoutExpired):
-                return False
+        return self.probe_runtime_health(chips).healthy
+
+    def _probe_cmd_verdict(self) -> HealthProbe:
+        try:
+            rc = subprocess.run(
+                self.health_probe_cmd, capture_output=True, timeout=10
+            ).returncode
+            return HealthProbe("probe-cmd", rc == 0, f"probe rc={rc}")
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return HealthProbe("probe-cmd", False, f"probe failed: {e}")
+
+    def probe_runtime_health(
+        self, chips: tuple[TpuChip, ...] | None = None
+    ) -> HealthProbe:
+        """Layered health probe, strongest AVAILABLE tier first (contract
+        HEALTH_TIER_STRENGTH): runtime health port (TCP) > explicit probe
+        command > systemd ActiveState + device nodes > device nodes alone.
+        Bare device-node existence is the weakest signal (nodes persist
+        across a wedged runtime) and is only the last resort — the watchdog
+        exports the active tier so that fallback is visible, never silent.
+        """
         if self.health_port:
+            port_up = True
             try:
                 with socket.create_connection(
                     ("127.0.0.1", self.health_port), timeout=2
                 ):
-                    return True
-            except OSError:
-                return False
+                    pass
+            except OSError as e:
+                if not self._health_port_seen:
+                    # Never answered since process start: the runtime
+                    # build most likely exposes no liveness port (the
+                    # manifest defaults CC_RUNTIME_HEALTH_PORT on), which
+                    # must read as tier-unavailable, not fleet-wide
+                    # unhealthy. Fall through to the next tier.
+                    log.debug(
+                        "health port %d never answered; treating the tier "
+                        "as unavailable: %s", self.health_port, e,
+                    )
+                    port_up = None
+                else:
+                    return HealthProbe(
+                        "health-port", False, f"port {self.health_port}: {e}"
+                    )
+            if port_up:
+                self._health_port_seen = True
+                # A bare TCP accept can come straight from the kernel
+                # backlog of a wedged process; when the operator ALSO
+                # supplied a probe command, it still runs as the
+                # application-level second opinion and both must pass (the
+                # port alone must not mask a wedge the command would
+                # catch).
+                if self.health_probe_cmd is not None:
+                    cmd = self._probe_cmd_verdict()
+                    return HealthProbe(
+                        "health-port",
+                        cmd.healthy,
+                        f"port {self.health_port} answers; {cmd.detail}",
+                    )
+                return HealthProbe(
+                    "health-port", True, f"port {self.health_port} answers"
+                )
+        if self.health_probe_cmd is not None:
+            return self._probe_cmd_verdict()
+        device_paths = (
+            [c.device_path for c in chips]
+            if chips is not None
+            else sorted(glob.glob(self.device_glob))
+            or sorted(glob.glob(self.vfio_glob))
+        )
+        nodes_present = bool(device_paths) and all(
+            os.path.exists(p) for p in device_paths
+        )
         stamp = self._runtime_stamp()
-        if stamp is not None and stamp[0] not in ("active", "unknown"):
-            return False
-        return all(os.path.exists(c.device_path) for c in chips)
+        if stamp is not None:
+            if stamp[0] not in ("active", "unknown"):
+                return HealthProbe(
+                    "systemd", False, f"runtime unit {stamp[0]}"
+                )
+            return HealthProbe(
+                "systemd",
+                nodes_present,
+                f"runtime unit {stamp[0]}; device nodes "
+                + ("present" if nodes_present else "MISSING"),
+            )
+        return HealthProbe(
+            "device-node",
+            nodes_present,
+            "device nodes " + ("present" if nodes_present else "missing")
+            + " (weakest probe tier — configure CC_RUNTIME_HEALTH_PORT or a "
+            "probe command)",
+        )
 
     def fetch_attestation(self, nonce: str) -> AttestationQuote:
         committed = self._read_state("committed.json")

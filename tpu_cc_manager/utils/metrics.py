@@ -178,6 +178,13 @@ class MetricsRegistry:
         # the pre-apply failure paths), keyed exactly as the failed.reason
         # node label is.
         self._failure_totals: dict[str, int] = {}
+        # (op, reason) -> retries through the shared policy (utils/retry.py).
+        self._retry_totals: dict[tuple[str, str], int] = {}
+        # Circuit breaker states by path name ("apiserver", "device-cmd").
+        self._breaker_states: dict[str, str] = {}
+        # Runtime-health watchdog: active probe tier + last probe verdict.
+        self._health_tier: tuple[str, int] | None = None
+        self._runtime_healthy: bool | None = None
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -204,6 +211,36 @@ class MetricsRegistry:
         string the failed.reason node label carries)."""
         with self._lock:
             self._failure_totals[reason] = self._failure_totals.get(reason, 0) + 1
+
+    def record_retry(self, op: str, reason: str) -> None:
+        """Count one retry through the shared policy (utils/retry.py)."""
+        with self._lock:
+            key = (op, reason)
+            self._retry_totals[key] = self._retry_totals.get(key, 0) + 1
+
+    def retry_totals(self) -> dict[tuple[str, str], int]:
+        with self._lock:
+            return dict(self._retry_totals)
+
+    def set_breaker_state(self, name: str, state: str) -> None:
+        with self._lock:
+            self._breaker_states[name] = state
+
+    def breaker_states(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._breaker_states)
+
+    def set_health_tier(self, tier: str, strength: int, healthy: bool) -> None:
+        """Record the runtime-health watchdog's active probe tier (strength
+        is the tier's rank — device-node existence being the weakest) and
+        the latest probe verdict."""
+        with self._lock:
+            self._health_tier = (tier, strength)
+            self._runtime_healthy = healthy
+
+    def health_tier(self) -> tuple[str, int] | None:
+        with self._lock:
+            return self._health_tier
 
     def _accumulate(self, m: ReconcileMetrics) -> None:
         with self._lock:
@@ -261,6 +298,10 @@ class MetricsRegistry:
             phase_totals = {k: list(v) for k, v in self._phase_totals.items()}
             phase_hist = {k: list(v) for k, v in self._phase_hist.items()}
             failure_totals = dict(self._failure_totals)
+            retry_totals = dict(self._retry_totals)
+            breaker_states = dict(self._breaker_states)
+            health_tier = self._health_tier
+            runtime_healthy = self._runtime_healthy
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -275,6 +316,52 @@ class MetricsRegistry:
             lines.append(
                 "tpu_cc_failures_total%s %d"
                 % (_labels(reason=reason), failure_totals[reason])
+            )
+        if retry_totals:
+            lines.append(
+                "# HELP tpu_cc_retries_total Retries through the shared "
+                "backoff policy (utils/retry.py), by operation and reason."
+            )
+            lines.append("# TYPE tpu_cc_retries_total counter")
+            for (op, reason), count in sorted(retry_totals.items()):
+                lines.append(
+                    "tpu_cc_retries_total%s %d"
+                    % (_labels(op=op, reason=reason), count)
+                )
+        if breaker_states:
+            lines.append(
+                "# HELP tpu_cc_breaker_state Circuit breaker state per "
+                "dependency path (0=closed, 1=half_open, 2=open)."
+            )
+            lines.append("# TYPE tpu_cc_breaker_state gauge")
+            state_value = {"closed": 0, "half_open": 1, "open": 2}
+            for name in sorted(breaker_states):
+                lines.append(
+                    "tpu_cc_breaker_state%s %d"
+                    % (
+                        _labels(path=name),
+                        state_value.get(breaker_states[name], 2),
+                    )
+                )
+        if health_tier is not None:
+            tier, strength = health_tier
+            lines.append(
+                "# HELP tpu_cc_health_probe_tier Active runtime-health probe "
+                "tier; the value is the tier's strength rank (higher = "
+                "stronger signal; 1 = bare device-node existence)."
+            )
+            lines.append("# TYPE tpu_cc_health_probe_tier gauge")
+            lines.append(
+                "tpu_cc_health_probe_tier%s %d" % (_labels(tier=tier), strength)
+            )
+        if runtime_healthy is not None:
+            lines.append(
+                "# HELP tpu_cc_runtime_healthy Last watchdog probe verdict "
+                "(1 = healthy)."
+            )
+            lines.append("# TYPE tpu_cc_runtime_healthy gauge")
+            lines.append(
+                "tpu_cc_runtime_healthy %d" % (1 if runtime_healthy else 0)
             )
         # The cumulative per-phase sums/counts are served exclusively as
         # the histogram's _sum/_count series below — separate
