@@ -9,14 +9,23 @@
 # asserts convergence: correct final mode labels, no stuck pause labels,
 # bounded retry counts, a watchdog demote→restore cycle.
 #
-#   CC_CHAOS_SEED    base seed (default 20260803); each iteration offsets it
-#   CC_CHAOS_ROUNDS  mode-drive rounds per soak (default 5; tier-1 runs 2)
-#   CC_CHAOS_ITERS   how many seeds to soak (default 5)
-#   OUT              JSON summary artifact (default artifacts/chaos_soak.json)
+# Terminal-fault mode (on by default, CC_CHAOS_TERMINAL=0 disables): the
+# same suite also seeds a device fault that NEVER clears and asserts the
+# remediation ladder (ccmanager/remediation.py) escalates end-to-end —
+# backoff retry → device re-reset → runtime restart → quarantine (taint +
+# label + event + halted rollouts) → probation auto-lift once the fault
+# clears. Its REMEDIATION_SUMMARY counters land in the JSON summary.
+#
+#   CC_CHAOS_SEED     base seed (default 20260803); each iteration offsets it
+#   CC_CHAOS_ROUNDS   mode-drive rounds per soak (default 5; tier-1 runs 2)
+#   CC_CHAOS_ITERS    how many seeds to soak (default 5)
+#   CC_CHAOS_TERMINAL 1 (default) runs the terminal-fault ladder leg too
+#   OUT               JSON summary artifact (default artifacts/chaos_soak.json)
 #
 # Exit 0 only when every seed converged. The summary records per-seed
-# fault/retry counts (grepped from the test's CHAOS_SOAK_SUMMARY line) so
-# the evidence ladder can cite them.
+# fault/retry counts (grepped from the test's CHAOS_SOAK_SUMMARY line) and
+# remediation-ladder counters (REMEDIATION_SUMMARY) so the evidence ladder
+# can cite them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -27,18 +36,25 @@ export JAX_PLATFORMS=cpu
 SEED="${CC_CHAOS_SEED:-20260803}"
 ROUNDS="${CC_CHAOS_ROUNDS:-5}"
 ITERS="${CC_CHAOS_ITERS:-5}"
+TERMINAL="${CC_CHAOS_TERMINAL:-1}"
 OUT="${OUT:-artifacts/chaos_soak.json}"
 mkdir -p "$(dirname "$OUT")" artifacts
+
+# The terminal-fault leg is one named test; deselect it when disabled.
+PYTEST_ARGS=(tests/test_chaos.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
+if [ "$TERMINAL" = "0" ]; then
+  PYTEST_ARGS+=(--deselect \
+    "tests/test_chaos.py::test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts")
+fi
 
 results=()
 failed=0
 for i in $(seq 0 $((ITERS - 1))); do
   seed=$((SEED + i))
   log="artifacts/chaos_soak_seed${seed}.log"
-  echo "=== chaos soak: seed=$seed rounds=$ROUNDS ==="
+  echo "=== chaos soak: seed=$seed rounds=$ROUNDS terminal=$TERMINAL ==="
   if CC_CHAOS_SEED=$seed CC_CHAOS_ROUNDS=$ROUNDS \
-     timeout -k 10 600 python -m pytest tests/test_chaos.py -q -m chaos \
-       -p no:cacheprovider -p no:randomly -s > "$log" 2>&1; then
+     timeout -k 10 600 python -m pytest "${PYTEST_ARGS[@]}" > "$log" 2>&1; then
     ok=true
   else
     ok=false
@@ -48,12 +64,14 @@ for i in $(seq 0 $((ITERS - 1))); do
   fi
   # -q progress dots share the line, so match anywhere, not just column 0.
   summary=$(grep -ao "CHAOS_SOAK_SUMMARY.*" "$log" | tail -1 | sed 's/^CHAOS_SOAK_SUMMARY //')
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\"}")
+  remediation=$(grep -ao "REMEDIATION_SUMMARY.*" "$log" | tail -1 | sed "s/^REMEDIATION_SUMMARY //; s/'/ /g; s/\"/ /g")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\"}")
 done
 
 {
-  printf '{"ok": %s, "rounds": %s, "iterations": %s, "results": [' \
-    "$([ "$failed" -eq 0 ] && echo true || echo false)" "$ROUNDS" "$ITERS"
+  printf '{"ok": %s, "rounds": %s, "iterations": %s, "terminal_faults": %s, "results": [' \
+    "$([ "$failed" -eq 0 ] && echo true || echo false)" "$ROUNDS" "$ITERS" \
+    "$([ "$TERMINAL" = "0" ] && echo false || echo true)"
   (IFS=,; printf '%s' "${results[*]}")
   printf ']}\n'
 } > "$OUT"

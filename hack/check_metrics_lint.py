@@ -235,6 +235,12 @@ def _seeded_registry_text() -> str:
     registry.set_breaker_state("apiserver", "half_open")
     registry.set_breaker_state("device-cmd", "closed")
     registry.set_health_tier("device-node", 1, healthy=False)
+    # Failure-containment families (ccmanager/remediation.py + slice
+    # fencing), awkward outcome value included.
+    registry.set_quarantined(True)
+    registry.record_remediation_step("device-reset", "ok")
+    registry.record_remediation_step("quarantine", 'odd"outcome')
+    registry.record_barrier_fenced()
     return registry.render_prometheus()
 
 

@@ -105,4 +105,38 @@ dp=$(kubectl get node "$NODE" \
   -o jsonpath="{.metadata.labels.google\.com/tpu\.deploy\.device-plugin}")
 [ "$dp" = "true" ] || { echo "FAIL: component label not restored ($dp)"; exit 1; }
 
-echo ">>> kind integration OK (RBAC + real watch + merge-patch verified)"
+echo ">>> rolling reconfiguration via tpu-cc-ctl against the real apiserver"
+kubectl label node "$NODE" pool=tpu-it --overwrite
+PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl rollout \
+    --selector pool=tpu-it --mode on --node-timeout 120
+await_state on
+
+echo ">>> quarantine drill: the taint patch verb against real RBAC"
+PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl quarantine --node "$NODE" --reason kind-drill
+effect=$(kubectl get node "$NODE" -o jsonpath\
+='{.spec.taints[?(@.key=="cloud.google.com/tpu-cc.quarantined")].effect}')
+[ "$effect" = "NoSchedule" ] || {
+  echo "FAIL: quarantine taint not applied (effect='$effect')"; exit 1; }
+q=$(kubectl get node "$NODE" \
+  -o jsonpath="{.metadata.labels.cloud\.google\.com/tpu-cc\.quarantined}")
+[ "$q" = "true" ] || { echo "FAIL: quarantine label not applied ($q)"; exit 1; }
+
+echo ">>> pool failure budget halts a rollout over the quarantined pool"
+if PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+   python3 -m tpu_cc_manager.ctl rollout \
+     --selector pool=tpu-it --mode off --failure-budget 0 --node-timeout 30; then
+  echo "FAIL: rollout did not halt on an exceeded failure budget"; exit 1
+fi
+
+PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl unquarantine --node "$NODE" --reason kind-drill
+effect=$(kubectl get node "$NODE" -o jsonpath\
+='{.spec.taints[?(@.key=="cloud.google.com/tpu-cc.quarantined")].effect}')
+[ -z "$effect" ] || { echo "FAIL: quarantine taint not removed"; exit 1; }
+# The agent still reconciles after the drill.
+kubectl label node "$NODE" "$MODE_LABEL=off" --overwrite
+await_state off
+
+echo ">>> kind integration OK (RBAC incl. taints + real watch + merge-patch + rollout + quarantine verified)"

@@ -492,6 +492,18 @@ class Handler(BaseHTTPRequestHandler):
                             anns.pop(k, None)
                         else:
                             anns[k] = v
+                spec_patch = body.get("spec") or {}
+                if "taints" in spec_patch:
+                    # Merge-patch semantics on a LIST: wholesale replace
+                    # (the client does the read-modify-write; quarantine
+                    # taints ride this path, ccmanager/remediation.py).
+                    taints = spec_patch["taints"]
+                    if not isinstance(taints, list) or any(
+                        not isinstance(t, dict) or not t.get("key")
+                        for t in taints
+                    ):
+                        return self._invalid("spec.taints entries need a key")
+                    node.setdefault("spec", {})["taints"] = taints
                 bump_rv(node)
                 emit_watch_event(node)
                 return self._json(node)

@@ -18,7 +18,9 @@ import time
 
 import pytest
 
+from tpu_cc_manager.ccmanager import remediation as remediation_mod
 from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
 from tpu_cc_manager.ccmanager.watchdog import RuntimeHealthWatchdog
 from tpu_cc_manager.drain.pause import is_paused
 from tpu_cc_manager.faults import FaultPlan, FaultyKubeClient
@@ -32,6 +34,8 @@ from tpu_cc_manager.labels import (
     MODE_DEVTOOLS,
     MODE_OFF,
     MODE_ON,
+    QUARANTINE_TAINT_KEY,
+    QUARANTINED_LABEL,
 )
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend
 from tpu_cc_manager.utils.metrics import MetricsRegistry
@@ -331,4 +335,164 @@ def test_chaos_soak_converges_with_bounded_retries(fake_kube, tmp_path):
         "CHAOS_SOAK_SUMMARY "
         f"seed={plan.seed} rounds={rounds} faults={len(plan.injected)} "
         f"retries={total_retries} budget={budget}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Terminal-fault mode: the remediation ladder end-to-end
+# ---------------------------------------------------------------------------
+
+
+def await_cond(cond, what: str, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        assert time.monotonic() < deadline, f"never reached: {what}"
+        time.sleep(0.02)
+
+
+def test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts(
+    fake_kube, tmp_path,
+):
+    """The failure-containment acceptance bar: a seeded TERMINAL device
+    fault (never clears on its own) drives the real watch loop through the
+    whole remediation ladder — backoff retries, a device re-reset, a
+    runtime restart — to quarantine (NoSchedule taint, cc.quarantined
+    label, ready.state=false, CCNodeQuarantined event); the rolling
+    orchestrator skips the node and its pool failure budget halts the
+    rollout; and once the hardware recovers, the watchdog's probes lift
+    the quarantine after probation and the node converges to the desired
+    mode again."""
+    plan = FaultPlan.from_env(rate=0.0, watch_rate=0.0)
+    api = FaultyKubeClient(fake_kube, plan)
+    backend = FakeTpuBackend()
+    # The condemned op is a pure function of the seed; any of these three
+    # defeats every reconcile attempt until the fault is cleared.
+    condemned = plan.seed_terminal_backend_fault(
+        backend, ops=("stage", "reset", "attest")
+    )
+    fake_kube.add_node(NODE)
+
+    registry = MetricsRegistry()
+    ladder = remediation_mod.RemediationLadder(
+        api, NODE, backend=backend,
+        failures_per_step=1,   # one failure per rung: 4 failures to the top
+        probation_s=0.1,
+        metrics=registry,
+    )
+    mgr = CCManager(
+        api=api,
+        backend=backend,
+        node_name=NODE,
+        default_mode=MODE_OFF,
+        evict_components=False,
+        smoke_workload="none",
+        metrics=registry,
+        watch_timeout_s=1,
+        reconnect_delay_s=0.01,
+        retry_backoff_s=0.02,
+        retry_backoff_max_s=0.2,
+        readiness_file=str(tmp_path / "ready"),
+        remediation=ladder,
+    )
+    ladder.emit_event = mgr._emit_node_event
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=lambda: mgr.watch_and_apply(stop), daemon=True
+    )
+    thread.start()
+    try:
+        # Drive a mode the terminal fault defeats; the agent's failed
+        # reconciles feed the ladder until it quarantines the node.
+        fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+        await_cond(lambda: ladder.quarantined, "quarantine")
+        # The event is the LAST side effect of quarantine(); once it has
+        # landed, the label/taint/ready writes all have too.
+        await_cond(
+            lambda: any(
+                e.get("reason") == "CCNodeQuarantined"
+                for e in fake_kube.events
+            ),
+            "CCNodeQuarantined event",
+        )
+        node = fake_kube.get_node(NODE)
+        labels = node_labels(node)
+        assert labels[QUARANTINED_LABEL] == "true"
+        assert labels[CC_READY_STATE_LABEL] == "false"
+        assert any(
+            t["key"] == QUARANTINE_TAINT_KEY and t["effect"] == "NoSchedule"
+            for t in (node.get("spec") or {}).get("taints") or []
+        )
+        # The ladder walked every rung on the way down.
+        totals = registry.remediation_totals()
+        for step in remediation_mod.STEPS:
+            assert any(s == step for s, _ in totals), (
+                f"rung {step} never ran: {totals}"
+            )
+
+        # Rolling orchestrator: the quarantined node is skipped, and the
+        # pool failure budget halts the rollout entirely (fleet breaker).
+        fake_kube.add_node("chaos-peer-0", {"pool": "tpu"})
+        fake_kube.set_node_label(NODE, "pool", "tpu")
+
+        def peer_converges(name, node):
+            if name == "chaos-peer-0":
+                desired = node_labels(node).get(CC_MODE_LABEL)
+                state = node_labels(node).get(CC_MODE_STATE_LABEL)
+                if desired and state != desired:
+                    fake_kube.set_node_label(
+                        name, CC_MODE_STATE_LABEL, desired
+                    )
+
+        fake_kube.add_patch_reactor(peer_converges)
+        result = RollingReconfigurator(
+            api, "pool=tpu", node_timeout_s=5.0, poll_interval_s=0.01,
+        ).rollout(MODE_OFF)
+        assert result.ok and result.skipped_quarantined == [NODE]
+        halted = RollingReconfigurator(
+            api, "pool=tpu", node_timeout_s=5.0, poll_interval_s=0.01,
+            failure_budget=0,
+        ).rollout(MODE_OFF)
+        assert not halted.ok
+        assert halted.halted_reason == "failure-budget-exceeded"
+
+        # Hardware recovers: the terminal fault clears, the watchdog's
+        # healthy probes run probation down, and quarantine auto-lifts.
+        backend.fail.pop(condemned, None)
+        backend.healthy = True
+        watchdog = RuntimeHealthWatchdog(
+            api, backend, NODE,
+            demote_after=2, restore_after=1,
+            is_busy=lambda: mgr.reconciling,
+            metrics=registry,
+            on_probe=ladder.note_probe,
+            on_condemn=ladder.condemn,
+        )
+        def probe_until_lifted():
+            watchdog.tick()
+            return not ladder.quarantined
+        await_cond(probe_until_lifted, "probation lift")
+        # The agent's pending backoff retry now re-applies the desired
+        # mode and the node converges for real.
+        await_cond(
+            lambda: node_labels(fake_kube.get_node(NODE)).get(
+                CC_MODE_STATE_LABEL
+            ) == MODE_ON,
+            "post-lift convergence",
+        )
+        assert any(
+            e.get("reason") == "CCNodeUnquarantined" for e in fake_kube.events
+        )
+        labels = node_labels(fake_kube.get_node(NODE))
+        assert QUARANTINED_LABEL not in labels
+        assert labels[CC_READY_STATE_LABEL] == "true"
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    assert not thread.is_alive()
+    totals = registry.remediation_totals()
+    print(
+        "REMEDIATION_SUMMARY "
+        f"seed={plan.seed} condemned_op={condemned} "
+        f"steps={sorted((f'{s}:{o}', c) for (s, o), c in totals.items())} "
+        f"quarantines={sum(c for (s, _), c in totals.items() if s == 'quarantine')}"
     )

@@ -1,0 +1,686 @@
+"""Failure containment (ccmanager/remediation.py + slicecoord fencing).
+
+Covers the escalation ladder (backoff-retry -> device-reset ->
+runtime-restart -> quarantine), annotation-persisted ladder state across
+agent restarts, quarantine side effects (NoSchedule taint, label, ready
+demotion, event, slice fencing), the watchdog-driven probation auto-lift,
+the barrier fencing-generation protocol (peers fail fast; stale agents can
+neither complete nor re-stage an aborted round), the rolling orchestrator's
+quarantine skip + pool failure budget, and the operator CLI overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager import ctl
+from tpu_cc_manager.ccmanager import remediation, slicecoord
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.ccmanager.slicecoord import (
+    SLICE_COMMIT_GEN_LABEL,
+    SLICE_COMMIT_LABEL,
+    SLICE_FENCE_LABEL,
+    SLICE_STAGED_GEN_LABEL,
+    SLICE_STAGED_LABEL,
+    BarrierFenced,
+    BarrierTimeout,
+    SliceBarrier,
+)
+from tpu_cc_manager.ccmanager.watchdog import RuntimeHealthWatchdog
+from tpu_cc_manager.kubeclient.api import node_annotations, node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    CC_READY_STATE_LABEL,
+    MODE_ON,
+    MODE_SLICE,
+    QUARANTINE_TAINT_KEY,
+    QUARANTINED_LABEL,
+    SLICE_ID_LABEL,
+)
+from tpu_cc_manager.tpudev.contract import SliceTopology
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "remedy-node-0"
+SLICE = "remedy-slice"
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, s: float) -> None:
+        self.now += s
+
+
+def node_taints(node: dict) -> list[dict]:
+    return (node.get("spec") or {}).get("taints") or []
+
+
+def make_ladder(kube, backend=None, **kw):
+    events: list[tuple[str, str, str]] = []
+    clock = kw.pop("clock", FakeClock())
+    ladder = remediation.RemediationLadder(
+        kube,
+        NODE,
+        backend=backend,
+        failures_per_step=kw.pop("failures_per_step", 2),
+        probation_s=kw.pop("probation_s", 30.0),
+        emit_event=lambda *a: events.append(a),
+        metrics=kw.pop("metrics", MetricsRegistry()),
+        clock=clock,
+        **kw,
+    )
+    return ladder, events, clock
+
+
+# ---------------------------------------------------------------------------
+# Escalation ladder
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_escalates_in_order(fake_kube):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    ladder, events, _ = make_ladder(fake_kube, backend)
+
+    # Rung 0: the manager's own backoff retry — no device action.
+    assert ladder.note_failure("apply-failed") == remediation.STEP_RETRY
+    assert ladder.note_failure("apply-failed") == remediation.STEP_RETRY
+    assert not any(op == "reset" for op, _ in backend.op_log)
+
+    # Rung 1: device re-reset.
+    assert ladder.note_failure("apply-failed") == remediation.STEP_DEVICE_RESET
+    assert sum(1 for op, _ in backend.op_log if op == "reset") == 1
+    assert ladder.note_failure("apply-failed") == remediation.STEP_DEVICE_RESET
+
+    # Rung 2: runtime restart (distinct backend op).
+    assert (
+        ladder.note_failure("apply-failed") == remediation.STEP_RUNTIME_RESTART
+    )
+    assert any(op == "restart_runtime" for op, _ in backend.op_log)
+    ladder.note_failure("apply-failed")
+
+    # Rung 3: quarantine — terminal.
+    assert ladder.note_failure("apply-failed") == remediation.STEP_QUARANTINE
+    assert ladder.quarantined
+    node = fake_kube.get_node(NODE)
+    labels = node_labels(node)
+    assert labels[QUARANTINED_LABEL] == "true"
+    assert labels[CC_READY_STATE_LABEL] == "false"
+    taints = node_taints(node)
+    assert any(
+        t["key"] == QUARANTINE_TAINT_KEY and t["effect"] == "NoSchedule"
+        for t in taints
+    )
+    assert ("Warning", "CCNodeQuarantined") in {
+        (t, r) for t, r, _ in events
+    }
+    # Further failures stay contained (no re-escalation, no new actions).
+    resets = sum(1 for op, _ in backend.op_log if op == "reset")
+    assert ladder.note_failure("apply-failed") == remediation.STEP_QUARANTINE
+    assert sum(1 for op, _ in backend.op_log if op == "reset") == resets
+
+
+def test_peer_and_apiserver_failures_do_not_escalate(fake_kube):
+    """A fenced/timed-out barrier is a PEER's failure and an apiserver
+    outage is nobody's hardware fault: neither climbs the ladder — one
+    quarantined host must not cascade its healthy slice-mates into
+    resets and quarantine."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    ladder, _, _ = make_ladder(fake_kube, backend)
+    for _ in range(20):
+        ladder.note_failure("barrier-fenced")
+        ladder.note_failure("barrier-timeout")
+        ladder.note_failure("apiserver-error")
+    assert ladder.failures == 0
+    assert not ladder.quarantined
+    assert not backend.op_log  # no remediation action ever ran
+
+
+def test_drain_timeout_skips_hardware_rungs_but_still_quarantines(fake_kube):
+    """Resetting chips under workloads that refused to drain would break
+    the strict-eviction guarantee; sustained drain failure still ends in
+    quarantine (stop scheduling onto a node that cannot drain)."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    ladder, _, _ = make_ladder(fake_kube, backend, metrics=registry)
+    for _ in range(7):
+        ladder.note_failure("drain-timeout")
+    assert ladder.quarantined
+    assert not any(
+        op in ("reset", "restart_runtime") for op, _ in backend.op_log
+    )
+    totals = registry.remediation_totals()
+    assert totals[(remediation.STEP_DEVICE_RESET, "skipped")] >= 1
+    assert totals[(remediation.STEP_RUNTIME_RESTART, "skipped")] >= 1
+
+
+def test_failed_startup_load_is_retried_before_acting(fake_kube):
+    """A quarantined node whose agent rebooted through an apiserver blip
+    must re-learn its quarantine before any ladder decision runs."""
+    fake_kube.add_node(NODE)
+    first, _, _ = make_ladder(fake_kube, FakeTpuBackend())
+    first.quarantine(reason="test")
+
+    real_get = fake_kube.get_node
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    fail = {"on": True}
+
+    def flaky_get(name):
+        if fail["on"]:
+            raise KubeApiError(None, "blip")
+        return real_get(name)
+
+    fake_kube.get_node = flaky_get
+    try:
+        reborn, _, _ = make_ladder(fake_kube, FakeTpuBackend())
+        assert not reborn.quarantined  # load failed; state unknown so far
+        fail["on"] = False
+        # The first ladder decision re-loads and rediscovers quarantine:
+        # the failure is absorbed (already contained), not escalated.
+        assert reborn.note_failure("apply-failed") == remediation.STEP_QUARANTINE
+        assert reborn.quarantined
+        assert reborn.failures == 0
+    finally:
+        fake_kube.get_node = real_get
+
+
+def test_ctl_quarantine_without_backend_still_fences(fake_kube):
+    """The operator CLI has no device layer; fencing falls back to the
+    node's published slice-membership label."""
+    fake_kube.add_node(
+        "ctl-f0", {SLICE_ID_LABEL: SLICE, CC_MODE_STATE_LABEL: MODE_SLICE}
+    )
+    rc = ctl.cmd_quarantine(
+        fake_kube, argparse.Namespace(node="ctl-f0", reason="drill")
+    )
+    assert rc == 0
+    assert node_labels(fake_kube.get_node("ctl-f0"))[SLICE_FENCE_LABEL] == "1"
+
+
+def test_success_resets_the_ladder(fake_kube):
+    fake_kube.add_node(NODE)
+    ladder, _, _ = make_ladder(fake_kube, FakeTpuBackend())
+    for _ in range(3):
+        ladder.note_failure("apply-failed")
+    assert ladder.failures == 3
+    ladder.note_success()
+    assert ladder.failures == 0 and ladder.step == remediation.STEP_RETRY
+    # The persisted annotation is dropped with it.
+    assert remediation.REMEDIATION_ANNOTATION not in node_annotations(
+        fake_kube.get_node(NODE)
+    )
+
+
+def test_ladder_state_survives_agent_restart(fake_kube):
+    """The annotation is the ladder's crash-safety: a terminally bad node
+    cannot dodge quarantine by crash-restarting the agent."""
+    fake_kube.add_node(NODE)
+    ladder, _, _ = make_ladder(fake_kube, FakeTpuBackend())
+    for _ in range(4):
+        ladder.note_failure("apply-failed")
+    assert ladder.step == remediation.STEP_DEVICE_RESET
+
+    reborn, _, _ = make_ladder(fake_kube, FakeTpuBackend())
+    assert reborn.failures == 4
+    assert reborn.step == remediation.STEP_DEVICE_RESET
+    # Three more failures drive the RESUMED ladder to quarantine — the
+    # restart did not reset the count.
+    for _ in range(3):
+        reborn.note_failure("apply-failed")
+    assert reborn.quarantined
+
+    third, _, _ = make_ladder(fake_kube, FakeTpuBackend())
+    assert third.quarantined
+
+
+def test_remediation_action_failure_still_escalates(fake_kube):
+    """A rung whose action itself fails (the device is THAT broken) keeps
+    counting failures toward the next rung instead of wedging."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    backend.fail_next("reset", times=-1)
+    backend.fail_next("restart_runtime", times=-1)
+    registry = MetricsRegistry()
+    ladder, _, _ = make_ladder(fake_kube, backend, metrics=registry)
+    for _ in range(7):
+        ladder.note_failure("apply-failed")
+    assert ladder.quarantined
+    totals = registry.remediation_totals()
+    assert totals[(remediation.STEP_DEVICE_RESET, "failed")] >= 1
+    assert totals[(remediation.STEP_RUNTIME_RESTART, "failed")] >= 1
+    assert any(step == remediation.STEP_QUARANTINE for step, _ in totals)
+
+
+# ---------------------------------------------------------------------------
+# Probation auto-lift
+# ---------------------------------------------------------------------------
+
+
+def test_probation_lifts_quarantine_after_sustained_health(fake_kube):
+    fake_kube.add_node(NODE, {CC_MODE_STATE_LABEL: MODE_ON})
+    ladder, events, clock = make_ladder(
+        fake_kube, FakeTpuBackend(), probation_s=30.0
+    )
+    ladder.quarantine(reason="test")
+    assert node_labels(fake_kube.get_node(NODE))[CC_READY_STATE_LABEL] == "false"
+
+    ladder.note_probe(True)  # probation starts
+    clock.advance(10.0)
+    ladder.note_probe(False)  # relapse: probation resets
+    clock.advance(25.0)
+    ladder.note_probe(True)  # new streak starts here
+    clock.advance(29.0)
+    ladder.note_probe(True)
+    assert ladder.quarantined  # 29 s < 30 s probation
+    clock.advance(2.0)
+    ladder.note_probe(True)
+    assert not ladder.quarantined
+
+    node = fake_kube.get_node(NODE)
+    labels = node_labels(node)
+    assert QUARANTINED_LABEL not in labels
+    # Ready restored from the CURRENT mode.state.
+    assert labels[CC_READY_STATE_LABEL] == "true"
+    assert not any(
+        t["key"] == QUARANTINE_TAINT_KEY for t in node_taints(node)
+    )
+    assert ("Normal", "CCNodeUnquarantined") in {(t, r) for t, r, _ in events}
+    # Ladder reset and annotation dropped.
+    assert ladder.failures == 0
+    assert remediation.REMEDIATION_ANNOTATION not in node_annotations(node)
+
+
+def test_watchdog_probes_feed_probation(fake_kube):
+    """The PR-2 watchdog's recovery signal IS the probation driver: its
+    ticks call note_probe, and sustained healthy probes lift quarantine."""
+    fake_kube.add_node(NODE, {CC_MODE_STATE_LABEL: MODE_ON})
+    backend = FakeTpuBackend()
+    clock = FakeClock()
+    ladder, _, _ = make_ladder(fake_kube, backend, probation_s=5.0, clock=clock)
+    watchdog = RuntimeHealthWatchdog(
+        fake_kube, backend, NODE,
+        demote_after=1, restore_after=1,
+        metrics=MetricsRegistry(),
+        on_probe=ladder.note_probe,
+        on_condemn=ladder.condemn,
+    )
+    ladder.quarantine(reason="test")
+    backend.healthy = True
+    watchdog.tick()  # starts probation
+    clock.advance(6.0)
+    watchdog.tick()  # probation elapsed -> lift
+    assert not ladder.quarantined
+
+
+# ---------------------------------------------------------------------------
+# Slice fencing
+# ---------------------------------------------------------------------------
+
+
+def make_barrier(kube, i: int, num_hosts: int = 2, timeout_s: float = 5.0):
+    topo = SliceTopology(
+        slice_id=SLICE, accelerator_type="v5p-32",
+        num_hosts=num_hosts, host_index=i, chips=(),
+    )
+    return SliceBarrier(
+        kube, f"fence-node-{i}", topo,
+        timeout_s=timeout_s, poll_interval_s=0.01,
+        complete_timeout_s=0.2,
+    )
+
+
+def test_fenced_peers_fail_fast(fake_kube):
+    """The acceptance bullet: a peer waiting at the barrier aborts well
+    under the barrier deadline once the slice is fenced."""
+    for i in range(2):
+        fake_kube.add_node(f"fence-node-{i}", {SLICE_ID_LABEL: SLICE})
+    waiter = make_barrier(fake_kube, 0, timeout_s=30.0)
+    waiter.publish_staged(MODE_SLICE)
+
+    outcome: dict = {}
+
+    def wait():
+        started = time.monotonic()
+        try:
+            waiter.await_commit(MODE_SLICE)
+            outcome["result"] = "committed"
+        except BarrierFenced:
+            outcome["result"] = "fenced"
+        except BarrierTimeout:
+            outcome["result"] = "timeout"
+        outcome["seconds"] = time.monotonic() - started
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.05)
+    # Host 1 is condemned: it bumps the fencing generation.
+    slicecoord.fence_slice(
+        fake_kube, "fence-node-1", SLICE, reason="quarantine",
+        metrics=MetricsRegistry(),
+    )
+    t.join(timeout=10)
+    assert outcome["result"] == "fenced"
+    assert outcome["seconds"] < 5.0, (
+        f"peer burned {outcome['seconds']:.1f}s of a 30s deadline"
+    )
+
+
+def test_stale_staged_marker_cannot_satisfy_a_new_round(fake_kube):
+    """A pre-fence staged marker never counts as ready for the current
+    generation — a stale agent cannot re-stage an aborted barrier."""
+    for i in range(2):
+        fake_kube.add_node(f"fence-node-{i}", {SLICE_ID_LABEL: SLICE})
+    stale = make_barrier(fake_kube, 1)
+    stale.publish_staged(MODE_SLICE)  # generation 0
+    slicecoord.fence_slice(fake_kube, "fence-node-0", SLICE)
+    # fence_slice clears the FENCING node's marker; node 1's stale marker
+    # survives (its agent is presumed dead/stalled).
+    labels1 = node_labels(fake_kube.get_node("fence-node-1"))
+    assert labels1[SLICE_STAGED_LABEL] == MODE_SLICE
+    assert labels1[SLICE_STAGED_GEN_LABEL] == "0"
+
+    fresh = make_barrier(fake_kube, 0, timeout_s=0.3)
+    fresh.publish_staged(MODE_SLICE)  # enters at generation 1
+    assert fresh.generation == 1
+    with pytest.raises(BarrierTimeout):
+        fresh.await_commit(MODE_SLICE)  # stale peer never reads as ready
+
+
+def test_stale_commit_marker_cannot_release_a_new_round(fake_kube):
+    """A commit marker from a pre-fence round (stale leader) must not let
+    a current-round follower reset."""
+    for i in range(2):
+        fake_kube.add_node(f"fence-node-{i}", {SLICE_ID_LABEL: SLICE})
+    # Simulate a pre-fence leader that committed right before dying: its
+    # commit marker carries generation 0.
+    fake_kube.set_node_label("fence-node-0", SLICE_COMMIT_LABEL, MODE_SLICE)
+    fake_kube.set_node_label("fence-node-0", SLICE_COMMIT_GEN_LABEL, "0")
+    fake_kube.set_node_label("fence-node-0", SLICE_STAGED_LABEL, MODE_SLICE)
+    fake_kube.set_node_label("fence-node-0", SLICE_STAGED_GEN_LABEL, "0")
+    slicecoord.fence_slice(fake_kube, "fence-node-1", SLICE)
+
+    follower = make_barrier(fake_kube, 1, timeout_s=0.3)
+    follower.publish_staged(MODE_SLICE)  # generation 1
+    # Old-gen staged marker doesn't count ready, old-gen commit doesn't
+    # count committed: the round times out instead of resetting.
+    with pytest.raises(BarrierTimeout):
+        follower.await_commit(MODE_SLICE)
+
+
+def test_stale_leader_stops_completing_a_fenced_round(fake_kube):
+    for i in range(2):
+        fake_kube.add_node(f"fence-node-{i}", {SLICE_ID_LABEL: SLICE})
+    leader = make_barrier(fake_kube, 0)
+    leader.publish_staged(MODE_SLICE)
+    # Peer staged at the same generation -> barrier forms, leader commits.
+    fake_kube.set_node_label("fence-node-1", SLICE_STAGED_LABEL, MODE_SLICE)
+    fake_kube.set_node_label("fence-node-1", SLICE_STAGED_GEN_LABEL, "0")
+    leader.await_commit(MODE_SLICE)
+    assert node_labels(fake_kube.get_node("fence-node-0"))[
+        SLICE_COMMIT_LABEL
+    ] == MODE_SLICE
+    # The slice gets fenced before completion; the stale leader retires
+    # its own (now old-generation) commit marker and stops driving.
+    slicecoord.fence_slice(fake_kube, "fence-node-1", SLICE)
+    leader.complete(MODE_SLICE)
+    labels = node_labels(fake_kube.get_node("fence-node-0"))
+    assert SLICE_COMMIT_LABEL not in labels
+    assert SLICE_COMMIT_GEN_LABEL not in labels
+
+
+def test_fence_generation_is_monotonic(fake_kube):
+    fake_kube.add_node("fence-node-0", {SLICE_ID_LABEL: SLICE})
+    assert slicecoord.fence_slice(fake_kube, "fence-node-0", SLICE) == 1
+    assert slicecoord.fence_slice(fake_kube, "fence-node-0", SLICE) == 2
+    labels = node_labels(fake_kube.get_node("fence-node-0"))
+    assert labels[SLICE_FENCE_LABEL] == "2"
+
+
+def test_quarantine_fences_a_multi_host_slice(fake_kube):
+    """Quarantining one host of a multi-host slice aborts the slice
+    barrier: the fence generation bumps on the condemned node."""
+    backend = FakeTpuBackend(num_hosts=2, host_index=0, slice_id=SLICE)
+    fake_kube.add_node(NODE, {SLICE_ID_LABEL: SLICE})
+    registry = MetricsRegistry()
+    ladder, _, _ = make_ladder(fake_kube, backend, metrics=registry)
+    ladder.quarantine(reason="test")
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[SLICE_FENCE_LABEL] == "1"
+
+
+def test_watchdog_condemn_fences_without_quarantining(fake_kube):
+    """The watchdog's demote edge fences peers out of the barrier even
+    before the ladder reaches quarantine."""
+    backend = FakeTpuBackend(num_hosts=2, host_index=0, slice_id=SLICE)
+    fake_kube.add_node(NODE, {SLICE_ID_LABEL: SLICE})
+    ladder, _, _ = make_ladder(fake_kube, backend)
+    watchdog = RuntimeHealthWatchdog(
+        fake_kube, backend, NODE,
+        demote_after=1, restore_after=1,
+        metrics=MetricsRegistry(),
+        on_probe=ladder.note_probe,
+        on_condemn=ladder.condemn,
+    )
+    backend.healthy = False
+    watchdog.tick()
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[SLICE_FENCE_LABEL] == "1"
+    assert not ladder.quarantined
+
+
+# ---------------------------------------------------------------------------
+# Manager integration
+# ---------------------------------------------------------------------------
+
+
+def test_manager_defers_reconciles_while_quarantined(fake_kube):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    ladder, _, _ = make_ladder(fake_kube, backend)
+    ladder.quarantine(reason="test")
+    mgr = CCManager(
+        api=fake_kube, backend=backend, node_name=NODE,
+        evict_components=False, smoke_workload="none",
+        metrics=MetricsRegistry(), remediation=ladder,
+    )
+    ops_before = len(backend.op_log)
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert len(backend.op_log) == ops_before  # hardware untouched
+    assert mgr.retryable_failure is False  # slow re-check cadence
+
+
+# ---------------------------------------------------------------------------
+# Rolling orchestrator: skip + failure budget
+# ---------------------------------------------------------------------------
+
+
+def converge_reactor(kube):
+    """Agents in miniature: desired-mode label edits converge instantly."""
+
+    def reactor(name, node):
+        labels = node_labels(node)
+        desired = labels.get(CC_MODE_LABEL)
+        if desired and labels.get(CC_MODE_STATE_LABEL) != desired:
+            kube.set_node_label(name, CC_MODE_STATE_LABEL, desired)
+
+    kube.add_patch_reactor(reactor)
+
+
+def test_rollout_skips_quarantined_nodes(fake_kube):
+    converge_reactor(fake_kube)
+    fake_kube.add_node("roll-0", {"pool": "tpu"})
+    fake_kube.add_node("roll-1", {"pool": "tpu", QUARANTINED_LABEL: "true"})
+    roller = RollingReconfigurator(
+        fake_kube, "pool=tpu", node_timeout_s=5.0, poll_interval_s=0.01,
+    )
+    result = roller.rollout(MODE_ON)
+    assert result.ok
+    assert result.skipped_quarantined == ["roll-1"]
+    # The quarantined node's desired label was never touched.
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("roll-1"))
+    assert node_labels(fake_kube.get_node("roll-0"))[
+        CC_MODE_STATE_LABEL
+    ] == MODE_ON
+    assert result.summary()["quarantined_skipped"] == ["roll-1"]
+
+
+def test_rollout_halts_when_failure_budget_exceeded(fake_kube):
+    converge_reactor(fake_kube)
+    fake_kube.add_node("roll-0", {"pool": "tpu"})
+    fake_kube.add_node("roll-1", {"pool": "tpu", QUARANTINED_LABEL: "true"})
+    fake_kube.add_node("roll-2", {"pool": "tpu", QUARANTINED_LABEL: "true"})
+    roller = RollingReconfigurator(
+        fake_kube, "pool=tpu", node_timeout_s=5.0, poll_interval_s=0.01,
+        failure_budget=1,
+    )
+    result = roller.rollout(MODE_ON)
+    assert not result.ok
+    assert result.halted_reason == "failure-budget-exceeded"
+    assert result.groups == []  # nothing was bounced
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("roll-0"))
+    # Budget 2 tolerates the same pool.
+    roller2 = RollingReconfigurator(
+        fake_kube, "pool=tpu", node_timeout_s=5.0, poll_interval_s=0.01,
+        failure_budget=2,
+    )
+    assert roller2.rollout(MODE_ON).ok
+
+
+def test_rollout_rechecks_budget_between_windows(fake_kube):
+    converge_reactor(fake_kube)
+    for i in range(3):
+        fake_kube.add_node(f"roll-{i}", {"pool": "tpu"})
+
+    roller = RollingReconfigurator(
+        fake_kube, "pool=tpu", node_timeout_s=5.0, poll_interval_s=0.01,
+        failure_budget=0,
+    )
+
+    # A node gets quarantined the moment the first window converges —
+    # mid-rollout, after the start-of-rollout budget check passed.
+    def quarantine_mid_rollout(name, node):
+        if node_labels(node).get(CC_MODE_STATE_LABEL) == MODE_ON:
+            if QUARANTINED_LABEL not in node_labels(
+                fake_kube.get_node("roll-2")
+            ):
+                fake_kube.set_node_label("roll-2", QUARANTINED_LABEL, "true")
+
+    fake_kube.add_patch_reactor(quarantine_mid_rollout)
+    result = roller.rollout(MODE_ON)
+    assert not result.ok
+    assert result.halted_reason == "failure-budget-exceeded"
+    assert len(result.groups) < 3  # halted before finishing the pool
+
+
+# ---------------------------------------------------------------------------
+# Pool attestation skips quarantined hosts
+# ---------------------------------------------------------------------------
+
+
+def test_pool_attestation_skips_quarantined_host(fake_kube):
+    from tpu_cc_manager.ccmanager.multislice import (
+        PoolAttestationError,
+        publish_quote,
+        verify_pool_attestation,
+    )
+
+    quote = FakeTpuBackend(slice_id="s1", initial_mode="on").fetch_attestation("n")
+    fake_kube.add_node("att-0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    fake_kube.add_node("att-1", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    publish_quote(fake_kube, "att-0", quote)
+    # att-1 never attested: the pool fails ...
+    with pytest.raises(PoolAttestationError):
+        verify_pool_attestation(fake_kube, "pool=tpu", "on", allow_fake=True)
+    # ... until it is quarantined, at which point it is skipped (reported,
+    # not enforced) and the healthy host's evidence carries the slice.
+    fake_kube.set_node_label("att-1", QUARANTINED_LABEL, "true")
+    slices = verify_pool_attestation(
+        fake_kube, "pool=tpu", "on", allow_fake=True
+    )
+    assert slices["s1"]["quarantined"] == ["att-1"]
+    # A slice with EVERY host quarantined still fails: containment must
+    # not read as verification.
+    fake_kube.set_node_label("att-0", QUARANTINED_LABEL, "true")
+    with pytest.raises(PoolAttestationError):
+        verify_pool_attestation(fake_kube, "pool=tpu", "on", allow_fake=True)
+
+
+# ---------------------------------------------------------------------------
+# Operator CLI
+# ---------------------------------------------------------------------------
+
+
+def test_ctl_quarantine_and_unquarantine(fake_kube, capsys):
+    fake_kube.add_node("ctl-0", {CC_MODE_STATE_LABEL: MODE_ON})
+    rc = ctl.cmd_quarantine(
+        fake_kube, argparse.Namespace(node="ctl-0", reason="maintenance")
+    )
+    assert rc == 0
+    node = fake_kube.get_node("ctl-0")
+    assert node_labels(node)[QUARANTINED_LABEL] == "true"
+    assert node_labels(node)[CC_READY_STATE_LABEL] == "false"
+    assert any(t["key"] == QUARANTINE_TAINT_KEY for t in node_taints(node))
+    # Idempotent.
+    assert ctl.cmd_quarantine(
+        fake_kube, argparse.Namespace(node="ctl-0", reason="maintenance")
+    ) == 0
+
+    rc = ctl.cmd_unquarantine(
+        fake_kube, argparse.Namespace(node="ctl-0", reason="fixed")
+    )
+    assert rc == 0
+    node = fake_kube.get_node("ctl-0")
+    assert QUARANTINED_LABEL not in node_labels(node)
+    assert node_labels(node)[CC_READY_STATE_LABEL] == "true"
+    assert not any(t["key"] == QUARANTINE_TAINT_KEY for t in node_taints(node))
+
+
+def test_ctl_status_shows_quarantine_and_ladder_step(fake_kube, capsys):
+    fake_kube.add_node(NODE, {"pool": "tpu"})
+    ladder, _, _ = make_ladder(fake_kube, FakeTpuBackend())
+    for _ in range(3):
+        ladder.note_failure("apply-failed")
+    rc = ctl.cmd_status(fake_kube, argparse.Namespace(selector="pool=tpu"))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "remediation:device-reset(3)" in out
+
+    ladder.quarantine(reason="test-reason")
+    rc = ctl.cmd_status(fake_kube, argparse.Namespace(selector="pool=tpu"))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "quarantined(test-reason)" in out
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+def test_containment_metrics_render(fake_kube):
+    registry = MetricsRegistry()
+    fake_kube.add_node(NODE, {SLICE_ID_LABEL: SLICE})
+    backend = FakeTpuBackend(num_hosts=2, host_index=0, slice_id=SLICE)
+    ladder, _, _ = make_ladder(fake_kube, backend, metrics=registry)
+    for _ in range(7):
+        ladder.note_failure("apply-failed")
+    text = registry.render_prometheus()
+    assert "tpu_cc_quarantined 1" in text
+    assert 'tpu_cc_remediation_step_total{step="quarantine"' in text
+    assert "tpu_cc_barrier_fenced_total 1" in text
+    ladder.unquarantine("test")
+    assert "tpu_cc_quarantined 0" in registry.render_prometheus()

@@ -114,6 +114,19 @@ def main(argv: list[str] | None = None) -> int:
         host_cc_capable=host_cc,
         smoke_workload=args.smoke_workload,
     )
+    # Failure containment (ccmanager/remediation.py): escalating ladder
+    # from backoff retries through device re-reset and runtime restart to
+    # quarantine (taint + label + fenced slice barrier), persisted in a
+    # node annotation so it survives agent crash-restarts.
+    from tpu_cc_manager.ccmanager import remediation as remediation_mod
+
+    manager.remediation = remediation_mod.from_env(
+        api,
+        args.node_name,
+        backend=backend,
+        emit_event=manager._emit_node_event,
+        metrics=manager.metrics,
+    )
     if args.metrics_port:
         # Same journal the manager records to, so /tracez and /statusz
         # serve the live reconcile traces.
@@ -135,6 +148,7 @@ def main(argv: list[str] | None = None) -> int:
     # Runtime-health watchdog (ccmanager/watchdog.py): probes the runtime
     # BETWEEN reconciles and demotes/restores cc.ready.state on sustained
     # degradation. Stands down while a reconcile is in flight.
+    remediation = manager.remediation
     start_watchdog(
         api,
         backend,
@@ -143,6 +157,10 @@ def main(argv: list[str] | None = None) -> int:
         is_busy=lambda: manager.reconciling,
         emit_event=manager._emit_node_event,
         metrics=manager.metrics,
+        # Probe verdicts drive the quarantine probation window; the demote
+        # edge fences this host's slice barrier so peers fail fast.
+        on_probe=(remediation.note_probe if remediation is not None else None),
+        on_condemn=(remediation.condemn if remediation is not None else None),
     )
 
     def _force_exit_when_idle():

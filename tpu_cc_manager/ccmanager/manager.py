@@ -102,6 +102,7 @@ class CCManager:
         retry_backoff_max_s: float | None = None,
         metrics: metrics_mod.MetricsRegistry | None = None,
         journal: journal_mod.Journal | None = None,
+        remediation=None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -215,6 +216,14 @@ class CCManager:
         # fix still converges without a label edit, without re-failing an
         # identical reconcile every few seconds.
         self.retryable_failure = True
+        # Machine-readable reason of the most recent failure (what the
+        # failed.reason label carries); feeds the remediation ladder.
+        self.last_failure_reason: str | None = None
+        # Escalating remediation ladder (ccmanager/remediation.py): fed a
+        # note per reconcile outcome from the watch loop; while it holds
+        # the node quarantined, reconciles are deferred (slow re-check
+        # cadence) instead of hammering known-bad hardware. None disables.
+        self.remediation = remediation
         # Event dedup state (see _emit_node_event).
         self._last_event_key: tuple[str, str, str] | None = None
 
@@ -264,6 +273,12 @@ class CCManager:
             # JSONDecodeError, not KubeApiError) — a verified mode change
             # must not be re-reported failed over a convenience signal.
             log.debug("event emission failed (non-fatal): %s", e)
+
+    def _record_failure(self, reason: str) -> None:
+        """Count a failed reconcile and remember its reason for the
+        remediation ladder."""
+        self.last_failure_reason = reason
+        self.metrics.record_failure(reason)
 
     def with_default(self, label_value: str | None) -> str:
         """Absent/empty desired label means the configured default
@@ -316,6 +331,17 @@ class CCManager:
 
     def _set_cc_mode(self, mode: str) -> bool:
         mode = canonical_mode(mode)
+        if self.remediation is not None and self.remediation.quarantined:
+            # Containment: a quarantined node stops hammering known-bad
+            # hardware. The reconcile is deferred (slow re-check cadence);
+            # probation or `tpu-cc-ctl unquarantine` releases it and the
+            # pending retry then re-applies the desired mode.
+            log.warning(
+                "node is quarantined; deferring reconcile of mode %s "
+                "(probation or operator lift releases it)", mode,
+            )
+            self.retryable_failure = False
+            return False
         if mode not in VALID_MODES:
             # A typo'd label is as stable as unsupported hardware: report
             # failed with a reason (the reference refuses silently, leaving
@@ -324,7 +350,7 @@ class CCManager:
                 "invalid CC mode %r (valid: %s) — refusing to act", mode, VALID_MODES
             )
             self.retryable_failure = False
-            self.metrics.record_failure("invalid-mode")
+            self._record_failure("invalid-mode")
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason="invalid-mode"
             )
@@ -344,7 +370,7 @@ class CCManager:
             topo = self.backend.discover()
         except TpuError as e:
             log.error("TPU discovery failed: %s", e)
-            self.metrics.record_failure("discovery-failed")
+            self._record_failure("discovery-failed")
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason="discovery-failed"
             )
@@ -370,7 +396,7 @@ class CCManager:
             # main.py:237-240), where a restart can genuinely re-enumerate.
             log.error("mode %s unsupported on this node: %s", mode, e)
             self.retryable_failure = False  # only a label/pool edit helps
-            self.metrics.record_failure(e.reason)
+            self._record_failure(e.reason)
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason=e.reason
             )
@@ -532,7 +558,7 @@ class CCManager:
         except evict.EvictionTimeout as e:
             log.error("strict eviction failed: %s — not touching hardware", e)
             m.result = "failed"
-            self.metrics.record_failure("drain-timeout")
+            self._record_failure("drain-timeout")
             self._emit_node_event(
                 "Warning", "CCModeDrainTimeout",
                 f"strict eviction timed out before mode {mode}: {e}",
@@ -617,7 +643,7 @@ class CCManager:
                 # drained" no longer describes it: withdraw from the barrier.
                 barrier.abort()
             reason = self._failure_reason(e)
-            self.metrics.record_failure(reason)
+            self._record_failure(reason)
             state.set_cc_state_label(
                 self.api, self.node_name, STATE_FAILED, reason=reason,
             )
@@ -648,6 +674,8 @@ class CCManager:
         reason has only the logs, which a label watcher never sees)."""
         from tpu_cc_manager.smoke.runner import SmokeError
 
+        if isinstance(e, slicecoord.BarrierFenced):
+            return "barrier-fenced"
         if isinstance(e, slicecoord.BarrierTimeout):
             return "barrier-timeout"
         if isinstance(e, attestation.AttestationError):
@@ -686,6 +714,7 @@ class CCManager:
                 # otherwise; followers never act on a staged marker without
                 # re-verifying full staging.
                 patch[slicecoord.SLICE_STAGED_LABEL] = None
+                patch[slicecoord.SLICE_STAGED_GEN_LABEL] = None
             self.api.patch_node_labels(self.node_name, patch)
             # The full signed quote (or a clear when there is none) rides
             # in an annotation so PEERS can re-verify the signature instead
@@ -743,6 +772,18 @@ class CCManager:
 
         def note_result(ok: bool) -> bool:
             nonlocal retry_at, backoff
+            # Feed the remediation ladder (ccmanager/remediation.py): a
+            # success resets it; a RETRYABLE failure escalates it (stable
+            # misconfigurations — invalid mode, unsupported hardware —
+            # can't be remediated by resets, and a quarantined node's
+            # deferred reconciles must not re-escalate).
+            if self.remediation is not None:
+                if ok:
+                    self.remediation.note_success()
+                elif self.retryable_failure and not self.remediation.quarantined:
+                    self.remediation.note_failure(
+                        self.last_failure_reason or "apply-failed"
+                    )
             if ok or self.retry_backoff_s <= 0:
                 retry_at = None
                 backoff = self.retry_backoff_s

@@ -64,6 +64,7 @@ from tpu_cc_manager.tpudev.contract import AttestationQuote
 log = logging.getLogger(__name__)
 
 from tpu_cc_manager.labels import (  # noqa: E402 - shared constants
+    QUARANTINED_LABEL,
     SLICE_ID_LABEL,
     label_safe,
 )
@@ -161,8 +162,21 @@ def collect_pool_quotes(api: KubeApi, selector: str) -> dict[str, dict]:
         entry = slices.setdefault(
             slice_id,
             {"digest": None, "mode": None, "ts": None, "nodes": [],
-             "missing": [], "quotes": {}, "node_digests": {}},
+             "missing": [], "quarantined": [], "quotes": {},
+             "node_digests": {}},
         )
+        if labels.get(QUARANTINED_LABEL) == "true":
+            # A quarantined host is out of the serving pool (remediation
+            # ladder): its absent/stale evidence must not fail the healthy
+            # hosts' verification — it is reported, not enforced. A slice
+            # whose EVERY host is quarantined still fails (no evidence at
+            # all reads as a missing slice, which it operationally is).
+            entry["quarantined"].append(name)
+            log.warning(
+                "pool attestation: skipping quarantined host %s "
+                "(slice %s)", name, slice_id,
+            )
+            continue
         if digest is None:
             entry["missing"].append(name)
             continue
@@ -307,8 +321,16 @@ def _verify_pool_attestation(
                 f"slice {sid}: host(s) without attestation: "
                 f"{sorted(entry['missing'])}"
             )
+        if entry["quarantined"] and not entry["nodes"] and not entry["missing"]:
+            # Quarantined hosts are skipped, but a slice with NO healthy
+            # host left has no evidence at all — it must not read as
+            # verified just because its failures were contained.
+            problems.append(
+                f"slice {sid}: every host quarantined "
+                f"({sorted(entry['quarantined'])}); no attestable host left"
+            )
         if entry["digest"] is None:
-            continue  # covered by the missing-hosts problem above
+            continue  # covered by the missing/quarantined problems above
         if entry["digest"] == "MIXED":
             problems.append(f"slice {sid}: hosts disagree on runtime digest")
         else:
@@ -346,11 +368,14 @@ def _verify_pool_attestation(
 def pool_report(api: KubeApi, selector: str) -> str:
     """Human-readable attestation table (CLI helper)."""
     slices = collect_pool_quotes(api, selector)
-    lines = [f"{'SLICE':<28} {'MODE':<10} {'DIGEST':<18} {'ATTESTED':<9} MISSING"]
+    lines = [
+        f"{'SLICE':<28} {'MODE':<10} {'DIGEST':<18} {'ATTESTED':<9} "
+        f"{'MISSING':<8} QUAR"
+    ]
     for sid, e in sorted(slices.items()):
         lines.append(
             f"{sid:<28} {str(e['mode'] or '-'):<10} "
             f"{str(e['digest'] or '-'):<18} {len(e['nodes']):<9} "
-            f"{len(e['missing'])}"
+            f"{len(e['missing']):<8} {len(e['quarantined'])}"
         )
     return "\n".join(lines)

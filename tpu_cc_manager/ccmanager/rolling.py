@@ -69,6 +69,12 @@ class RolloutResult:
     # Groups reverted to their pre-rollout desired mode after a failure
     # halt (rollback_on_failure).
     rolled_back: list[GroupResult] = dataclasses.field(default_factory=list)
+    # Quarantined nodes excluded from the rollout (remediation ladder).
+    skipped_quarantined: list[str] = dataclasses.field(default_factory=list)
+    # Why the rollout halted before finishing ("failure-budget-exceeded"
+    # for the pool-level circuit breaker; None otherwise — a plain group
+    # failure reads from ok/groups as before).
+    halted_reason: str | None = None
 
     @property
     def seconds(self) -> float:
@@ -80,6 +86,8 @@ class RolloutResult:
         return {
             "mode": self.mode,
             "ok": self.ok,
+            "halted": self.halted_reason,
+            "quarantined_skipped": self.skipped_quarantined or None,
             "groups": len(self.groups),
             "skipped_groups": sum(1 for g in self.groups if g.skipped) or None,
             "nodes": sum(len(g.nodes) for g in self.groups),
@@ -142,6 +150,7 @@ class RollingReconfigurator:
         poll_interval_s: float = 2.0,
         continue_on_failure: bool = False,
         rollback_on_failure: bool = False,
+        failure_budget: int | None = None,
     ) -> None:
         self.api = api
         self.selector = selector
@@ -150,6 +159,11 @@ class RollingReconfigurator:
         self.poll_interval_s = poll_interval_s
         self.continue_on_failure = continue_on_failure
         self.rollback_on_failure = rollback_on_failure
+        # Pool-level circuit breaker: when MORE than this many nodes of the
+        # pool are quarantined, the rollout refuses to proceed — a fleet
+        # bleeding nodes should stop being reconfigured, not have its
+        # remaining capacity bounced. None = no budget.
+        self.failure_budget = failure_budget
         # Transient apiserver failures during the per-poll listing ride the
         # shared jittered backoff instead of crashing the whole rollout —
         # one attempt when the client retries internally (RestKube), so
@@ -190,8 +204,44 @@ class RollingReconfigurator:
                 sp.status = obs_trace.STATUS_ERROR
             return result
 
+    def _quarantined_of(self, listing: list[dict]) -> list[str]:
+        from tpu_cc_manager.ccmanager.remediation import quarantined_nodes
+
+        return quarantined_nodes(listing)
+
+    def _budget_exceeded(self, quarantined: list[str]) -> bool:
+        if self.failure_budget is None or len(quarantined) <= self.failure_budget:
+            return False
+        log.error(
+            "pool failure budget exceeded: %d node(s) quarantined (%s), "
+            "budget %d — halting rollout (fleet-level circuit breaker)",
+            len(quarantined), quarantined, self.failure_budget,
+        )
+        return True
+
     def _rollout(self, mode: str) -> RolloutResult:
         listing = self.api.list_nodes(self.selector)
+        # Quarantined nodes are out of the rollout entirely: their agents
+        # defer reconciles, so awaiting them only burns the node timeout,
+        # and bouncing a condemned node's slice-mates around it helps
+        # nobody (the whole group is skipped only if ALL its hosts are
+        # quarantined — a partially-quarantined multi-host slice cannot
+        # converge and is surfaced by the group's await instead).
+        quarantined = self._quarantined_of(listing)
+        if quarantined:
+            log.warning(
+                "skipping quarantined node(s): %s", quarantined
+            )
+            listing = [
+                n for n in listing
+                if n["metadata"]["name"] not in quarantined
+            ]
+        if self._budget_exceeded(quarantined):
+            return RolloutResult(
+                mode=mode, ok=False, groups=[],
+                skipped_quarantined=quarantined,
+                halted_reason="failure-budget-exceeded",
+            )
         groups = plan_groups(self.api, self.selector, nodes=listing)
         log.info(
             "rolling %s over %d group(s) (%d node(s)), max_unavailable=%d",
@@ -236,6 +286,23 @@ class RollingReconfigurator:
         ok = True
         # Strictly bounded concurrency: process in windows of max_unavailable.
         for i in range(0, len(groups), self.max_unavailable):
+            if i and self.failure_budget is not None:
+                # Re-check the budget at every window boundary: remediation
+                # ladders run concurrently with the rollout, and a pool
+                # that started bleeding nodes mid-rollout must stop being
+                # reconfigured even though it started healthy.
+                fresh = self._quarantined_of(self.retry_policy.call(
+                    lambda: self.api.list_nodes(self.selector),
+                    op="rollout.list_nodes",
+                    classify=classify_kube_error,
+                ))
+                if self._budget_exceeded(fresh):
+                    return RolloutResult(
+                        mode=mode, ok=False, groups=results,
+                        window_seconds=window_seconds,
+                        skipped_quarantined=sorted(set(quarantined) | set(fresh)),
+                        halted_reason="failure-budget-exceeded",
+                    )
             window = groups[i : i + self.max_unavailable]
             started = time.monotonic()
             for gid, names in window:
@@ -265,9 +332,11 @@ class RollingReconfigurator:
                 return RolloutResult(
                     mode=mode, ok=False, groups=results,
                     window_seconds=window_seconds, rolled_back=rolled_back,
+                    skipped_quarantined=quarantined,
                 )
         return RolloutResult(
-            mode=mode, ok=ok, groups=results, window_seconds=window_seconds
+            mode=mode, ok=ok, groups=results, window_seconds=window_seconds,
+            skipped_quarantined=quarantined,
         )
 
     # -- internals --------------------------------------------------------

@@ -41,6 +41,25 @@ Failure semantics:
   reset (the fabric transition was already decided); the restarted leader's
   re-apply clears its stale marker at barrier entry and re-runs the
   protocol against its peers' already-committed state.
+
+Dead-peer fencing (failure containment, ccmanager/remediation.py):
+
+A host that dies mid-barrier used to cost every peer the full barrier
+deadline. When a host is condemned — quarantined by the remediation
+ladder, or watchdog-condemned — it (or the operator) bumps the slice's
+**fencing generation** (``…slice.fence``, an integer label on the
+condemned node). Every barrier round is entered at the generation current
+at publish time, carried in ``…slice.staged-gen`` / ``…slice.commit-gen``:
+
+- peers polling the barrier see a fence generation NEWER than their own
+  round and abort immediately with :class:`BarrierFenced` — fail fast,
+  well under the barrier deadline;
+- a stale agent from a pre-fence round can neither complete the aborted
+  barrier (its commit marker carries the old generation, which no
+  current-round follower accepts, and its own next poll aborts) nor
+  re-stage it (its old-generation staged marker never counts as ready
+  for the new round). Re-entering the barrier afresh reads the CURRENT
+  generation — a fresh round is always allowed.
 """
 
 from __future__ import annotations
@@ -61,12 +80,20 @@ from tpu_cc_manager.labels import (
 )
 from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.tpudev.contract import SliceTopology, TpuError
+from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
 SLICE_STAGED_LABEL = "cloud.google.com/tpu-cc.slice.staged"
 SLICE_COMMIT_LABEL = "cloud.google.com/tpu-cc.slice.commit"
+# Dead-peer fencing: the slice's current fencing generation (integer),
+# bumped on the condemned node; rounds entered at an older generation
+# abort fast and can neither complete nor re-stage.
+SLICE_FENCE_LABEL = "cloud.google.com/tpu-cc.slice.fence"
+# Which generation a host's staged / commit marker belongs to.
+SLICE_STAGED_GEN_LABEL = "cloud.google.com/tpu-cc.slice.staged-gen"
+SLICE_COMMIT_GEN_LABEL = "cloud.google.com/tpu-cc.slice.commit-gen"
 
 DEFAULT_BARRIER_TIMEOUT_S = 300.0
 # How long the leader lingers after its own transition for peers to clear
@@ -76,6 +103,64 @@ DEFAULT_COMPLETE_TIMEOUT_S = 60.0
 
 class BarrierTimeout(TpuError):
     """The slice barrier did not form (or complete) in time."""
+
+
+class BarrierFenced(TpuError):
+    """The barrier round was aborted by a newer fencing generation (a peer
+    was condemned mid-barrier); the caller fails fast instead of burning
+    the barrier deadline."""
+
+
+def _gen_of(labels: dict, key: str) -> int:
+    """Integer generation from a label value (absent/garbled -> 0, so
+    pre-fencing peers interoperate as generation 0)."""
+    try:
+        return int(labels.get(key) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def fence_generation(nodes: list[dict]) -> int:
+    """The slice's current fencing generation: the max fence label across
+    its nodes (any node may carry it — normally the condemned one)."""
+    return max(
+        (_gen_of(node_labels(n), SLICE_FENCE_LABEL) for n in nodes),
+        default=0,
+    )
+
+
+def fence_slice(
+    api: KubeApi,
+    node_name: str,
+    slice_id: str,
+    reason: str = "",
+    metrics: "metrics_mod.MetricsRegistry | None" = None,
+) -> int:
+    """Abort any in-flight barrier round of ``slice_id`` by bumping the
+    fencing generation on ``node_name`` (the condemned host — the caller
+    holds patch RBAC on it). Also withdraws that host's own staged marker:
+    a condemned host is by definition not "staged and drained". Returns
+    the new generation. Raises KubeApiError on failure — the caller
+    decides whether fencing is best-effort."""
+    slice_value = label_safe(slice_id)
+    nodes = api.list_nodes(f"{SLICE_ID_LABEL}={slice_value}")
+    generation = fence_generation(nodes) + 1
+    api.patch_node_labels(node_name, {
+        # Peers discover the fence through the slice-membership listing, so
+        # membership is (re)published with it — a host condemned before its
+        # first successful reconcile must not carry an invisible fence.
+        SLICE_ID_LABEL: slice_value,
+        SLICE_FENCE_LABEL: str(generation),
+        SLICE_STAGED_LABEL: None,
+        SLICE_STAGED_GEN_LABEL: None,
+    })
+    (metrics if metrics is not None else metrics_mod.REGISTRY).record_barrier_fenced()
+    log.warning(
+        "slice %s FENCED at generation %d by %s%s: in-flight barrier "
+        "rounds abort; peers fail fast",
+        slice_id, generation, node_name, f" ({reason})" if reason else "",
+    )
+    return generation
 
 
 class SliceBarrier:
@@ -97,6 +182,10 @@ class SliceBarrier:
         self.poll_interval_s = poll_interval_s
         self.complete_timeout_s = complete_timeout_s
         self.slice_label_value = label_safe(topo.slice_id)
+        # The fencing generation this round was entered at (publish_staged
+        # reads the slice's current generation). A newer generation
+        # observed while waiting aborts the round with BarrierFenced.
+        self.generation = 0
         # Transient-failure policy for the peer listing: short ladder (the
         # outer barrier deadline is authoritative) through the shared
         # jittered backoff instead of the old warn-and-poll-again. One
@@ -120,18 +209,36 @@ class SliceBarrier:
         Also publishes slice membership (peer discovery does not depend on a
         previous successful reconcile) and clears any commit marker this
         node owns from an earlier, possibly crashed, round.
+
+        The round is entered at the slice's CURRENT fencing generation
+        (read from the peers before publishing) and the staged marker is
+        stamped with it — a marker left behind by a pre-fence round can
+        never satisfy the current round's readiness count.
         """
+        try:
+            self.generation = fence_generation(self._slice_nodes())
+        except KubeApiError as e:
+            # Peer listing down at entry: enter at the last generation this
+            # process saw (0 for a fresh barrier). Safe — a stale entry is
+            # fenced out on the first successful poll.
+            log.warning(
+                "slice barrier: could not read fence generation (%s); "
+                "entering at generation %d", e, self.generation,
+            )
         self.api.patch_node_labels(
             self.node_name,
             {
                 SLICE_ID_LABEL: self.slice_label_value,
                 SLICE_STAGED_LABEL: mode,
+                SLICE_STAGED_GEN_LABEL: str(self.generation),
                 SLICE_COMMIT_LABEL: None,
+                SLICE_COMMIT_GEN_LABEL: None,
             },
         )
         log.info(
-            "slice %s host %d/%d: staged marker published (mode=%s)",
-            self.topo.slice_id, self.topo.host_index, self.topo.num_hosts, mode,
+            "slice %s host %d/%d: staged marker published (mode=%s gen=%d)",
+            self.topo.slice_id, self.topo.host_index, self.topo.num_hosts,
+            mode, self.generation,
         )
 
     def _slice_nodes(self) -> list[dict]:
@@ -183,27 +290,46 @@ class SliceBarrier:
                 # polling — the barrier deadline is authoritative.
                 log.warning("slice barrier: peer listing failed (%s); retrying", e)
                 return False
+            self._check_fence(nodes, mode)  # raises BarrierFenced
             ready, peers_committed = [], []
             for n in nodes:
                 labels = node_labels(n)
                 name = n["metadata"]["name"]
                 already = labels.get(CC_MODE_STATE_LABEL) == mode
-                if labels.get(SLICE_STAGED_LABEL) == mode or already:
+                staged_current = (
+                    labels.get(SLICE_STAGED_LABEL) == mode
+                    # A marker from a pre-fence round never counts: its
+                    # host must re-enter at the current generation.
+                    and _gen_of(labels, SLICE_STAGED_GEN_LABEL)
+                    >= self.generation
+                )
+                if staged_current or already:
                     ready.append(name)
                 if already and name != self.node_name:
                     peers_committed.append(name)
             state["ready"] = ready
             state["committed_seen"] = state["committed_seen"] or any(
-                node_labels(n).get(SLICE_COMMIT_LABEL) == mode for n in nodes
+                node_labels(n).get(SLICE_COMMIT_LABEL) == mode
+                # A stale leader's pre-fence commit marker must not let a
+                # current-round follower reset.
+                and _gen_of(node_labels(n), SLICE_COMMIT_GEN_LABEL)
+                >= self.generation
+                for n in nodes
             )
             all_ready = len(ready) >= self.topo.num_hosts
             if all_ready and self.is_leader:
                 self.api.patch_node_labels(
-                    self.node_name, {SLICE_COMMIT_LABEL: mode}
+                    self.node_name,
+                    {
+                        SLICE_COMMIT_LABEL: mode,
+                        SLICE_COMMIT_GEN_LABEL: str(self.generation),
+                    },
                 )
                 log.info(
-                    "slice %s: all %d host(s) ready; leader committing mode=%s",
+                    "slice %s: all %d host(s) ready; leader committing "
+                    "mode=%s (gen=%d)",
                     self.topo.slice_id, self.topo.num_hosts, mode,
+                    self.generation,
                 )
                 return True
             if all_ready and (
@@ -236,12 +362,27 @@ class SliceBarrier:
                 f"/{self.topo.num_hosts} hosts ready)"
             )
 
+    def _check_fence(self, nodes: list[dict], mode: str) -> None:
+        """Raise BarrierFenced when the slice's fencing generation moved
+        past this round's — a peer was condemned; fail fast."""
+        current = fence_generation(nodes)
+        if current > self.generation:
+            raise BarrierFenced(
+                f"slice {self.topo.slice_id}: barrier for mode {mode} "
+                f"aborted — fencing generation advanced to {current} "
+                f"(this round entered at {self.generation}); a peer was "
+                "condemned mid-barrier"
+            )
+
     def clear_staged(self) -> None:
         """Withdraw this host's staged marker (it is either done or about
         to re-admit components — either way no longer "staged and
         drained"). Best-effort."""
         try:
-            self.api.patch_node_labels(self.node_name, {SLICE_STAGED_LABEL: None})
+            self.api.patch_node_labels(self.node_name, {
+                SLICE_STAGED_LABEL: None,
+                SLICE_STAGED_GEN_LABEL: None,
+            })
         except KubeApiError as e:
             log.warning("slice barrier: could not clear staged marker: %s", e)
 
@@ -269,11 +410,19 @@ class SliceBarrier:
             self._complete_as_leader(mode)
 
     def _complete_as_leader(self, mode: str) -> None:
+        fenced = {"hit": False}
+
         def peers_cleared() -> bool:
             try:
                 nodes = self._slice_nodes()
             except KubeApiError:
                 return False
+            if fence_generation(nodes) > self.generation:
+                # This round was fenced: a stale leader must not keep
+                # driving completion — retire its own (old-generation)
+                # commit marker and get out of the new round's way.
+                fenced["hit"] = True
+                return True
             return not any(
                 node_labels(n).get(SLICE_STAGED_LABEL) == mode for n in nodes
             )
@@ -287,7 +436,16 @@ class SliceBarrier:
                 self.topo.slice_id, self.complete_timeout_s,
             )
             return
+        if fenced["hit"]:
+            log.warning(
+                "slice %s: fencing generation advanced past this round "
+                "(gen=%d); leader stops completing the aborted barrier",
+                self.topo.slice_id, self.generation,
+            )
         try:
-            self.api.patch_node_labels(self.node_name, {SLICE_COMMIT_LABEL: None})
+            self.api.patch_node_labels(self.node_name, {
+                SLICE_COMMIT_LABEL: None,
+                SLICE_COMMIT_GEN_LABEL: None,
+            })
         except KubeApiError as e:
             log.warning("slice barrier: could not clear commit marker: %s", e)

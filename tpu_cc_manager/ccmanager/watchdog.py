@@ -69,6 +69,8 @@ class RuntimeHealthWatchdog:
         is_busy: Callable[[], bool] | None = None,
         emit_event: Callable[[str, str, str], None] | None = None,
         metrics: metrics_mod.MetricsRegistry | None = None,
+        on_probe: Callable[[bool], None] | None = None,
+        on_condemn: Callable[[], None] | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -81,6 +83,14 @@ class RuntimeHealthWatchdog:
         # watchdog stands down instead of racing it.
         self.is_busy = is_busy or (lambda: False)
         self.emit_event = emit_event or (lambda *_: None)
+        # Failure-containment hooks (ccmanager/remediation.py): every probe
+        # verdict feeds the quarantine probation window, and the demote
+        # edge condemns the host — aborting any in-flight slice barrier
+        # with a fencing generation so ICI peers fail fast instead of
+        # waiting out the barrier deadline on a host that just went
+        # unhealthy.
+        self.on_probe = on_probe or (lambda healthy: None)
+        self.on_condemn = on_condemn or (lambda: None)
         self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
         self.degraded = False
         self._consecutive_unhealthy = 0
@@ -109,6 +119,10 @@ class RuntimeHealthWatchdog:
             # tier at all — the weakest possible state.
             probe = HealthProbe("none", False, f"probe raised: {e}")
         self.metrics.set_health_tier(probe.tier, probe.strength, probe.healthy)
+        try:
+            self.on_probe(probe.healthy)
+        except Exception as e:  # noqa: BLE001 - probation must not stop probing
+            log.warning("watchdog on_probe hook failed: %s", e)
         if probe.tier == "device-node" and not self._warned_weak_tier:
             # The silent-weakest-probe fallback, made loud exactly once.
             log.warning(
@@ -166,6 +180,12 @@ class RuntimeHealthWatchdog:
         if not first:
             log.debug("watchdog: not-ready state re-asserted")
             return
+        try:
+            # Condemn on the demote EDGE only: peers mid-barrier stop
+            # waiting on this host now, not once per re-asserting tick.
+            self.on_condemn()
+        except Exception as e:  # noqa: BLE001 - fencing peers is best-effort
+            log.warning("watchdog on_condemn hook failed: %s", e)
         self.metrics.record_failure("runtime-unhealthy")
         log.error(
             "sustained runtime degradation (%d consecutive unhealthy "
@@ -243,6 +263,8 @@ def start_from_env(
     is_busy: Callable[[], bool] | None = None,
     emit_event: Callable[[str, str, str], None] | None = None,
     metrics: metrics_mod.MetricsRegistry | None = None,
+    on_probe: Callable[[bool], None] | None = None,
+    on_condemn: Callable[[], None] | None = None,
 ) -> RuntimeHealthWatchdog | None:
     """CLI wiring: CC_WATCHDOG_INTERVAL_S (0 disables),
     CC_WATCHDOG_DEMOTE_AFTER, CC_WATCHDOG_RESTORE_AFTER."""
@@ -270,6 +292,8 @@ def start_from_env(
         is_busy=is_busy,
         emit_event=emit_event,
         metrics=metrics,
+        on_probe=on_probe,
+        on_condemn=on_condemn,
     )
     watchdog.start(stop)
     return watchdog

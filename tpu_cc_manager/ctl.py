@@ -56,6 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="on halt, revert already-converged groups to their prior "
         "desired mode (the failed group is left for the operator)",
     )
+    r.add_argument(
+        "--failure-budget", type=int, default=None,
+        help="pool failure budget: halt (and refuse to start) when MORE "
+        "than this many nodes are quarantined — a fleet-level circuit "
+        "breaker (default: no budget)",
+    )
 
     a = sub.add_parser("attest", help="verify cross-slice attestation coherence")
     a.add_argument("--selector", required=True)
@@ -75,6 +81,26 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("status", help="per-node CC state table")
     s.add_argument("--selector", required=True)
+
+    q = sub.add_parser(
+        "quarantine",
+        help="manually quarantine a node: NoSchedule taint + "
+        "cc.quarantined label + ready.state=false; rollouts and pool "
+        "attestation skip it (ccmanager/remediation.py)",
+    )
+    q.add_argument("--node", required=True)
+    q.add_argument(
+        "--reason", default="operator",
+        help="recorded in the remediation annotation and node event",
+    )
+
+    uq = sub.add_parser(
+        "unquarantine",
+        help="lift a quarantine: remove the taint + label, restore "
+        "ready.state from the current mode.state, reset the ladder",
+    )
+    uq.add_argument("--node", required=True)
+    uq.add_argument("--reason", default="operator")
 
     rb = sub.add_parser(
         "rbac-check",
@@ -129,10 +155,32 @@ def cmd_rollout(api, args) -> int:
         node_timeout_s=args.node_timeout,
         continue_on_failure=args.continue_on_failure,
         rollback_on_failure=args.rollback_on_failure,
+        failure_budget=getattr(args, "failure_budget", None),
     )
     result = roller.rollout(args.mode)
     print(json.dumps(result.summary()))
     return 0 if result.ok else 1
+
+
+def cmd_quarantine(api, args) -> int:
+    from tpu_cc_manager.ccmanager.remediation import RemediationLadder
+
+    ladder = RemediationLadder(api, args.node)
+    if ladder.quarantined:
+        print(f"{args.node}: already quarantined")
+        return 0
+    ladder.quarantine(reason=args.reason, manual=True)
+    print(f"{args.node}: quarantined ({args.reason})")
+    return 0
+
+
+def cmd_unquarantine(api, args) -> int:
+    from tpu_cc_manager.ccmanager.remediation import RemediationLadder
+
+    ladder = RemediationLadder(api, args.node)
+    ladder.unquarantine(reason=args.reason)
+    print(f"{args.node}: quarantine lifted ({args.reason})")
+    return 0
 
 
 def cmd_attest(api, args) -> int:
@@ -152,11 +200,14 @@ def cmd_attest(api, args) -> int:
 
 
 def cmd_status(api, args) -> int:
+    from tpu_cc_manager.ccmanager import remediation as remediation_mod
     from tpu_cc_manager.ccmanager.slicecoord import (
         SLICE_COMMIT_LABEL,
+        SLICE_FENCE_LABEL,
         SLICE_STAGED_LABEL,
     )
     from tpu_cc_manager.drain import handshake
+    from tpu_cc_manager.kubeclient.api import node_annotations
     from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
 
     rows = [
@@ -165,13 +216,20 @@ def cmd_status(api, args) -> int:
     ]
     for node in api.list_nodes(args.selector):
         labels = node_labels(node)
-        # Transient barrier markers / failure reason: the things an
-        # operator staring at a stuck rollout needs to see first.
+        # Transient barrier markers / failure reason / remediation ladder:
+        # the things an operator staring at a stuck rollout needs first.
         notes = []
+        ladder = remediation_mod.describe_annotation(
+            node_annotations(node).get(remediation_mod.REMEDIATION_ANNOTATION)
+        )
+        if ladder:
+            notes.append(ladder)
         if labels.get(SLICE_STAGED_LABEL):
             notes.append(f"barrier:staged={labels[SLICE_STAGED_LABEL]}")
         if labels.get(SLICE_COMMIT_LABEL):
             notes.append(f"barrier:commit={labels[SLICE_COMMIT_LABEL]}")
+        if labels.get(SLICE_FENCE_LABEL):
+            notes.append(f"barrier:fence-gen={labels[SLICE_FENCE_LABEL]}")
         if labels.get(CC_FAILED_REASON_LABEL):
             notes.append(f"reason={labels[CC_FAILED_REASON_LABEL]}")
         token = handshake.request_token(
@@ -206,6 +264,9 @@ def cmd_rbac_check(api, args) -> int:
     checks = [
         ("get", "nodes", None, True),
         ("list", "nodes", None, True),
+        # `patch nodes` covers BOTH the metadata (labels/annotations)
+        # writes and the quarantine taint write (spec.taints rides the
+        # same resource + verb; ccmanager/remediation.py).
         ("patch", "nodes", None, True),
         ("watch", "nodes", None, True),
         ("list", "pods", args.namespace, True),
@@ -306,6 +367,8 @@ def main(argv: list[str] | None = None) -> int:
             "rollout": cmd_rollout,
             "attest": cmd_attest,
             "status": cmd_status,
+            "quarantine": cmd_quarantine,
+            "unquarantine": cmd_unquarantine,
             "rbac-check": cmd_rbac_check,
             "drain-subscribe": cmd_drain_subscribe,
         }[args.command](api, args)

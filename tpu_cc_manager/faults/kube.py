@@ -85,6 +85,12 @@ class FaultyKubeClient(KubeApi):
         self._maybe_fault("patch_node_annotations")
         return self.inner.patch_node_annotations(name, annotations)
 
+    def patch_node_taints(
+        self, name: str, add: list[dict], remove_keys: list[str]
+    ) -> dict:
+        self._maybe_fault("patch_node_taints")
+        return self.inner.patch_node_taints(name, add, remove_keys)
+
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         self._maybe_fault("list_nodes")
         return self.inner.list_nodes(label_selector)

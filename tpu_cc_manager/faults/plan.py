@@ -130,3 +130,19 @@ class FaultPlan:
         self.injected.append(Fault(kind="backend", op=op, seq=self._seq))
         backend.fail_next(op)
         return op
+
+    def seed_terminal_backend_fault(self, backend, ops: tuple[str, ...]) -> str:
+        """Arm one TERMINAL device fault (``times=-1``: never clears) on an
+        op drawn from the seeded stream — the chaos mode that drives the
+        remediation ladder end-to-end: retries cannot fix it, device
+        re-reset and runtime restart keep failing, and the node must end
+        quarantined. Which op is condemned is a pure function of the seed,
+        like every other decision; the caller clears the fault
+        (``backend.fail.pop(op)``) to model hardware recovery for the
+        probation-lift leg. Always injects (a terminal-fault soak without
+        a terminal fault proves nothing). Returns the op armed."""
+        self._seq += 1
+        op = ops[self.rng.randrange(len(ops))]
+        self.injected.append(Fault(kind="backend-terminal", op=op, seq=self._seq))
+        backend.fail_next(op, times=-1)
+        return op

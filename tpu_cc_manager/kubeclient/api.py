@@ -127,6 +127,21 @@ class KubeApi(abc.ABC):
             None, "annotation patching not supported by this client"
         )
 
+    def patch_node_taints(
+        self, name: str, add: list[dict], remove_keys: list[str]
+    ) -> dict:
+        """Add/remove taints on the node's ``spec.taints``.
+
+        ``add`` entries are taint dicts ({key, value, effect}); existing
+        taints with the same key are replaced, and ``remove_keys`` are
+        deleted. Taints are a LIST in the node spec, so implementations do
+        a read-modify-write and replace the whole list in one merge-patch
+        — same ``patch nodes`` RBAC verb as the label writes. Used by
+        quarantine (ccmanager/remediation.py) to fence workloads off a
+        condemned node with ``NoSchedule``. Optional capability — the
+        default raises so callers degrade cleanly."""
+        raise KubeApiError(None, "taint patching not supported by this client")
+
     @abc.abstractmethod
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         """GET /api/v1/nodes, optionally filtered by an equality label

@@ -206,6 +206,23 @@ class FakeKube(KubeApi):
             self._record_event("MODIFIED", node)
             return copy.deepcopy(node)
 
+    def patch_node_taints(
+        self, name: str, add: list[dict], remove_keys: list[str]
+    ) -> dict:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise KubeApiError(404, f"node {name} not found")
+            taints = list((node.get("spec") or {}).get("taints") or [])
+            doomed = set(remove_keys) | {t.get("key") for t in add}
+            taints = [t for t in taints if t.get("key") not in doomed]
+            taints.extend(copy.deepcopy(dict(t)) for t in add)
+            node.setdefault("spec", {})["taints"] = taints
+            self._rv += 1
+            node["metadata"]["resourceVersion"] = str(self._rv)
+            self._record_event("MODIFIED", node)
+            return copy.deepcopy(node)
+
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         with self._lock:
             return [

@@ -290,6 +290,26 @@ class RestKube(KubeApi):
             content_type="application/merge-patch+json",
         )
 
+    def patch_node_taints(
+        self, name: str, add: list[dict], remove_keys: list[str]
+    ) -> dict:
+        """Read-modify-write of ``spec.taints`` (a list, so a merge-patch
+        replaces it wholesale — the RMW keeps foreign taints intact). A
+        concurrent writer between the GET and the PATCH loses its edit to
+        ours; acceptable for the quarantine taint, whose only writers are
+        this agent and the operator CLI, and the patch is idempotent."""
+        node = self.get_node(name)
+        taints = list((node.get("spec") or {}).get("taints") or [])
+        doomed = set(remove_keys) | {t.get("key") for t in add}
+        taints = [t for t in taints if t.get("key") not in doomed]
+        taints.extend(dict(t) for t in add)
+        return self._request_json(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            body={"spec": {"taints": taints}},
+            content_type="application/merge-patch+json",
+        )
+
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         query: dict = {}
         if label_selector:

@@ -79,6 +79,15 @@ DRAIN_COMPONENT_LABELS = {
 # rolling orchestrator (group-by-slice) and multi-slice attestation.
 SLICE_ID_LABEL = "cloud.google.com/tpu-slice-id"
 
+# Quarantine: the terminal rung of the remediation ladder
+# (ccmanager/remediation.py). A quarantined node carries this label (value
+# "true"), a NoSchedule taint under the same key, and ready.state=false;
+# the rolling orchestrator and pool attestation skip it, and the pool
+# failure budget counts it. Cleared on probation lift or manual
+# `tpu-cc-ctl unquarantine`.
+QUARANTINED_LABEL = "cloud.google.com/tpu-cc.quarantined"
+QUARANTINE_TAINT_KEY = "cloud.google.com/tpu-cc.quarantined"
+
 # Pause protocol (reference gpu_operator_eviction.py:43-95):
 #   'true'        -> PAUSED_VALUE
 #   custom 'v'    -> 'v' + PAUSED_SUFFIX

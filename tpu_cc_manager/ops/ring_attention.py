@@ -163,6 +163,13 @@ def ring_attention_in_jit(
         mesh=mesh,
         in_specs=(spec,) * 3,
         out_specs=spec,
+        # The skip-future-shards lax.cond takes different collective paths
+        # per branch; at sp>2 JAX's static replication checker cannot
+        # prove the branches' replication types equal and aborts tracing.
+        # The branches are element-wise equivalent in rep terms (both
+        # return (m, l, acc) sharded exactly like the carry), so disable
+        # the check rather than the FLOP-saving skip.
+        check_rep=False,
     )
     return mapped(q, k, v)
 

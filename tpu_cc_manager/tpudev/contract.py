@@ -171,3 +171,12 @@ class TpuCcBackend(abc.ABC):
         share the same probe so "ready" and "still healthy" can never
         disagree on methodology. Default: no probe capability."""
         return HealthProbe(tier="none", healthy=True, detail="no probe available")
+
+    def restart_runtime(self) -> None:
+        """Restart the TPU runtime WITHOUT changing the committed mode —
+        the remediation ladder's rung above a device re-reset
+        (ccmanager/remediation.py). Default: a reset of the discovered
+        chip set with nothing staged, which for the tpuvm backend IS the
+        runtime-restart commit path and leaves the committed mode
+        untouched. May raise TpuError."""
+        self.reset(self.discover().chips)

@@ -172,6 +172,20 @@ class FakeTpuBackend(TpuCcBackend):
                 time.sleep(0.01)
         self.op_log.append(("wait_ready", tuple(c.index for c in chips)))
 
+    def restart_runtime(self) -> None:
+        """Distinct remediation op (vs ``reset``) so chaos plans can arm
+        terminal faults per ladder rung and tests can assert which rung
+        ran."""
+        self._maybe_fail("restart_runtime")
+        with self._lock:
+            now = time.monotonic()
+            for chip in self._chips:
+                self.booted[chip.index] = False
+                self._boot_done_at[chip.index] = now + self.boot_latency_s
+            self.op_log.append(
+                ("restart_runtime", tuple(c.index for c in self._chips))
+            )
+
     def probe_runtime_health(self) -> HealthProbe:
         self._maybe_fail("probe")
         with self._lock:

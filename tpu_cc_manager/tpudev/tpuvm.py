@@ -197,6 +197,7 @@ class TpuVmBackend(TpuCcBackend):
         measure_globs: list[str] | None = None,
         tsm_root: str | None = None,
         runtime_env_file: str | None = None,
+        cc_guest_devices: tuple[str, ...] = ("/dev/tdx_guest", "/dev/sev-guest"),
     ) -> None:
         self.state_dir = state_dir
         self.reset_cmd = host_wrap(reset_cmd or list(DEFAULT_RESET_CMD))
@@ -237,6 +238,10 @@ class TpuVmBackend(TpuCcBackend):
             runtime_env_file = os.environ.get(RUNTIME_ENV_FILE_ENV) or None
         # A HOST path (CC_HOST_ROOT-prefixed at write time); None disables.
         self.runtime_env_file = runtime_env_file
+        # Confidential-VM guest device nodes (TDX/SEV-SNP surface these
+        # inside a CC VM); injectable so multi-host tests can model
+        # CC-capable hosts without kernel support on the test box.
+        self.cc_guest_devices = tuple(cc_guest_devices)
         # Device-command path protection: one classified retry per command
         # (utils/retry.py; a dbus hiccup should not fail a whole reconcile)
         # behind a breaker so a host whose systemctl keeps failing fails
@@ -367,6 +372,9 @@ class TpuVmBackend(TpuCcBackend):
         self._stamp_cache = (time.monotonic(), result)
         return result
 
+    def _host_is_confidential(self) -> bool:
+        return any(os.path.exists(p) for p in self.cc_guest_devices)
+
     # ---- contract --------------------------------------------------------
 
     def discover(self) -> SliceTopology:
@@ -398,7 +406,7 @@ class TpuVmBackend(TpuCcBackend):
         # same host signals the reference probes for TDX/SEV-SNP
         # (main.py:80-103), which surface inside a CC VM as /dev/tdx_guest or
         # /dev/sev-guest.
-        host_cc = os.path.exists("/dev/tdx_guest") or os.path.exists("/dev/sev-guest")
+        host_cc = self._host_is_confidential()
         if not device_paths:
             # Multi-host slices schedule one worker per host; synthesize this
             # host's chip share when the device nodes are containerized away.
@@ -690,9 +698,7 @@ class TpuVmBackend(TpuCcBackend):
             "libtpu_version": self._libtpu_version(files),
             "runtime_files": str(len(files)),
             "cc_mode": mode,
-            "confidential_vm": str(
-                os.path.exists("/dev/tdx_guest") or os.path.exists("/dev/sev-guest")
-            ).lower(),
+            "confidential_vm": str(self._host_is_confidential()).lower(),
             # Pool-comparable: every host of one confidential pool runs the
             # same TEE provider (or none).
             "tsm_provider": tsm["provider"] if tsm else "none",

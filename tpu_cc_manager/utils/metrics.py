@@ -185,6 +185,12 @@ class MetricsRegistry:
         # Runtime-health watchdog: active probe tier + last probe verdict.
         self._health_tier: tuple[str, int] | None = None
         self._runtime_healthy: bool | None = None
+        # Failure containment (ccmanager/remediation.py): whether this node
+        # is quarantined, ladder actions by (step, outcome), and how many
+        # slice barriers were aborted with a fencing generation.
+        self._quarantined: bool | None = None
+        self._remediation_totals: dict[tuple[str, str], int] = {}
+        self._barrier_fenced_total = 0
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -241,6 +247,30 @@ class MetricsRegistry:
     def health_tier(self) -> tuple[str, int] | None:
         with self._lock:
             return self._health_tier
+
+    def set_quarantined(self, quarantined: bool) -> None:
+        """Record this node's quarantine state (remediation ladder)."""
+        with self._lock:
+            self._quarantined = bool(quarantined)
+
+    def record_remediation_step(self, step: str, outcome: str) -> None:
+        """Count one remediation-ladder action by step and outcome
+        (``ok`` / ``failed`` / ``escalated``)."""
+        with self._lock:
+            key = (step, outcome)
+            self._remediation_totals[key] = (
+                self._remediation_totals.get(key, 0) + 1
+            )
+
+    def remediation_totals(self) -> dict[tuple[str, str], int]:
+        with self._lock:
+            return dict(self._remediation_totals)
+
+    def record_barrier_fenced(self) -> None:
+        """Count one slice-barrier fence event (a barrier round aborted
+        with a new fencing generation so peers fail fast)."""
+        with self._lock:
+            self._barrier_fenced_total += 1
 
     def _accumulate(self, m: ReconcileMetrics) -> None:
         with self._lock:
@@ -302,6 +332,9 @@ class MetricsRegistry:
             breaker_states = dict(self._breaker_states)
             health_tier = self._health_tier
             runtime_healthy = self._runtime_healthy
+            quarantined = self._quarantined
+            remediation_totals = dict(self._remediation_totals)
+            barrier_fenced_total = self._barrier_fenced_total
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -362,6 +395,34 @@ class MetricsRegistry:
             lines.append("# TYPE tpu_cc_runtime_healthy gauge")
             lines.append(
                 "tpu_cc_runtime_healthy %d" % (1 if runtime_healthy else 0)
+            )
+        if quarantined is not None:
+            lines.append(
+                "# HELP tpu_cc_quarantined Whether this node is quarantined "
+                "by the remediation ladder (1 = quarantined)."
+            )
+            lines.append("# TYPE tpu_cc_quarantined gauge")
+            lines.append("tpu_cc_quarantined %d" % (1 if quarantined else 0))
+        if remediation_totals:
+            lines.append(
+                "# HELP tpu_cc_remediation_step_total Remediation-ladder "
+                "actions by step and outcome (ccmanager/remediation.py)."
+            )
+            lines.append("# TYPE tpu_cc_remediation_step_total counter")
+            for (step, outcome), count in sorted(remediation_totals.items()):
+                lines.append(
+                    "tpu_cc_remediation_step_total%s %d"
+                    % (_labels(step=step, outcome=outcome), count)
+                )
+        if barrier_fenced_total:
+            lines.append(
+                "# HELP tpu_cc_barrier_fenced_total Slice barrier rounds "
+                "aborted with a fencing generation (peers fail fast "
+                "instead of burning the barrier deadline)."
+            )
+            lines.append("# TYPE tpu_cc_barrier_fenced_total counter")
+            lines.append(
+                "tpu_cc_barrier_fenced_total %d" % barrier_fenced_total
             )
         # The cumulative per-phase sums/counts are served exclusively as
         # the histogram's _sum/_count series below — separate
