@@ -241,6 +241,11 @@ def _seeded_registry_text() -> str:
     registry.record_remediation_step("device-reset", "ok")
     registry.record_remediation_step("quarantine", 'odd"outcome')
     registry.record_barrier_fenced()
+    # Crash-safe rollout families (ccmanager/rollout_state.py).
+    registry.record_rollout_resume()
+    registry.record_lease_transition()
+    registry.record_lease_transition()
+    registry.record_fenced_write()
     return registry.render_prometheus()
 
 

@@ -112,6 +112,47 @@ PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
     --selector pool=tpu-it --mode on --node-timeout 120
 await_state on
 
+echo ">>> crash-safe rollout drill: SIGKILL mid-window, resume under real Lease RBAC"
+# Stop the agent so the pool cannot converge and the rollout stays
+# in-window, then SIGKILL the orchestrator: no cleanup runs, the lease
+# and its checkpointed record survive in the apiserver.
+kill "$AGENT_PID" 2>/dev/null || true
+wait "$AGENT_PID" 2>/dev/null || true
+PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl rollout \
+    --selector pool=tpu-it --mode off --node-timeout 120 \
+    --lease-duration 5 &
+ROLLOUT_PID=$!
+sleep 4
+kill -9 "$ROLLOUT_PID" 2>/dev/null || true
+wait "$ROLLOUT_PID" 2>/dev/null || true
+# The dead orchestrator left a durable record in the Lease (real
+# coordination.k8s.io RBAC: the ClusterRole's get/create/update grants).
+record=$(kubectl get lease tpu-cc-rollout -n "$NS" \
+  -o jsonpath='{.metadata.annotations.cloud\.google\.com/tpu-cc\.rollout-record}')
+echo "$record" | grep -q '"status":"in-progress"' || {
+  echo "FAIL: no in-progress rollout record survived the SIGKILL"; exit 1; }
+PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl status --selector pool=tpu-it \
+  | grep -q "ROLLOUT" || {
+  echo "FAIL: ctl status does not surface the interrupted rollout"; exit 1; }
+echo ">>> restarting the agent; resuming the rollout after lease expiry"
+NODE_NAME="$NODE" KUBECONFIG="$SA_KUBECONFIG" JAX_PLATFORMS=cpu \
+  PALLAS_AXON_POOL_IPS= CC_READINESS_FILE=$(mktemp -u) \
+  OPERATOR_NAMESPACE="$NS" PYTHONPATH="$REPO" \
+  python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
+AGENT_PID=$!
+sleep 6   # the dead orchestrator's 5 s lease lapses
+RESUME_OUT=$(PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl rollout \
+    --selector pool=tpu-it --resume --node-timeout 120 --lease-duration 5)
+echo "$RESUME_OUT"
+echo "$RESUME_OUT" | grep -q '"resumed": true' || {
+  echo "FAIL: successor did not resume from the persisted record"; exit 1; }
+await_state off
+kubectl label node "$NODE" "$MODE_LABEL=on" --overwrite
+await_state on
+
 echo ">>> quarantine drill: the taint patch verb against real RBAC"
 PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
   python3 -m tpu_cc_manager.ctl quarantine --node "$NODE" --reason kind-drill
@@ -139,4 +180,4 @@ effect=$(kubectl get node "$NODE" -o jsonpath\
 kubectl label node "$NODE" "$MODE_LABEL=off" --overwrite
 await_state off
 
-echo ">>> kind integration OK (RBAC incl. taints + real watch + merge-patch + rollout + quarantine verified)"
+echo ">>> kind integration OK (RBAC incl. taints + leases + real watch + merge-patch + rollout + SIGKILL/resume + quarantine verified)"

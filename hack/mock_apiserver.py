@@ -153,6 +153,8 @@ def _load_cluster_role_grants() -> set[tuple[str, str]]:
     return {
         ("get", "nodes"), ("list", "nodes"), ("watch", "nodes"),
         ("patch", "nodes"), ("list", "pods"), ("create", "events"),
+        ("get", "leases"), ("create", "leases"), ("update", "leases"),
+        ("delete", "leases"),
     }
 
 
@@ -165,6 +167,14 @@ rv = [1]
 compacted_below = [0]
 nodes: dict[str, dict] = {}
 pods: dict[str, dict] = {}  # pod name -> pod dict
+# coordination.k8s.io/v1 Leases ((namespace, name) -> Lease dict): the
+# rolling orchestrator's single-writer lock + checkpoint record
+# (ccmanager/rollout_state.py). Updates enforce resourceVersion CAS.
+leases: dict[tuple[str, str], dict] = {}
+
+_LEASE_PATH_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases(?:/([^/]+))?$"
+)
 
 
 def add_node(name: str) -> None:
@@ -339,6 +349,15 @@ class Handler(BaseHTTPRequestHandler):
             "code": 422, "reason": "Invalid", "message": detail,
         }, 422)
 
+    def _conflict(self, detail):
+        """409 with a k8s-shaped Status — what a real apiserver answers to
+        an update whose metadata.resourceVersion is stale (optimistic
+        concurrency) or a create of an existing object."""
+        return self._json({
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "code": 409, "reason": "Conflict", "message": detail,
+        }, 409)
+
     def _authorized(self, verb, resource) -> bool:
         return (verb, resource) in GRANTS
 
@@ -439,6 +458,18 @@ class Handler(BaseHTTPRequestHandler):
                 return self._json({"kind": "NodeList",
                                    "items": items,
                                    "metadata": {"resourceVersion": str(rv[0])}})
+        lm = _LEASE_PATH_RE.match(u.path)
+        if lm and lm.group(2):
+            if not self._authorized("get", "leases"):
+                return self._forbid("get", "leases")
+            with lock:
+                lease = leases.get((lm.group(1), lm.group(2)))
+                if lease is None:
+                    return self._json(
+                        {"kind": "Status", "code": 404,
+                         "message": "no such lease"}, 404,
+                    )
+                return self._json(lease)
         if u.path == f"/api/v1/namespaces/{NS}/pods":
             if not self._authorized("list", "pods"):
                 return self._forbid("list", "pods")
@@ -470,6 +501,20 @@ class Handler(BaseHTTPRequestHandler):
                 if node is None:
                     return self._json({"kind": "Status", "code": 404}, 404)
                 meta = body.get("metadata") or {}
+                # Optimistic concurrency, as the real apiserver enforces
+                # it: a patch that names a resourceVersion is a
+                # conditional update — a stale one gets 409 Conflict, not
+                # last-write-wins.
+                sent_rv = meta.get("resourceVersion")
+                if sent_rv is not None and str(sent_rv) != str(
+                    node["metadata"]["resourceVersion"]
+                ):
+                    return self._conflict(
+                        f"Operation cannot be fulfilled on nodes "
+                        f"\"{m.group(1)}\": the object has been modified "
+                        f"(sent resourceVersion {sent_rv}, current "
+                        f"{node['metadata']['resourceVersion']})"
+                    )
                 patch_labels = meta.get("labels") or {}
                 patch_annotations = meta.get("annotations") or {}
                 bad = validate_label_patch(patch_labels)
@@ -509,6 +554,68 @@ class Handler(BaseHTTPRequestHandler):
                 return self._json(node)
         self._json({"kind": "Status", "code": 404}, 404)
 
+    def do_PUT(self):
+        """Full-object update — only Leases use it. Enforces the same
+        optimistic concurrency a real apiserver does: the sent
+        metadata.resourceVersion must match the stored one or the update
+        409s, which is exactly what makes the rollout lease's fencing
+        token trustworthy against a stale orchestrator."""
+        u = urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        lm = _LEASE_PATH_RE.match(u.path)
+        if lm and lm.group(2):
+            if not self._authorized("update", "leases"):
+                return self._forbid("update", "leases")
+            key = (lm.group(1), lm.group(2))
+            with lock:
+                stored = leases.get(key)
+                if stored is None:
+                    return self._json(
+                        {"kind": "Status", "code": 404,
+                         "message": "no such lease"}, 404,
+                    )
+                sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+                if str(sent_rv) != stored["metadata"]["resourceVersion"]:
+                    return self._conflict(
+                        f'Operation cannot be fulfilled on leases '
+                        f'"{lm.group(2)}": the object has been modified '
+                        f"(sent resourceVersion {sent_rv}, current "
+                        f"{stored['metadata']['resourceVersion']})"
+                    )
+                rv[0] += 1
+                updated = {
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {
+                        **(body.get("metadata") or {}),
+                        "name": lm.group(2), "namespace": lm.group(1),
+                        "resourceVersion": str(rv[0]),
+                    },
+                    "spec": body.get("spec") or {},
+                }
+                leases[key] = updated
+                return self._json(updated)
+        self._json({"kind": "Status", "code": 404}, 404)
+
+    def do_DELETE(self):
+        u = urlparse(self.path)
+        lm = _LEASE_PATH_RE.match(u.path)
+        if lm and lm.group(2):
+            if not self._authorized("delete", "leases"):
+                return self._forbid("delete", "leases")
+            with lock:
+                if leases.pop((lm.group(1), lm.group(2)), None) is None:
+                    return self._json(
+                        {"kind": "Status", "code": 404,
+                         "message": "no such lease"}, 404,
+                    )
+                return self._json({
+                    "kind": "Status", "apiVersion": "v1",
+                    "status": "Success", "code": 200,
+                })
+        self._json({"kind": "Status", "code": 404}, 404)
+
     def do_POST(self):
         u = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0))
@@ -532,6 +639,31 @@ class Handler(BaseHTTPRequestHandler):
             with lock:
                 events.append(body)
             return self._json(body, 201)
+        lm = _LEASE_PATH_RE.match(u.path)
+        if lm and not lm.group(2):
+            if not self._authorized("create", "leases"):
+                return self._forbid("create", "leases")
+            name = ((body.get("metadata") or {}).get("name")) or ""
+            if not name:
+                return self._invalid("lease create: metadata.name required")
+            with lock:
+                key = (lm.group(1), name)
+                if key in leases:
+                    return self._conflict(
+                        f'leases.coordination.k8s.io "{name}" already exists'
+                    )
+                rv[0] += 1
+                lease = {
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {
+                        "name": name, "namespace": lm.group(1),
+                        "resourceVersion": str(rv[0]),
+                    },
+                    "spec": body.get("spec") or {},
+                }
+                leases[key] = lease
+                return self._json(lease, 201)
         if u.path == "/_ctl/set-label":
             with lock:
                 node = nodes.get(body.get("node", DEFAULT_NODE))

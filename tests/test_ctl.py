@@ -180,3 +180,64 @@ def test_rollout_command(fake_kube, capsys):
     )
     assert rc == 0
     assert '"ok": true' in capsys.readouterr().out
+
+
+def test_attest_challenge_round(fake_kube, capsys):
+    """`attest --challenge`: issue, await the (simulated) agent's answer,
+    verify with challenged freshness; a silent pool fails instead."""
+    import threading
+
+    from tpu_cc_manager.ccmanager import multislice
+
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    fake_kube.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    publish_quote(fake_kube, "n0", backend.fetch_attestation("stale"))
+
+    def answer_when_challenged():
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            nonce = multislice.challenge_nonce_of(fake_kube.get_node("n0"))
+            if nonce:
+                publish_quote(
+                    fake_kube, "n0", backend.fetch_attestation(nonce)
+                )
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=answer_when_challenged, daemon=True)
+    t.start()
+    rc = ctl.cmd_attest(
+        fake_kube,
+        ns(selector="pool=tpu", mode="on", slices=None, max_age=3600,
+           allow_fake=True, challenge=True, challenge_timeout=5.0),
+    )
+    t.join(timeout=5)
+    out = capsys.readouterr().out
+    assert rc == 0 and "challenged re-attestation" in out
+
+    # No agent answering the NEXT challenge round -> the stale quote
+    # fails the challenged path loudly.
+    rc = ctl.cmd_attest(
+        fake_kube,
+        ns(selector="pool=tpu", mode="on", slices=None, max_age=3600,
+           allow_fake=True, challenge=True, challenge_timeout=0.05),
+    )
+    out = capsys.readouterr().out
+    assert rc == 1 and "FAIL" in out
+
+
+def test_attest_challenge_rejects_no_verify_signatures(fake_kube):
+    """--challenge + --no-verify-signatures is contradictory: the
+    challenge binding lives inside the signed quote the other flag says
+    not to read."""
+    import pytest
+
+    with pytest.raises(ValueError, match="no-verify-signatures"):
+        ctl.cmd_attest(
+            fake_kube,
+            ns(selector="pool=tpu", mode="on", slices=None, max_age=3600,
+               allow_fake=True, challenge=True, challenge_timeout=1.0,
+               no_verify_signatures=True),
+        )

@@ -71,3 +71,32 @@ def test_patch_reactor_fires(fake_kube):
     fake_kube.add_patch_reactor(lambda name, node: seen.append(name))
     fake_kube.patch_node_labels("n1", {"x": "1"})
     assert seen == ["n1"]
+
+
+def test_lease_crud_and_optimistic_concurrency(fake_kube):
+    """The fake's Lease verbs carry honest apiserver semantics: create
+    conflicts on an existing name, update is a resourceVersion CAS (409
+    on mismatch — the hinge the rollout fencing token hangs on)."""
+    lease = fake_kube.create_lease("ns", "l1", {"holderIdentity": "a"})
+    assert lease["spec"]["holderIdentity"] == "a"
+    with pytest.raises(KubeApiError) as exc:
+        fake_kube.create_lease("ns", "l1", {"holderIdentity": "b"})
+    assert exc.value.status == 409
+
+    fresh = fake_kube.get_lease("ns", "l1")
+    stale = dict(fresh, metadata=dict(fresh["metadata"]))
+    fresh["spec"] = {"holderIdentity": "a2"}
+    updated = fake_kube.update_lease("ns", "l1", fresh)
+    assert updated["spec"]["holderIdentity"] == "a2"
+    # The loser of the race (stale resourceVersion) must get 409, never
+    # last-write-wins.
+    stale["spec"] = {"holderIdentity": "b"}
+    with pytest.raises(KubeApiError) as exc:
+        fake_kube.update_lease("ns", "l1", stale)
+    assert exc.value.status == 409
+    assert fake_kube.get_lease("ns", "l1")["spec"]["holderIdentity"] == "a2"
+
+    fake_kube.delete_lease("ns", "l1")
+    with pytest.raises(KubeApiError) as exc:
+        fake_kube.get_lease("ns", "l1")
+    assert exc.value.status == 404

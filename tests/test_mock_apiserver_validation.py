@@ -57,6 +57,8 @@ def test_grants_come_from_the_real_cluster_role_manifest():
     assert mock_apiserver.GRANTS == {
         ("get", "nodes"), ("list", "nodes"), ("watch", "nodes"),
         ("patch", "nodes"), ("list", "pods"), ("create", "events"),
+        ("get", "leases"), ("create", "leases"), ("update", "leases"),
+        ("delete", "leases"),
     }
 
 
@@ -269,3 +271,69 @@ def test_compacted_watch_resume_is_410(server, client):
         # The module-scope server is shared; don't leave the floor up for
         # whichever test runs next.
         mock_apiserver.compacted_below[0] = 0
+
+
+def test_node_patch_with_stale_resource_version_is_409(client):
+    """Satellite (ISSUE 4): update verbs honor optimistic concurrency —
+    a PATCH naming a stale metadata.resourceVersion gets 409 Conflict
+    exactly as a real apiserver answers, instead of last-write-wins."""
+    current = client.get_node(NODE)["metadata"]["resourceVersion"]
+    # A conditional patch at the CURRENT rv lands...
+    client._request_json(
+        "PATCH", f"/api/v1/nodes/{NODE}",
+        body={"metadata": {"resourceVersion": current,
+                           "labels": {"occ-test": "v1"}}},
+        content_type="application/merge-patch+json",
+    )
+    # ...which bumps the rv, so re-sending the SAME rv now conflicts.
+    with pytest.raises(KubeApiError) as exc:
+        client._request_json(
+            "PATCH", f"/api/v1/nodes/{NODE}",
+            body={"metadata": {"resourceVersion": current,
+                               "labels": {"occ-test": "v2"}}},
+            content_type="application/merge-patch+json",
+        )
+    assert exc.value.status == 409
+    assert node_labels(client.get_node(NODE))["occ-test"] == "v1"
+
+
+def test_lease_lifecycle_over_http(client):
+    """RestKube's coordination.k8s.io verbs against the mock: create,
+    get, CAS update (stale rv -> 409), delete — the wire surface the
+    rollout lease (ccmanager/rollout_state.py) runs on."""
+    ns = "tpu-operator"
+    created = client.create_lease(ns, "occ-lease", {
+        "holderIdentity": "orch-a", "leaseDurationSeconds": 15,
+        "leaseTransitions": 1,
+    })
+    assert created["spec"]["holderIdentity"] == "orch-a"
+    with pytest.raises(KubeApiError) as exc:
+        client.create_lease(ns, "occ-lease", {"holderIdentity": "orch-b"})
+    assert exc.value.status == 409
+
+    fresh = client.get_lease(ns, "occ-lease")
+    stale_rv = fresh["metadata"]["resourceVersion"]
+    fresh["spec"]["holderIdentity"] = "orch-a"
+    fresh["spec"]["leaseTransitions"] = 2
+    fresh["metadata"].setdefault("annotations", {})[
+        "cloud.google.com/tpu-cc.rollout-record"
+    ] = "{}"
+    updated = client.update_lease(ns, "occ-lease", fresh)
+    assert updated["spec"]["leaseTransitions"] == 2
+    assert updated["metadata"]["annotations"]
+
+    stale = {
+        "metadata": {"resourceVersion": stale_rv},
+        "spec": {"holderIdentity": "orch-b"},
+    }
+    with pytest.raises(KubeApiError) as exc:
+        client.update_lease(ns, "occ-lease", stale)
+    assert exc.value.status == 409
+    assert client.get_lease(ns, "occ-lease")["spec"][
+        "holderIdentity"
+    ] == "orch-a"
+
+    client.delete_lease(ns, "occ-lease")
+    with pytest.raises(KubeApiError) as exc:
+        client.get_lease(ns, "occ-lease")
+    assert exc.value.status == 404

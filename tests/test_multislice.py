@@ -333,3 +333,329 @@ def test_quote_serialization_roundtrip():
 
     q = make_quote("s1")
     assert deserialize_quote(serialize_quote(q)) == q
+
+
+# ---------------------------------------------------------------------------
+# Verifier-challenge re-attestation (VERDICT weak #5)
+# ---------------------------------------------------------------------------
+
+
+def test_replayed_quote_passes_exp_only_but_fails_challenged_path(fake_kube):
+    """THE replay scenario: a same-slice quote with a valid platform
+    signature, matching digest labels and correct slice binding passes
+    today's (exp-only) check — and must FAIL once the verifier issues a
+    challenge, because the replayed quote cannot be bound to a nonce the
+    verifier only just minted."""
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    replayed = backend.fetch_attestation("old-self-chosen-nonce")
+    add_attested_node(fake_kube, "n0", "s1", replayed)
+
+    # Exp-only policy: the replay sails through (this is the weakness).
+    multislice.verify_pool_attestation(fake_kube, POOL, "on", allow_fake=True)
+
+    # Challenged policy: the same evidence is refused.
+    challenges = multislice.issue_pool_challenges(fake_kube, POOL)
+    assert set(challenges) == {"n0"}
+    with pytest.raises(multislice.PoolAttestationError) as exc:
+        multislice.verify_pool_attestation(
+            fake_kube, POOL, "on", allow_fake=True, challenges=challenges
+        )
+    assert "challenge" in str(exc.value)
+
+    # A live agent re-quotes bound to the challenge -> verification
+    # passes again, now with challenged freshness.
+    answered = backend.fetch_attestation(challenges["n0"])
+    multislice.publish_quote(fake_kube, "n0", answered)
+    multislice.verify_pool_attestation(
+        fake_kube, POOL, "on", allow_fake=True, challenges=challenges
+    )
+
+
+def test_challenge_annotation_is_read_opportunistically(fake_kube):
+    """Without the verifier-held dict, an outstanding challenge
+    annotation on the node still arms the challenged check."""
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    add_attested_node(fake_kube, "n0", "s1",
+                      backend.fetch_attestation("stale"))
+    multislice.issue_pool_challenges(fake_kube, POOL)
+    with pytest.raises(multislice.PoolAttestationError):
+        multislice.verify_pool_attestation(
+            fake_kube, POOL, "on", allow_fake=True
+        )
+
+
+def test_quarantined_nodes_are_not_challenged(fake_kube):
+    from tpu_cc_manager.labels import QUARANTINED_LABEL
+
+    fake_kube.add_node("q0", {"pool": "tpu", QUARANTINED_LABEL: "true"})
+    add_attested_node(fake_kube, "n0", "s1", make_quote("s1"))
+    challenges = multislice.issue_pool_challenges(fake_kube, POOL)
+    assert set(challenges) == {"n0"}
+
+
+def test_await_challenge_answers_converges_and_times_out(fake_kube):
+    import threading
+
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    add_attested_node(fake_kube, "n0", "s1",
+                      backend.fetch_attestation("stale"))
+    fake_kube.add_node("dead", {"pool": "tpu"})
+    challenges = multislice.issue_pool_challenges(fake_kube, POOL)
+    assert set(challenges) == {"n0", "dead"}
+
+    def answer():
+        multislice.publish_quote(
+            fake_kube, "n0", backend.fetch_attestation(challenges["n0"])
+        )
+
+    t = threading.Timer(0.05, answer)
+    t.daemon = True
+    t.start()
+    # n0 answers inside the window; "dead" (no agent) never does and is
+    # reported, not waited on forever.
+    pending = multislice.await_challenge_answers(
+        fake_kube, POOL, challenges, timeout_s=2.0, poll_interval_s=0.02
+    )
+    assert pending == ["dead"]
+
+
+def test_manager_answers_challenge_bound_to_verifier_nonce(fake_kube):
+    """The agent side: a challenge annotation on the node makes the
+    manager re-quote bound to the verifier's nonce and republish — the
+    full challenged verification then passes end-to-end."""
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.drain import state as drain_state
+
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    fake_kube.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    drain_state.set_cc_state_label(fake_kube, "n0", "on")
+    mgr = CCManager(fake_kube, backend, "n0", evict_components=False,
+                    smoke_workload="none")
+    challenges = multislice.issue_pool_challenges(fake_kube, POOL)
+    mgr._maybe_answer_challenge(fake_kube.get_node("n0"))
+    from tpu_cc_manager.kubeclient.api import node_annotations
+    from tpu_cc_manager.tpudev.attestation import deserialize_quote
+
+    raw = node_annotations(fake_kube.get_node("n0"))[
+        multislice.QUOTE_FULL_ANNOTATION
+    ]
+    assert deserialize_quote(raw).nonce == challenges["n0"]
+    multislice.verify_pool_attestation(
+        fake_kube, POOL, "on", allow_fake=True, challenges=challenges
+    )
+    # The answered challenge annotation is RETIRED in the same patch: a
+    # one-time challenge must not re-arm forever (it would fail every
+    # later unchallenged verification once a reconcile republishes a
+    # self-nonce quote, and make the agent re-answer it endlessly).
+    assert multislice.challenge_nonce_of(fake_kube.get_node("n0")) is None
+    # Idempotent: the MODIFIED event from our own answer does not loop.
+    patches_before = fake_kube.patch_calls
+    mgr._maybe_answer_challenge(fake_kube.get_node("n0"))
+    assert fake_kube.patch_calls == patches_before
+    # A later reconcile republishing a self-nonce quote no longer trips
+    # over the (now retired) challenge in a plain verification.
+    multislice.publish_quote(
+        fake_kube, "n0", backend.fetch_attestation("fresh-self-nonce")
+    )
+    multislice.verify_pool_attestation(fake_kube, POOL, "on", allow_fake=True)
+
+
+def test_manager_ignores_challenge_with_no_attested_mode(fake_kube):
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.kubeclient.api import node_annotations
+
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="off")
+    fake_kube.add_node("n0", {"pool": "tpu"})
+    mgr = CCManager(fake_kube, backend, "n0", evict_components=False,
+                    smoke_workload="none")
+    multislice.issue_pool_challenges(fake_kube, POOL)
+    mgr._maybe_answer_challenge(fake_kube.get_node("n0"))
+    assert multislice.QUOTE_FULL_ANNOTATION not in node_annotations(
+        fake_kube.get_node("n0")
+    )
+
+
+def test_failed_challenge_issuance_still_fails_challenged_verification(
+    fake_kube,
+):
+    """A node whose challenge patch flaked stays IN the challenge set: it
+    must fail challenged verification loudly, not silently verify
+    exp-only in the very mode built to defeat replay."""
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    add_attested_node(fake_kube, "n0", "s1",
+                      backend.fetch_attestation("stale"))
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    real_patch = fake_kube.patch_node_annotations
+    fake_kube.patch_node_annotations = (
+        lambda *a, **kw: (_ for _ in ()).throw(KubeApiError(503, "flake"))
+    )
+    try:
+        challenges = multislice.issue_pool_challenges(fake_kube, POOL)
+    finally:
+        fake_kube.patch_node_annotations = real_patch
+    assert set(challenges) == {"n0"}  # kept despite the failed patch
+    with pytest.raises(multislice.PoolAttestationError):
+        multislice.verify_pool_attestation(
+            fake_kube, POOL, "on", allow_fake=True, challenges=challenges
+        )
+
+
+def test_manager_retries_challenge_answer_after_annotation_flake(fake_kube):
+    """A flaked quote-annotation patch must NOT mark the challenge
+    answered: the next watch event re-answers instead of the verifier
+    timing out on a healthy node."""
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.drain import state as drain_state
+    from tpu_cc_manager.kubeclient.api import KubeApiError, node_annotations
+    from tpu_cc_manager.tpudev.attestation import deserialize_quote
+
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    fake_kube.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    drain_state.set_cc_state_label(fake_kube, "n0", "on")
+    mgr = CCManager(fake_kube, backend, "n0", evict_components=False,
+                    smoke_workload="none")
+    challenges = multislice.issue_pool_challenges(fake_kube, POOL)
+
+    real_patch = fake_kube.patch_node_annotations
+    fake_kube.patch_node_annotations = (
+        lambda *a, **kw: (_ for _ in ()).throw(KubeApiError(503, "flake"))
+    )
+    try:
+        mgr._maybe_answer_challenge(fake_kube.get_node("n0"))
+    finally:
+        fake_kube.patch_node_annotations = real_patch
+    assert mgr._answered_challenge_nonce is None  # NOT marked answered
+    # Next watch event: the answer goes through.
+    mgr._maybe_answer_challenge(fake_kube.get_node("n0"))
+    raw = node_annotations(fake_kube.get_node("n0"))[
+        multislice.QUOTE_FULL_ANNOTATION
+    ]
+    assert deserialize_quote(raw).nonce == challenges["n0"]
+
+
+def test_challenge_issuance_degrades_on_annotationless_client(fake_kube):
+    """A client that structurally cannot patch annotations degrades to
+    the documented exp-only fallback ({}), instead of arming challenges
+    no node could ever receive and failing the whole healthy pool."""
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    class NoAnnotations(FakeKube):
+        def patch_node_annotations(self, name, annotations):
+            raise KubeApiError(
+                None, "annotation patching not supported by this client"
+            )
+
+    api = NoAnnotations()
+    api.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    assert multislice.issue_pool_challenges(api, POOL) == {}
+
+
+def test_answering_does_not_erase_a_newer_challenge(fake_kube):
+    """A challenge issued WHILE the agent was fetching its quote (the
+    device round trip takes seconds) must survive the agent's answer to
+    the older one — an unconditional clear would erase it unseen and the
+    new verifier's await would time out on a healthy node."""
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.drain import state as drain_state
+
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    fake_kube.add_node("n0", {"pool": "tpu", SLICE_ID_LABEL: "s1"})
+    drain_state.set_cc_state_label(fake_kube, "n0", "on")
+    mgr = CCManager(fake_kube, backend, "n0", evict_components=False,
+                    smoke_workload="none")
+    multislice.issue_pool_challenges(fake_kube, POOL)
+    stale_snapshot = fake_kube.get_node("n0")  # agent read N1 here
+    # Second verifier round lands while the agent is mid-answer.
+    newer = multislice.issue_pool_challenges(fake_kube, POOL)
+    mgr._maybe_answer_challenge(stale_snapshot)
+    # N2 survives the answer to N1...
+    assert multislice.challenge_nonce_of(
+        fake_kube.get_node("n0")
+    ) == newer["n0"]
+    # ...and the next watch event answers it.
+    mgr._maybe_answer_challenge(fake_kube.get_node("n0"))
+    pending = multislice.await_challenge_answers(
+        fake_kube, POOL, newer, timeout_s=0.2, poll_interval_s=0.02
+    )
+    assert pending == []
+    # Now fully answered: the annotation is retired.
+    assert multislice.challenge_nonce_of(fake_kube.get_node("n0")) is None
+
+
+def test_missed_challenge_reports_one_problem_not_two(fake_kube):
+    """A replayed quote under a challenge is one defect, reported once —
+    not a 'nonce mismatch' AND a 'not bound to the challenge' pair."""
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    quote = backend.fetch_attestation("old-nonce")
+    from tpu_cc_manager.tpudev.attestation import quote_digest
+
+    problems = multislice._peer_verify_node_quote(
+        "s1", "n0", quote, quote_digest(quote), "on",
+        allow_fake=True, challenge_nonce="fresh-challenge",
+    )
+    assert len(problems) == 1, problems
+    assert "challenge" in problems[0]
+
+
+def test_exp_only_downgrade_logs_once_per_verification(fake_kube, caplog):
+    """The exp-only downgrade is ONE aggregated warning per verification
+    run, not O(pool) identical lines on every plain attest."""
+    import logging
+
+    q = make_quote("s1")
+    for i in range(3):
+        add_attested_node(fake_kube, f"n{i}", "s1", q)
+    with caplog.at_level(logging.WARNING, logger=multislice.__name__):
+        multislice.verify_pool_attestation(
+            fake_kube, POOL, "on", allow_fake=True
+        )
+    downgrades = [r for r in caplog.records if "exp-only" in r.getMessage()]
+    assert len(downgrades) == 1
+    assert "3 node(s)" in downgrades[0].getMessage()
+
+
+def test_await_challenge_answers_rides_out_transient_listing_failures(
+    fake_kube,
+):
+    """One throttle/blip during the bounded wait must not abort the
+    challenged attestation; a permanent failure still raises."""
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    backend = FakeTpuBackend(slice_id="s1", initial_mode="on")
+    add_attested_node(fake_kube, "n0", "s1",
+                      backend.fetch_attestation("stale"))
+    challenges = multislice.issue_pool_challenges(fake_kube, POOL)
+    multislice.publish_quote(
+        fake_kube, "n0", backend.fetch_attestation(challenges["n0"])
+    )
+    real_list = fake_kube.list_nodes
+    blips = {"n": 1}
+
+    def flaky_list(selector=None):
+        if blips["n"] > 0:
+            blips["n"] -= 1
+            raise KubeApiError(429, "throttled", retry_after_s=0.01)
+        return real_list(selector)
+
+    fake_kube.list_nodes = flaky_list
+    try:
+        pending = multislice.await_challenge_answers(
+            fake_kube, POOL, challenges, timeout_s=2.0, poll_interval_s=0.02
+        )
+    finally:
+        fake_kube.list_nodes = real_list
+    assert pending == []
+
+    fake_kube.list_nodes = lambda selector=None: (_ for _ in ()).throw(
+        KubeApiError(403, "forbidden")
+    )
+    try:
+        with pytest.raises(KubeApiError):
+            multislice.await_challenge_answers(
+                fake_kube, POOL, challenges, timeout_s=0.2,
+                poll_interval_s=0.02,
+            )
+    finally:
+        fake_kube.list_nodes = real_list

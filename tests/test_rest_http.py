@@ -121,6 +121,8 @@ class _MockApiserver:
                         ("get", "nodes"), ("list", "nodes"),
                         ("watch", "nodes"), ("patch", "nodes"),
                         ("list", "pods"), ("create", "events"),
+                        ("get", "leases"), ("create", "leases"),
+                        ("update", "leases"), ("delete", "leases"),
                     }
                     return self._json({"status": {"allowed": allowed}}, 201)
                 if u.path.endswith("/events"):

@@ -1,0 +1,1026 @@
+"""Crash-safe rollouts: lease fencing, checkpointed records, resume.
+
+The acceptance bar (ISSUE 4): across seeded orchestrator deaths a
+successor resumes from the persisted record and converges the pool with
+ZERO double-bounced groups, and a deliberately stale (fenced-out)
+orchestrator's write is refused. Both are asserted here, in tier-1.
+"""
+
+import threading
+
+import pytest
+
+from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.faults.plan import FaultPlan, OrchestratorKilled
+from tpu_cc_manager.kubeclient.api import KubeApiError, node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    QUARANTINED_LABEL,
+    STATE_FAILED,
+)
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+POOL = "pool=tpu"
+NS = "tpu-operator"
+
+
+class Clock:
+    """Injectable wall/monotonic clock for deterministic lease expiry."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def add_pool(fake, n=4, slice_map=None):
+    for i in range(n):
+        labels = {"pool": "tpu"}
+        if slice_map and i in slice_map:
+            labels["cloud.google.com/tpu-slice-id"] = slice_map[i]
+        fake.add_node(f"node-{i}", labels)
+
+
+def agent_simulator(fake, fail_nodes=(), converge_counts=None):
+    """Emulate per-node agents, counting how often each node actually
+    reconciles — the double-bounce detector."""
+    in_flight = set()
+
+    def reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired and name not in in_flight:
+            in_flight.add(name)
+            if converge_counts is not None:
+                converge_counts[name] = converge_counts.get(name, 0) + 1
+
+            def fire():
+                target = STATE_FAILED if name in fail_nodes else desired
+                in_flight.discard(name)
+                fake.set_node_label(name, CC_MODE_STATE_LABEL, target)
+
+            t = threading.Timer(0.03, fire)
+            t.daemon = True
+            t.start()
+
+    fake.add_patch_reactor(reactor)
+
+
+def make_lease(fake, holder, clk, metrics=None, duration_s=30.0):
+    return rollout_state.RolloutLease(
+        fake, holder=holder, namespace=NS, duration_s=duration_s,
+        metrics=metrics or MetricsRegistry(), wall=clk, clock=clk,
+    )
+
+
+def make_roller(fake, lease=None, resume_record=None, **kw):
+    kw.setdefault("node_timeout_s", 5)
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("metrics", MetricsRegistry())
+    return RollingReconfigurator(
+        fake, POOL, lease=lease, resume_record=resume_record, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lease mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_lease_is_single_writer(fake_kube):
+    clk = Clock()
+    a = make_lease(fake_kube, "orch-a", clk)
+    assert a.acquire() is None
+    assert a.generation == 1
+    b = make_lease(fake_kube, "orch-b", clk)
+    with pytest.raises(rollout_state.LeaseHeld):
+        b.acquire()
+    # Released cleanly -> immediately claimable, fencing token moves on.
+    a.release()
+    assert b.acquire() is None
+    assert b.generation == 2
+
+
+def test_expired_lease_is_taken_over_with_higher_generation(fake_kube):
+    clk = Clock()
+    a = make_lease(fake_kube, "orch-a", clk, duration_s=10)
+    a.acquire()
+    clk.advance(11)  # orch-a died; its hold lapsed
+    b = make_lease(fake_kube, "orch-b", clk, duration_s=10)
+    b.acquire()
+    assert b.generation == 2
+    assert b.valid
+
+
+def test_stale_orchestrator_writes_are_refused(fake_kube):
+    """The fencing property itself: a paused pre-crash orchestrator whose
+    lease a successor took over gets RolloutFenced on every write, the
+    refusal is counted, and the pool never sees the stale patch."""
+    fake_kube.add_node("node-0", {"pool": "tpu"})
+    wall = Clock()
+    a_clock = Clock()  # orch-a's process clock FREEZES (suspended VM)
+    metrics = MetricsRegistry()
+    a = rollout_state.RolloutLease(
+        fake_kube, holder="orch-a", namespace=NS, duration_s=10,
+        metrics=metrics, wall=wall, clock=a_clock,
+    )
+    a.acquire()
+    fenced_api = rollout_state.FencedKube(fake_kube, a, metrics=metrics)
+    fenced_api.patch_node_labels("node-0", {CC_MODE_LABEL: "on"})  # live: ok
+    wall.advance(11)  # real time passes; orch-a's clock does not
+    b = make_lease(fake_kube, "orch-b", wall, duration_s=10)
+    b.acquire()
+    assert a.valid  # orch-a still BELIEVES it holds the lease...
+    with pytest.raises(rollout_state.RolloutFenced):
+        # ...but its next write CAS-discovers the takeover and is refused.
+        a.checkpoint()
+    with pytest.raises(rollout_state.RolloutFenced):
+        fenced_api.patch_node_labels("node-0", {CC_MODE_LABEL: "off"})
+    assert metrics.rollout_totals()["fenced_writes"] == 1
+    # The stale patch never reached the pool.
+    assert node_labels(fake_kube.get_node("node-0"))[CC_MODE_LABEL] == "on"
+
+
+def test_lease_local_expiry_fences_without_apiserver(fake_kube):
+    """A holder that slept past its own duration must refuse writes even
+    BEFORE any CAS disproves it — the successor may already be flipping
+    nodes."""
+    clk = Clock()
+    a = make_lease(fake_kube, "orch-a", clk, duration_s=10)
+    a.acquire()
+    clk.advance(11)
+    assert not a.valid
+    with pytest.raises(rollout_state.RolloutFenced):
+        a.check()
+
+
+def test_checkpoint_survives_own_ambiguous_write(fake_kube):
+    """A 409 caused by our OWN earlier write landing (retry after an
+    ambiguous failure) must re-adopt, not self-fence."""
+    clk = Clock()
+    a = make_lease(fake_kube, "orch-a", clk)
+    a.acquire()
+    # Simulate the ambiguity: the stored lease advanced (our write landed)
+    # while our in-memory copy still has the old resourceVersion.
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    stored["spec"]["renewTime"] = rollout_state._now_rfc3339(clk)
+    fake_kube.update_lease(NS, rollout_state.LEASE_NAME, stored)
+    a.checkpoint()  # 409 -> re-read -> still our holder -> adopt
+    assert a.valid
+
+
+def test_checkpoint_conflicting_with_own_renew_still_persists_record(
+    fake_kube,
+):
+    """The renewer-race case: a bare renew CASes the lease between the
+    checkpointing thread's read and write. Resolving the 409 as
+    'still ours' must RETRY the record write, not adopt-and-drop it — a
+    dropped window-boundary checkpoint means a successor resumes from a
+    stale record and re-bounces converged groups."""
+    clk = Clock()
+    a = make_lease(fake_kube, "orch-a", clk)
+    a.acquire()
+    import copy as _copy
+
+    before_renew = _copy.deepcopy(a._lease)
+    a.renew()  # what the renewer thread does: bumps the stored rv
+    a._lease = before_renew  # checkpointing thread read BEFORE the renew
+    rec = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[("g", ("node-0",))],
+    )
+    rec.note_group("g", ok=True, states={"node-0": "on"}, seconds=1.0)
+    a.checkpoint(rec)  # 409 -> still ours -> RETRY this write
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    back = rollout_state.record_of_lease(stored)
+    assert back is not None and back.done["g"]["ok"] is True
+    # Same for the final clear: a conflicted clear_record must not leave
+    # the stale record behind.
+    before_renew = _copy.deepcopy(a._lease)
+    a.renew()
+    a._lease = before_renew
+    a.checkpoint(clear_record=True)
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert rollout_state.record_of_lease(stored) is None
+
+
+def test_record_round_trip():
+    rec = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=3,
+        groups=[("s1", ("n0", "n1")), ("node/n2", ("n2",))],
+        failure_budget=2,
+    )
+    rec.note_group("s1", ok=True, states={"n0": "on", "n1": "on"}, seconds=1.5)
+    rec.charge_budget(["n2"])
+    back = rollout_state.RolloutRecord.from_json(rec.to_json())
+    assert back.groups == rec.groups
+    assert back.done["s1"]["ok"] is True
+    assert back.budget_spend == ["n2"]
+    assert back.failure_budget == 2
+    with pytest.raises(rollout_state.RolloutFenced):
+        rollout_state.RolloutRecord.from_json("{not json")
+
+
+# ---------------------------------------------------------------------------
+# Resumable rollouts
+# ---------------------------------------------------------------------------
+
+
+def test_fenced_rollout_checkpoints_and_stamps_generation(fake_kube):
+    add_pool(fake_kube, 2)
+    counts = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    clk = Clock()
+    lease = make_lease(fake_kube, "orch-a", clk)
+    lease.acquire()
+    result = make_roller(fake_kube, lease=lease).rollout("on")
+    assert result.ok and result.generation == 1
+    for i in range(2):
+        labels = node_labels(fake_kube.get_node(f"node-{i}"))
+        assert labels[rollout_state.ROLLOUT_GEN_LABEL] == "1"
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    record = rollout_state.record_of_lease(stored)
+    assert record.status == rollout_state.RECORD_COMPLETE
+    assert len(record.done) == 2 and all(
+        d["ok"] for d in record.done.values()
+    )
+
+
+def _run_crash_resume(kill_at: int):
+    """One crash/resume cycle: orchestrator A is SIGKILLed at the
+    ``kill_at``-th crash point (no cleanup, lease not released), successor
+    B takes over after lease expiry and resumes from the checkpoint.
+    Returns (killed, counts, result, fake)."""
+    fake = FakeKube()
+    add_pool(fake, 4, slice_map={0: "s1", 1: "s1"})  # s1 + 2 singles
+    counts: dict = {}
+    agent_simulator(fake, converge_counts=counts)
+    clk = Clock()
+    metrics = MetricsRegistry()
+    hook_calls = {"n": 0}
+
+    def killer(point):
+        if hook_calls["n"] == kill_at:
+            raise OrchestratorKilled(point, hook_calls["n"])
+        hook_calls["n"] += 1
+
+    lease_a = make_lease(fake, "orch-a", clk, metrics=metrics, duration_s=30)
+    lease_a.acquire()
+    roller_a = make_roller(fake, lease=lease_a, crash_hook=killer)
+    killed = False
+    try:
+        result = roller_a.rollout("on")
+    except OrchestratorKilled:
+        killed = True
+        # SIGKILL semantics: nothing released, nothing finalized.
+        clk.advance(31)  # the dead orchestrator's lease lapses
+        lease_b = make_lease(
+            fake, "orch-b", clk, metrics=metrics, duration_s=30
+        )
+        record = lease_b.acquire()
+        assert record is not None, "no resumable record after the kill"
+        assert record.status == rollout_state.RECORD_IN_PROGRESS
+        roller_b = make_roller(
+            fake, lease=lease_b, resume_record=record, metrics=metrics
+        )
+        result = roller_b.rollout(record.mode)
+        assert result.resumed is True
+        assert result.generation == 2
+        assert metrics.rollout_totals()["resumes"] == 1
+    return killed, counts, result, fake
+
+
+def test_successor_converges_after_kill_at_every_crash_point():
+    """The ISSUE's property test: kill the orchestrator at EVERY crash
+    point (checkpoint boundaries, inside windows, between windows) in
+    turn; the successor must converge the pool with each node bounced
+    exactly once and no group dropped."""
+    exhausted = False
+    for kill_at in range(32):
+        killed, counts, result, fake = _run_crash_resume(kill_at)
+        assert result.ok, f"kill_at={kill_at}: successor did not converge"
+        for i in range(4):
+            name = f"node-{i}"
+            labels = node_labels(fake.get_node(name))
+            assert labels[CC_MODE_STATE_LABEL] == "on", f"kill_at={kill_at}"
+            assert counts.get(name) == 1, (
+                f"kill_at={kill_at}: {name} reconciled {counts.get(name)} "
+                "times (must be exactly once — no double bounce)"
+            )
+        if not killed:
+            exhausted = True  # ran past the last crash point: all covered
+            break
+    assert exhausted, "never exhausted the crash points; raise the range"
+
+
+def test_resume_skips_done_groups_without_relisting_their_state(fake_kube):
+    """A resumed record's converged groups are skipped on the record's
+    say-so: no desired-label rewrite at the new generation, no await."""
+    add_pool(fake_kube, 3)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    clk = Clock()
+    lease_a = make_lease(fake_kube, "orch-a", clk, duration_s=30)
+    lease_a.acquire()
+
+    def kill_after_first_boundary(point):
+        if point == "window-boundary":
+            raise OrchestratorKilled(point, 0)
+
+    with pytest.raises(OrchestratorKilled):
+        make_roller(
+            fake_kube, lease=lease_a, crash_hook=kill_after_first_boundary
+        ).rollout("on")
+    clk.advance(31)
+    lease_b = make_lease(fake_kube, "orch-b", clk, duration_s=30)
+    record = lease_b.acquire()
+    assert set(record.done) == {"node/node-0"}
+    result = make_roller(
+        fake_kube, lease=lease_b, resume_record=record
+    ).rollout("on")
+    assert result.ok
+    by_group = {g.group: g for g in result.groups}
+    assert by_group["node/node-0"].skipped is True
+    # node-0 kept generation 1: the successor never re-patched it.
+    labels = node_labels(fake_kube.get_node("node-0"))
+    assert labels[rollout_state.ROLLOUT_GEN_LABEL] == "1"
+    assert node_labels(fake_kube.get_node("node-2"))[
+        rollout_state.ROLLOUT_GEN_LABEL
+    ] == "2"
+    assert counts == {"node-0": 1, "node-1": 1, "node-2": 1}
+
+
+def test_resume_preserves_failure_budget_spend(fake_kube):
+    """Pre-crash failures still count: a node that failed under the dead
+    orchestrator stays charged against --failure-budget in the successor,
+    so one more bleeding node halts a resumed rollout that a fresh one
+    would have accepted."""
+    add_pool(fake_kube, 4)
+    fails = {"node-1"}
+    agent_simulator(fake_kube, fail_nodes=fails)
+    clk = Clock()
+    lease_a = make_lease(fake_kube, "orch-a", clk, duration_s=30)
+    lease_a.acquire()
+    first = make_roller(
+        fake_kube, lease=lease_a, failure_budget=1
+    ).rollout("on")
+    assert first.ok is False  # halted on node-1's failure
+    lease_a.release()  # keep the record (halted), release the hold
+
+    # The operator fixes node-1 but ANOTHER node gets quarantined.
+    fails.clear()
+    fake_kube.set_node_label("node-3", QUARANTINED_LABEL, "true")
+
+    lease_b = make_lease(fake_kube, "orch-b", clk, duration_s=30)
+    record = lease_b.acquire()
+    assert record is not None and record.budget_spend == ["node-1"]
+    resumed = make_roller(
+        fake_kube, lease=lease_b, resume_record=record, failure_budget=1
+    ).rollout("on")
+    # spend = pre-crash failure (node-1) + fresh quarantine (node-3) = 2 > 1.
+    assert resumed.halted_reason == "failure-budget-exceeded"
+    lease_b.release()
+
+    # Control: WITHOUT the persisted spend the same pool passes the budget
+    # (only node-3 is quarantined) — the halt above really came from the
+    # pre-crash charge.
+    lease_c = make_lease(fake_kube, "orch-c", clk, duration_s=30)
+    lease_c.acquire()
+    fresh = make_roller(
+        fake_kube, lease=lease_c, failure_budget=1
+    ).rollout("on")
+    assert fresh.halted_reason is None
+
+
+def test_resume_recomputes_quarantine_fresh(fake_kube):
+    """Quarantined-node skips are recomputed at resume time: a node
+    quarantined AFTER the crash is skipped even though the record
+    predates its quarantine."""
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube)
+    clk = Clock()
+    lease_a = make_lease(fake_kube, "orch-a", clk, duration_s=30)
+    lease_a.acquire()
+
+    def kill_at_first_boundary(point):
+        if point == "window-boundary":
+            raise OrchestratorKilled(point, 0)
+
+    with pytest.raises(OrchestratorKilled):
+        make_roller(
+            fake_kube, lease=lease_a, crash_hook=kill_at_first_boundary
+        ).rollout("on")
+    fake_kube.set_node_label("node-2", QUARANTINED_LABEL, "true")
+    clk.advance(31)
+    lease_b = make_lease(fake_kube, "orch-b", clk, duration_s=30)
+    record = lease_b.acquire()
+    result = make_roller(
+        fake_kube, lease=lease_b, resume_record=record
+    ).rollout("on")
+    assert result.ok
+    assert {g.group for g in result.groups} == {
+        "node/node-0", "node/node-1"
+    }
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("node-2"))
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos: orchestrator kills from the FaultPlan
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_seeded_orchestrator_kill_soak():
+    """The FaultPlan kill mode end-to-end: seeded SIGKILLs land at crash
+    points across successive orchestrators until the plan's kill budget
+    runs dry; every successor resumes from the checkpoint and the pool
+    converges with zero double-bounced groups. Same seed -> same kill
+    schedule (the chaos reproducibility contract)."""
+    fake = FakeKube()
+    add_pool(fake, 6, slice_map={0: "s1", 1: "s1", 2: "s2", 3: "s2"})
+    counts: dict = {}
+    agent_simulator(fake, converge_counts=counts)
+    clk = Clock()
+    metrics = MetricsRegistry()
+    plan = FaultPlan(seed=20260803, kill_rate=0.6, max_kills=3)
+
+    result = None
+    for attempt in range(16):
+        lease = make_lease(
+            fake, f"orch-{attempt}", clk, metrics=metrics, duration_s=30
+        )
+        record = lease.acquire()
+        roller = make_roller(
+            fake, lease=lease,
+            resume_record=(
+                record
+                if record is not None
+                and record.status == rollout_state.RECORD_IN_PROGRESS
+                else None
+            ),
+            metrics=metrics, crash_hook=plan.decide_orchestrator_kill,
+        )
+        try:
+            result = roller.rollout("slice")
+            lease.release(clear_record=result.ok)
+            break
+        except OrchestratorKilled:
+            clk.advance(31)  # SIGKILL: no release; wait out the lease
+    assert result is not None and result.ok
+    kills = [f for f in plan.injected if f.kind == "orch-kill"]
+    assert kills, "seed produced no kills; pick a different seed"
+    for i in range(6):
+        assert counts.get(f"node-{i}") == 1, (
+            f"node-{i} bounced {counts.get(f'node-{i}')} times under kills "
+            f"at {[f.op for f in kills]}"
+        )
+    assert metrics.rollout_totals()["lease_transitions"] == len(kills) + 1
+    assert metrics.rollout_totals()["resumes"] == len(kills)
+
+
+@pytest.mark.chaos
+def test_kill_schedule_is_seed_deterministic():
+    """Same seed + same call sequence -> the kill lands at the same
+    decision index; a different seed reshuffles it."""
+
+    def schedule(seed):
+        plan = FaultPlan(seed=seed, kill_rate=0.5, max_kills=2)
+        out = []
+        for i in range(40):
+            try:
+                plan.decide_orchestrator_kill(f"p{i}")
+            except OrchestratorKilled as k:
+                out.append((k.point, k.seq))
+        return out
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7), "seed 7 produced no kills in 40 points"
+
+
+# ---------------------------------------------------------------------------
+# ctl plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_ctl_rollout_resume_and_status(fake_kube, capsys):
+    """`ctl rollout` acquires the lease; a crashed run leaves a record
+    that `ctl status` surfaces and a plain re-run auto-resumes."""
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    add_pool(fake_kube, 2)
+    agent_simulator(fake_kube)
+
+    def ns(**kw):
+        base = dict(
+            selector=POOL, mode="on", max_unavailable=1, node_timeout=5.0,
+            continue_on_failure=False, rollback_on_failure=False,
+            failure_budget=None, resume=False, abort_rollout=False,
+            no_lease=False, lease_duration=30.0, lease_namespace=NS,
+        )
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    # Seed a dead orchestrator's record + expired lease by hand.
+    clk = Clock()
+    lease = make_lease(fake_kube, "orch-dead", clk, duration_s=0.001)
+    lease.acquire()
+    rec = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[("node/node-0", ("node-0",)), ("node/node-1", ("node-1",))],
+    )
+    lease.checkpoint(rec)
+
+    import os
+    os.environ["CC_ROLLOUT_LEASE_NAMESPACE"] = NS
+    try:
+        rc = ctl.cmd_status(fake_kube, ns())
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ROLLOUT" in out and "orch-dead" in out
+        assert "groups=0/2 done" in out and "EXPIRED (resumable)" in out
+
+        import time as _time
+        _time.sleep(0.01)  # the dead holder's 1ms lease lapses in real time
+        rc = ctl.cmd_rollout(fake_kube, ns())
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert '"resumed": true' in out
+        # Finished: the record is cleared, the lease released.
+        stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+        assert rollout_state.record_of_lease(stored) is None
+        assert (stored["spec"].get("holderIdentity") or "") == ""
+        # A released, record-less leftover lease must NOT keep printing a
+        # ROLLOUT header on every status run forever.
+        rc = ctl.cmd_status(fake_kube, ns())
+        assert rc == 0
+        assert "ROLLOUT" not in capsys.readouterr().out
+
+        # --abort on a released lease: record discarded, but the Lease
+        # OBJECT (and its transitions counter) survives so the fencing
+        # generation stays monotonic.
+        rc = ctl.cmd_rollout(fake_kube, ns(abort_rollout=True))
+        assert rc == 0
+        stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+        assert (stored["spec"].get("holderIdentity") or "") == ""
+        assert rollout_state.record_of_lease(stored) is None
+        assert int(stored["spec"]["leaseTransitions"]) >= 2
+    finally:
+        os.environ.pop("CC_ROLLOUT_LEASE_NAMESPACE", None)
+
+
+def test_ctl_rollout_refuses_concurrent_invocation(fake_kube, capsys):
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    import time as _time
+
+    add_pool(fake_kube, 1)
+    # The live holder's renewTime must be fresh in REAL wall time: ctl's
+    # own lease uses time.time to judge expiry.
+    clk = Clock(_time.time())
+    live = make_lease(fake_kube, "orch-live", clk, duration_s=3600)
+    live.acquire()
+    args = argparse.Namespace(
+        selector=POOL, mode="on", max_unavailable=1, node_timeout=5.0,
+        continue_on_failure=False, rollback_on_failure=False,
+        failure_budget=None, resume=False, abort_rollout=False,
+        no_lease=False, lease_duration=30.0, lease_namespace=NS,
+    )
+    assert ctl.cmd_rollout(fake_kube, args) == 1
+    # The live holder's lease was untouched.
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert stored["spec"]["holderIdentity"] == "orch-live"
+
+
+def test_pre_plan_budget_halt_leaves_no_resumable_record(fake_kube):
+    """A fresh rollout halted by the budget BEFORE planning persisted
+    nothing worth resuming: an empty-groups record would make a later
+    --resume no-op with ok=true while no node was ever touched."""
+    add_pool(fake_kube, 2)
+    fake_kube.set_node_label("node-0", QUARANTINED_LABEL, "true")
+    clk = Clock()
+    lease = make_lease(fake_kube, "orch-a", clk)
+    lease.acquire()
+    result = make_roller(
+        fake_kube, lease=lease, failure_budget=0
+    ).rollout("on")
+    assert result.halted_reason == "failure-budget-exceeded"
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert rollout_state.record_of_lease(stored) is None
+    # The same halt on a RESUMED record keeps its (real) plan persisted.
+    rec = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[("node/node-1", ("node-1",))],
+    )
+    halted = make_roller(
+        fake_kube, lease=lease, resume_record=rec, failure_budget=0
+    ).rollout("on")
+    assert halted.halted_reason == "failure-budget-exceeded"
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    back = rollout_state.record_of_lease(stored)
+    assert back is not None and back.groups == [("node/node-1", ("node-1",))]
+    assert back.status == rollout_state.RECORD_HALTED
+
+
+def test_resumed_halted_record_checkpoints_in_progress(fake_kube):
+    """Resuming a halted record flips its persisted status back to
+    in-progress, so a crash of the RESUMED run is itself auto-resumable
+    (auto-resume only adopts in-progress records)."""
+    add_pool(fake_kube, 3)
+    fails = {"node-1"}
+    agent_simulator(fake_kube, fail_nodes=fails)
+    clk = Clock()
+    lease_a = make_lease(fake_kube, "orch-a", clk)
+    lease_a.acquire()
+    first = make_roller(fake_kube, lease=lease_a).rollout("on")
+    assert first.ok is False
+    lease_a.release()
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert rollout_state.record_of_lease(stored).status == (
+        rollout_state.RECORD_HALTED
+    )
+    # Operator fixes the node and resumes — but the resumed run is
+    # killed mid-flight. The record it checkpointed must say
+    # in-progress, not the stale halted.
+    fails.clear()
+    lease_b = make_lease(fake_kube, "orch-b", clk)
+    record = lease_b.acquire()
+
+    def kill_at_boundary(point):
+        if point == "window-boundary":
+            raise OrchestratorKilled(point, 0)
+
+    with pytest.raises(OrchestratorKilled):
+        make_roller(
+            fake_kube, lease=lease_b, resume_record=record,
+            crash_hook=kill_at_boundary,
+        ).rollout("on")
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert rollout_state.record_of_lease(stored).status == (
+        rollout_state.RECORD_IN_PROGRESS
+    )
+
+
+def test_corrupt_record_is_a_clean_ctl_error(fake_kube, capsys):
+    """An unreadable checkpointed record must surface as a clean error
+    pointing at --abort, not a RolloutFenced traceback."""
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    add_pool(fake_kube, 1)
+    clk = Clock()
+    seed = make_lease(fake_kube, "orch-dead", clk, duration_s=0.001)
+    seed.acquire()
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    stored["metadata"].setdefault("annotations", {})[
+        rollout_state.RECORD_ANNOTATION
+    ] = "{truncated"
+    fake_kube.update_lease(NS, rollout_state.LEASE_NAME, stored)
+    import time as _time
+    _time.sleep(0.01)  # the seed holder's 1ms lease lapses
+    args = argparse.Namespace(
+        selector=POOL, mode="on", max_unavailable=1, node_timeout=5.0,
+        continue_on_failure=False, rollback_on_failure=False,
+        failure_budget=None, resume=False, abort_rollout=False,
+        no_lease=False, lease_duration=30.0, lease_namespace=NS,
+    )
+    assert ctl.cmd_rollout(fake_kube, args) == 1
+    # --abort is the documented way out.
+    args.abort_rollout = True
+    assert ctl.cmd_rollout(fake_kube, args) == 0
+
+
+def test_resume_restores_persisted_budget_and_concurrency(fake_kube, capsys):
+    """A plain auto-resume must inherit the record's --failure-budget
+    (and max-unavailable): the fleet circuit breaker — with its
+    pre-crash spend — must not vanish because the re-run omitted the
+    flag."""
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube)
+    clk = Clock()
+    seed = make_lease(fake_kube, "orch-dead", clk, duration_s=0.001)
+    seed.acquire()
+    rec = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[(f"node/node-{i}", (f"node-{i}",)) for i in range(3)],
+        budget_spend=["node-9a", "node-9b"],  # two pre-crash charges
+        failure_budget=1, max_unavailable=2,
+    )
+    seed.checkpoint(rec)
+    import time as _time
+    _time.sleep(0.01)
+    args = argparse.Namespace(
+        selector=POOL, mode="on",
+        max_unavailable=None,  # flags omitted on the re-run: the
+        failure_budget=None,   # record's persisted settings must apply
+        node_timeout=5.0,
+        continue_on_failure=False, rollback_on_failure=False,
+        resume=False, abort_rollout=False, no_lease=False,
+        lease_duration=30.0, lease_namespace=NS,
+    )
+    import os
+    os.environ["CC_ROLLOUT_LEASE_NAMESPACE"] = NS
+    try:
+        rc = ctl.cmd_rollout(fake_kube, args)
+    finally:
+        os.environ.pop("CC_ROLLOUT_LEASE_NAMESPACE", None)
+    out = capsys.readouterr().out
+    # spend (2 pre-crash charges) > restored budget 1 -> halted, even
+    # though the re-run never passed --failure-budget.
+    assert rc == 1
+    assert '"halted": "failure-budget-exceeded"' in out
+
+
+def test_resume_with_no_lease_is_rejected(fake_kube):
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    args = argparse.Namespace(
+        selector=POOL, mode="on", max_unavailable=1, node_timeout=5.0,
+        continue_on_failure=False, rollback_on_failure=False,
+        failure_budget=None, resume=True, abort_rollout=False,
+        no_lease=True, lease_duration=30.0, lease_namespace=NS,
+    )
+    with pytest.raises(ValueError, match="--no-lease"):
+        ctl.cmd_rollout(fake_kube, args)
+
+
+def test_invalid_mode_does_not_strand_a_held_lease(fake_kube):
+    """A typo'd --mode must fail BEFORE the lease is acquired; otherwise
+    the corrected retry is refused with 'another rollout in progress'
+    for a whole lease duration."""
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    add_pool(fake_kube, 1)
+    args = argparse.Namespace(
+        selector=POOL, mode="onn", max_unavailable=1, node_timeout=5.0,
+        continue_on_failure=False, rollback_on_failure=False,
+        failure_budget=None, resume=False, abort_rollout=False,
+        no_lease=False, lease_duration=30.0, lease_namespace=NS,
+    )
+    with pytest.raises(ValueError, match="invalid CC mode"):
+        ctl.cmd_rollout(fake_kube, args)
+    with pytest.raises(KubeApiError) as exc:
+        fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert exc.value.status == 404  # the lease was never even created
+
+
+def test_unfenced_fallback_on_lease_less_client(fake_kube, capsys):
+    """A client without Lease support degrades to the legacy unfenced
+    rollout instead of crashing."""
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    add_pool(fake_kube, 1)
+    agent_simulator(fake_kube)
+
+    class NoLease(FakeKube):
+        def get_lease(self, namespace, name):
+            raise KubeApiError(None, self.LEASE_UNSUPPORTED)
+
+    api = NoLease()
+    api.add_node("node-0", {"pool": "tpu"})
+    agent_simulator(api)
+    args = argparse.Namespace(
+        selector=POOL, mode="on", max_unavailable=1, node_timeout=5.0,
+        continue_on_failure=False, rollback_on_failure=False,
+        failure_budget=None, resume=False, abort_rollout=False,
+        no_lease=False, lease_duration=30.0, lease_namespace=NS,
+    )
+    assert ctl.cmd_rollout(api, args) == 0
+    assert '"ok": true' in capsys.readouterr().out
+
+
+def test_abort_refuses_live_holder_without_force(fake_kube, capsys):
+    """--abort against a LIVE holder is the split-brain foot-gun the
+    lease exists to prevent: refused without --force; --force fences the
+    wedged holder out (its next write is refused) and keeps the
+    transitions counter monotonic."""
+    import argparse
+    import time as _time
+
+    from tpu_cc_manager import ctl
+
+    clk = Clock(_time.time())  # live in REAL wall time (ctl judges expiry)
+    metrics = MetricsRegistry()
+    wedged = rollout_state.RolloutLease(
+        fake_kube, holder="wedged", namespace=NS, duration_s=3600,
+        metrics=metrics, wall=clk, clock=clk,
+    )
+    wedged.acquire()
+
+    def ns(**kw):
+        base = dict(
+            selector=POOL, mode=None, max_unavailable=None,
+            node_timeout=5.0, continue_on_failure=False,
+            rollback_on_failure=False, failure_budget=None, resume=False,
+            abort_rollout=True, force=False, no_lease=False,
+            lease_duration=30.0, lease_namespace=NS,
+        )
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    assert ctl.cmd_rollout(fake_kube, ns()) == 1  # refused
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert stored["spec"]["holderIdentity"] == "wedged"
+
+    assert ctl.cmd_rollout(fake_kube, ns(force=True)) == 0
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    assert (stored["spec"].get("holderIdentity") or "") == ""
+    # The fenced-out holder's next write is refused (CAS-discovers the
+    # takeover), not silently applied.
+    with pytest.raises(rollout_state.RolloutFenced):
+        wedged.checkpoint()
+    # Generation monotonicity across the abort: the next acquire
+    # continues the counter instead of restarting at 1.
+    nxt = make_lease(fake_kube, "orch-next", Clock())
+    nxt.acquire()
+    assert nxt.generation == 2
+
+
+def test_checkpoint_retries_transients_over_internally_retrying_client(
+    fake_kube,
+):
+    """Production sizing: RestKube never retries the lease PUT, so the
+    checkpoint path must carry its own attempts even when
+    caller_retry_attempts collapses to 1 (retries_internally=True). One
+    connection blip must not abort an otherwise healthy rollout."""
+    add_pool(fake_kube, 2)
+    agent_simulator(fake_kube)
+    fake_kube.retries_internally = True  # what RestKube advertises
+    failures = {"n": 2}
+    real_update = fake_kube.update_lease
+
+    def flaky_update(ns_, name, lease):
+        if failures["n"] > 0:
+            failures["n"] -= 1
+            raise KubeApiError(503, "transient blip")
+        return real_update(ns_, name, lease)
+
+    clk = Clock()
+    lease = make_lease(fake_kube, "orch-a", clk)
+    lease.acquire()
+    fake_kube.update_lease = flaky_update
+    try:
+        result = make_roller(fake_kube, lease=lease).rollout("on")
+    finally:
+        fake_kube.update_lease = real_update
+    assert result.ok is True
+    assert failures["n"] == 0  # the blips were absorbed, not fatal
+
+
+def test_rfc3339_never_emits_seven_digit_micros():
+    """A wall clock within half a microsecond of the next second must
+    carry into the integer second, not emit '.1000000Z' (a real
+    apiserver's MicroTime parser rejects 7-digit fractions)."""
+    stamp = rollout_state._now_rfc3339(lambda: 999.99999996)
+    assert stamp == "1970-01-01T00:16:40.000000Z"
+    back = rollout_state._parse_rfc3339(stamp)
+    assert abs(back - 1000.0) < 1e-6
+
+
+def test_status_honors_lease_namespace_flag(fake_kube, capsys):
+    """A rollout run with --lease-namespace must stay visible to a
+    status invocation passing the same flag."""
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    add_pool(fake_kube, 1)
+    clk = Clock()
+    lease = rollout_state.RolloutLease(
+        fake_kube, holder="orch-a", namespace="custom-ns", duration_s=30,
+        metrics=MetricsRegistry(), wall=clk, clock=clk,
+    )
+    lease.acquire()
+    lease.checkpoint(rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[("node/node-0", ("node-0",))],
+    ))
+    args = argparse.Namespace(selector=POOL, lease_namespace="custom-ns")
+    assert ctl.cmd_status(fake_kube, args) == 0
+    assert "ROLLOUT" in capsys.readouterr().out
+    # Without the flag (default namespace) the lease is elsewhere: no line.
+    args = argparse.Namespace(selector=POOL, lease_namespace=None)
+    assert ctl.cmd_status(fake_kube, args) == 0
+    assert "ROLLOUT" not in capsys.readouterr().out
+
+
+def test_resume_redrive_of_rolled_back_groups(fake_kube):
+    """Rollback amends the checkpoint: a group whose desired label was
+    just REVERTED must not stay done:ok in the record, or a later
+    --resume skips it and reports a half-flipped pool green."""
+    add_pool(fake_kube, 2)
+    for i in range(2):
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_LABEL, "off")
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_STATE_LABEL, "off")
+    fails = {"node-1"}
+    agent_simulator(fake_kube, fail_nodes=fails)
+    clk = Clock()
+    lease_a = make_lease(fake_kube, "orch-a", clk)
+    lease_a.acquire()
+    first = make_roller(
+        fake_kube, lease=lease_a, rollback_on_failure=True
+    ).rollout("on")
+    assert first.ok is False
+    assert [g.group for g in first.rolled_back] == ["node/node-0"]
+    # node-0 was reverted: its desired label is back to 'off'.
+    assert node_labels(fake_kube.get_node("node-0"))[CC_MODE_LABEL] == "off"
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    rec = rollout_state.record_of_lease(stored)
+    assert "node/node-0" not in rec.done  # amended by the rollback
+    lease_a.release()
+
+    fails.clear()
+    lease_b = make_lease(fake_kube, "orch-b", clk)
+    record = lease_b.acquire()
+    resumed = make_roller(
+        fake_kube, lease=lease_b, resume_record=record
+    ).rollout("on")
+    assert resumed.ok is True
+    # The rolled-back group was RE-DRIVEN, not skipped on stale say-so.
+    by_group = {g.group: g for g in resumed.groups}
+    assert by_group["node/node-0"].skipped is False
+    for i in range(2):
+        labels = node_labels(fake_kube.get_node(f"node-{i}"))
+        assert labels[CC_MODE_LABEL] == "on"
+        assert labels[CC_MODE_STATE_LABEL] == "on"
+
+
+def test_crash_mid_rollback_leaves_no_false_done_claims(fake_kube):
+    """The done entries of groups ABOUT to be reverted are popped and
+    checkpointed BEFORE any revert write: an apiserver error (or kill)
+    mid-rollback must not leave a durable record claiming reverted
+    groups converged. The successor re-judges every popped group by the
+    fresh desired==state check: not-yet-reverted groups skip without a
+    bounce, reverted ones are re-driven."""
+    add_pool(fake_kube, 3)
+    for i in range(3):
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_LABEL, "off")
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_STATE_LABEL, "off")
+    fails = {"node-2"}
+    agent_simulator(fake_kube, fail_nodes=fails)
+    clk = Clock()
+    lease_a = make_lease(fake_kube, "orch-a", clk)
+    lease_a.acquire()
+
+    # Rollback reverts newest-first (node-1 then node-0); fail the
+    # SECOND revert write so the rollback dies half-done.
+    real_patch = fake_kube.patch_node_labels
+    state = {"reverts": 0}
+
+    def flaky_patch(name, labels, **kw):
+        if labels.get(CC_MODE_LABEL) == "off":
+            state["reverts"] += 1
+            if state["reverts"] == 2:
+                raise KubeApiError(None, "apiserver died mid-rollback")
+        return real_patch(name, labels, **kw)
+
+    fake_kube.patch_node_labels = flaky_patch
+    try:
+        with pytest.raises(KubeApiError):
+            make_roller(
+                fake_kube, lease=lease_a, rollback_on_failure=True
+            ).rollout("on")
+    finally:
+        fake_kube.patch_node_labels = real_patch
+    # The durable record no longer claims EITHER converged group done.
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    rec = rollout_state.record_of_lease(stored)
+    assert "node/node-0" not in rec.done
+    assert "node/node-1" not in rec.done
+    # node-1 was reverted before the crash; node-0 never was.
+    assert node_labels(fake_kube.get_node("node-1"))[CC_MODE_LABEL] == "off"
+    assert node_labels(fake_kube.get_node("node-0"))[CC_MODE_LABEL] == "on"
+    lease_a.release()
+
+    fails.clear()
+    lease_b = make_lease(fake_kube, "orch-b", clk)
+    record = lease_b.acquire()
+    resumed = make_roller(
+        fake_kube, lease=lease_b, resume_record=record
+    ).rollout("on")
+    assert resumed.ok is True
+    for i in range(3):
+        labels = node_labels(fake_kube.get_node(f"node-{i}"))
+        assert labels[CC_MODE_LABEL] == "on"
+        assert labels[CC_MODE_STATE_LABEL] == "on"

@@ -226,6 +226,10 @@ class CCManager:
         self.remediation = remediation
         # Event dedup state (see _emit_node_event).
         self._last_event_key: tuple[str, str, str] | None = None
+        # Verifier-challenge re-attestation (multislice.py): the last
+        # challenge nonce this agent answered, so the MODIFIED event our
+        # own answer generates doesn't loop into another answer.
+        self._answered_challenge_nonce: str | None = None
 
     # ------------------------------------------------------------------
     # Label plumbing
@@ -325,6 +329,14 @@ class CCManager:
                 sp.set_attribute("ok", ok)
                 if not ok:
                     sp.status = trace_mod.STATUS_ERROR
+                if ok:
+                    # A reconcile republishes the quote under a fresh
+                    # self-chosen nonce, so any verifier challenge this
+                    # agent answered earlier is no longer reflected in
+                    # the published evidence — forget the answer marker
+                    # so a still-outstanding challenge is re-answered on
+                    # the next watch event.
+                    self._answered_challenge_nonce = None
                 return ok
         finally:
             self.reconciling = False
@@ -732,6 +744,69 @@ class CCManager:
         except Exception as e:  # noqa: BLE001 - advisory metadata only
             log.warning("could not publish coordination labels: %s", e)
 
+    def _maybe_answer_challenge(self, node: dict) -> None:
+        """Answer an outstanding verifier challenge (multislice.py,
+        VERDICT weak #5): re-quote bound to the verifier's nonce and
+        republish, giving pool attestation peer-chosen-challenge
+        freshness — a replayed old quote cannot carry a nonce the
+        verifier only just minted. Best-effort like all coordination
+        metadata: an un-answerable challenge (device busy, apiserver
+        flake) is logged and re-attempted on the next watch event, and
+        verification fails loudly on its own timeout."""
+        try:
+            from tpu_cc_manager.ccmanager import multislice
+            from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
+
+            nonce = multislice.challenge_nonce_of(node)
+            if nonce is None or nonce == self._answered_challenge_nonce:
+                return
+            if self.reconciling:
+                return  # the reconcile republishes; answer on the next event
+            state = canonical_mode(
+                node_labels(node).get(CC_MODE_STATE_LABEL) or ""
+            )
+            if state not in VALID_MODES or state == MODE_OFF:
+                # No committed CC mode -> no quote to re-bind. Remember
+                # the nonce so an off node doesn't re-log every event;
+                # verification already treats the node as unattested.
+                log.info(
+                    "challenge %s ignored: no attested mode on this node "
+                    "(state=%r)", nonce[:8], state,
+                )
+                self._answered_challenge_nonce = nonce
+                return
+            topo = self.backend.discover()
+            quote = self.backend.fetch_attestation(nonce)
+            attestation.verify_quote(
+                quote,
+                nonce,
+                expected_mode=state,
+                expected_slice_id=topo.slice_id,
+                debug_policy=(state == MODE_DEVTOOLS),
+                allow_fake=self.allow_fake_quotes,
+            )
+            # strict: a swallowed annotation-patch failure must NOT mark
+            # the challenge answered (the verifier would time out on a
+            # healthy node that never retries) — it raises into the
+            # except below and the next watch event re-answers.
+            multislice.publish_quote(
+                self.api, self.node_name, quote, strict=True
+            )
+            self._answered_challenge_nonce = nonce
+            # Retire the answered challenge so it cannot re-arm after the
+            # next reconcile republishes a self-nonce quote — but only if
+            # it still holds OUR nonce: a newer challenge issued during
+            # the quote fetch must stay for the next event to answer.
+            multislice.retire_answered_challenge(
+                self.api, self.node_name, nonce
+            )
+            log.info(
+                "answered verifier challenge %s… with a re-quote bound to "
+                "it (mode=%s)", nonce[:8], state,
+            )
+        except Exception as e:  # noqa: BLE001 - advisory; next event retries
+            log.warning("could not answer verifier challenge: %s", e)
+
     def _run_smoke(self, workload: str) -> dict:
         if self.smoke_runner is not None:
             return self.smoke_runner(workload)
@@ -834,6 +909,12 @@ class CCManager:
         note_result(self.set_cc_mode(self.with_default(label)))
         self.create_readiness_file()
         last_label_value = label
+        try:
+            # A challenge issued while the agent was down must not wait
+            # for the next label edit to be answered.
+            self._maybe_answer_challenge(self.api.get_node(self.node_name))
+        except KubeApiError as e:
+            log.debug("startup challenge check failed (non-fatal): %s", e)
 
         while not (stop and stop.is_set()):
             timeout = self.watch_timeout_s
@@ -878,6 +959,7 @@ class CCManager:
                         maybe_retry()
                         continue
                     value = node_labels(event.object).get(CC_MODE_LABEL)
+                    self._maybe_answer_challenge(event.object)
                     if value != last_label_value:
                         log.info(
                             "%s changed: %r -> %r",

@@ -14,7 +14,17 @@ for the per-node agents (the DaemonSet) to converge, with:
   (PodDisruptionBudget-style, default 1 — strictly rolling);
 - **failure policy**: a node converging to ``failed`` halts the rollout by
   default (``continue_on_failure`` to override);
-- per-group latency records for the <90 s/node north-star accounting.
+- per-group latency records for the <90 s/node north-star accounting;
+- **crash safety** (ccmanager/rollout_state.py): when constructed with a
+  :class:`~tpu_cc_manager.ccmanager.rollout_state.RolloutLease`, every
+  write is fenced by the lease (a stale orchestrator's patches are
+  refused), desired-mode patches carry the rollout generation, the plan
+  and per-group progress are checkpointed into the lease at every window
+  boundary, and a successor constructed with the persisted
+  ``resume_record`` picks up exactly where a dead orchestrator stopped:
+  converged groups are never re-bounced, pre-crash failures still count
+  against the failure budget, quarantined-node skips are recomputed
+  fresh.
 """
 
 from __future__ import annotations
@@ -38,7 +48,9 @@ from tpu_cc_manager.labels import (
 )
 
 from tpu_cc_manager.labels import SLICE_ID_LABEL  # noqa: F401 - re-export
+from tpu_cc_manager.ccmanager import rollout_state
 from tpu_cc_manager.obs import trace as obs_trace
+from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
@@ -75,6 +87,11 @@ class RolloutResult:
     # for the pool-level circuit breaker; None otherwise — a plain group
     # failure reads from ok/groups as before).
     halted_reason: str | None = None
+    # Crash-safe orchestration (rollout_state.py): whether this run
+    # resumed a dead orchestrator's persisted record, and the fencing
+    # generation its writes carried.
+    resumed: bool = False
+    generation: int | None = None
 
     @property
     def seconds(self) -> float:
@@ -87,6 +104,8 @@ class RolloutResult:
             "mode": self.mode,
             "ok": self.ok,
             "halted": self.halted_reason,
+            "resumed": self.resumed or None,
+            "generation": self.generation,
             "quarantined_skipped": self.skipped_quarantined or None,
             "groups": len(self.groups),
             "skipped_groups": sum(1 for g in self.groups if g.skipped) or None,
@@ -151,7 +170,27 @@ class RollingReconfigurator:
         continue_on_failure: bool = False,
         rollback_on_failure: bool = False,
         failure_budget: int | None = None,
+        lease: "rollout_state.RolloutLease | None" = None,
+        resume_record: "rollout_state.RolloutRecord | None" = None,
+        crash_hook=None,
+        metrics: metrics_mod.MetricsRegistry | None = None,
     ) -> None:
+        # Crash safety: with a lease, every write goes through the fence
+        # (a lost lease refuses further patches) and progress is
+        # checkpointed into the lease at every window boundary so a
+        # successor can resume from ``resume_record``.
+        self.lease = lease
+        if lease is not None:
+            api = rollout_state.FencedKube(api, lease, metrics=metrics)
+        self.resume_record = resume_record
+        self.generation = lease.generation if lease is not None else None
+        # Test/chaos hook fired at named orchestrator crash points
+        # ("planned", "window-start", "mid-window", "awaited",
+        # "window-boundary") — FaultPlan.decide_orchestrator_kill raises
+        # OrchestratorKilled here to model a SIGKILL landing at exactly
+        # that point.
+        self.crash_hook = crash_hook
+        self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
         self.api = api
         self.selector = selector
         self.max_unavailable = max(1, max_unavailable)
@@ -170,6 +209,18 @@ class RollingReconfigurator:
         # exactly one ladder runs per logical call.
         self.retry_policy = retry_mod.RetryPolicy(
             max_attempts=caller_retry_attempts(api),
+            base_delay_s=min(1.0, max(0.01, poll_interval_s)),
+            max_delay_s=max(1.0, poll_interval_s * 4),
+        )
+        # Checkpoints get their OWN attempts regardless of the client's
+        # internal retries: the lease PUT is deliberately never retried
+        # inside RestKube (a blind PUT retry would 409 its own write),
+        # so caller_retry_attempts' collapse-to-1 would leave a single
+        # connection reset aborting the whole rollout. Retrying
+        # checkpoint() itself is safe — its 409 path re-reads and
+        # disambiguates by holder identity.
+        self.checkpoint_policy = retry_mod.RetryPolicy(
+            max_attempts=3,
             base_delay_s=min(1.0, max(0.01, poll_interval_s)),
             max_delay_s=max(1.0, poll_interval_s * 4),
         )
@@ -209,15 +260,49 @@ class RollingReconfigurator:
 
         return quarantined_nodes(listing)
 
-    def _budget_exceeded(self, quarantined: list[str]) -> bool:
-        if self.failure_budget is None or len(quarantined) <= self.failure_budget:
+    def _budget_exceeded(self, spend: list[str]) -> bool:
+        if self.failure_budget is None or len(spend) <= self.failure_budget:
             return False
         log.error(
-            "pool failure budget exceeded: %d node(s) quarantined (%s), "
-            "budget %d — halting rollout (fleet-level circuit breaker)",
-            len(quarantined), quarantined, self.failure_budget,
+            "pool failure budget exceeded: %d node(s) charged "
+            "(quarantined or failed: %s), budget %d — halting rollout "
+            "(fleet-level circuit breaker)",
+            len(spend), spend, self.failure_budget,
         )
         return True
+
+    def _crash_point(self, point: str) -> None:
+        """Named orchestrator crash points for chaos testing: the hook
+        (FaultPlan.decide_orchestrator_kill) may raise OrchestratorKilled
+        here, modeling a SIGKILL that runs no cleanup."""
+        if self.crash_hook is not None:
+            self.crash_hook(point)
+
+    def _checkpoint(self, record, status: str | None = None) -> None:
+        """Persist plan + progress into the lease (one CAS write that also
+        renews it). Transient apiserver failures ride the shared retry
+        policy; a CAS loss raises RolloutFenced — a fenced-out
+        orchestrator must stop, not keep flipping nodes it no longer
+        owns."""
+        if record is None or self.lease is None:
+            return
+        if status is not None:
+            record.status = status
+        self.checkpoint_policy.call(
+            lambda: self.lease.checkpoint(record),
+            op="rollout.checkpoint",
+            classify=classify_kube_error,
+        )
+
+    def _spend(self, record, *extra_sets) -> list[str]:
+        """The failure-budget spend: persisted pre-crash charges plus any
+        freshly observed quarantined/failed sets."""
+        spend: set[str] = set()
+        if record is not None:
+            spend |= set(record.budget_spend)
+        for s in extra_sets:
+            spend |= set(s)
+        return sorted(spend)
 
     def _rollout(self, mode: str) -> RolloutResult:
         listing = self.api.list_nodes(self.selector)
@@ -236,13 +321,64 @@ class RollingReconfigurator:
                 n for n in listing
                 if n["metadata"]["name"] not in quarantined
             ]
-        if self._budget_exceeded(quarantined):
+        record = self.resume_record
+        resumed = record is not None
+        if resumed:
+            # A successor picking up a dead orchestrator's checkpoint:
+            # the PLAN comes from the record (no group bounced twice, no
+            # group silently dropped), budget spend carries over, but
+            # quarantine skips are recomputed fresh — remediation kept
+            # running while the orchestrator was dead.
+            self.metrics.record_rollout_resume()
+            log.warning(
+                "resuming rollout of mode %s (generation %s -> %s): "
+                "%d/%d group(s) already recorded done",
+                record.mode, record.generation, self.generation,
+                len(record.done), len(record.groups),
+            )
+            # A HALTED record being resumed is live again: every mid-
+            # flight checkpoint must say in-progress, or a crash of THIS
+            # run would leave a record the next invocation's auto-resume
+            # refuses (it only adopts in-progress records) — silently
+            # dropping the persisted budget spend and done map.
+            record.status = rollout_state.RECORD_IN_PROGRESS
+            # Re-persist the live settings: a resume that adjusted the
+            # budget/concurrency must hand THOSE to its own successor.
+            record.max_unavailable = self.max_unavailable
+            record.failure_budget = self.failure_budget
+        elif self.lease is not None:
+            record = rollout_state.RolloutRecord(
+                mode=mode, selector=self.selector,
+                generation=self.generation or 0, groups=[],
+                max_unavailable=self.max_unavailable,
+                failure_budget=self.failure_budget,
+            )
+        if record is not None:
+            record.charge_budget(quarantined)
+        if self._budget_exceeded(self._spend(record, quarantined)):
+            # Only checkpoint when the record carries a real plan (a
+            # resumed record): a FRESH run halted before planning has
+            # nothing to resume, and persisting its empty-groups record
+            # would make a later --resume no-op with ok=true while no
+            # node was ever reconfigured.
+            if record is not None and record.groups:
+                self._checkpoint(record, status=rollout_state.RECORD_HALTED)
             return RolloutResult(
                 mode=mode, ok=False, groups=[],
                 skipped_quarantined=quarantined,
                 halted_reason="failure-budget-exceeded",
+                resumed=resumed, generation=self.generation,
             )
-        groups = plan_groups(self.api, self.selector, nodes=listing)
+        if resumed:
+            groups = []
+            for gid, names in record.groups:
+                keep = tuple(n for n in names if n not in quarantined)
+                if keep:
+                    groups.append((gid, keep))
+        else:
+            groups = plan_groups(self.api, self.selector, nodes=listing)
+            if record is not None:
+                record.groups = list(groups)
         log.info(
             "rolling %s over %d group(s) (%d node(s)), max_unavailable=%d",
             mode, len(groups),
@@ -253,12 +389,33 @@ class RollingReconfigurator:
         # Idempotent resume (an interrupted rollout re-run must not re-bounce
         # what already converged): groups whose every node already carries
         # BOTH desired=mode and state=mode are recorded as skipped — no
-        # label rewrite, no disruption, no await.
+        # label rewrite, no disruption, no await. A resumed record's done
+        # groups are skipped on the record's say-so alone: their agents
+        # already converged once, and re-awaiting them would re-burn the
+        # node timeout if one has since drifted (drift is a new failure,
+        # surfaced by the NEXT rollout, not silently folded into this one).
         labels_by_name = {
             n["metadata"]["name"]: node_labels(n) for n in listing
         }
         todo: list[tuple[str, tuple[str, ...]]] = []
         for gid, names in groups:
+            done = record.done.get(gid) if resumed else None
+            if done is not None and done.get("ok"):
+                log.info(
+                    "group %s already %s by the interrupted rollout; "
+                    "skipping (no second bounce)",
+                    gid, "skipped" if done.get("skipped") else "converged",
+                )
+                results.append(GroupResult(
+                    group=gid, nodes=names, ok=True, seconds=0.0,
+                    states={n: mode for n in names}, skipped=True,
+                ))
+                continue
+            if done is not None:
+                # A group the dead orchestrator saw FAIL: re-drive it (the
+                # operator re-ran the rollout on purpose), but its failed
+                # nodes stay charged against the budget.
+                record.done.pop(gid, None)
             if all(
                 labels_by_name.get(n, {}).get(CC_MODE_LABEL) == mode
                 and labels_by_name.get(n, {}).get(CC_MODE_STATE_LABEL) == mode
@@ -269,6 +426,11 @@ class RollingReconfigurator:
                     group=gid, nodes=names, ok=True, seconds=0.0,
                     states={n: mode for n in names}, skipped=True,
                 ))
+                if record is not None:
+                    record.note_group(
+                        gid, ok=True, states={n: mode for n in names},
+                        seconds=0.0, skipped=True,
+                    )
             else:
                 todo.append((gid, names))
         groups = todo
@@ -283,6 +445,11 @@ class RollingReconfigurator:
             for _, names in groups:
                 for name in names:
                     prior[name] = labels_by_name.get(name, {}).get(CC_MODE_LABEL)
+        # First durable checkpoint: the full plan exists before any node is
+        # touched, so even a kill INSIDE the first window leaves a
+        # resumable record.
+        self._checkpoint(record)
+        self._crash_point("planned")
         ok = True
         # Strictly bounded concurrency: process in windows of max_unavailable.
         for i in range(0, len(groups), self.max_unavailable):
@@ -290,23 +457,36 @@ class RollingReconfigurator:
                 # Re-check the budget at every window boundary: remediation
                 # ladders run concurrently with the rollout, and a pool
                 # that started bleeding nodes mid-rollout must stop being
-                # reconfigured even though it started healthy.
+                # reconfigured even though it started healthy. The spend
+                # also carries every pre-crash charge from the record — a
+                # node that failed before the orchestrator died still
+                # counts, even if it has since been unquarantined.
                 fresh = self._quarantined_of(self.retry_policy.call(
                     lambda: self.api.list_nodes(self.selector),
                     op="rollout.list_nodes",
                     classify=classify_kube_error,
                 ))
-                if self._budget_exceeded(fresh):
+                if record is not None:
+                    record.charge_budget(fresh)
+                if self._budget_exceeded(
+                    self._spend(record, quarantined, fresh)
+                ):
+                    self._checkpoint(
+                        record, status=rollout_state.RECORD_HALTED
+                    )
                     return RolloutResult(
                         mode=mode, ok=False, groups=results,
                         window_seconds=window_seconds,
                         skipped_quarantined=sorted(set(quarantined) | set(fresh)),
                         halted_reason="failure-budget-exceeded",
+                        resumed=resumed, generation=self.generation,
                     )
             window = groups[i : i + self.max_unavailable]
+            self._crash_point("window-start")
             started = time.monotonic()
             for gid, names in window:
                 self._set_desired(names, mode)
+            self._crash_point("mid-window")
             # Always await the FULL window even after a failure: every group
             # in it already received its desired label and is transitioning —
             # halting without awaiting would report in-flight slices as
@@ -315,28 +495,63 @@ class RollingReconfigurator:
             for gid, names in window:
                 gres = self._await_group(gid, names, mode, started)
                 results.append(gres)
+                if record is not None:
+                    record.note_group(gid, gres.ok, gres.states, gres.seconds)
+                    if not gres.ok:
+                        record.charge_budget(
+                            n for n, s in gres.states.items() if s != mode
+                        )
                 if not gres.ok:
                     ok = False
                     window_failed.append(gid)
             window_seconds.append(time.monotonic() - started)
+            self._crash_point("awaited")
+            self._checkpoint(record)
+            self._crash_point("window-boundary")
             if window_failed and not self.continue_on_failure:
                 log.error(
                     "group(s) %s failed; halting rollout (%d group(s) not "
                     "attempted)", window_failed, len(groups) - i - len(window),
                 )
+                if self.rollback_on_failure and record is not None:
+                    # A rolled-back group is NOT done: its desired label
+                    # is about to be reverted to the pre-rollout mode.
+                    # The done entries are popped and checkpointed BEFORE
+                    # any revert write — a crash mid-rollback must not
+                    # leave a durable record claiming reverted groups
+                    # converged (a later --resume would skip them on the
+                    # record's say-so and report a half-flipped pool
+                    # green). Groups the interrupted rollback never got
+                    # to are re-judged by the successor's fresh
+                    # desired==state idempotency check, which skips them
+                    # without a bounce.
+                    for g in results:
+                        if g.ok and not g.skipped:
+                            record.done.pop(g.group, None)
+                    self._checkpoint(record)
                 rolled_back = (
                     self._rollback(results, prior)
                     if self.rollback_on_failure
                     else []
                 )
+                self._checkpoint(record, status=rollout_state.RECORD_HALTED)
                 return RolloutResult(
                     mode=mode, ok=False, groups=results,
                     window_seconds=window_seconds, rolled_back=rolled_back,
                     skipped_quarantined=quarantined,
+                    resumed=resumed, generation=self.generation,
                 )
+        self._checkpoint(
+            record,
+            status=(
+                rollout_state.RECORD_COMPLETE if ok
+                else rollout_state.RECORD_HALTED
+            ),
+        )
         return RolloutResult(
             mode=mode, ok=ok, groups=results, window_seconds=window_seconds,
             skipped_quarantined=quarantined,
+            resumed=resumed, generation=self.generation,
         )
 
     # -- internals --------------------------------------------------------
@@ -350,9 +565,12 @@ class RollingReconfigurator:
 
         Nodes whose prior label was absent get the label removed; their
         agents re-apply the default mode, which depends on host capability,
-        so convergence is only awaited where the prior mode is known."""
+        so convergence is only awaited where the prior mode is known.
+        Skipped groups are left alone: this rollout never bounced them,
+        so it has no business reverting them (and for record-resumed
+        skips the pre-rollout mode died with the first orchestrator)."""
         rolled_back: list[GroupResult] = []
-        for gres in reversed([g for g in results if g.ok]):
+        for gres in reversed([g for g in results if g.ok and not g.skipped]):
             modes = {prior.get(n) for n in gres.nodes}
             log.warning(
                 "rolling back group %s to prior desired mode(s) %s",
@@ -388,7 +606,14 @@ class RollingReconfigurator:
     def _set_desired(self, names: tuple[str, ...], mode: str) -> None:
         for name in names:
             log.info("setting %s=%s on %s", CC_MODE_LABEL, mode, name)
-            self.api.patch_node_labels(name, {CC_MODE_LABEL: mode})
+            patch: dict = {CC_MODE_LABEL: mode}
+            if self.generation is not None:
+                # Every fenced write records which rollout generation
+                # drove it — a successor (or `tpu-cc-ctl status`) can see
+                # at a glance whether a node's desired mode came from the
+                # live rollout or a fenced-out predecessor.
+                patch[rollout_state.ROLLOUT_GEN_LABEL] = str(self.generation)
+            self.api.patch_node_labels(name, patch)
 
     def _pending_states(self, names: list[str]) -> dict[str, str | None]:
         """Current state-label values for ``names`` from ONE selector

@@ -47,8 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     r = sub.add_parser("rollout", help="rolling CC reconfiguration over a pool")
     r.add_argument("--selector", required=True, help="node label selector, e.g. pool=tpu")
-    r.add_argument("--mode", required=True, help=f"target mode: {VALID_MODES}")
-    r.add_argument("--max-unavailable", type=int, default=1)
+    r.add_argument(
+        "--mode", default=None,
+        help=f"target mode: {VALID_MODES} (optional with --resume, which "
+        "adopts the persisted record's mode)",
+    )
+    r.add_argument(
+        "--max-unavailable", type=int, default=None,
+        help="concurrent group budget (default 1; a resumed rollout "
+        "inherits the record's value unless this flag is passed)",
+    )
     r.add_argument("--node-timeout", type=float, default=600.0)
     r.add_argument("--continue-on-failure", action="store_true")
     r.add_argument(
@@ -59,8 +67,46 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument(
         "--failure-budget", type=int, default=None,
         help="pool failure budget: halt (and refuse to start) when MORE "
-        "than this many nodes are quarantined — a fleet-level circuit "
-        "breaker (default: no budget)",
+        "than this many nodes are quarantined or already failed this "
+        "rollout (pre-crash failures persist in the record) — a "
+        "fleet-level circuit breaker (default: no budget)",
+    )
+    r.add_argument(
+        "--resume", action="store_true",
+        help="resume an interrupted rollout from the record checkpointed "
+        "in the rollout lease (converged groups are never re-bounced; "
+        "also auto-detected when an in-progress record matches this "
+        "invocation)",
+    )
+    r.add_argument(
+        "--abort", dest="abort_rollout", action="store_true",
+        help="release the rollout lease and DISCARD the persisted record "
+        "(the escape hatch for a dead orchestrator's leftovers; safe — "
+        "node agents keep converging on whatever desired labels were "
+        "already written). Refuses a LIVE holder unless --force is also "
+        "given",
+    )
+    r.add_argument(
+        "--force", action="store_true",
+        help="with --abort: fence out a LIVE (wedged) holder — its next "
+        "lease write is refused and it stops. Never use this to jump the "
+        "queue past a healthy rollout",
+    )
+    r.add_argument(
+        "--no-lease", action="store_true",
+        help="run UNFENCED without the single-writer lease/record "
+        "(legacy behavior: no crash resume, concurrent rollouts race)",
+    )
+    r.add_argument(
+        "--lease-duration", type=float, default=None,
+        help="rollout lease duration in seconds (default 15; a dead "
+        "orchestrator's lease becomes claimable this long after its "
+        "last renewal)",
+    )
+    r.add_argument(
+        "--lease-namespace", default=None,
+        help="namespace of the rollout lease (default: "
+        "$CC_ROLLOUT_LEASE_NAMESPACE or tpu-operator)",
     )
 
     a = sub.add_parser("attest", help="verify cross-slice attestation coherence")
@@ -78,9 +124,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="digest-labels-only check (r4 behavior): trusts node-patch "
         "RBAC instead of platform signatures",
     )
+    a.add_argument(
+        "--challenge", action="store_true",
+        help="challenged re-attestation: publish a fresh per-node nonce, "
+        "wait for each agent to re-quote bound to it, then verify — a "
+        "replayed quote that passes every signature check fails this "
+        "path (without it, freshness rests on token exp only)",
+    )
+    a.add_argument(
+        "--challenge-timeout", type=float, default=30.0,
+        help="seconds to wait for agents to answer the challenge before "
+        "verifying (unanswered nodes then fail verification)",
+    )
 
     s = sub.add_parser("status", help="per-node CC state table")
     s.add_argument("--selector", required=True)
+    s.add_argument(
+        "--lease-namespace", default=None,
+        help="where to look for the rollout lease (default: "
+        "$CC_ROLLOUT_LEASE_NAMESPACE or tpu-operator) — pass the same "
+        "value the rollout used or its ROLLOUT line stays invisible",
+    )
 
     q = sub.add_parser(
         "quarantine",
@@ -147,17 +211,223 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def cmd_rollout(api, args) -> int:
-    roller = RollingReconfigurator(
-        api,
-        args.selector,
-        max_unavailable=args.max_unavailable,
-        node_timeout_s=args.node_timeout,
-        continue_on_failure=args.continue_on_failure,
-        rollback_on_failure=args.rollback_on_failure,
-        failure_budget=getattr(args, "failure_budget", None),
+def _abort_rollout(api, namespace: str | None, force: bool = False) -> int:
+    """Release the rollout lease and discard its record. Safe against the
+    pool: desired labels already written stay written and the node agents
+    keep converging on them — aborting only removes the orchestrator-side
+    lock + checkpoint. The lease OBJECT is kept (holder emptied via CAS,
+    not deleted) so ``leaseTransitions`` — the fencing generation — stays
+    monotonic across the abort. A LIVE holder is refused without
+    ``--force``: aborting a healthy rollout opens exactly the concurrent-
+    writer window the lease exists to close."""
+    from tpu_cc_manager.ccmanager import rollout_state
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    namespace = namespace or rollout_state.lease_namespace()
+    try:
+        lease = api.get_lease(namespace, rollout_state.LEASE_NAME)
+    except KubeApiError as e:
+        if e.status == 404:
+            print(f"no rollout lease in {namespace}; nothing to abort")
+            return 0
+        raise
+    holder, alive = rollout_state.lease_holder_alive(lease)
+    if alive and not force:
+        log.error(
+            "the rollout lease is held by a LIVE orchestrator (%s); "
+            "aborting it would let two writers race the same pool. If it "
+            "is wedged, re-run with --force (its next lease write is then "
+            "refused and it stops); otherwise just wait", holder,
+        )
+        return 1
+    rollout_state.release_lease(api, namespace, rollout_state.LEASE_NAME)
+    print(
+        f"rollout lease {namespace}/{rollout_state.LEASE_NAME} "
+        + ("force-released (live holder fenced out)" if alive else "released")
+        + "; persisted record discarded"
     )
-    result = roller.rollout(args.mode)
+    return 0
+
+
+def cmd_rollout(api, args) -> int:
+    from tpu_cc_manager.ccmanager import rollout_state
+    from tpu_cc_manager.kubeclient.api import KubeApiError, is_lease_unsupported
+    from tpu_cc_manager.labels import canonical_mode
+
+    lease_namespace = getattr(args, "lease_namespace", None)
+    if getattr(args, "abort_rollout", False):
+        return _abort_rollout(
+            api, lease_namespace, force=getattr(args, "force", False)
+        )
+    mode = canonical_mode(args.mode) if getattr(args, "mode", None) else None
+    if mode is not None and mode not in VALID_MODES:
+        # Fail BEFORE touching the lease: a typo'd mode must not leave a
+        # held lease behind that blocks the corrected retry for a whole
+        # lease duration.
+        raise ValueError(f"invalid CC mode {mode!r} (valid: {VALID_MODES})")
+    resume_requested = getattr(args, "resume", False)
+    if resume_requested and getattr(args, "no_lease", False):
+        # Contradictory: resume reads the record checkpointed in the
+        # lease the other flag refuses to touch.
+        raise ValueError("--resume cannot be combined with --no-lease")
+    lease = None
+    resume_record = None
+    if not getattr(args, "no_lease", False):
+        import os as _os
+        import socket as _socket
+
+        lease = rollout_state.RolloutLease(
+            api,
+            holder=f"{_socket.gethostname()}-{_os.getpid()}",
+            namespace=lease_namespace,
+            duration_s=(
+                getattr(args, "lease_duration", None)
+                or rollout_state.DEFAULT_LEASE_DURATION_S
+            ),
+        )
+        try:
+            record = lease.acquire()
+        except rollout_state.LeaseHeld as e:
+            log.error(
+                "another rollout is already in progress: %s — wait for it "
+                "to finish (or its lease to expire). Only if that holder "
+                "is WEDGED: `tpu-cc-ctl rollout --abort --force` fences it "
+                "out", e,
+            )
+            return 1
+        except rollout_state.RolloutFenced as e:
+            # An unreadable/corrupt checkpointed record (partial write,
+            # manual edit): surface it cleanly with the escape hatch
+            # instead of a traceback.
+            log.error(
+                "rollout record on the lease is unreadable (%s); "
+                "`tpu-cc-ctl rollout --abort` discards it", e,
+            )
+            return 1
+        except KubeApiError as e:
+            if not is_lease_unsupported(e):
+                log.error("could not acquire the rollout lease: %s", e)
+                return 1
+            if resume_requested:
+                # An explicit --resume must not silently degrade into a
+                # fresh unfenced rollout that re-plans from scratch.
+                log.error(
+                    "--resume: this client has no Lease support, so no "
+                    "persisted record can be read"
+                )
+                return 2
+            log.warning(
+                "this client has no Lease support; running UNFENCED "
+                "(no crash resume, concurrent rollouts race)"
+            )
+            lease = None
+            record = None
+        if record is not None:
+            matches = record.selector == args.selector and (
+                mode is None or record.mode == mode
+            )
+            if resume_requested:
+                if record.status == rollout_state.RECORD_COMPLETE:
+                    log.error(
+                        "--resume: the persisted rollout already completed; "
+                        "start a fresh rollout (or --abort to clear)"
+                    )
+                    lease.release()
+                    return 2
+                if not matches:
+                    log.error(
+                        "--resume: persisted record (mode=%s selector=%s) "
+                        "does not match this invocation", record.mode,
+                        record.selector,
+                    )
+                    lease.release()
+                    return 2
+                resume_record = record
+            elif record.status == rollout_state.RECORD_IN_PROGRESS:
+                # Auto-detect a dead orchestrator's unfinished rollout: a
+                # matching invocation resumes it; a mismatched one must
+                # not silently bulldoze a half-flipped pool.
+                if matches:
+                    log.warning(
+                        "found an in-progress rollout record from a dead "
+                        "orchestrator; resuming it (use --abort to discard)"
+                    )
+                    resume_record = record
+                else:
+                    log.error(
+                        "an unfinished rollout record exists (mode=%s "
+                        "selector=%s, %d/%d groups done) and does not match "
+                        "this invocation — resume it with matching "
+                        "arguments, or --abort to discard it",
+                        record.mode, record.selector,
+                        sum(1 for d in record.done.values() if d.get("ok")),
+                        len(record.groups),
+                    )
+                    lease.release()
+                    return 2
+        elif resume_requested and lease is not None:
+            log.error("--resume: no persisted rollout record found")
+            lease.release()
+            return 2
+    failure_budget = getattr(args, "failure_budget", None)
+    # None = flag omitted (the parser's default), distinguishable from an
+    # explicit `--max-unavailable 1`.
+    max_unavailable = getattr(args, "max_unavailable", None)
+    if resume_record is not None:
+        mode = resume_record.mode
+        # The record also carries the dead orchestrator's settings: a
+        # resume that wasn't explicitly re-parameterized must keep them —
+        # above all the failure budget, or the fleet-level circuit
+        # breaker (and its persisted pre-crash spend) silently vanishes
+        # on resume. An explicitly-passed flag still wins.
+        if failure_budget is None:
+            failure_budget = resume_record.failure_budget
+        if max_unavailable is None:
+            max_unavailable = resume_record.max_unavailable
+    if max_unavailable is None:
+        max_unavailable = 1
+    if mode is None:
+        if lease is not None:
+            lease.release()
+        raise ValueError("--mode is required (unless --resume)")
+    if lease is not None:
+        lease.start_renewer()
+    try:
+        roller = RollingReconfigurator(
+            api,
+            args.selector,
+            max_unavailable=max_unavailable,
+            node_timeout_s=args.node_timeout,
+            continue_on_failure=args.continue_on_failure,
+            rollback_on_failure=args.rollback_on_failure,
+            failure_budget=failure_budget,
+            lease=lease,
+            resume_record=resume_record,
+        )
+        result = roller.rollout(mode)
+    except rollout_state.RolloutFenced as e:
+        log.error(
+            "rollout fenced out mid-flight (%s); a successor owns the pool "
+            "now — this process wrote nothing after losing the lease", e,
+        )
+        return 1
+    except BaseException:
+        # Any unexpected failure (usage error, apiserver crash mid-plan,
+        # Ctrl-C) must not strand a held lease that blocks the corrected
+        # retry for a whole lease duration; the checkpointed record (if
+        # any) survives the release for --resume.
+        if lease is not None:
+            lease.release()
+        raise
+    finally:
+        if lease is not None:
+            lease.stop_renewer()
+    if lease is not None:
+        # A finished rollout clears its record (nothing to resume); a
+        # failed/halted one keeps it so `--resume` can pick up after the
+        # operator intervenes — either way the lease itself is released
+        # so the next orchestrator need not wait out the duration.
+        lease.release(clear_record=result.ok)
     print(json.dumps(result.summary()))
     return 0 if result.ok else 1
 
@@ -184,6 +454,29 @@ def cmd_unquarantine(api, args) -> int:
 
 
 def cmd_attest(api, args) -> int:
+    challenges = None
+    if getattr(args, "challenge", False):
+        if getattr(args, "no_verify_signatures", False):
+            # Contradictory: challenge binding is checked inside the
+            # signed quote, which this flag says not to read — reporting
+            # "(challenged re-attestation)" over a digest-labels-only
+            # check would claim replay protection that never ran.
+            raise ValueError(
+                "--challenge cannot be combined with "
+                "--no-verify-signatures (the challenge is verified "
+                "inside the signed quote)"
+            )
+        from tpu_cc_manager.ccmanager import multislice
+
+        challenges = multislice.issue_pool_challenges(api, args.selector)
+        pending = multislice.await_challenge_answers(
+            api, args.selector, challenges,
+            timeout_s=getattr(args, "challenge_timeout", 30.0),
+        )
+        if pending:
+            # Not fatal here: verification below fails the unanswered
+            # nodes with the precise per-node problem.
+            print(f"WARN: challenge unanswered by: {', '.join(pending)}")
     print(pool_report(api, args.selector))
     try:
         verify_pool_attestation(
@@ -191,16 +484,45 @@ def cmd_attest(api, args) -> int:
             expected_slices=args.slices, max_age_s=args.max_age,
             allow_fake=getattr(args, "allow_fake", False),
             verify_signatures=not getattr(args, "no_verify_signatures", False),
+            challenges=challenges,
         )
     except PoolAttestationError as e:
         print(f"FAIL: {e}")
         return 1
-    print("OK: pool attestation coherent")
+    print(
+        "OK: pool attestation coherent"
+        + (" (challenged re-attestation)" if challenges else "")
+    )
     return 0
+
+
+def _rollout_status_line(api, namespace: str | None = None) -> str | None:
+    """The active/resumable rollout, from the lease + checkpointed record
+    (None when there is no lease or the client lacks Lease support)."""
+    from tpu_cc_manager.ccmanager import rollout_state
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    try:
+        lease = api.get_lease(
+            namespace or rollout_state.lease_namespace(),
+            rollout_state.LEASE_NAME,
+        )
+    except KubeApiError:
+        return None
+    try:
+        record = rollout_state.record_of_lease(lease)
+    except rollout_state.RolloutFenced:
+        record = "unreadable"  # still worth showing: --abort clears it
+    if record is None and not (lease.get("spec") or {}).get("holderIdentity"):
+        # A released, record-less lease is just the leftover object of a
+        # finished rollout — nothing active or resumable to report.
+        return None
+    return rollout_state.describe_lease(lease)
 
 
 def cmd_status(api, args) -> int:
     from tpu_cc_manager.ccmanager import remediation as remediation_mod
+    from tpu_cc_manager.ccmanager.rollout_state import ROLLOUT_GEN_LABEL
     from tpu_cc_manager.ccmanager.slicecoord import (
         SLICE_COMMIT_LABEL,
         SLICE_FENCE_LABEL,
@@ -210,6 +532,11 @@ def cmd_status(api, args) -> int:
     from tpu_cc_manager.kubeclient.api import node_annotations
     from tpu_cc_manager.labels import CC_FAILED_REASON_LABEL
 
+    rollout_line = _rollout_status_line(
+        api, getattr(args, "lease_namespace", None)
+    )
+    if rollout_line:
+        print(rollout_line)
     rows = [
         f"{'NODE':<24} {'SLICE':<20} {'DESIRED':<10} {'STATE':<10} "
         f"{'READY':<6} NOTE"
@@ -232,6 +559,8 @@ def cmd_status(api, args) -> int:
             notes.append(f"barrier:fence-gen={labels[SLICE_FENCE_LABEL]}")
         if labels.get(CC_FAILED_REASON_LABEL):
             notes.append(f"reason={labels[CC_FAILED_REASON_LABEL]}")
+        if labels.get(ROLLOUT_GEN_LABEL):
+            notes.append(f"rollout-gen={labels[ROLLOUT_GEN_LABEL]}")
         token = handshake.request_token(
             labels.get(handshake.DRAIN_REQUESTED_LABEL)
         )
@@ -274,6 +603,16 @@ def cmd_rbac_check(api, args) -> int:
         # reported, but a denial doesn't fail the check. Node events live
         # in "default" (cluster-scoped involvedObject).
         ("create", "events", "default", False),
+        # Rollout lease (ccmanager/rollout_state.py): get+create+update
+        # carry acquisition, renewal and the checkpointed record; without
+        # them `ctl rollout` degrades to an unfenced legacy rollout, so
+        # they are reported required — a fleet relying on crash-safe
+        # rollouts must not discover the gap mid-incident. delete is only
+        # the operator's force-release (`rollout --abort`): optional.
+        ("get", "leases", args.namespace, True),
+        ("create", "leases", args.namespace, True),
+        ("update", "leases", args.namespace, True),
+        ("delete", "leases", args.namespace, False),
     ]
     ok = True
     for verb, resource, ns, required in checks:

@@ -20,6 +20,17 @@ pytest marker) and hack/chaos_soak.sh (the longer seeded soak).
 """
 
 from tpu_cc_manager.faults.kube import FaultyKubeClient
-from tpu_cc_manager.faults.plan import CHAOS_SEED_ENV, Fault, FaultPlan
+from tpu_cc_manager.faults.plan import (
+    CHAOS_SEED_ENV,
+    Fault,
+    FaultPlan,
+    OrchestratorKilled,
+)
 
-__all__ = ["CHAOS_SEED_ENV", "Fault", "FaultPlan", "FaultyKubeClient"]
+__all__ = [
+    "CHAOS_SEED_ENV",
+    "Fault",
+    "FaultPlan",
+    "FaultyKubeClient",
+    "OrchestratorKilled",
+]

@@ -117,6 +117,27 @@ class FaultyKubeClient(KubeApi):
         self._maybe_fault("ssar")
         return self.inner.self_subject_access_review(verb, resource, namespace)
 
+    # Lease verbs: faulted like any unary call, so the rollout lease's
+    # acquire/renew/checkpoint paths prove themselves under throttling and
+    # connection resets — a renew loop that dies on one 429 would silently
+    # forfeit the lease mid-rollout.
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        self._maybe_fault("get_lease")
+        return self.inner.get_lease(namespace, name)
+
+    def create_lease(self, namespace: str, name: str, spec: dict) -> dict:
+        self._maybe_fault("create_lease")
+        return self.inner.create_lease(namespace, name, spec)
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        self._maybe_fault("update_lease")
+        return self.inner.update_lease(namespace, name, lease)
+
+    def delete_lease(self, namespace: str, name: str) -> None:
+        self._maybe_fault("delete_lease")
+        return self.inner.delete_lease(namespace, name)
+
     def watch_nodes(
         self,
         name: str,

@@ -20,6 +20,20 @@ from dataclasses import dataclass, field
 
 CHAOS_SEED_ENV = "CC_CHAOS_SEED"
 
+
+class OrchestratorKilled(BaseException):
+    """A seeded SIGKILL of the rolling orchestrator (FaultPlan
+    ``decide_orchestrator_kill``). Derives from BaseException so no
+    except-Exception cleanup path in the orchestrator can swallow it —
+    the whole point is modeling a death that runs NO handlers: the lease
+    is not released, the record not finalized, and the successor must
+    recover from exactly what was durably checkpointed."""
+
+    def __init__(self, point: str, seq: int):
+        super().__init__(f"orchestrator killed at {point} (seq={seq})")
+        self.point = point
+        self.seq = seq
+
 #: Fault kinds the kube wrapper understands.
 KINDS = (
     "http-429",      # throttled, with a Retry-After header
@@ -59,6 +73,11 @@ class FaultPlan:
     # Probability an eligible call gets a fault (split evenly over kinds).
     rate: float = 0.2
     watch_rate: float = 0.3
+    # Probability a crash point kills the orchestrator (0 = kill mode off;
+    # decide_orchestrator_kill). Separate from ``rate``: orchestrator
+    # deaths are rare catastrophic events, not per-call weather.
+    kill_rate: float = 0.0
+    max_kills: int | None = None
     max_faults: int | None = None
     retry_after_s: float = 0.05
     slow_s: float = 0.02
@@ -116,6 +135,27 @@ class FaultPlan:
     def decide_watch(self, op: str = "watch") -> Fault | None:
         """One decision for a watch-stream connect."""
         return self._draw(op, self.watch_rate, WATCH_KINDS)
+
+    def decide_orchestrator_kill(self, point: str) -> None:
+        """One decision per orchestrator crash point (window start, mid-
+        window, checkpoint boundary): with probability ``kill_rate``,
+        raise :class:`OrchestratorKilled` — simulating a SIGKILL landing
+        exactly there. Like every decision, drawn from the single seeded
+        stream (same seed + same call sequence → the kill lands at the
+        same point), and ALWAYS advances the rng even when kill mode is
+        off so enabling kills doesn't reshuffle the other faults'
+        schedule. ``max_kills`` bounds deaths so a soak's final successor
+        gets clean weather to converge in."""
+        self._seq += 1
+        roll = self.rng.random()
+        kills = sum(1 for f in self.injected if f.kind == "orch-kill")
+        if roll >= self.kill_rate or self.exhausted or (
+            self.max_kills is not None and kills >= self.max_kills
+        ):
+            return
+        fault = Fault(kind="orch-kill", op=point, seq=self._seq)
+        self.injected.append(fault)
+        raise OrchestratorKilled(point, self._seq)
 
     def schedule_backend_fault(self, backend, ops: tuple[str, ...]) -> str | None:
         """Optionally arm ONE fault on a fake device backend

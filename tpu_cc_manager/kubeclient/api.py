@@ -84,6 +84,18 @@ def resource_version(obj: dict) -> str:
     return str((obj.get("metadata") or {}).get("resourceVersion") or "")
 
 
+def is_lease_unsupported(e: BaseException) -> bool:
+    """Whether an error is the KubeApi default's lease-unsupported marker
+    (as opposed to a real apiserver failure): callers use it to degrade to
+    an unfenced rollout on minimal clients while still surfacing genuine
+    lease errors."""
+    return (
+        isinstance(e, KubeApiError)
+        and e.status is None
+        and KubeApi.LEASE_UNSUPPORTED in (e.reason or "")
+    )
+
+
 def caller_retry_attempts(api: "KubeApi", default: int = 3) -> int:
     """How many attempts a CALLER-side retry policy should make against
     ``api``: 1 when the client already retries transients internally
@@ -182,6 +194,46 @@ class KubeApi(abc.ABC):
         control-plane state). Not retried on failure: POST is not
         idempotent and a lost event is acceptable."""
         raise KubeApiError(None, "event creation not supported by this client")
+
+    # -- coordination.k8s.io/v1 Leases ---------------------------------
+    #
+    # The single-writer primitive for fleet-scale operations: the rolling
+    # orchestrator holds a Lease while it flips a pool, with the rollout
+    # record checkpointed into the Lease's annotations so a successor can
+    # resume (ccmanager/rollout_state.py). All four verbs are OPTIONAL
+    # capabilities (the defaults raise the LEASE_UNSUPPORTED marker) so
+    # minimal clients degrade to an unfenced legacy rollout instead of
+    # crashing. ``update_lease`` is the optimistic-concurrency hinge:
+    # implementations MUST reject a stale ``metadata.resourceVersion``
+    # with 409 Conflict — that CAS is what makes the fencing token
+    # trustworthy.
+
+    LEASE_UNSUPPORTED = "lease operations not supported by this client"
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        """GET a coordination.k8s.io/v1 Lease (404 if absent)."""
+        raise KubeApiError(None, self.LEASE_UNSUPPORTED)
+
+    def create_lease(self, namespace: str, name: str, spec: dict) -> dict:
+        """POST a new Lease with the given ``spec`` (holderIdentity,
+        leaseDurationSeconds, acquireTime, renewTime, leaseTransitions).
+        Raises 409 AlreadyExists when the Lease exists — the loser of a
+        create race must observe the winner, never overwrite it."""
+        raise KubeApiError(None, self.LEASE_UNSUPPORTED)
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        """PUT the full Lease object back. ``lease`` must carry the
+        ``metadata.resourceVersion`` the caller read; a mismatch with the
+        stored object raises 409 Conflict (optimistic concurrency — the
+        compare-and-swap every lease transition and rollout checkpoint
+        rides on). Never retried internally: a retry after an ambiguous
+        first attempt would 409 against its own write."""
+        raise KubeApiError(None, self.LEASE_UNSUPPORTED)
+
+    def delete_lease(self, namespace: str, name: str) -> None:
+        """DELETE a Lease (404 if absent) — the operator's force-release
+        escape hatch for a wedged rollout lease."""
+        raise KubeApiError(None, self.LEASE_UNSUPPORTED)
 
     def self_subject_access_review(
         self, verb: str, resource: str, namespace: str | None = None

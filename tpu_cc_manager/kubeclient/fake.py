@@ -70,6 +70,7 @@ class FakeKube(KubeApi):
         self._compacted_before = 0  # rvs strictly below this are 410-Gone
         self._nodes: dict[str, dict] = {}
         self._pods: dict[tuple[str, str], dict] = {}  # (namespace, name) -> pod
+        self._leases: dict[tuple[str, str], dict] = {}  # (namespace, name)
         self._node_events: list[tuple[int, WatchEvent]] = []
         self._watch_faults: list[Exception | WatchEvent] = []
         self._patch_reactors: list[Callable[[str, dict], None]] = []
@@ -251,6 +252,63 @@ class FakeKube(KubeApi):
         with self._lock:
             self.events.append({"namespace": namespace, **copy.deepcopy(event)})
             return copy.deepcopy(event)
+
+    # Lease verbs with honest optimistic concurrency: update_lease does a
+    # real resourceVersion compare-and-swap (409 on mismatch), because the
+    # rollout lease's fencing guarantee is only as strong as that CAS.
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            if lease is None:
+                raise KubeApiError(404, f"lease {namespace}/{name} not found")
+            return copy.deepcopy(lease)
+
+    def create_lease(self, namespace: str, name: str, spec: dict) -> dict:
+        with self._lock:
+            if (namespace, name) in self._leases:
+                raise KubeApiError(
+                    409, f"lease {namespace}/{name} already exists"
+                )
+            self._rv += 1
+            lease = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {
+                    "name": name,
+                    "namespace": namespace,
+                    "resourceVersion": str(self._rv),
+                },
+                "spec": copy.deepcopy(dict(spec)),
+            }
+            self._leases[(namespace, name)] = lease
+            return copy.deepcopy(lease)
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        with self._lock:
+            stored = self._leases.get((namespace, name))
+            if stored is None:
+                raise KubeApiError(404, f"lease {namespace}/{name} not found")
+            sent_rv = (lease.get("metadata") or {}).get("resourceVersion")
+            if str(sent_rv) != stored["metadata"]["resourceVersion"]:
+                raise KubeApiError(
+                    409,
+                    f"lease {namespace}/{name}: resourceVersion conflict "
+                    f"(sent {sent_rv}, stored "
+                    f"{stored['metadata']['resourceVersion']})",
+                )
+            self._rv += 1
+            updated = copy.deepcopy(lease)
+            updated["metadata"]["resourceVersion"] = str(self._rv)
+            updated["metadata"]["name"] = name
+            updated["metadata"]["namespace"] = namespace
+            self._leases[(namespace, name)] = updated
+            return copy.deepcopy(updated)
+
+    def delete_lease(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if self._leases.pop((namespace, name), None) is None:
+                raise KubeApiError(404, f"lease {namespace}/{name} not found")
 
     def self_subject_access_review(
         self, verb: str, resource: str, namespace: str | None = None

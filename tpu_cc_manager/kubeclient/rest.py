@@ -335,6 +335,44 @@ class RestKube(KubeApi):
             content_type="application/json",
         )
 
+    # Lease verbs (coordination.k8s.io/v1). GET retries like any read;
+    # POST/PUT/DELETE run exactly one attempt (the idempotent-verb gate in
+    # _request_json): a PUT retried after an ambiguous first attempt would
+    # 409 against its own write, and the lease renew loop is itself the
+    # retry layer.
+
+    @staticmethod
+    def _lease_path(namespace: str, name: str | None = None) -> str:
+        path = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        return f"{path}/{name}" if name else path
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._request_json("GET", self._lease_path(namespace, name))
+
+    def create_lease(self, namespace: str, name: str, spec: dict) -> dict:
+        return self._request_json(
+            "POST",
+            self._lease_path(namespace),
+            body={
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": dict(spec),
+            },
+            content_type="application/json",
+        )
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        return self._request_json(
+            "PUT",
+            self._lease_path(namespace, name),
+            body=lease,
+            content_type="application/json",
+        )
+
+    def delete_lease(self, namespace: str, name: str) -> None:
+        self._request_json("DELETE", self._lease_path(namespace, name))
+
     def self_subject_access_review(
         self, verb: str, resource: str, namespace: str | None = None
     ) -> bool:

@@ -191,6 +191,12 @@ class MetricsRegistry:
         self._quarantined: bool | None = None
         self._remediation_totals: dict[tuple[str, str], int] = {}
         self._barrier_fenced_total = 0
+        # Crash-safe rollout orchestration (ccmanager/rollout_state.py):
+        # resumes from a persisted record, lease acquisitions/takeovers,
+        # and writes refused because the lease was lost (fencing).
+        self._rollout_resumes_total = 0
+        self._rollout_lease_transitions_total = 0
+        self._rollout_fenced_writes_total = 0
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -272,6 +278,32 @@ class MetricsRegistry:
         with self._lock:
             self._barrier_fenced_total += 1
 
+    def record_rollout_resume(self) -> None:
+        """Count one rollout resumed from a persisted record (a successor
+        picking up a dead orchestrator's checkpoint)."""
+        with self._lock:
+            self._rollout_resumes_total += 1
+
+    def record_lease_transition(self) -> None:
+        """Count one rollout-lease acquisition/takeover (the fencing
+        token increments with each)."""
+        with self._lock:
+            self._rollout_lease_transitions_total += 1
+
+    def record_fenced_write(self) -> None:
+        """Count one write REFUSED because the rollout lease was lost
+        (a stale orchestrator's patch stopped by the fence)."""
+        with self._lock:
+            self._rollout_fenced_writes_total += 1
+
+    def rollout_totals(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "resumes": self._rollout_resumes_total,
+                "lease_transitions": self._rollout_lease_transitions_total,
+                "fenced_writes": self._rollout_fenced_writes_total,
+            }
+
     def _accumulate(self, m: ReconcileMetrics) -> None:
         with self._lock:
             self._result_totals[m.result] = self._result_totals.get(m.result, 0) + 1
@@ -335,6 +367,9 @@ class MetricsRegistry:
             quarantined = self._quarantined
             remediation_totals = dict(self._remediation_totals)
             barrier_fenced_total = self._barrier_fenced_total
+            rollout_resumes = self._rollout_resumes_total
+            rollout_transitions = self._rollout_lease_transitions_total
+            rollout_fenced = self._rollout_fenced_writes_total
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -423,6 +458,35 @@ class MetricsRegistry:
             lines.append("# TYPE tpu_cc_barrier_fenced_total counter")
             lines.append(
                 "tpu_cc_barrier_fenced_total %d" % barrier_fenced_total
+            )
+        if rollout_resumes or rollout_transitions or rollout_fenced:
+            lines.append(
+                "# HELP tpu_cc_rollout_resumes_total Pool rollouts resumed "
+                "from a persisted record (a successor picking up a dead "
+                "orchestrator's checkpoint)."
+            )
+            lines.append("# TYPE tpu_cc_rollout_resumes_total counter")
+            lines.append(
+                "tpu_cc_rollout_resumes_total %d" % rollout_resumes
+            )
+            lines.append(
+                "# HELP tpu_cc_rollout_lease_transitions_total Rollout-"
+                "lease acquisitions/takeovers (the fencing token "
+                "increments with each)."
+            )
+            lines.append("# TYPE tpu_cc_rollout_lease_transitions_total counter")
+            lines.append(
+                "tpu_cc_rollout_lease_transitions_total %d"
+                % rollout_transitions
+            )
+            lines.append(
+                "# HELP tpu_cc_rollout_fenced_writes_total Writes refused "
+                "because the rollout lease was lost (stale orchestrator "
+                "stopped by the fence)."
+            )
+            lines.append("# TYPE tpu_cc_rollout_fenced_writes_total counter")
+            lines.append(
+                "tpu_cc_rollout_fenced_writes_total %d" % rollout_fenced
             )
         # The cumulative per-phase sums/counts are served exclusively as
         # the histogram's _sum/_count series below — separate
