@@ -65,7 +65,8 @@ for i in $(seq 0 $((ITERS - 1))); do
   # -q progress dots share the line, so match anywhere, not just column 0.
   summary=$(grep -ao "CHAOS_SOAK_SUMMARY.*" "$log" | tail -1 | sed 's/^CHAOS_SOAK_SUMMARY //')
   remediation=$(grep -ao "REMEDIATION_SUMMARY.*" "$log" | tail -1 | sed "s/^REMEDIATION_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\"}")
+  offline=$(grep -ao "OFFLINE_SUMMARY.*" "$log" | tail -1 | sed "s/^OFFLINE_SUMMARY //; s/'/ /g; s/\"/ /g")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\"}")
 done
 
 {

@@ -246,6 +246,13 @@ def _seeded_registry_text() -> str:
     registry.record_lease_transition()
     registry.record_lease_transition()
     registry.record_fenced_write()
+    # Apiserver-outage autonomy families (ccmanager/intent_journal.py).
+    registry.set_apiserver_connected(False)
+    registry.set_offline_seconds(93.5)
+    registry.record_journal_replay("completed")
+    registry.record_journal_replay("rolled-back")
+    registry.record_journal_replay('odd"outcome\nhere')
+    registry.record_deferred_patch()
     return registry.render_prometheus()
 
 

@@ -25,6 +25,7 @@ STATE_LABEL="cloud.google.com/tpu-cc.mode.state"
 
 cleanup() {
   [ -n "${AGENT_PID:-}" ] && kill "$AGENT_PID" 2>/dev/null || true
+  [ -n "${PROXY_PID:-}" ] && kill "$PROXY_PID" 2>/dev/null || true
   kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true
 }
 trap cleanup EXIT
@@ -180,4 +181,101 @@ effect=$(kubectl get node "$NODE" -o jsonpath\
 kubectl label node "$NODE" "$MODE_LABEL=off" --overwrite
 await_state off
 
-echo ">>> kind integration OK (RBAC incl. taints + leases + real watch + merge-patch + rollout + SIGKILL/resume + quarantine verified)"
+echo ">>> apiserver outage drill: intent journal + disconnected-mode restart"
+# A local TCP proxy in front of the (127.0.0.1-served) kind apiserver is
+# the blackout switch: the agent's kubeconfig dials the proxy, so killing
+# the proxy is a TOTAL outage for the agent while kubectl keeps working.
+PROXY_PORT=$(python3 -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')
+API_HOST_PORT=${SERVER#https://}
+start_proxy() {
+  python3 - "$PROXY_PORT" "${API_HOST_PORT%:*}" "${API_HOST_PORT##*:}" <<'PYEOF' &
+import socket, sys, threading
+lport, host, port = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+srv = socket.socket()
+srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+srv.bind(("127.0.0.1", lport)); srv.listen(64)
+def pump(a, b):
+    try:
+        while True:
+            data = a.recv(65536)
+            if not data:
+                break
+            b.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s in (a, b):
+            try: s.close()
+            except OSError: pass
+def serve(c):
+    try:
+        u = socket.create_connection((host, port), timeout=5)
+    except OSError:
+        c.close(); return
+    threading.Thread(target=pump, args=(c, u), daemon=True).start()
+    threading.Thread(target=pump, args=(u, c), daemon=True).start()
+while True:
+    c, _ = srv.accept()
+    threading.Thread(target=serve, args=(c,), daemon=True).start()
+PYEOF
+  PROXY_PID=$!
+  sleep 1
+}
+start_proxy
+PROXY_KUBECONFIG=$(mktemp)
+sed "s|$SERVER|https://127.0.0.1:$PROXY_PORT|" "$SA_KUBECONFIG" > "$PROXY_KUBECONFIG"
+
+kill "$AGENT_PID" 2>/dev/null || true
+wait "$AGENT_PID" 2>/dev/null || true
+STATE_DIR=$(mktemp -d)
+AGENT_LOG=$(mktemp)
+JOURNALZ_PORT=$(python3 -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')
+start_proxied_agent() {
+  NODE_NAME="$NODE" KUBECONFIG="$PROXY_KUBECONFIG" JAX_PLATFORMS=cpu \
+    PALLAS_AXON_POOL_IPS= CC_READINESS_FILE=$(mktemp -u) \
+    OPERATOR_NAMESPACE="$NS" PYTHONPATH="$REPO" \
+    CC_STATE_DIR="$STATE_DIR" CC_OFFLINE_GRACE_S=2 \
+    CC_METRICS_PORT="$JOURNALZ_PORT" CC_METRICS_BIND=127.0.0.1 \
+    python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none \
+    --debug >> "$AGENT_LOG" 2>&1 &
+  AGENT_PID=$!
+}
+start_proxied_agent
+kubectl label node "$NODE" "$MODE_LABEL=on" --overwrite
+await_state on
+
+echo ">>> blackout: killing the apiserver proxy, flipping the mode unseen"
+kill "$PROXY_PID" 2>/dev/null || true
+wait "$PROXY_PID" 2>/dev/null || true
+kubectl label node "$NODE" "$MODE_LABEL=off" --overwrite   # agent is dark
+sleep 3   # outlast CC_OFFLINE_GRACE_S so disconnected mode engages
+
+echo ">>> SIGKILL the agent; restart it while still dark"
+kill -9 "$AGENT_PID" 2>/dev/null || true
+wait "$AGENT_PID" 2>/dev/null || true
+start_proxied_agent
+sleep 5
+kill -0 "$AGENT_PID" 2>/dev/null || {
+  echo "FAIL: agent did not survive the dark restart (startup GET used to be fatal)"
+  tail -40 "$AGENT_LOG"; exit 1; }
+grep -q "last-known desired mode" "$AGENT_LOG" || {
+  echo "FAIL: restarted agent never reported serving journaled local truth"
+  tail -40 "$AGENT_LOG"; exit 1; }
+[ -s "$STATE_DIR/intent.journal" ] || {
+  echo "FAIL: no intent journal written under $STATE_DIR"; exit 1; }
+
+echo ">>> restoring connectivity; asserting convergence + flushed journal"
+start_proxy
+await_state off
+JOURNALZ=$(PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl journal \
+    --url "http://127.0.0.1:$JOURNALZ_PORT/journalz")
+echo "$JOURNALZ"
+echo "$JOURNALZ" | grep -q "open intents: 0" || {
+  echo "FAIL: intent journal still holds open intents after convergence"
+  exit 1; }
+echo "$JOURNALZ" | grep -q "deferred label patches: 0" || {
+  echo "FAIL: deferred label patches were not flushed after reconnect"
+  exit 1; }
+
+echo ">>> kind integration OK (RBAC incl. taints + leases + real watch + merge-patch + rollout + SIGKILL/resume + quarantine + apiserver-outage drill verified)"

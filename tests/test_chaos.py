@@ -496,3 +496,321 @@ def test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts(
         f"steps={sorted((f'{s}:{o}', c) for (s, o), c in totals.items())} "
         f"quarantines={sum(c for (s, _), c in totals.items() if s == 'quarantine')}"
     )
+
+
+# ---------------------------------------------------------------------------
+# Apiserver-blackout mode: the disconnected-mode ladder + intent journal
+# ---------------------------------------------------------------------------
+
+
+def test_blackout_refuses_every_verb_including_watch(fake_kube):
+    """During a blackout window EVERY verb — watch connects included —
+    refuses with a connection reset (status=None), the signature of a
+    dead apiserver; ending the window restores the inner client."""
+    from tpu_cc_manager.faults.plan import BLACKOUT_KIND
+
+    fake_kube.add_node(NODE)
+    plan = FaultPlan(seed=7, rate=0.0, watch_rate=0.0)
+    api = FaultyKubeClient(fake_kube, plan, sleep=lambda s: None)
+    plan.begin_blackout()
+    for call in (
+        lambda: api.get_node(NODE),
+        lambda: api.patch_node_labels(NODE, {"x": "1"}),
+        lambda: api.list_nodes(),
+        lambda: list(api.watch_nodes(NODE, None, 0)),
+        lambda: api.create_event("default", {}),
+    ):
+        with pytest.raises(KubeApiError) as exc:
+            call()
+        assert exc.value.status is None
+        assert BLACKOUT_KIND in str(exc.value)
+    plan.end_blackout()
+    assert api.get_node(NODE)["metadata"]["name"] == NODE
+    assert plan.blackout_refusals == 5
+
+
+def test_seeded_blackout_windows_are_deterministic_and_bounded():
+    """blackout_rate opens seeded windows of seeded length: same seed →
+    same refusal schedule, and the windows draw from a DERIVED stream so
+    the main per-call fault schedule is not reshuffled."""
+    def refusal_pattern(seed):
+        kube = FakeKube()
+        kube.add_node(NODE)
+        plan = FaultPlan(
+            seed=seed, rate=0.0, watch_rate=0.0,
+            blackout_rate=0.12, blackout_min_calls=2, blackout_max_calls=5,
+        )
+        api = FaultyKubeClient(kube, plan, sleep=lambda s: None)
+        pattern = []
+        for _ in range(120):
+            try:
+                api.get_node(NODE)
+                pattern.append(0)
+            except KubeApiError:
+                pattern.append(1)
+        return pattern, plan
+
+    p1, plan1 = refusal_pattern(31)
+    p2, plan2 = refusal_pattern(31)
+    assert p1 == p2
+    assert plan1.blackout_windows >= 1
+    # Window lengths bounded by the configured span.
+    runs, run = [], 0
+    for bit in p1 + [0]:
+        if bit:
+            run += 1
+        elif run:
+            runs.append(run)
+            run = 0
+    # Each window spans 2..5 calls; adjacent windows may merge into one
+    # longer refusal run, so runs are bounded below by the min span and
+    # never exceed windows*max-span overall.
+    assert runs and all(r >= 2 for r in runs)
+    assert sum(runs) <= plan1.blackout_windows * 5
+    assert len(runs) <= plan1.blackout_windows
+    # The main stream is untouched: a blackout-free plan with the same
+    # seed injects the same (non-blackout) faults on the same calls.
+    base = FaultPlan(seed=31, rate=0.35, watch_rate=0.0)
+    with_blackout = FaultPlan(
+        seed=31, rate=0.35, watch_rate=0.0,
+        blackout_rate=0.12, blackout_min_calls=2, blackout_max_calls=5,
+    )
+
+    def key(f):
+        return None if f is None else (f.kind, f.status)
+
+    base_draws = [key(base.decide("op")) for _ in range(60)]
+    # Blackout refusals DISPLACE main-stream draws (the call never reaches
+    # the apiserver), so the drawn decisions — Nones included — must be a
+    # prefix of the blackout-free plan's draw sequence.
+    overlay_draws = []
+    for _ in range(60):
+        f = with_blackout.decide("op")
+        if f is not None and f.kind == "blackout":
+            continue
+        overlay_draws.append(key(f))
+    assert overlay_draws == base_draws[: len(overlay_draws)]
+
+
+class AgentKilled(BaseException):
+    """Models a SIGKILL landing inside the agent: BaseException so no
+    except-Exception path (the manager's failure handler included) can
+    run 'cleanup' a real SIGKILL would never run — the intent journal's
+    open record and the hardware are all the successor gets."""
+
+
+def test_blackout_sigkill_mid_reset_converges_from_journal_alone(
+    fake_kube, tmp_path,
+):
+    """The apiserver-outage acceptance bar (ISSUE 5): a blackout covers an
+    entire mode transition AND the agent is SIGKILLed right after the
+    device reset commits (before any label write). The restarted agent
+    must converge the hardware from the intent journal alone while still
+    dark — each chip reset exactly ONCE across the crash — and on
+    reconnect the node labels must reach the truthful state with zero
+    lost or duplicated patches."""
+    from tpu_cc_manager.ccmanager.intent_journal import IntentJournal
+
+    plan = FaultPlan(seed=11, rate=0.0, watch_rate=0.0)
+    api = FaultyKubeClient(fake_kube, plan)
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    registry1 = MetricsRegistry()
+    journal1 = IntentJournal.from_state_dir(str(tmp_path))
+
+    mgr1 = CCManager(
+        api=api, backend=backend, node_name=NODE,
+        default_mode=MODE_OFF, evict_components=False,
+        smoke_workload="none", metrics=registry1,
+        watch_timeout_s=1, reconnect_delay_s=0.01,
+        retry_backoff_s=0.02, retry_backoff_max_s=0.2,
+        readiness_file=str(tmp_path / "ready1"),
+        intent_journal=journal1, offline_grace_s=0.05,
+    )
+    stop1 = threading.Event()
+
+    def agent1():
+        try:
+            mgr1.watch_and_apply(stop1)
+        except AgentKilled:
+            pass  # the process is dead; nothing else runs
+
+    t1 = threading.Thread(target=agent1, daemon=True)
+    t1.start()
+    try:
+        fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+        await_state(fake_kube, MODE_ON)
+
+        # Arm the kill: the NEXT reset commits on the device, then the
+        # blackout begins and the SIGKILL lands — intent open at
+        # phase=reset, labels untouched, apiserver dark.
+        real_reset = backend.reset
+
+        def killer_reset(chips):
+            real_reset(chips)
+            plan.begin_blackout()
+            raise AgentKilled()
+
+        backend.reset = killer_reset
+        resets_before = sum(
+            1 for op, _ in backend.op_log if op == "reset"
+        )
+        fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_DEVTOOLS)
+        t1.join(timeout=10)
+        assert not t1.is_alive(), "the modeled SIGKILL never landed"
+    finally:
+        stop1.set()
+        backend.reset = backend.__class__.reset.__get__(backend)
+
+    # Crash truth: the device holds devtools, the journal holds an open
+    # reset-phase intent, the labels still claim the OLD mode.
+    assert all(m == MODE_DEVTOOLS for m in backend.committed.values())
+    open_intents = journal1.open_intents("transition")
+    assert len(open_intents) == 1
+    assert open_intents[0]["phase"] == "reset"
+    assert open_intents[0]["mode"] == MODE_DEVTOOLS
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON  # stale: blackout held it
+
+    # ---- restart while still dark ------------------------------------
+    registry2 = MetricsRegistry()
+    journal2 = IntentJournal.from_state_dir(str(tmp_path))
+    mgr2 = CCManager(
+        api=api, backend=backend, node_name=NODE,
+        default_mode=MODE_OFF, evict_components=False,
+        smoke_workload="none", metrics=registry2,
+        watch_timeout_s=1, reconnect_delay_s=0.01,
+        retry_backoff_s=0.02, retry_backoff_max_s=0.2,
+        readiness_file=str(tmp_path / "ready2"),
+        intent_journal=journal2, offline_grace_s=0.05,
+    )
+    stop2 = threading.Event()
+    t2 = threading.Thread(
+        target=lambda: mgr2.watch_and_apply(stop2), daemon=True
+    )
+    # Record every post-restart write of the state label so "zero lost or
+    # duplicated patches" is checked against actual writes, not just the
+    # final value.
+    state_writes: list[str] = []
+    fake_kube.add_patch_reactor(
+        lambda name, node: state_writes.append(
+            node_labels(node).get(CC_MODE_STATE_LABEL)
+        )
+    )
+    t2.start()
+    try:
+        # While dark: the journal alone converges the node — the open
+        # intent completes against hardware truth with NO second reset,
+        # and the truthful state report queues as a pending patch (wait on
+        # the patch, the LAST step of the recovery, so every assert below
+        # sees the finished recovery).
+        await_cond(
+            lambda: CC_MODE_STATE_LABEL in journal2.pending_patches(),
+            "recovery queued the deferred state report",
+        )
+        assert journal2.pending_patches()[CC_MODE_STATE_LABEL] == MODE_DEVTOOLS
+        assert not journal2.open_intents("transition")
+        resets_after = sum(1 for op, _ in backend.op_log if op == "reset")
+        assert resets_after == resets_before + 1, (
+            "the crashed transition's reset must happen exactly once"
+        )
+        assert registry2.journal_replay_totals().get("completed") == 1
+        assert t2.is_alive(), "agent must ride out the outage, not crash"
+        # Labels are still stale — the apiserver is dark and stays dark.
+        assert node_labels(fake_kube.get_node(NODE))[
+            CC_MODE_STATE_LABEL
+        ] == MODE_ON
+
+        # ---- reconnect ----------------------------------------------
+        plan.end_blackout()
+        await_state(fake_kube, MODE_DEVTOOLS)
+        await_cond(
+            lambda: not journal2.has_pending_patches(),
+            "deferred patches flushed",
+        )
+        labels = node_labels(fake_kube.get_node(NODE))
+        assert labels[CC_READY_STATE_LABEL] == "debug"
+    finally:
+        stop2.set()
+        t2.join(timeout=10)
+    assert not t2.is_alive()
+    # Zero lost or duplicated patches: every post-restart state-label
+    # write carried the truthful mode — no stale value was replayed back
+    # and nothing bounced through 'failed'.
+    assert state_writes, "the deferred state report never flushed"
+    assert set(state_writes) == {MODE_DEVTOOLS}
+    print(
+        "OFFLINE_ACCEPTANCE "
+        f"resets_across_crash=1 replays={registry2.journal_replay_totals()} "
+        f"state_writes={len(state_writes)}"
+    )
+
+
+def test_blackout_soak_serves_last_known_mode_and_flushes(
+    fake_kube, tmp_path,
+):
+    """Seeded blackout windows composed with the ordinary fault weather:
+    the agent (journal + disconnected mode) keeps converging every driven
+    mode; transitions that finish inside a window defer their state
+    report and flush it on reconnect. Prints the OFFLINE_SUMMARY line the
+    chaos soak harness (hack/chaos_soak.sh) records."""
+    from tpu_cc_manager.ccmanager.intent_journal import IntentJournal
+
+    rounds = int(os.environ.get("CC_CHAOS_ROUNDS", "2"))
+    plan = FaultPlan.from_env(
+        rate=0.08, watch_rate=0.1,
+        blackout_rate=0.04, blackout_min_calls=2, blackout_max_calls=6,
+        max_blackouts=2 * rounds, max_faults=20 * rounds,
+        retry_after_s=0.005, slow_s=0.002,
+    )
+    api = FaultyKubeClient(fake_kube, plan)
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    journal = IntentJournal.from_state_dir(str(tmp_path))
+    mgr = CCManager(
+        api=api, backend=backend, node_name=NODE,
+        default_mode=MODE_OFF, evict_components=False,
+        smoke_workload="none", metrics=registry,
+        watch_timeout_s=1, reconnect_delay_s=0.01,
+        retry_backoff_s=0.02, retry_backoff_max_s=0.2,
+        readiness_file=str(tmp_path / "ready"),
+        intent_journal=journal, offline_grace_s=0.05,
+    )
+    stop = threading.Event()
+
+    def agent():
+        while not stop.is_set():
+            try:
+                mgr.watch_and_apply(stop)
+                return
+            except (KubeApiError, RuntimeError):
+                time.sleep(0.01)  # DaemonSet crash-restart semantics
+
+    thread = threading.Thread(target=agent, daemon=True)
+    thread.start()
+    try:
+        # Seed a journal disk fault from the same stream now and then —
+        # the agent must reconcile (unjournaled, loudly) through it.
+        for mode in ([MODE_ON, MODE_OFF, MODE_DEVTOOLS] * rounds) + [MODE_ON]:
+            plan.schedule_journal_fault(journal)
+            fake_kube.set_node_label(NODE, CC_MODE_LABEL, mode)
+            await_state(fake_kube, mode, timeout_s=30.0)
+        await_cond(
+            lambda: not journal.has_pending_patches(),
+            "deferred patches flushed after the blackout weather",
+        )
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    assert not thread.is_alive()
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON
+    assert not journal.open_intents()
+    print(
+        "OFFLINE_SUMMARY "
+        f"seed={plan.seed} windows={plan.blackout_windows} "
+        f"refusals={plan.blackout_refusals} "
+        f"replays={registry.journal_replay_totals()} "
+        f"pending_left={len(journal.pending_patches())}"
+    )

@@ -1,0 +1,868 @@
+"""The node-local intent WAL (ccmanager/intent_journal.py) and the
+manager's disconnected-mode / boot-recovery integration.
+
+Covers, in order:
+
+- journal mechanics: framed append/replay roundtrip, torn-tail
+  truncation, pending-patch merge, compaction;
+- the corruption fuzz property: truncating, bit-flipping, or duplicating
+  records at EVERY byte offset of a valid journal either recovers a
+  consistent prefix or fails closed (JournalCorrupt) — never a
+  half-applied view;
+- replay recovery decisions: complete (hardware holds the mode, no
+  second reset), roll back (crash before reset clears the staging),
+  reset-incomplete (backend crash markers force a clean re-apply);
+- boot ordering: journal → hardware truth → apiserver, with the
+  stale-first-read guard (regression: a blackout ending mid-boot serves
+  one stale label and must not trigger a spurious transition);
+- disconnected mode: engaged-outage state reports defer into the
+  journal, flush idempotently (RMW) on reconnect, and the watchdog's
+  condemn-while-offline rides the same path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager import intent_journal as ij
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.watchdog import RuntimeHealthWatchdog
+from tpu_cc_manager.kubeclient.api import KubeApiError, node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    CC_READY_STATE_LABEL,
+    MODE_DEVTOOLS,
+    MODE_OFF,
+    MODE_ON,
+)
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "journal-node-0"
+
+
+# ---------------------------------------------------------------------------
+# Journal mechanics
+# ---------------------------------------------------------------------------
+
+
+def make_journal(tmp_path, **kwargs) -> ij.IntentJournal:
+    return ij.IntentJournal.from_state_dir(str(tmp_path), **kwargs)
+
+
+def test_append_replay_roundtrip(tmp_path):
+    j = make_journal(tmp_path)
+    txn = j.begin("transition", mode="on", chips=[0, 1, 2, 3])
+    j.mark(txn, ij.PHASE_STAGED)
+    j.note_desired("on")
+    j.defer_patch({"a": "1"})
+    j.commit(txn)
+
+    j2 = make_journal(tmp_path)
+    replay = j2.replay()
+    assert replay.truncated_bytes == 0
+    assert [r["t"] for r in replay.records] == [
+        "intent", "mark", "desired", "patch", "commit",
+    ]
+    assert j2.open_intents() == []
+    assert j2.last_desired_mode == "on"
+    assert j2.pending_patches() == {"a": "1"}
+    # Sequence numbers are strictly increasing and survive the reload.
+    seqs = [r["seq"] for r in replay.records]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    j2.patches_flushed()
+    assert not j2.has_pending_patches()
+
+
+def test_torn_tail_is_truncated_and_replay_is_stable(tmp_path):
+    j = make_journal(tmp_path)
+    t1 = j.begin("transition", mode="on", chips=[0])
+    j.commit(t1)
+    j.begin("transition", mode="off", chips=[0])
+    with open(j.path, "ab") as f:
+        f.write(b"TCCJ1 deadbeef {\"seq\": 99, \"t\": \"commit\"")  # torn
+
+    j2 = make_journal(tmp_path)
+    replay = j2.replay()
+    assert replay.truncated_bytes > 0
+    assert len(replay.records) == 3
+    assert len(j2.open_intents("transition")) == 1
+    # The file was physically truncated: a second replay sees a clean log.
+    j3 = make_journal(tmp_path)
+    replay2 = j3.replay()
+    assert replay2.truncated_bytes == 0
+    assert [r["seq"] for r in replay2.records] == [
+        r["seq"] for r in replay.records
+    ]
+
+
+def test_pending_patches_merge_in_order_and_flush_marker(tmp_path):
+    j = make_journal(tmp_path)
+    j.defer_patch({"k": "old", "x": "1"})
+    j.defer_patch({"k": "new", "y": None})
+    assert j.pending_patches() == {"k": "new", "x": "1", "y": None}
+    j.patches_flushed()
+    assert j.pending_patches() == {}
+    j.defer_patch({"z": "2"})
+    # Only post-flush patches survive a reload.
+    j2 = make_journal(tmp_path)
+    j2.replay()
+    assert j2.pending_patches() == {"z": "2"}
+
+
+def test_compaction_preserves_live_state(tmp_path):
+    j = make_journal(tmp_path, max_bytes=1)  # force compaction on close
+    keep = j.begin("transition", mode="on", chips=[0])
+    j.mark(keep, ij.PHASE_RESET)
+    j.note_desired("on")
+    j.defer_patch({"a": "1"})
+    done = j.begin("drain", mode="on")
+    j.commit(done)  # commit, abort and flush all trigger compaction
+    j.patches_flushed()
+    j.defer_patch({"b": "2"})
+    gone = j.begin("transition", mode="off", chips=[0])
+    j.abort(gone)  # triggers the size-based compaction
+
+    j2 = make_journal(tmp_path)
+    j2.replay()
+    opens = j2.open_intents()
+    assert [i["txn"] for i in opens] == [keep]
+    assert opens[0]["phase"] == ij.PHASE_RESET
+    assert j2.last_desired_mode == "on"
+    assert j2.pending_patches() == {"b": "2"}
+
+
+def test_newline_less_tail_is_torn_even_when_crc_verifies(tmp_path):
+    """A crash that cuts the final append exactly one byte short (frame
+    minus the trailing newline) leaves a CRC-valid fragment. Replay must
+    treat it as a torn tail — accepting it would leave the file ending
+    mid-line, the next append would glue onto it, and the replay after
+    THAT would fail closed over a benign torn write."""
+    j = make_journal(tmp_path)
+    t1 = j.begin("transition", mode="on", chips=[0])
+    j.mark(t1, ij.PHASE_STAGED)
+    with open(j.path, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 1)  # eat ONLY the final newline
+
+    j2 = make_journal(tmp_path)
+    replay = j2.replay()
+    # The newline-less mark record is torn tail: dropped and truncated.
+    assert replay.truncated_bytes > 0
+    assert [r["t"] for r in replay.records] == ["intent"]
+    assert j2.open_intents()[0]["phase"] == ij.PHASE_BEGUN
+    # The survivor appends cleanly and the NEXT replay must not fail
+    # closed (the regression this guards: record glued onto the tail).
+    j2.mark(t1, ij.PHASE_RESET)
+    j3 = make_journal(tmp_path)
+    replay3 = j3.replay()
+    assert replay3.truncated_bytes == 0
+    assert [r["t"] for r in replay3.records] == ["intent", "mark"]
+    assert j3.open_intents()[0]["phase"] == ij.PHASE_RESET
+
+
+def test_commits_alone_trigger_compaction(tmp_path):
+    """The healthy success path (begin/mark/commit, no aborts, no
+    deferred patches) must still bound the file: compaction fires from
+    commit as well."""
+    import os
+
+    j = make_journal(tmp_path, max_bytes=2048)
+    for _ in range(200):
+        txn = j.begin("transition", mode="on", chips=[0, 1, 2, 3])
+        j.mark(txn, ij.PHASE_STAGED)
+        j.mark(txn, ij.PHASE_RESET)
+        j.commit(txn)
+    # One full transition (~4 records) can land between compactions, so
+    # the bound is max_bytes plus a handful of records, not unbounded.
+    assert os.path.getsize(j.path) < 4096
+    assert j.open_intents() == []
+
+
+def test_disk_fault_rolls_back_seq_and_raises(tmp_path):
+    j = make_journal(tmp_path)
+    j.note_desired("on")
+    j.fail_appends = 1
+    with pytest.raises(ij.JournalError):
+        j.defer_patch({"a": "1"})
+    # The failed append left no trace: the next record lands cleanly.
+    j.defer_patch({"b": "2"})
+    j2 = make_journal(tmp_path)
+    replay = j2.replay()
+    assert [r["t"] for r in replay.records] == ["desired", "patch"]
+    assert j2.pending_patches() == {"b": "2"}
+
+
+# ---------------------------------------------------------------------------
+# Corruption fuzz: prefix-or-fail-closed at every byte offset
+# ---------------------------------------------------------------------------
+
+
+def _valid_journal_bytes(tmp_path):
+    j = make_journal(tmp_path / "seed")
+    t1 = j.begin("transition", mode="on", chips=[0, 1])
+    j.mark(t1, ij.PHASE_STAGED)
+    j.mark(t1, ij.PHASE_RESET)
+    j.commit(t1)
+    j.note_desired("on")
+    j.defer_patch({CC_MODE_STATE_LABEL: "on"})
+    t2 = j.begin("drain", mode="devtools")
+    j.abort(t2)
+    with open(j.path, "rb") as f:
+        data = f.read()
+    j2 = make_journal(tmp_path / "seed")
+    original = [tuple(sorted(r.items())) for r in j2.replay().records]
+    return data, original
+
+
+def _replay_mutant(tmp_path, name, data):
+    d = tmp_path / name
+    d.mkdir()
+    j = ij.IntentJournal.from_state_dir(str(d))
+    with open(j.path, "wb") as f:
+        f.write(data)
+    return j
+
+
+def _assert_prefix_or_fail_closed(j, original, what):
+    """The fuzz property: replay yields a prefix of the original record
+    list, or raises JournalCorrupt — never a record the original journal
+    did not contain, never a reordered/half view."""
+    try:
+        replay = j.replay()
+    except ij.JournalCorrupt:
+        return "failed-closed"
+    got = [tuple(sorted(r.items())) for r in replay.records]
+    assert got == original[: len(got)], f"{what}: not a consistent prefix"
+    return "prefix"
+
+
+def test_fuzz_truncation_at_every_byte_offset(tmp_path):
+    data, original = _valid_journal_bytes(tmp_path)
+    outcomes = set()
+    for cut in range(len(data)):
+        j = _replay_mutant(tmp_path, f"trunc{cut}", data[:cut])
+        outcomes.add(
+            _assert_prefix_or_fail_closed(j, original, f"truncate@{cut}")
+        )
+    # Truncation is always a torn tail — it must never fail closed.
+    assert outcomes == {"prefix"}
+
+
+def test_fuzz_bitflip_at_every_byte_offset(tmp_path):
+    data, original = _valid_journal_bytes(tmp_path)
+    outcomes = set()
+    for pos in range(len(data)):
+        flipped = bytearray(data)
+        flipped[pos] ^= 0x40
+        j = _replay_mutant(tmp_path, f"flip{pos}", bytes(flipped))
+        outcomes.add(
+            _assert_prefix_or_fail_closed(j, original, f"bitflip@{pos}")
+        )
+    # Mid-file flips fail closed; tail flips recover the prefix. Both
+    # must occur across the sweep or the property isn't being exercised.
+    assert outcomes == {"prefix", "failed-closed"}
+
+
+def test_fuzz_duplicated_records(tmp_path):
+    data, original = _valid_journal_bytes(tmp_path)
+    lines = data.split(b"\n")[:-1]
+    for i in range(len(lines)):
+        for j_pos in range(len(lines) + 1):
+            mutated = lines[:j_pos] + [lines[i]] + lines[j_pos:]
+            j = _replay_mutant(
+                tmp_path, f"dup{i}at{j_pos}",
+                b"\n".join(mutated) + b"\n",
+            )
+            _assert_prefix_or_fail_closed(
+                j, original, f"duplicate record {i} at {j_pos}"
+            )
+
+
+def test_failed_closed_journal_is_quarantined_and_feeds_the_ladder(
+    fake_kube, tmp_path,
+):
+    """Mid-file corruption → JournalCorrupt → the manager fails closed:
+    the remediation ladder is fed (reason journal-corrupt), the corrupt
+    file is moved aside, and the metric counts the outcome."""
+    data, _ = _valid_journal_bytes(tmp_path)
+    flipped = bytearray(data)
+    flipped[10] ^= 0xFF  # first record's frame: verifiable data follows
+    j = _replay_mutant(tmp_path, "corrupt", bytes(flipped))
+
+    fed = []
+
+    class Ladder:
+        quarantined = False
+
+        def note_failure(self, reason):
+            fed.append(reason)
+
+    registry = MetricsRegistry()
+    fake_kube.add_node(NODE)
+    mgr = CCManager(
+        api=fake_kube, backend=FakeTpuBackend(), node_name=NODE,
+        evict_components=False, smoke_workload="none",
+        metrics=registry, intent_journal=j, remediation=Ladder(),
+        readiness_file=str(tmp_path / "ready"),
+    )
+    mgr.recover_from_journal()
+    assert fed == ["journal-corrupt"]
+    assert registry.journal_replay_totals() == {"failed-closed": 1}
+    import os
+
+    assert os.path.exists(j.path + ".corrupt")
+    assert not os.path.exists(j.path)
+
+
+# ---------------------------------------------------------------------------
+# Replay recovery decisions against hardware truth
+# ---------------------------------------------------------------------------
+
+
+def make_manager(fake_kube, backend, tmp_path, **kwargs):
+    kwargs.setdefault("metrics", MetricsRegistry())
+    kwargs.setdefault("intent_journal", make_journal(tmp_path))
+    return CCManager(
+        api=kwargs.pop("api", fake_kube),
+        backend=backend,
+        node_name=NODE,
+        default_mode=MODE_OFF,
+        evict_components=kwargs.pop("evict_components", False),
+        smoke_workload="none",
+        watch_timeout_s=1,
+        reconnect_delay_s=0.01,
+        retry_backoff_s=0.02,
+        retry_backoff_max_s=0.2,
+        readiness_file=str(tmp_path / "ready"),
+        **kwargs,
+    )
+
+
+def test_reconcile_journals_intent_then_commit(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    j = make_journal(tmp_path)
+    mgr = make_manager(fake_kube, backend, tmp_path, intent_journal=j)
+    assert mgr.set_cc_mode(MODE_ON)
+    assert j.open_intents() == []
+    assert j.last_desired_mode == MODE_ON
+    kinds = [r["t"] for r in make_journal(tmp_path).replay().records]
+    assert "intent" in kinds and "commit" in kinds
+
+
+def test_replay_rolls_back_a_pre_reset_crash_without_any_reset(
+    fake_kube, tmp_path,
+):
+    """Crash after stage, before reset: replay clears the staging and
+    aborts the intent — the chips were never disrupted and must not be."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    j = make_journal(tmp_path)
+    txn = j.begin(
+        "transition", mode=MODE_ON, chips=[c.index for c in backend.discover().chips],
+    )
+    j.mark(txn, ij.PHASE_STAGED)
+    backend.stage_cc_mode(backend.discover().chips, MODE_ON)
+
+    registry = MetricsRegistry()
+    j2 = make_journal(tmp_path)
+    mgr = make_manager(
+        fake_kube, backend, tmp_path, intent_journal=j2, metrics=registry,
+    )
+    mgr.recover_from_journal()
+    assert j2.open_intents() == []
+    assert backend.staged == {}  # rolled back
+    assert all(m == MODE_OFF for m in backend.committed.values())
+    assert not any(op == "reset" for op, _ in backend.op_log)
+    assert registry.journal_replay_totals() == {"rolled-back": 1}
+
+
+def test_replay_completes_a_committed_reset_without_a_second_reset(
+    fake_kube, tmp_path,
+):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    chips = backend.discover().chips
+    j = make_journal(tmp_path)
+    txn = j.begin("transition", mode=MODE_ON, chips=[c.index for c in chips])
+    j.mark(txn, ij.PHASE_RESET)
+    backend.stage_cc_mode(chips, MODE_ON)
+    backend.reset(chips)  # the reset landed; the crash ate the commit
+    resets = sum(1 for op, _ in backend.op_log if op == "reset")
+
+    registry = MetricsRegistry()
+    j2 = make_journal(tmp_path)
+    mgr = make_manager(
+        fake_kube, backend, tmp_path, intent_journal=j2, metrics=registry,
+    )
+    mgr.recover_from_journal()
+    assert j2.open_intents() == []
+    assert registry.journal_replay_totals() == {"completed": 1}
+    assert sum(1 for op, _ in backend.op_log if op == "reset") == resets
+    # Connected at replay time → the truthful state lands immediately.
+    assert node_labels(fake_kube.get_node(NODE))[
+        CC_MODE_STATE_LABEL
+    ] == MODE_ON
+
+
+def test_replay_restores_stranded_paused_components(fake_kube, tmp_path):
+    """An open drain intent (crash between pause and readmit) re-admits
+    the paused components at replay time when the apiserver answers."""
+    dp = "google.com/tpu.deploy.device-plugin"
+    fake_kube.add_node(NODE, {dp: "true"})
+    from tpu_cc_manager.drain.pause import pause_value
+
+    fake_kube.set_node_label(NODE, dp, pause_value("true"))
+    j = make_journal(tmp_path)
+    j.begin("drain", mode=MODE_ON)
+
+    backend = FakeTpuBackend()
+    j2 = make_journal(tmp_path)
+    mgr = make_manager(fake_kube, backend, tmp_path, intent_journal=j2)
+    mgr.recover_from_journal()
+    assert node_labels(fake_kube.get_node(NODE))[dp] == "true"
+    assert j2.open_intents("drain") == []
+
+
+# ---------------------------------------------------------------------------
+# Boot ordering: journal → hardware truth → apiserver
+# ---------------------------------------------------------------------------
+
+
+class StaleThenLiveKube:
+    """Wrapper modeling a blackout ending mid-boot: the FIRST get_node
+    serves a stale snapshot (an old desired label), later reads serve the
+    live store. Every other verb passes through."""
+
+    def __init__(self, inner, stale_node):
+        self.inner = inner
+        self._stale = stale_node
+        self.stale_reads = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def get_node(self, name):
+        if self._stale is not None:
+            self.stale_reads += 1
+            node, self._stale = self._stale, None
+            return node
+        return self.inner.get_node(name)
+
+
+def test_stale_boot_read_cannot_trigger_a_spurious_transition(
+    fake_kube, tmp_path,
+):
+    """Regression (ISSUE 5 satellite): the agent converged to devtools,
+    crashed, and boots through a flaky apiserver whose first answer is a
+    STALE node (desired=on, from before the last transition). Boot-time
+    ordering journal → hardware → apiserver must confirm the read before
+    acting: the node must NOT bounce through a spurious transition to the
+    stale mode."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    j = make_journal(tmp_path)
+    mgr1 = make_manager(fake_kube, backend, tmp_path, intent_journal=j)
+    fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+    assert mgr1.set_cc_mode(MODE_ON)
+    stale_node = fake_kube.get_node(NODE)  # desired=on, about to go stale
+    fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_DEVTOOLS)
+    assert mgr1.set_cc_mode(MODE_DEVTOOLS)
+    resets = sum(1 for op, _ in backend.op_log if op == "reset")
+
+    api = StaleThenLiveKube(fake_kube, stale_node)
+    j2 = make_journal(tmp_path)
+    mgr2 = make_manager(fake_kube, backend, tmp_path, api=api, intent_journal=j2)
+    mgr2.recover_from_journal()
+    label, rv = mgr2._startup_mode_read()
+    # The stale read was served and DISAGREED with the journal; the
+    # confirming read returned the live value, which won.
+    assert api.stale_reads == 1
+    assert label == MODE_DEVTOOLS
+    assert mgr2.set_cc_mode(mgr2.with_default(label))
+    # Idempotent: the stale 'on' never caused a transition.
+    assert sum(1 for op, _ in backend.op_log if op == "reset") == resets
+    assert all(m == MODE_DEVTOOLS for m in backend.committed.values())
+
+
+def test_boot_without_local_truth_keeps_crash_as_retry(fake_kube, tmp_path):
+    """A fresh node (empty journal, no last-known mode) keeps the
+    reference's fatal startup GET — autonomy needs local truth."""
+    class DeadKube:
+        def __getattr__(self, name):
+            def dead(*a, **k):
+                raise KubeApiError(None, "connection refused")
+            return dead
+
+    backend = FakeTpuBackend()
+    mgr = make_manager(fake_kube, backend, tmp_path, api=DeadKube())
+    with pytest.raises(KubeApiError):
+        mgr._startup_mode_read()
+
+
+def test_confirm_read_api_error_is_fatal_and_outage_waits_the_ladder(
+    fake_kube, tmp_path,
+):
+    """The confirming read keeps the first read's semantics: a server
+    that ANSWERED with an error (403, not a transport failure) is fatal,
+    and an outage error waits out the jittered ladder instead of
+    busy-looping read pairs against a flapping apiserver."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    j = make_journal(tmp_path)
+    j.note_desired(MODE_DEVTOOLS)  # disagrees with the label below
+    fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+
+    class FlakyConfirmKube:
+        def __init__(self, inner, error):
+            self.inner = inner
+            self.error = error
+            self.reads = 0
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def get_node(self, name):
+            self.reads += 1
+            if self.reads > 1:
+                raise self.error
+            return self.inner.get_node(name)
+
+    # Answered error on the confirm read: fatal, like the first read.
+    api = FlakyConfirmKube(fake_kube, KubeApiError(403, "forbidden"))
+    mgr = make_manager(fake_kube, backend, tmp_path, api=api, intent_journal=j)
+    with pytest.raises(KubeApiError):
+        mgr._startup_mode_read()
+    assert api.reads == 2
+
+    # Outage error on the confirm read: ladder wait, not a hot loop —
+    # bounded read count over the window, clean exit on stop.
+    j2 = make_journal(tmp_path)
+    j2.replay()
+    api2 = FlakyConfirmKube(fake_kube, KubeApiError(None, "conn reset"))
+    mgr2 = make_manager(
+        fake_kube, backend, tmp_path, api=api2, intent_journal=j2,
+    )
+    mgr2._reconnect_policy = mgr2._reconnect_policy.__class__(
+        base_delay_s=0.05, max_delay_s=0.05, jitter=False,
+    )
+    stop = threading.Event()
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(read=mgr2._startup_mode_read(stop)),
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.2)
+    assert t.is_alive()
+    stop.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert result["read"] is None
+    # ~0.2 s at >=0.05 s per failed confirm: a busy loop would have read
+    # hundreds of times.
+    assert api2.reads <= 20
+
+
+def test_boot_waits_out_outage_with_local_truth(fake_kube, tmp_path):
+    """With a journaled desired mode, a dark apiserver at boot is ridden
+    out (retry loop) instead of crashing; stop exits cleanly."""
+    j = make_journal(tmp_path)
+    j.note_desired(MODE_ON)
+
+    class DeadKube:
+        def __getattr__(self, name):
+            def dead(*a, **k):
+                raise KubeApiError(None, "connection refused")
+            return dead
+
+    j2 = make_journal(tmp_path)
+    j2.replay()
+    backend = FakeTpuBackend()
+    mgr = make_manager(
+        fake_kube, backend, tmp_path, api=DeadKube(), intent_journal=j2,
+    )
+    stop = threading.Event()
+    result = {}
+
+    def boot():
+        result["read"] = mgr._startup_mode_read(stop)
+
+    t = threading.Thread(target=boot, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert t.is_alive(), "boot must ride out the outage, not crash"
+    stop.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert result["read"] is None
+
+
+# ---------------------------------------------------------------------------
+# Disconnected mode: deferral + idempotent flush + watchdog condemn
+# ---------------------------------------------------------------------------
+
+
+class BlackoutKube:
+    """Pass-through wrapper with a manual blackout switch."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dark = False
+
+    def __getattr__(self, name):
+        inner_fn = getattr(self.inner, name)
+
+        def call(*a, **k):
+            if self.dark:
+                raise KubeApiError(None, "blackout")
+            return inner_fn(*a, **k)
+
+        return call
+
+
+def engaged_offline_manager(fake_kube, backend, tmp_path, **kwargs):
+    api = BlackoutKube(fake_kube)
+    mgr = make_manager(
+        fake_kube, backend, tmp_path, api=api,
+        offline_grace_s=0.01, **kwargs,
+    )
+    api.dark = True
+    mgr.offline.note_failure()
+    time.sleep(0.02)  # outlast the grace window
+    assert mgr.offline.engaged
+    return api, mgr
+
+
+def test_engaged_outage_defers_state_reports_and_flushes_rmw(
+    fake_kube, tmp_path,
+):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    api, mgr = engaged_offline_manager(
+        fake_kube, backend, tmp_path, metrics=registry,
+    )
+    # The reconcile succeeds against hardware; the state report defers.
+    assert mgr.set_cc_mode(MODE_ON)
+    assert all(m == MODE_ON for m in backend.committed.values())
+    pending = mgr.intents.pending_patches()
+    assert pending[CC_MODE_STATE_LABEL] == MODE_ON
+    assert pending[CC_READY_STATE_LABEL] == "true"
+    assert CC_MODE_STATE_LABEL not in node_labels(fake_kube.get_node(NODE))
+
+    # Reconnect: the flush is RMW — a key some other writer already
+    # landed is not re-patched (no blind replay), missing keys are.
+    fake_kube.set_node_label(NODE, CC_READY_STATE_LABEL, "true")
+    api.dark = False
+    patches_before = fake_kube.patch_calls
+    mgr._note_api_ok()
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON
+    assert labels[CC_READY_STATE_LABEL] == "true"
+    assert not mgr.intents.has_pending_patches()
+    assert fake_kube.patch_calls == patches_before + 1
+    # A second reconnect edge flushes nothing (idempotent).
+    mgr._note_api_ok()
+    assert fake_kube.patch_calls == patches_before + 1
+    assert registry.journal_replay_totals() == {}
+
+
+def test_flush_preserves_order_of_conflicting_deferred_writes(
+    fake_kube, tmp_path,
+):
+    """Journal order is flush order: a later deferred demote (ready=false)
+    beats the earlier deferred ready=true from the same outage."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    api, mgr = engaged_offline_manager(fake_kube, backend, tmp_path)
+    assert mgr.set_cc_mode(MODE_ON)
+    assert mgr.defer_patch_if_offline(
+        {CC_READY_STATE_LABEL: "false"}, KubeApiError(None, "blackout")
+    )
+    api.dark = False
+    mgr._note_api_ok()
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON
+    assert labels[CC_READY_STATE_LABEL] == "false"
+
+
+def test_direct_write_supersedes_stale_pending_patches(fake_kube, tmp_path):
+    """A label write that LANDS while stale deferred patches are still
+    queued (an earlier flush failed) must not be clobbered back by the
+    eventual flush: the direct write journals a superseding patch record,
+    so the journal-order merge carries the fresh values."""
+    from tpu_cc_manager.drain.state import STATE_FAILED
+
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    api, mgr = engaged_offline_manager(fake_kube, backend, tmp_path)
+    assert mgr.set_cc_mode(MODE_ON)  # defers mode.state=on / ready=true
+    assert mgr.intents.pending_patches()[CC_MODE_STATE_LABEL] == MODE_ON
+
+    # Connectivity returns; a DIRECT state write (a failed reconcile)
+    # lands before any successful flush of the stale 'on' patches.
+    api.dark = False
+    mgr._report_state(STATE_FAILED, reason="smoke-failed")
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == STATE_FAILED
+    assert labels[CC_READY_STATE_LABEL] == ""  # failed -> unknown-ready
+    assert not mgr.intents.has_pending_patches()
+    # Another flush edge changes nothing — the stale 'on' never returns.
+    mgr._note_api_ok()
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == STATE_FAILED
+    assert labels[CC_READY_STATE_LABEL] == ""
+
+
+def test_patch_deferred_during_flush_is_not_lost(fake_kube, tmp_path):
+    """A patch deferred concurrently with a flush — AFTER the flush's
+    snapshot — must stay queued (the flushed marker covers only the
+    snapshot), and the next flush writes it."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    api, mgr = engaged_offline_manager(fake_kube, backend, tmp_path)
+    mgr._defer_patch({CC_MODE_STATE_LABEL: MODE_ON})
+    api.dark = False
+
+    # Model the race: mid-flush (between the snapshot and the flushed
+    # marker), another thread defers a demote.
+    real_get = fake_kube.get_node
+
+    def get_and_race(name):
+        node = real_get(name)
+        if mgr._flushing_patches and not raced["done"]:
+            raced["done"] = True
+            mgr.intents.defer_patch({CC_READY_STATE_LABEL: "false"})
+        return node
+
+    raced = {"done": False}
+    fake_kube.get_node = get_and_race
+    try:
+        mgr._note_api_ok()
+    finally:
+        fake_kube.get_node = real_get
+    # The snapshot flushed; the racing demote is STILL pending.
+    assert raced["done"]
+    assert mgr.intents.pending_patches() == {CC_READY_STATE_LABEL: "false"}
+    mgr._note_api_ok()
+    assert not mgr.intents.has_pending_patches()
+    assert node_labels(fake_kube.get_node(NODE))[
+        CC_READY_STATE_LABEL
+    ] == "false"
+
+
+def test_watchdog_condemn_while_offline_is_journaled(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    fake_kube.set_node_label(NODE, CC_MODE_STATE_LABEL, MODE_ON)
+    fake_kube.set_node_label(NODE, CC_READY_STATE_LABEL, "true")
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    api, mgr = engaged_offline_manager(
+        fake_kube, backend, tmp_path, metrics=registry,
+    )
+    watchdog = RuntimeHealthWatchdog(
+        api, backend, NODE, demote_after=2, restore_after=1,
+        metrics=registry, defer_patch=mgr.defer_patch_if_offline,
+    )
+    backend.healthy = False
+    watchdog.tick()
+    watchdog.tick()
+    # The demote could not reach the apiserver but was NOT lost: it is
+    # journaled and the watchdog state advanced.
+    assert watchdog.degraded
+    assert mgr.intents.pending_patches()[CC_READY_STATE_LABEL] == "false"
+    api.dark = False
+    mgr._note_api_ok()
+    assert node_labels(fake_kube.get_node(NODE))[
+        CC_READY_STATE_LABEL
+    ] == "false"
+
+
+def test_short_blip_under_grace_still_fails_the_reconcile(
+    fake_kube, tmp_path,
+):
+    """Deferral is an ENGAGED-outage behavior: a blip shorter than the
+    grace window keeps the existing fail-and-backoff semantics, so a
+    healthy apiserver hiccup cannot silently buffer label writes."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    api = BlackoutKube(fake_kube)
+    mgr = make_manager(
+        fake_kube, backend, tmp_path, api=api, offline_grace_s=60.0,
+    )
+    api.dark = True
+    with pytest.raises(KubeApiError):
+        mgr._report_state(MODE_ON)
+    assert not mgr.intents.has_pending_patches()
+
+
+# ---------------------------------------------------------------------------
+# /journalz debug endpoint + `tpu-cc-ctl journal`
+# ---------------------------------------------------------------------------
+
+
+def test_journalz_endpoint_and_ctl_journal(fake_kube, tmp_path, capsys):
+    from tpu_cc_manager import ctl
+    from tpu_cc_manager.ccmanager.metrics_server import start_metrics_server
+
+    j = make_journal(tmp_path)
+    j.note_desired(MODE_ON)
+    j.begin("transition", mode=MODE_ON, chips=[0, 1])
+    j.defer_patch({CC_MODE_STATE_LABEL: MODE_ON})
+
+    registry = MetricsRegistry()
+    server = start_metrics_server(
+        0, registry, bind="127.0.0.1", intent_journal=j,
+    )
+    try:
+        port = server.server_address[1]
+        url = f"http://127.0.0.1:{port}/journalz"
+        args = ctl.build_parser().parse_args(["journal", "--url", url])
+        assert ctl.cmd_journal(fake_kube, args) == 0
+        out = capsys.readouterr().out
+        assert "last desired mode: on" in out
+        assert "open intents: 1" in out
+        assert "kind=transition" in out
+        assert CC_MODE_STATE_LABEL in out
+        # --json round-trips the raw snapshot.
+        args = ctl.build_parser().parse_args(
+            ["journal", "--url", url, "--json"]
+        )
+        assert ctl.cmd_journal(fake_kube, args) == 0
+        import json as json_mod
+
+        payload = json_mod.loads(capsys.readouterr().out)
+        assert payload["last_desired_mode"] == MODE_ON
+        assert len(payload["open_intents"]) == 1
+    finally:
+        server.shutdown()
+
+
+def test_ctl_journal_resolves_node_address(fake_kube, capsys):
+    """Without --url, `ctl journal` dials the node's InternalIP from
+    status.addresses; an address-less node gets the actionable error."""
+    from tpu_cc_manager import ctl
+
+    fake_kube.add_node(NODE)
+    args = ctl.build_parser().parse_args(["journal", "--node", NODE])
+    with pytest.raises(ValueError, match="status.addresses"):
+        ctl.cmd_journal(fake_kube, args)
+    node = fake_kube.get_node(NODE)
+    assert (
+        ctl._node_debug_address(
+            type("K", (), {"get_node": staticmethod(lambda n: {
+                "status": {"addresses": [
+                    {"type": "Hostname", "address": "host-a"},
+                    {"type": "InternalIP", "address": "10.0.0.7"},
+                ]},
+            })})(), NODE,
+        )
+        == "10.0.0.7"
+    )
+    assert node  # the apiserver lookup path was exercised above

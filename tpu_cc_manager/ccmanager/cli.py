@@ -106,6 +106,25 @@ def main(argv: list[str] | None = None) -> int:
         return 1
 
     backend = load_backend(args.tpu_backend)
+    # Node-local intent WAL (ccmanager/intent_journal.py) in the same
+    # writable state dir the tpuvm backend persists its mode files to:
+    # crash-restarts replay it BEFORE the first apiserver read, and a
+    # total apiserver outage longer than CC_OFFLINE_GRACE_S flips the
+    # agent into disconnected mode (serve last-known desired mode, defer
+    # label writes as pending patches). CC_INTENT_JOURNAL=0 disables.
+    intent_journal = None
+    if os.environ.get("CC_INTENT_JOURNAL", "1").lower() not in (
+        "0", "false", "no",
+    ):
+        from tpu_cc_manager.ccmanager.intent_journal import IntentJournal
+        from tpu_cc_manager.tpudev.tpuvm import DEFAULT_STATE_DIR
+
+        state_dir = (
+            os.environ.get("CC_STATE_DIR")
+            or getattr(backend, "state_dir", None)
+            or DEFAULT_STATE_DIR
+        )
+        intent_journal = IntentJournal.from_state_dir(state_dir)
     manager = CCManager(
         api=api,
         backend=backend,
@@ -113,6 +132,7 @@ def main(argv: list[str] | None = None) -> int:
         default_mode=default_mode,
         host_cc_capable=host_cc,
         smoke_workload=args.smoke_workload,
+        intent_journal=intent_journal,
     )
     # Failure containment (ccmanager/remediation.py): escalating ladder
     # from backoff retries through device re-reset and runtime restart to
@@ -129,9 +149,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     if args.metrics_port:
         # Same journal the manager records to, so /tracez and /statusz
-        # serve the live reconcile traces.
+        # serve the live reconcile traces; the intent journal backs the
+        # /journalz endpoint `tpu-cc-ctl journal` reads.
         start_metrics_server(
-            args.metrics_port, manager.metrics, journal=manager.journal
+            args.metrics_port, manager.metrics, journal=manager.journal,
+            intent_journal=intent_journal,
         )
     # Graceful shutdown: SIGTERM (kubelet pod stop) sets the stop event so
     # the watch loop exits at the next event/timeout boundary and the
@@ -161,6 +183,11 @@ def main(argv: list[str] | None = None) -> int:
         # edge fences this host's slice barrier so peers fail fast.
         on_probe=(remediation.note_probe if remediation is not None else None),
         on_condemn=(remediation.condemn if remediation is not None else None),
+        # A demote (condemn) while the apiserver is dark is journaled as a
+        # pending patch and flushed on reconnect; a write that LANDS while
+        # stale deferred patches are queued supersedes them.
+        defer_patch=manager.defer_patch_if_offline,
+        note_patched=manager.note_direct_patch,
     )
 
     def _force_exit_when_idle():

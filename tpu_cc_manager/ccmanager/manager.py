@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Callable
 
+from tpu_cc_manager.ccmanager import intent_journal as intent_mod
 from tpu_cc_manager.ccmanager import slicecoord
 from tpu_cc_manager.drain import evict, state
 from tpu_cc_manager.kubeclient.api import (
@@ -103,6 +104,8 @@ class CCManager:
         metrics: metrics_mod.MetricsRegistry | None = None,
         journal: journal_mod.Journal | None = None,
         remediation=None,
+        intent_journal: intent_mod.IntentJournal | None = None,
+        offline_grace_s: float | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -224,6 +227,19 @@ class CCManager:
         # the node quarantined, reconciles are deferred (slow re-check
         # cadence) instead of hammering known-bad hardware. None disables.
         self.remediation = remediation
+        # Node-local write-ahead intent log (ccmanager/intent_journal.py):
+        # every hardware-effecting transition and drain bracket is
+        # journaled intent→(committed|aborted), so a crash-restart replays
+        # the journal BEFORE touching the apiserver and completes or rolls
+        # back the in-flight transition from local truth alone. None
+        # disables (behavior reverts to apiserver-only state).
+        self.intents = intent_journal
+        # Disconnected-mode ladder: after CC_OFFLINE_GRACE_S of total
+        # apiserver outage the agent keeps serving its last-known desired
+        # mode and defers label writes into the journal as pending
+        # patches, flushed idempotently (RMW) on reconnect.
+        self.offline = intent_mod.OfflineTracker(offline_grace_s)
+        self._flushing_patches = False
         # Event dedup state (see _emit_node_event).
         self._last_event_key: tuple[str, str, str] | None = None
         # Verifier-challenge re-attestation (multislice.py): the last
@@ -284,6 +300,177 @@ class CCManager:
         self.last_failure_reason = reason
         self.metrics.record_failure(reason)
 
+    # ------------------------------------------------------------------
+    # Apiserver connectivity + intent journal (disconnected mode)
+    # ------------------------------------------------------------------
+
+    def _note_api_ok(self) -> None:
+        """An apiserver interaction succeeded: reset the outage clock and,
+        if deferred label writes are queued in the intent journal, flush
+        them — this is the reconnect edge of the disconnected-mode
+        ladder."""
+        self.offline.note_success()
+        self.metrics.set_apiserver_connected(True)
+        self.metrics.set_offline_seconds(0.0)
+        if self.intents is not None and self.intents.has_pending_patches():
+            self._flush_pending_patches()
+
+    def _note_api_err(self, e: BaseException | None = None) -> None:
+        """A transport-level apiserver failure: advance the outage clock
+        (HTTP-status errors are a server that ANSWERED and never count)."""
+        if e is not None and not intent_mod.is_outage_error(e):
+            return
+        self.offline.note_failure()
+        self.metrics.set_apiserver_connected(False)
+        self.metrics.set_offline_seconds(self.offline.offline_seconds)
+
+    def _flush_pending_patches(self) -> None:
+        """Flush label writes deferred while disconnected. Idempotent RMW,
+        not blind replay: the merged pending state is compared against the
+        node's CURRENT labels and only differing keys are patched — a
+        value some other writer (or a fresher reconcile) already landed is
+        neither duplicated nor clobbered back. A failed flush keeps the
+        patches queued for the next successful interaction."""
+        if self._flushing_patches or self.intents is None:
+            return
+        self._flushing_patches = True
+        try:
+            pending, upto = self.intents.pending_snapshot()
+            if not pending:
+                return
+            node = self.api.get_node(self.node_name)
+            labels = node_labels(node)
+            patch: dict = {}
+            for key, value in pending.items():
+                if value is None:
+                    if key in labels:
+                        patch[key] = None
+                elif labels.get(key) != value:
+                    patch[key] = value
+            if patch:
+                self.api.patch_node_labels(self.node_name, patch)
+            # Only the snapshot is flushed: a patch deferred concurrently
+            # (seq > upto) stays queued for the next flush.
+            self.intents.patches_flushed(upto)
+            log.info(
+                "flushed %d deferred label write(s) after reconnect "
+                "(%d key(s) still differed and were patched)",
+                len(pending), len(patch),
+            )
+        except KubeApiError as e:
+            self._note_api_err(e)
+            log.warning("deferred-patch flush failed; will retry: %s", e)
+        except intent_mod.JournalError as e:
+            log.warning("could not mark deferred patches flushed: %s", e)
+        finally:
+            self._flushing_patches = False
+
+    def _defer_patch(self, patch) -> bool:
+        """Queue a label write in the intent journal for the reconnect
+        flush; False when there is no journal (or it cannot persist)."""
+        if self.intents is None:
+            return False
+        try:
+            self.intents.defer_patch(dict(patch))
+        except intent_mod.JournalError as e:
+            log.warning("could not defer label write to the journal: %s", e)
+            return False
+        self.metrics.record_deferred_patch()
+        return True
+
+    def note_direct_patch(self, patch) -> None:
+        """A label write LANDED directly while deferred patches are still
+        queued (an earlier flush failed or is racing): journal the fresh
+        values as a superseding patch record, so the eventual flush's
+        journal-order merge carries them and cannot clobber the labels
+        back to the stale pre-outage values."""
+        if self.intents is None or not self.intents.has_pending_patches():
+            return
+        try:
+            self.intents.defer_patch(dict(patch))
+        except intent_mod.JournalError as e:
+            log.warning(
+                "could not journal a superseding label write: %s", e
+            )
+
+    def defer_patch_if_offline(self, patch, error: BaseException) -> bool:
+        """Hook for co-located writers (the runtime-health watchdog): when
+        a label write failed on a transport-level error during an ENGAGED
+        outage, journal it as a pending patch and report it handled. The
+        watchdog's condemn-while-offline rides this: the demote patch is
+        deferred and flushed, in journal order, on reconnect."""
+        if not intent_mod.is_outage_error(error):
+            return False
+        self._note_api_err(error)
+        if not self.offline.engaged:
+            return False
+        return self._defer_patch(patch)
+
+    def _report_state(
+        self, state_value: str, reason: str | None = None,
+        force_defer: bool = False,
+    ) -> None:
+        """Report actual state like drain/state.py, but disconnected-
+        aware: when the apiserver is in an engaged outage (or
+        ``force_defer``, the journal-replay path while still dark), the
+        patch is journaled as a pending write instead of failing the
+        reconcile — the node's local truth keeps advancing and the labels
+        catch up idempotently on reconnect."""
+        patch = state.state_label_patch(state_value, reason)
+        try:
+            state.set_cc_state_label(
+                self.api, self.node_name, state_value, reason=reason
+            )
+            # BEFORE the reconnect-edge flush: if stale pre-outage patches
+            # are still queued (a flush failed earlier), this fresher
+            # direct write supersedes them in journal order.
+            self.note_direct_patch(patch)
+            self._note_api_ok()
+        except KubeApiError as e:
+            self._note_api_err(e)
+            if (
+                intent_mod.is_outage_error(e)
+                and (force_defer or self.offline.engaged)
+                and self._defer_patch(patch)
+            ):
+                log.warning(
+                    "apiserver unreachable; state report (%s) deferred to "
+                    "the intent journal", state_value,
+                )
+                return
+            raise
+
+    def _journal_begin(self, kind: str, **fields) -> str | None:
+        if self.intents is None:
+            return None
+        try:
+            return self.intents.begin(kind, **fields)
+        except intent_mod.JournalError as e:
+            log.warning(
+                "intent journal unavailable; %s runs unjournaled: %s",
+                kind, e,
+            )
+            return None
+
+    def _journal_mark(self, txn: str | None, phase: str) -> None:
+        if txn is None or self.intents is None:
+            return
+        try:
+            self.intents.mark(txn, phase)
+        except intent_mod.JournalError as e:
+            log.warning("intent journal mark failed: %s", e)
+
+    def _journal_close(self, txn: str | None, ok: bool, **fields) -> None:
+        if txn is None or self.intents is None:
+            return
+        try:
+            if ok:
+                self.intents.commit(txn, **fields)
+            else:
+                self.intents.abort(txn, **fields)
+        except intent_mod.JournalError as e:
+            log.warning("intent journal close failed: %s", e)
+
     def with_default(self, label_value: str | None) -> str:
         """Absent/empty desired label means the configured default
         (reference main.py:686-691)."""
@@ -318,6 +505,14 @@ class CCManager:
     def set_cc_mode(self, mode: str) -> bool:
         self.reconciling = True
         self.retryable_failure = True
+        if self.intents is not None:
+            # Boot-time local truth: a restart that cannot reach the
+            # apiserver serves this journaled desired mode instead of
+            # crash-looping with no record of what it was converging on.
+            try:
+                self.intents.note_desired(canonical_mode(mode))
+            except intent_mod.JournalError as e:
+                log.warning("could not journal desired mode: %s", e)
         try:
             # One reconcile = one trace: every phase span, drain step,
             # barrier wait and log line below nests under this root.
@@ -363,9 +558,7 @@ class CCManager:
             )
             self.retryable_failure = False
             self._record_failure("invalid-mode")
-            state.set_cc_state_label(
-                self.api, self.node_name, STATE_FAILED, reason="invalid-mode"
-            )
+            self._report_state(STATE_FAILED, reason="invalid-mode")
             self._emit_node_event(
                 "Warning", "CCModeInvalid", f"invalid desired CC mode {mode!r}"
             )
@@ -383,9 +576,7 @@ class CCManager:
         except TpuError as e:
             log.error("TPU discovery failed: %s", e)
             self._record_failure("discovery-failed")
-            state.set_cc_state_label(
-                self.api, self.node_name, STATE_FAILED, reason="discovery-failed"
-            )
+            self._report_state(STATE_FAILED, reason="discovery-failed")
             self._emit_node_event(
                 "Warning", "CCModeFailed", f"TPU discovery failed: {e}"
             )
@@ -409,9 +600,7 @@ class CCManager:
             log.error("mode %s unsupported on this node: %s", mode, e)
             self.retryable_failure = False  # only a label/pool edit helps
             self._record_failure(e.reason)
-            state.set_cc_state_label(
-                self.api, self.node_name, STATE_FAILED, reason=e.reason
-            )
+            self._report_state(STATE_FAILED, reason=e.reason)
             self._emit_node_event(
                 "Warning", "CCModeUnsupported",
                 f"mode {mode} unsupported on this node: {e}",
@@ -454,7 +643,7 @@ class CCManager:
                 # not advertise ready while its components are known to
                 # still be paused.
                 self._readmit_leftover_paused()
-                state.set_cc_state_label(self.api, self.node_name, mode)
+                self._report_state(mode)
                 self._publish_coordination_labels(topo, quote)
                 return True
 
@@ -497,8 +686,15 @@ class CCManager:
         failure here propagates: the reconcile is noted failed and the
         backoff retry re-attempts the restore — reporting success over
         still-stranded components would end the retry ladder with the node
-        not serving."""
+        not serving. A successful restore also retires any drain intents a
+        crashed run left open in the journal — the stranding they recorded
+        no longer exists."""
         evict.readmit_components(self.api, self.node_name, {})
+        if self.intents is not None:
+            try:
+                self.intents.close_open("drain", recovered="readmitted")
+            except intent_mod.JournalError as e:
+                log.warning("could not close recovered drain intents: %s", e)
 
     def _cc_mode_chips(
         self, topo: SliceTopology, mode: str
@@ -517,7 +713,7 @@ class CCManager:
             sys.exit(1)
         if not cc_capable:
             log.info("no CC-capable chips; reporting state off")
-            state.set_cc_state_label(self.api, self.node_name, MODE_OFF)
+            self._report_state(MODE_OFF)
             return None
         return topo.chips if mode == MODE_OFF else cc_capable
 
@@ -555,7 +751,14 @@ class CCManager:
 
         Re-admission runs even when the reconfigure fails, so components are
         never left paused by a failed toggle — including a strict-mode drain
-        timeout, which fails the reconcile without touching the hardware."""
+        timeout, which fails the reconcile without touching the hardware.
+
+        The drain bracket is journaled intent→commit around pause/readmit:
+        a crash (or SIGKILL) between the pause landing and re-admission
+        leaves the intent open, and journal replay restores the paused set
+        at the next boot even when the apiserver read that used to reveal
+        the stranding is unavailable."""
+        dtxn = self._journal_begin("drain", mode=mode)
         try:
             with m.phase(metrics_mod.PHASE_DRAIN):
                 original = evict.evict_components(
@@ -576,21 +779,33 @@ class CCManager:
                 f"strict eviction timed out before mode {mode}: {e}",
             )
             try:
-                state.set_cc_state_label(
-                    self.api, self.node_name, STATE_FAILED,
-                    reason="drain-timeout",
-                )
+                self._report_state(STATE_FAILED, reason="drain-timeout")
             finally:
                 # Re-admit even if the state-label patch itself fails —
                 # components must never stay paused behind a failed toggle.
                 with m.phase(metrics_mod.PHASE_READMIT):
                     evict.readmit_components(self.api, self.node_name, e.original)
+                self._journal_close(dtxn, ok=True, outcome="drain-timeout")
             return False
+        # Any other exception escaping the drain (e.g. a transport error
+        # during the pod wait, AFTER the pause patch landed) leaves the
+        # intent OPEN on purpose: components may genuinely be paused, and
+        # replay's recovery readmit is a no-op when they are not.
         try:
             return self._apply_direct(topo, chips, mode, m, barrier)
         finally:
             with m.phase(metrics_mod.PHASE_READMIT):
                 evict.readmit_components(self.api, self.node_name, original)
+            # Only after a SUCCESSFUL readmit (a readmit aborted by an
+            # apiserver error must leave the intent open for replay); the
+            # restore covered any stranding, so older leftover drain
+            # intents retire with this one.
+            self._journal_close(dtxn, ok=True)
+            if self.intents is not None:
+                try:
+                    self.intents.close_open("drain", recovered="readmitted")
+                except intent_mod.JournalError as err:
+                    log.warning("could not close drain intents: %s", err)
 
     def _apply_direct(
         self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
@@ -609,13 +824,23 @@ class CCManager:
         atomicity (main.py:362-368). Barrier COMPLETION (marker cleanup,
         the leader's bounded wait for peers) happens in set_cc_mode after
         re-admission, so it never extends the drain window."""
+        # Write-ahead intent: the journal record lands (fsync'd) BEFORE the
+        # first hardware-effecting step, so a crash anywhere in the
+        # pipeline restarts with a local record of exactly what was in
+        # flight — phase marks tell replay whether the disruptive reset
+        # had begun (roll back) or may have landed (ask the hardware).
+        txn = self._journal_begin(
+            "transition", mode=mode, chips=[c.index for c in chips],
+        )
         try:
             with m.phase(metrics_mod.PHASE_STAGE):
                 self.backend.stage_cc_mode(chips, mode)
+            self._journal_mark(txn, intent_mod.PHASE_STAGED)
             if barrier is not None:
                 with m.phase(metrics_mod.PHASE_BARRIER):
                     barrier.publish_staged(mode)
                     barrier.await_commit(mode)
+            self._journal_mark(txn, intent_mod.PHASE_RESET)
             with m.phase(metrics_mod.PHASE_RESET):
                 self.backend.reset(chips)
             with m.phase(metrics_mod.PHASE_WAIT_READY):
@@ -628,6 +853,12 @@ class CCManager:
                         f"verification failed on {chip.name}: "
                         f"wanted {mode}, device reports {got}"
                     )
+            # The hardware transition is now fact: commit the intent before
+            # the (non-hardware) attest/smoke verifies — their failure
+            # labels the node failed but must not make replay re-reset
+            # chips that verifiably hold the mode.
+            self._journal_close(txn, ok=True)
+            txn = None
             # Verify 2: attestation (new; skipped for plain 'off').
             quote = None
             if mode != MODE_OFF:
@@ -648,7 +879,11 @@ class CCManager:
                     self._run_smoke(self.smoke_workload)
         except Exception as e:  # noqa: BLE001 - reference parity:
             # any failure labels the node 'failed' and keeps the loop alive
-            # (main.py:531-538).
+            # (main.py:531-538). BaseExceptions (sys.exit, a modeled
+            # SIGKILL) bypass this handler and leave the intent OPEN —
+            # exactly the crash record replay recovers from.
+            self._journal_close(txn, ok=False, reason=self._failure_reason(e))
+            txn = None
             log.error("CC mode change to %s failed: %s", mode, e, exc_info=True)
             if barrier is not None:
                 # This host is about to re-admit components, so "staged and
@@ -656,15 +891,13 @@ class CCManager:
                 barrier.abort()
             reason = self._failure_reason(e)
             self._record_failure(reason)
-            state.set_cc_state_label(
-                self.api, self.node_name, STATE_FAILED, reason=reason,
-            )
+            self._report_state(STATE_FAILED, reason=reason)
             self._emit_node_event(
                 "Warning", "CCModeFailed", f"CC mode change to {mode} failed: {e}"
             )
             m.result = "failed"
             return False
-        state.set_cc_state_label(self.api, self.node_name, mode)
+        self._report_state(mode)
         # The publish patch below also withdraws this host's staged marker
         # (it is no longer mid-transition); the leader's commit-marker
         # retirement waits until set_cc_mode's post-readmit completion.
@@ -815,8 +1048,224 @@ class CCManager:
         return run_workload_subprocess(workload)
 
     # ------------------------------------------------------------------
+    # Intent-journal boot recovery (before the first apiserver read)
+    # ------------------------------------------------------------------
+
+    def recover_from_journal(self) -> None:
+        """Replay the intent journal and resolve whatever a crash left in
+        flight — from LOCAL truth (journal + hardware), before the first
+        apiserver read, so recovery works identically whether the control
+        plane is back or still dark.
+
+        Per open transition intent: if every journaled chip already
+        reports the intended mode, the reset landed before the crash —
+        the intent completes with NO second reset, and the state report
+        is queued (deferred while dark). If the crash hit before the
+        reset phase, nothing disruptive ran: the staging is rolled back
+        and the intent aborted. If the reset had begun but the hardware
+        doesn't hold the mode, the reset provably never committed (the
+        tpuvm backend's pending markers keep reporting ``resetting``) —
+        the intent aborts and the normal reconcile re-applies: each chip
+        is reset at most once across the crash, never twice.
+
+        Open drain intents get their components re-admitted when the
+        apiserver answers; while dark they stay open and the first
+        reconcile's readmit retires them.
+
+        A journal that fails closed (mid-file corruption) feeds the
+        remediation ladder instead of guessing at half-applied state."""
+        if self.intents is None:
+            return
+        try:
+            replayed = self.intents.replay()
+        except intent_mod.JournalCorrupt as e:
+            log.error("intent journal failed closed: %s", e)
+            self.metrics.record_journal_replay("failed-closed")
+            self.last_failure_reason = "journal-corrupt"
+            if self.remediation is not None:
+                try:
+                    self.remediation.note_failure("journal-corrupt")
+                except Exception as err:  # noqa: BLE001 - ladder is advisory
+                    log.warning("could not feed remediation ladder: %s", err)
+            return
+        transitions = self.intents.open_intents("transition")
+        drains = self.intents.open_intents("drain")
+        if replayed.records and not transitions and not drains:
+            self.metrics.record_journal_replay("clean")
+        for intent in transitions:
+            self._recover_transition(intent)
+        if drains:
+            # Stranded paused components from a crashed drain bracket:
+            # restore them now if the apiserver answers; otherwise the
+            # intents stay open and the first post-reconnect reconcile's
+            # readmit retires them.
+            try:
+                self._readmit_leftover_paused()
+                log.info(
+                    "journal replay restored components from %d open drain "
+                    "intent(s)", len(drains),
+                )
+            except KubeApiError as e:
+                self._note_api_err(e)
+                log.warning(
+                    "apiserver unreachable; %d open drain intent(s) kept "
+                    "for the first post-reconnect reconcile: %s",
+                    len(drains), e,
+                )
+
+    def _recover_transition(self, intent: dict) -> None:
+        mode = canonical_mode(str(intent.get("mode") or ""))
+        txn = intent["txn"]
+        phase = intent.get("phase")
+        try:
+            topo = self.backend.discover()
+        except TpuError as e:
+            log.error(
+                "journal replay cannot resolve %s (discovery failed: %s); "
+                "intent stays open for the next restart", txn, e,
+            )
+            self.metrics.record_journal_replay("failed-closed")
+            return
+        by_index = {c.index: c for c in topo.chips}
+        chips = tuple(
+            by_index[i] for i in (intent.get("chips") or []) if i in by_index
+        )
+        committed = bool(chips) and self._mode_is_set(chips, mode)
+        if committed:
+            log.info(
+                "journal replay: transition %s to %s already committed on "
+                "the hardware; completing without a second reset", txn, mode,
+            )
+            self._journal_close(txn, ok=True, recovered="hardware-committed")
+            self.metrics.record_journal_replay("completed")
+            if not self.intents.open_intents("drain"):
+                # Queue the truthful state report (deferred while dark);
+                # with a drain still open the first reconcile readmits
+                # BEFORE reporting — a node must not advertise ready over
+                # known-stranded components.
+                try:
+                    self._report_state(mode, force_defer=True)
+                except KubeApiError as e:
+                    log.warning(
+                        "recovered state report failed (%s); the first "
+                        "reconcile re-reports", e,
+                    )
+            return
+        if phase in (intent_mod.PHASE_BEGUN, intent_mod.PHASE_STAGED, None):
+            # The disruptive reset never started: roll the staging back.
+            try:
+                self.backend.clear_staged(chips)
+            except TpuError as e:
+                log.warning("could not clear staged mode during replay: %s", e)
+            self._journal_close(txn, ok=False, recovered="rolled-back")
+            self.metrics.record_journal_replay("rolled-back")
+            log.info(
+                "journal replay: transition %s to %s rolled back "
+                "(crash before reset; nothing disruptive ran)", txn, mode,
+            )
+        else:
+            # Reset begun but the mode never landed: the backend's own
+            # crash markers (pending.json → 'resetting') already force the
+            # full re-apply; close the intent so it isn't re-judged.
+            self._journal_close(txn, ok=False, recovered="reset-incomplete")
+            self.metrics.record_journal_replay("rolled-back")
+            log.warning(
+                "journal replay: transition %s to %s was interrupted "
+                "mid-reset and did not commit; the reconcile will re-apply",
+                txn, mode,
+            )
+
+    # ------------------------------------------------------------------
     # Watch loop (reference call stack 3.4)
     # ------------------------------------------------------------------
+
+    def _startup_mode_read(
+        self, stop: threading.Event | None = None
+    ) -> tuple[str | None, str] | None:
+        """The boot-time desired-mode read, ordered journal → hardware →
+        apiserver (recover_from_journal has already run).
+
+        Two divergences from the reference's fatal first GET:
+
+        - **Outage autonomy**: when the apiserver is unreachable AND the
+          journal holds a last-known desired mode, the agent keeps serving
+          that mode and retries the read on the jittered ladder instead of
+          crash-looping — the hardware is already converged (or journal
+          replay converged it) and a restart loop would add nothing. With
+          no local truth (fresh node, no journal) the GET stays fatal by
+          design: crash-as-retry.
+        - **Stale-read guard**: a first read that DISAGREES with the
+          journaled last-acted-on mode is confirmed with a second read
+          before anything acts on it. During a flaky boot (a blackout
+          ending mid-boot, a lagging replica) a single stale label must
+          not trigger a spurious hardware transition; the confirming read
+          either re-errors with an outage (still flaky — keep serving
+          local truth, wait out the ladder, retry), fails fatally on a
+          real API error (the server answered: same semantics as the
+          first read), or returns the fresher value, which wins.
+
+        Returns (label, rv), or None when ``stop`` was set while waiting
+        out an outage."""
+        attempts = 0
+
+        def wait_out() -> bool:
+            """One jittered-ladder wait between boot-time read attempts;
+            False when ``stop`` was set while waiting."""
+            nonlocal attempts
+            attempts += 1
+            delay = self._reconnect_policy.delay_for(min(attempts - 1, 8))
+            if stop is not None:
+                return not stop.wait(delay)
+            time.sleep(delay)
+            return True
+
+        while True:
+            try:
+                label, rv = self.get_node_cc_mode_label()
+                self._note_api_ok()
+            except KubeApiError as e:
+                self._note_api_err(e)
+                local = (
+                    self.intents.last_desired_mode
+                    if self.intents is not None else None
+                )
+                if local is None or not intent_mod.is_outage_error(e):
+                    raise  # no local truth (or a real API error): fatal
+                log.warning(
+                    "apiserver unreachable at boot (%s); serving last-known "
+                    "desired mode %r from the intent journal "
+                    "(offline %.0fs)", e, local, self.offline.offline_seconds,
+                )
+                if not wait_out():
+                    return None
+                continue
+            local = (
+                self.intents.last_desired_mode
+                if self.intents is not None else None
+            )
+            if local is not None and self.with_default(label) != local:
+                try:
+                    label2, rv2 = self.get_node_cc_mode_label()
+                    self._note_api_ok()
+                except KubeApiError as e:
+                    self._note_api_err(e)
+                    if not intent_mod.is_outage_error(e):
+                        raise  # the server ANSWERED: fatal, like read 1
+                    log.warning(
+                        "boot-time desired mode %r disagrees with the "
+                        "journaled %r and could not be confirmed (%s); "
+                        "keeping local truth and retrying", label, local, e,
+                    )
+                    if not wait_out():
+                        return None
+                    continue
+                if (label2, rv2) != (label, rv):
+                    log.info(
+                        "boot-time confirm read superseded %r with %r",
+                        label, label2,
+                    )
+                label, rv = label2, rv2
+            return label, rv
 
     def watch_and_apply(self, stop: threading.Event | None = None) -> None:
         """Initial apply, then watch the node label forever.
@@ -890,6 +1339,7 @@ class CCManager:
             try:
                 return note_result(self.set_cc_mode(self.with_default(value)))
             except KubeApiError as e:
+                self._note_api_err(e)
                 log.warning(
                     "reconcile aborted by apiserver error (%s); scheduling "
                     "backoff retry", e,
@@ -905,7 +1355,14 @@ class CCManager:
                 log.info("retrying failed reconcile")
                 apply_noted(last_label_value)
 
-        label, rv = self.get_node_cc_mode_label()
+        # Boot ordering: journal replay and hardware-truth recovery run
+        # BEFORE the first apiserver read, and that read is stale-guarded
+        # and outage-tolerant (_startup_mode_read).
+        self.recover_from_journal()
+        first = self._startup_mode_read(stop)
+        if first is None:
+            return  # stopped while riding out an apiserver outage
+        label, rv = first
         note_result(self.set_cc_mode(self.with_default(label)))
         self.create_readiness_file()
         last_label_value = label
@@ -948,6 +1405,7 @@ class CCManager:
                             )
                         break
                     consecutive_errors = 0
+                    self._note_api_ok()
                     rv = resource_version(event.object) or rv
                     if event.type == "BOOKMARK":
                         # Bookmarks carry ONLY metadata.resourceVersion — no
@@ -977,23 +1435,47 @@ class CCManager:
                     else:
                         maybe_retry()
                 else:
-                    # Stream ended normally (server-side timeout): retry a
-                    # failed reconcile if due — unless shutdown is in
-                    # progress (a retry started after SIGTERM would race
-                    # the hard-exit fallback) — then reconnect with the
-                    # tracked rv.
+                    # Stream ended normally (server-side timeout): the
+                    # apiserver answered, so the outage clock resets and
+                    # any deferred patches flush even on a QUIET node
+                    # whose stream carries no events. Then retry a failed
+                    # reconcile if due — unless shutdown is in progress (a
+                    # retry started after SIGTERM would race the hard-exit
+                    # fallback) — and reconnect with the tracked rv.
+                    self._note_api_ok()
                     if not (stop and stop.is_set()):
                         maybe_retry()
                     continue
             except KubeApiError as e:
+                self._note_api_err(e)
                 consecutive_errors += 1
+                # Disconnected-mode ladder: once a TOTAL outage outlasts
+                # CC_OFFLINE_GRACE_S (and the journal holds local truth),
+                # the agent stops treating the error cap as fatal — a
+                # crash-exit would gain nothing, and the node keeps
+                # serving its last-known desired mode while label writes
+                # defer into the journal. Reconnects continue on the
+                # capped jittered ladder.
+                offline_autonomy = (
+                    self.intents is not None
+                    and self.offline.engaged
+                    and intent_mod.is_outage_error(e)
+                )
                 if consecutive_errors >= self.max_watch_errors:
-                    raise RuntimeError(
-                        f"{consecutive_errors} consecutive watch errors; giving "
-                        f"up (pod restart acts as recovery)"
-                    ) from e
+                    if not offline_autonomy:
+                        raise RuntimeError(
+                            f"{consecutive_errors} consecutive watch errors; "
+                            f"giving up (pod restart acts as recovery)"
+                        ) from e
+                    log.warning(
+                        "disconnected mode: apiserver dark for %.0fs "
+                        "(%d consecutive watch errors); serving last-known "
+                        "desired mode %r from the intent journal",
+                        self.offline.offline_seconds, consecutive_errors,
+                        self.intents.last_desired_mode,
+                    )
                 delay = self._reconnect_policy.delay_for(
-                    max(0, consecutive_errors - 1)
+                    min(max(0, consecutive_errors - 1), 16)
                 )
                 if e.status == 410:
                     log.info("watch resourceVersion expired; resyncing")

@@ -11,7 +11,10 @@ subsystem (obs/) is served at two debug endpoints:
   tree (what the agent is doing *right now*, nested);
 - ``/tracez`` — JSON: recent finished spans from the journal ring,
   filterable by ``?trace_id=`` (returns that trace's spans plus their
-  nested tree) and boundable by ``?limit=``.
+  nested tree) and boundable by ``?limit=``;
+- ``/journalz`` — JSON: the live node-local intent journal
+  (ccmanager/intent_journal.py): open intents, deferred label patches,
+  last replay outcome — what ``tpu-cc-ctl journal <node>`` reads.
 """
 
 from __future__ import annotations
@@ -94,6 +97,7 @@ def start_metrics_server(
     registry: MetricsRegistry,
     bind: str | None = None,
     journal: journal_mod.Journal | None = None,
+    intent_journal=None,
 ) -> http.server.ThreadingHTTPServer:
     """Serve /metrics, /healthz, /statusz and /tracez on ``bind``:``port``.
 
@@ -134,6 +138,14 @@ def start_metrics_server(
                     )
                     + "\n"
                 ).encode()
+                code = 200
+            elif path == "/journalz":
+                payload = (
+                    intent_journal.snapshot()
+                    if intent_journal is not None
+                    else {"enabled": False}
+                )
+                body = (json.dumps(payload, indent=1) + "\n").encode()
                 code = 200
             else:
                 body = b"not found\n"

@@ -71,6 +71,8 @@ class RuntimeHealthWatchdog:
         metrics: metrics_mod.MetricsRegistry | None = None,
         on_probe: Callable[[bool], None] | None = None,
         on_condemn: Callable[[], None] | None = None,
+        defer_patch: Callable[[dict, BaseException], bool] | None = None,
+        note_patched: Callable[[dict], None] | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -91,6 +93,17 @@ class RuntimeHealthWatchdog:
         # unhealthy.
         self.on_probe = on_probe or (lambda healthy: None)
         self.on_condemn = on_condemn or (lambda: None)
+        # Disconnected-mode hook (manager.defer_patch_if_offline): a ready-
+        # state write refused by a TOTAL apiserver outage is journaled as a
+        # pending patch instead of silently dropped — a condemn that
+        # happens while offline still reaches the labels, in journal
+        # order, when connectivity returns.
+        self.defer_patch = defer_patch
+        # Superseding hook (manager.note_direct_patch): a ready-state
+        # write that LANDS while stale deferred patches are still queued
+        # must outrank them in journal order, or the eventual flush would
+        # clobber it back.
+        self.note_patched = note_patched
         self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
         self.degraded = False
         self._consecutive_unhealthy = 0
@@ -155,13 +168,25 @@ class RuntimeHealthWatchdog:
         return probe
 
     def _patch_ready(self, value: str) -> None:
-        self.retry_policy.call(
-            lambda: self.api.patch_node_labels(
-                self.node_name, {CC_READY_STATE_LABEL: value}
-            ),
-            op="watchdog.patch_ready",
-            classify=classify_kube_error,
-        )
+        try:
+            self.retry_policy.call(
+                lambda: self.api.patch_node_labels(
+                    self.node_name, {CC_READY_STATE_LABEL: value}
+                ),
+                op="watchdog.patch_ready",
+                classify=classify_kube_error,
+            )
+            if self.note_patched is not None:
+                self.note_patched({CC_READY_STATE_LABEL: value})
+        except KubeApiError as e:
+            patch = {CC_READY_STATE_LABEL: value}
+            if self.defer_patch is not None and self.defer_patch(patch, e):
+                log.warning(
+                    "watchdog: apiserver offline; %s=%s deferred to the "
+                    "intent journal", CC_READY_STATE_LABEL, value,
+                )
+                return
+            raise
 
     def _demote(self, probe: HealthProbe, first: bool = True) -> None:
         if self.is_busy():
@@ -265,6 +290,8 @@ def start_from_env(
     metrics: metrics_mod.MetricsRegistry | None = None,
     on_probe: Callable[[bool], None] | None = None,
     on_condemn: Callable[[], None] | None = None,
+    defer_patch: Callable[[dict, BaseException], bool] | None = None,
+    note_patched: Callable[[dict], None] | None = None,
 ) -> RuntimeHealthWatchdog | None:
     """CLI wiring: CC_WATCHDOG_INTERVAL_S (0 disables),
     CC_WATCHDOG_DEMOTE_AFTER, CC_WATCHDOG_RESTORE_AFTER."""
@@ -294,6 +321,8 @@ def start_from_env(
         metrics=metrics,
         on_probe=on_probe,
         on_condemn=on_condemn,
+        defer_patch=defer_patch,
+        note_patched=note_patched,
     )
     watchdog.start(stop)
     return watchdog

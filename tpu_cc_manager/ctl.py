@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 
 from tpu_cc_manager.ccmanager.multislice import (
@@ -165,6 +166,29 @@ def build_parser() -> argparse.ArgumentParser:
     )
     uq.add_argument("--node", required=True)
     uq.add_argument("--reason", default="operator")
+
+    jn = sub.add_parser(
+        "journal",
+        help="show a node's live intent journal (open hardware-transition "
+        "intents, deferred label patches, last replay outcome) by reading "
+        "the agent's /journalz debug endpoint — the first stop when a "
+        "node rode out an apiserver outage (ccmanager/intent_journal.py)",
+    )
+    jn.add_argument("--node", default=None, help="node whose agent to query")
+    jn.add_argument(
+        "--port", type=int,
+        default=int(os.environ.get("CC_METRICS_PORT") or 0) or 9099,
+        help="agent metrics/debug port (default: $CC_METRICS_PORT or 9099)",
+    )
+    jn.add_argument(
+        "--url", default=None,
+        help="query this /journalz URL directly instead of resolving the "
+        "node's address through the apiserver",
+    )
+    jn.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="print the raw JSON payload instead of the summary view",
+    )
 
     rb = sub.add_parser(
         "rbac-check",
@@ -585,6 +609,73 @@ def cmd_status(api, args) -> int:
     return 0
 
 
+def _node_debug_address(api, node_name: str) -> str:
+    """The address `ctl journal` dials: InternalIP preferred (the debug
+    port binds the pod/host network), Hostname as the fallback."""
+    node = api.get_node(node_name)
+    addresses = (node.get("status") or {}).get("addresses") or []
+    by_type = {a.get("type"): a.get("address") for a in addresses}
+    addr = (
+        by_type.get("InternalIP")
+        or by_type.get("ExternalIP")
+        or by_type.get("Hostname")
+    )
+    if not addr:
+        raise ValueError(
+            f"node {node_name} exposes no address in status.addresses; "
+            "pass --url http://<agent>:<port>/journalz directly"
+        )
+    return addr
+
+
+def cmd_journal(api, args) -> int:
+    """Show a node's live intent journal via the agent's /journalz debug
+    endpoint (ccmanager/metrics_server.py)."""
+    import urllib.request
+
+    url = getattr(args, "url", None)
+    if not url:
+        if not getattr(args, "node", None):
+            raise ValueError("journal: --node (or --url) is required")
+        addr = _node_debug_address(api, args.node)
+        url = f"http://{addr}:{args.port}/journalz"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+    except (OSError, ValueError) as e:
+        log.error("could not read %s: %s", url, e)
+        return 1
+    if getattr(args, "as_json", False):
+        print(json.dumps(payload, indent=1))
+        return 0
+    if payload.get("enabled") is False:
+        print("intent journal: DISABLED on this agent (CC_INTENT_JOURNAL=0)")
+        return 0
+    print(f"intent journal: {payload.get('path')} (seq={payload.get('seq')})")
+    print(f"last desired mode: {payload.get('last_desired_mode') or '-'}")
+    replay = payload.get("last_replay") or {}
+    if replay:
+        print(
+            "last replay: %d record(s), %d torn byte(s) truncated"
+            % (replay.get("records", 0), replay.get("truncated_bytes", 0))
+        )
+    intents = payload.get("open_intents") or []
+    print(f"open intents: {len(intents)}")
+    for i in intents:
+        print(
+            f"  {i.get('txn')}: kind={i.get('kind')} phase={i.get('phase')} "
+            f"mode={i.get('mode', '-')} seq={i.get('seq')}"
+        )
+    pending = payload.get("pending_patches") or {}
+    print(
+        f"deferred label patches: {len(pending)} key(s) in "
+        f"{payload.get('pending_patch_records', 0)} record(s)"
+    )
+    for key in sorted(pending):
+        print(f"  {key} = {pending[key]!r}")
+    return 0
+
+
 def cmd_rbac_check(api, args) -> int:
     """Check every verb the agent uses (kubeclient/rest.py; the DaemonSet
     ClusterRole in deployments/manifests/daemonset.yaml must grant exactly
@@ -708,6 +799,7 @@ def main(argv: list[str] | None = None) -> int:
             "status": cmd_status,
             "quarantine": cmd_quarantine,
             "unquarantine": cmd_unquarantine,
+            "journal": cmd_journal,
             "rbac-check": cmd_rbac_check,
             "drain-subscribe": cmd_drain_subscribe,
         }[args.command](api, args)

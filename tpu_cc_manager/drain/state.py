@@ -23,22 +23,31 @@ from tpu_cc_manager.labels import (
 log = logging.getLogger(__name__)
 
 
+def state_label_patch(state: str, reason: str | None = None) -> dict:
+    """The merge-patch reporting an actual state (mode.state, the derived
+    ready.state, and the failed reason — cleared by any non-failed state).
+    Exposed separately from :func:`set_cc_state_label` so the manager's
+    disconnected mode can journal exactly this patch for a deferred flush
+    when the apiserver is unreachable (ccmanager/intent_journal.py)."""
+    return {
+        CC_MODE_STATE_LABEL: state,
+        CC_READY_STATE_LABEL: ready_state_for(state),
+        CC_FAILED_REASON_LABEL: (
+            label_safe(reason) if state == STATE_FAILED and reason else None
+        ),
+    }
+
+
 def set_cc_state_label(
     api: KubeApi, node_name: str, state: str, reason: str | None = None
 ) -> None:
     """Report actual state; on ``failed`` also publish a machine-readable
     reason label, cleared again by any non-failed state. One merge-patch."""
-    ready = ready_state_for(state)
+    patch = state_label_patch(state, reason)
     log.info(
         "reporting state on %s: %s=%s %s=%s%s",
-        node_name, CC_MODE_STATE_LABEL, state, CC_READY_STATE_LABEL, ready,
+        node_name, CC_MODE_STATE_LABEL, state,
+        CC_READY_STATE_LABEL, patch[CC_READY_STATE_LABEL],
         f" reason={reason}" if reason else "",
     )
-    patch: dict[str, str | None] = {
-        CC_MODE_STATE_LABEL: state,
-        CC_READY_STATE_LABEL: ready,
-        CC_FAILED_REASON_LABEL: (
-            label_safe(reason) if state == STATE_FAILED and reason else None
-        ),
-    }
     api.patch_node_labels(node_name, patch)

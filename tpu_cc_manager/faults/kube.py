@@ -148,6 +148,11 @@ class FaultyKubeClient(KubeApi):
         if fault is not None and fault.kind == "stale-rv":
             log.info("chaos: injecting %s", fault.describe())
             raise KubeApiError(410, f"chaos: {fault.describe()}")
+        if fault is not None and fault.kind == "blackout":
+            # Total outage: the watch connect is refused like every other
+            # verb — no events leak through a dead apiserver.
+            log.info("chaos: injecting %s", fault.describe())
+            raise KubeApiError(None, f"chaos: {fault.describe()}")
         stream = self.inner.watch_nodes(name, resource_version, timeout_seconds)
         if fault is None:
             yield from stream

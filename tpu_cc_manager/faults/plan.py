@@ -45,6 +45,11 @@ WATCH_KINDS = (
     "watch-hangup",  # stream dies mid-flight with a transport error
     "stale-rv",      # 410 Gone on connect (forces the resync path)
 )
+#: Total-outage mode (``blackout_rate``): while a window is open EVERY
+#: verb — watch connects included — refuses with a connection reset, the
+#: signature of a dead apiserver/load balancer. This is the fault the
+#: disconnected-mode ladder (ccmanager/intent_journal.py) exists for.
+BLACKOUT_KIND = "blackout"
 
 
 @dataclass(frozen=True)
@@ -81,6 +86,16 @@ class FaultPlan:
     max_faults: int | None = None
     retry_after_s: float = 0.05
     slow_s: float = 0.02
+    # Apiserver-blackout mode: probability an eligible call STARTS a total-
+    # outage window (0 disables), and the window's length in API calls,
+    # drawn uniformly from [blackout_min_calls, blackout_max_calls]. The
+    # windows are seeded — a DERIVED stream, so enabling blackouts does not
+    # reshuffle the per-call fault schedule other modes draw from the main
+    # stream — and each whole window counts ONCE against max_faults.
+    blackout_rate: float = 0.0
+    blackout_min_calls: int = 5
+    blackout_max_calls: int = 20
+    max_blackouts: int | None = None
     rng: random.Random = field(init=False, repr=False)
     injected: list[Fault] = field(init=False, repr=False)
     _seq: int = field(init=False, repr=False)
@@ -89,6 +104,12 @@ class FaultPlan:
         self.rng = random.Random(self.seed)
         self.injected = []
         self._seq = 0
+        # Derived, not the main stream (see blackout_rate above).
+        self._blackout_rng = random.Random((self.seed << 1) ^ 0x0B1AC0)
+        self._blackout_left = 0
+        self._forced_blackout = False
+        self.blackout_windows = 0
+        self.blackout_refusals = 0
 
     @classmethod
     def from_env(cls, default_seed: int = 20260803, **kwargs) -> "FaultPlan":
@@ -128,12 +149,69 @@ class FaultPlan:
         self.injected.append(fault)
         return fault
 
+    # ---- apiserver-blackout mode ----------------------------------------
+
+    @property
+    def in_blackout(self) -> bool:
+        return self._forced_blackout or self._blackout_left > 0
+
+    def begin_blackout(self, calls: int | None = None) -> None:
+        """Open a total-outage window deterministically (tests and drills):
+        ``calls`` bounds it, None keeps it open until :meth:`end_blackout`.
+        """
+        if calls is None:
+            self._forced_blackout = True
+        else:
+            self._blackout_left = max(self._blackout_left, calls)
+        self.blackout_windows += 1
+
+    def end_blackout(self) -> None:
+        self._forced_blackout = False
+        self._blackout_left = 0
+
+    def _blackout_tick(self, op: str) -> Fault | None:
+        """One blackout decision per API call: refuse while a window is
+        open, otherwise (blackout_rate > 0) maybe open a seeded one. Both
+        draws come from the derived blackout stream on EVERY call, so the
+        schedule stays a pure function of (seed, call sequence)."""
+        if self.in_blackout:
+            if self._blackout_left > 0:
+                self._blackout_left -= 1
+            self._seq += 1
+            self.blackout_refusals += 1
+            return Fault(kind=BLACKOUT_KIND, op=op, seq=self._seq)
+        if self.blackout_rate <= 0:
+            return None
+        roll = self._blackout_rng.random()
+        span = self._blackout_rng.randint(
+            self.blackout_min_calls, max(self.blackout_min_calls,
+                                         self.blackout_max_calls)
+        )
+        if roll >= self.blackout_rate or self.exhausted or (
+            self.max_blackouts is not None
+            and self.blackout_windows >= self.max_blackouts
+        ):
+            return None
+        self._seq += 1
+        self._blackout_left = span - 1  # this call is the first refusal
+        self.blackout_windows += 1
+        self.blackout_refusals += 1
+        fault = Fault(kind=BLACKOUT_KIND, op=op, seq=self._seq)
+        self.injected.append(fault)  # the window counts once
+        return fault
+
     def decide(self, op: str) -> Fault | None:
         """One decision for a unary API call."""
+        fault = self._blackout_tick(op)
+        if fault is not None:
+            return fault
         return self._draw(op, self.rate, KINDS)
 
     def decide_watch(self, op: str = "watch") -> Fault | None:
         """One decision for a watch-stream connect."""
+        fault = self._blackout_tick(op)
+        if fault is not None:
+            return fault
         return self._draw(op, self.watch_rate, WATCH_KINDS)
 
     def decide_orchestrator_kill(self, point: str) -> None:
@@ -156,6 +234,23 @@ class FaultPlan:
         fault = Fault(kind="orch-kill", op=point, seq=self._seq)
         self.injected.append(fault)
         raise OrchestratorKilled(point, self._seq)
+
+    def schedule_journal_fault(self, journal) -> bool:
+        """Optionally arm ONE disk fault on the node-local intent journal
+        (ccmanager/intent_journal.py ``fail_appends``): the next append
+        raises as if the state-dir disk faulted mid-write. Drawn from the
+        seeded main stream like the backend faults — the agent must keep
+        reconciling (loudly, unjournaled) when its WAL cannot persist.
+        Returns whether a fault was armed."""
+        self._seq += 1
+        roll = self.rng.random()
+        if roll >= self.rate or self.exhausted:
+            return False
+        self.injected.append(
+            Fault(kind="journal-disk", op="journal.append", seq=self._seq)
+        )
+        journal.fail_appends += 1
+        return True
 
     def schedule_backend_fault(self, backend, ops: tuple[str, ...]) -> str | None:
         """Optionally arm ONE fault on a fake device backend

@@ -148,6 +148,16 @@ class TpuCcBackend(abc.ABC):
         property (reference analogue: per-gpu set_cc_mode, main.py:511,
         batched by the caller)."""
 
+    def clear_staged(self, chips: tuple[TpuChip, ...]) -> None:
+        """Withdraw a staged-but-uncommitted mode from ``chips`` — the
+        rollback half of ``stage_cc_mode``. The intent-journal replayer
+        (ccmanager/intent_journal.py) calls this when a crash interrupted
+        a transition BEFORE its reset: nothing disruptive ran, so the
+        clean recovery is to roll the staging back rather than re-drive a
+        transition the desired label may no longer want. Idempotent; the
+        default is a no-op for backends whose staging has no durable
+        side effects."""
+
     @abc.abstractmethod
     def reset(self, chips: tuple[TpuChip, ...]) -> None:
         """Commit staged modes by resetting the chip set together. The whole

@@ -132,6 +132,15 @@ class FakeTpuBackend(TpuCcBackend):
                 self.staged[chip.index] = mode
             self.op_log.append(("stage", (tuple(c.index for c in chips), mode)))
 
+    def clear_staged(self, chips: tuple[TpuChip, ...]) -> None:
+        self._maybe_fail("clear_staged")
+        with self._lock:
+            for chip in chips:
+                self.staged.pop(chip.index, None)
+            self.op_log.append(
+                ("clear_staged", tuple(c.index for c in chips))
+            )
+
     def reset(self, chips: tuple[TpuChip, ...]) -> None:
         self._maybe_fail("reset")
         if self.reset_latency_s:

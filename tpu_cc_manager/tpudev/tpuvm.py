@@ -471,6 +471,19 @@ class TpuVmBackend(TpuCcBackend):
         self._write_state("staged.json", staged)
         log.info("staged mode=%s on %d chip(s)", mode, len(chips))
 
+    def clear_staged(self, chips: tuple[TpuChip, ...]) -> None:
+        """Roll a staged-but-never-reset mode back out of staged.json (the
+        intent-journal replayer's pre-reset rollback). Idempotent — chips
+        that never staged are skipped — and leaves committed/pending state
+        untouched, so query_cc_mode keeps reporting hardware truth."""
+        staged = self._read_state("staged.json")
+        dropped = [
+            k for k in (str(c.index) for c in chips) if staged.pop(k, None)
+        ]
+        if dropped:
+            self._write_state("staged.json", staged)
+            log.info("cleared staged mode on %d chip(s)", len(dropped))
+
     def reset(self, chips: tuple[TpuChip, ...]) -> None:
         staged = self._read_state("staged.json")
         pending = {}
