@@ -197,6 +197,13 @@ class MetricsRegistry:
         self._rollout_resumes_total = 0
         self._rollout_lease_transitions_total = 0
         self._rollout_fenced_writes_total = 0
+        # Apiserver-outage autonomy (ccmanager/intent_journal.py): live
+        # connectivity, how long the current outage has lasted, intent-
+        # journal replays by outcome, and deferred label patches.
+        self._apiserver_connected: bool | None = None
+        self._offline_seconds: float | None = None
+        self._journal_replay_totals: dict[str, int] = {}
+        self._deferred_patch_total = 0
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -296,6 +303,36 @@ class MetricsRegistry:
         with self._lock:
             self._rollout_fenced_writes_total += 1
 
+    def set_apiserver_connected(self, connected: bool) -> None:
+        """Record whether the last apiserver interaction succeeded (the
+        disconnected-mode ladder's outward signal)."""
+        with self._lock:
+            self._apiserver_connected = bool(connected)
+
+    def set_offline_seconds(self, seconds: float) -> None:
+        """Record how long the current total apiserver outage has lasted
+        (0 when connected)."""
+        with self._lock:
+            self._offline_seconds = max(0.0, seconds)
+
+    def record_journal_replay(self, outcome: str) -> None:
+        """Count one intent-journal replay resolution by outcome
+        (``completed`` / ``rolled-back`` / ``clean`` / ``failed-closed``)."""
+        with self._lock:
+            self._journal_replay_totals[outcome] = (
+                self._journal_replay_totals.get(outcome, 0) + 1
+            )
+
+    def record_deferred_patch(self) -> None:
+        """Count one node-label write deferred into the intent journal
+        because the apiserver was unreachable."""
+        with self._lock:
+            self._deferred_patch_total += 1
+
+    def journal_replay_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._journal_replay_totals)
+
     def rollout_totals(self) -> dict[str, int]:
         with self._lock:
             return {
@@ -370,6 +407,10 @@ class MetricsRegistry:
             rollout_resumes = self._rollout_resumes_total
             rollout_transitions = self._rollout_lease_transitions_total
             rollout_fenced = self._rollout_fenced_writes_total
+            apiserver_connected = self._apiserver_connected
+            offline_seconds = self._offline_seconds
+            journal_replays = dict(self._journal_replay_totals)
+            deferred_patches = self._deferred_patch_total
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -487,6 +528,49 @@ class MetricsRegistry:
             lines.append("# TYPE tpu_cc_rollout_fenced_writes_total counter")
             lines.append(
                 "tpu_cc_rollout_fenced_writes_total %d" % rollout_fenced
+            )
+        if apiserver_connected is not None:
+            lines.append(
+                "# HELP tpu_cc_apiserver_connected Whether the last "
+                "apiserver interaction succeeded (0 = total outage; the "
+                "disconnected-mode ladder is engaged once the outage "
+                "outlasts CC_OFFLINE_GRACE_S)."
+            )
+            lines.append("# TYPE tpu_cc_apiserver_connected gauge")
+            lines.append(
+                "tpu_cc_apiserver_connected %d"
+                % (1 if apiserver_connected else 0)
+            )
+        if offline_seconds is not None:
+            lines.append(
+                "# HELP tpu_cc_offline_seconds How long the current total "
+                "apiserver outage has lasted (0 when connected)."
+            )
+            lines.append("# TYPE tpu_cc_offline_seconds gauge")
+            lines.append("tpu_cc_offline_seconds %.3f" % offline_seconds)
+        if journal_replays:
+            lines.append(
+                "# HELP tpu_cc_journal_replays_total Intent-journal replay "
+                "resolutions by outcome (completed / rolled-back / clean / "
+                "failed-closed; ccmanager/intent_journal.py)."
+            )
+            lines.append("# TYPE tpu_cc_journal_replays_total counter")
+            for outcome in sorted(journal_replays):
+                lines.append(
+                    "tpu_cc_journal_replays_total%s %d"
+                    % (_labels(outcome=outcome), journal_replays[outcome])
+                )
+        if deferred_patches:
+            lines.append(
+                "# HELP tpu_cc_journal_deferred_patches_total Node-label "
+                "writes deferred into the intent journal while the "
+                "apiserver was unreachable (flushed on reconnect)."
+            )
+            lines.append(
+                "# TYPE tpu_cc_journal_deferred_patches_total counter"
+            )
+            lines.append(
+                "tpu_cc_journal_deferred_patches_total %d" % deferred_patches
             )
         # The cumulative per-phase sums/counts are served exclusively as
         # the histogram's _sum/_count series below — separate
