@@ -456,6 +456,14 @@ def main() -> int:
         [control["smoke"]] + [r["smoke"] for r in realistic_runs],
         control_backend=control["backend"],
     )
+    # The smoke result only self-reports a generation when it ran ON the
+    # chip; a CPU-fallback smoke on a TPU host still knows what chip the
+    # node carries (env: PALLAS_AXON_TPU_GEN / TPU_ACCELERATOR_TYPE, else
+    # device_kind) — per-generation result keying (ROADMAP 5b) needs the
+    # field populated either way.
+    from tpu_cc_manager.utils.tpu_info import tpu_generation
+
+    chip_generation = smoke.get("generation") or tpu_generation()
     result = {
         "metric": "node_drain_cc_on_ready_sec",
         # Headline is the REALISTIC scenario (simulated-real device
@@ -467,7 +475,7 @@ def main() -> int:
         "vs_baseline": round(90.0 / dt, 2) if dt > 0 else 0.0,
         "ok": bool(control["ok"] and all(r["ok"] for r in realistic_runs)),
         "smoke_backend": best_backend,
-        "chip_generation": smoke.get("generation"),
+        "chip_generation": chip_generation,
         "smoke_tflops": smoke.get("tflops"),
         "smoke_mfu": smoke.get("mfu"),
         # Raw chip-side values behind the median above, one per run that

@@ -253,6 +253,10 @@ def _seeded_registry_text() -> str:
     registry.record_journal_replay("rolled-back")
     registry.record_journal_replay('odd"outcome\nhere')
     registry.record_deferred_patch()
+    # Fleet-scale orchestration family (kubeclient per-verb accounting).
+    registry.record_apiserver_request("list")
+    registry.record_apiserver_request("watch")
+    registry.record_apiserver_request('odd"verb')
     return registry.render_prometheus()
 
 

@@ -42,6 +42,7 @@ mkdir -p artifacts
 # even when the ladder stops at an early stage.
 ARTIFACTS=(
   artifacts/chaos_soak.json
+  SCALE_r01.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
   artifacts/resnet_ladder_r05.jsonl
@@ -136,6 +137,26 @@ else
     [ -s artifacts/chaos_soak.json ] && \
       mv artifacts/chaos_soak.json artifacts/chaos_soak.failed.json
     echo ">>> chaos soak FAILED; stopping ladder (robustness evidence gates the rest; summary in artifacts/chaos_soak.failed.json)"
+    finish
+  }
+fi
+
+# Fleet-scale evidence: the scale bench is CPU-only too (simulated
+# FakeKube fleets), so it also runs before the tunnel-gated ladder.
+# Resumable at two grains: completed (mode, size) rows persist in the
+# partial JSONL and are skipped on re-run (the 10k pool takes minutes —
+# an interruption must not re-buy finished pools), and the whole stage is
+# skipped once the summary records ok:true. A failed summary is parked
+# like the chaos soak's so finish() can't mistake it for captured.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("SCALE_r01.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> SCALE_r01.json already captured (ok:true); skipping"
+else
+  echo "=== stage: scale-bench (local, no tunnel) ==="
+  python3 hack/scale_bench.py --out SCALE_r01.json \
+      --partial artifacts/scale_partial.jsonl \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s SCALE_r01.json ] && mv SCALE_r01.json artifacts/SCALE_r01.failed.json
+    echo ">>> scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r01.failed.json; partial rows kept for resume)"
     finish
   }
 fi

@@ -30,6 +30,7 @@ unpause. Control endpoints (not part of k8s): POST /_ctl/set-label
 (optional "node"), POST /_ctl/stick-pod, POST /_ctl/state,
 POST /_ctl/compact (410-expire watches resuming below a rv floor).
 """
+import copy
 import json
 import os
 import queue
@@ -162,6 +163,16 @@ GRANTS = _load_cluster_role_grants()
 
 lock = threading.Lock()
 rv = [1]
+# Per-verb request counters (get/list/watch/patch/create/update/delete):
+# served at POST /_ctl/requests so the demos and the scale harness can
+# read the apiserver-side QPS the orchestrator generated.
+request_counts: dict = {}
+
+
+def count_request(verb: str) -> None:
+    with lock:
+        request_counts[verb] = request_counts.get(verb, 0) + 1
+
 # Watch resumes below this resourceVersion answer 410 Gone, like a real
 # apiserver after etcd compaction. Raised via POST /_ctl/compact.
 compacted_below = [0]
@@ -171,6 +182,12 @@ pods: dict[str, dict] = {}  # pod name -> pod dict
 # rolling orchestrator's single-writer lock + checkpoint record
 # (ccmanager/rollout_state.py). Updates enforce resourceVersion CAS.
 leases: dict[tuple[str, str], dict] = {}
+# In-flight chunked listings: a continue token serves from the snapshot
+# taken at the FIRST page (real apiservers pin continues to the first
+# page's etcd revision) so a label flip between pages can't shift the
+# name sort and drop a node from the listing. token -> (items, rv).
+page_snapshots: dict[str, tuple[list, str]] = {}
+page_snapshot_seq = [0]
 
 _LEASE_PATH_RE = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases(?:/([^/]+))?$"
@@ -199,7 +216,10 @@ def add_node(name: str) -> None:
 
 
 # watchers: list of (chunk_writer, node_name_filter or None,
-# wants_bookmarks)
+# label_selector or None, in_view name set, wants_bookmarks). in_view
+# tracks which nodes a selector-scoped watcher currently "sees", so a
+# node whose labels stop matching is delivered as DELETED — the rule a
+# real apiserver applies and an informer cache depends on.
 watchers = []
 
 # Real apiservers send periodic BOOKMARK events (metadata-only, fresh
@@ -229,35 +249,57 @@ _event_queue: "queue.Queue[tuple[object, bytes]]" = queue.Queue()
 
 
 def emit_watch_event(node: dict) -> None:
-    """Serialize under the caller's lock, enqueue for the single writer
+    """Snapshot under the caller's lock, enqueue for the single writer
     thread: writes happen OUTSIDE the lock (a stalled watch client must
     not wedge the other endpoints by blocking sendall while holding it),
-    and one writer preserves both frame integrity and event ordering."""
+    and one writer preserves both frame integrity and event ordering.
+    The writer serializes per watcher, because selector-scoped watchers
+    each need their own event type (MODIFIED vs ADDED vs synthesized
+    DELETED, depending on what that watcher saw before)."""
     name = node["metadata"]["name"]
-    frame = (json.dumps({"type": "MODIFIED", "object": node}) + "\n").encode()
-    _event_queue.put((name, frame))
+    snapshot = json.loads(json.dumps(node))  # frozen at emit time
+    _event_queue.put((name, snapshot))
 
 
 def _watch_writer():
     while True:
-        name, ev = _event_queue.get()
+        name, node = _event_queue.get()
+        # (writer, frame) pairs resolved under the lock, written outside.
+        deliveries = []
         if name is _BOOKMARK:
             with lock:
-                targets = [wf for wf, _, bm in watchers if bm]
-                ev = (json.dumps({
+                frame = (json.dumps({
                     "type": "BOOKMARK",
                     "object": {"metadata": {"resourceVersion": str(rv[0])}},
                 }) + "\n").encode()
+                deliveries = [
+                    (wf, frame) for wf, _, _, _, bm in watchers if bm
+                ]
         else:
             with lock:
-                targets = [
-                    wf for wf, flt, _ in watchers
-                    if flt is None or flt == name
-                ]
+                for wf, flt, lsel, in_view, _ in watchers:
+                    if flt is not None and flt != name:
+                        continue
+                    matches = _match_label_selector(
+                        node["metadata"].get("labels") or {}, lsel
+                    )
+                    if matches:
+                        etype = "MODIFIED" if name in in_view else "ADDED"
+                        in_view.add(name)
+                    elif name in in_view:
+                        # Left the watcher's selector: a real apiserver
+                        # sends DELETED so caches drop the node.
+                        in_view.discard(name)
+                        etype = "DELETED"
+                    else:
+                        continue
+                    deliveries.append((wf, (json.dumps(
+                        {"type": etype, "object": node}
+                    ) + "\n").encode()))
         dead = []
-        for wf in targets:
+        for wf, frame in deliveries:
             try:
-                wf.write(ev)
+                wf.write(frame)
                 wf.flush()
             except Exception:
                 dead.append(wf)
@@ -368,6 +410,7 @@ class Handler(BaseHTTPRequestHandler):
         if m and not self._authorized("get", "nodes"):
             return self._forbid("get", "nodes")
         if m:
+            count_request("get")
             with lock:
                 node = nodes.get(m.group(1))
             if node is None:
@@ -380,6 +423,7 @@ class Handler(BaseHTTPRequestHandler):
         if u.path == "/api/v1/nodes" and q.get("watch") == ["true"]:
             if not self._authorized("watch", "nodes"):
                 return self._forbid("watch", "nodes")
+            count_request("watch")
             # Real apiservers 410-Gone a watch resuming from a
             # resourceVersion older than the compaction floor; the
             # manager's resync path (re-GET + conditional re-apply,
@@ -401,12 +445,14 @@ class Handler(BaseHTTPRequestHandler):
                         410,
                     )
             # Field selector metadata.name=<n> scopes the stream to one node
-            # (the agent's watch); absent means all nodes.
+            # (the agent's watch); absent means all nodes. A labelSelector
+            # scopes it to a pool (the informer cache's watch).
             flt = None
             fsel = q.get("fieldSelector", [None])[0]
             fm = re.match(r"^metadata\.name=(.+)$", fsel or "")
             if fm:
                 flt = fm.group(1)
+            lsel = q.get("labelSelector", [None])[0]
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
@@ -429,13 +475,17 @@ class Handler(BaseHTTPRequestHandler):
             self.connection.settimeout(10.0)
             cw = ChunkWriter(self.wfile)
             with lock:
+                in_view = set()
                 for name, node in nodes.items():
-                    if flt is None or flt == name:
+                    if (flt is None or flt == name) and _match_label_selector(
+                        node["metadata"].get("labels") or {}, lsel
+                    ):
+                        in_view.add(name)
                         ev = json.dumps({"type": "ADDED", "object": node}) + "\n"
                         cw.write(ev.encode())
                 cw.flush()
                 wants_bookmarks = q.get("allowWatchBookmarks") == ["true"]
-                watchers.append((cw, flt, wants_bookmarks))
+                watchers.append((cw, flt, lsel, in_view, wants_bookmarks))
             # Hold the connection open; events pushed by emit_watch_event.
             timeout = float(q.get("timeoutSeconds", ["300"])[0])
             time.sleep(timeout)
@@ -449,19 +499,53 @@ class Handler(BaseHTTPRequestHandler):
         if u.path == "/api/v1/nodes":
             if not self._authorized("list", "nodes"):
                 return self._forbid("list", "nodes")
+            count_request("list")
             sel = q.get("labelSelector", [None])[0]
+            # limit/continue chunking, as the real apiserver pages big
+            # listings: the first page snapshots the name-sorted matching
+            # set and the token walks THAT snapshot, so a label change
+            # between pages can't shift the sort and drop a node. An
+            # unknown or unparseable token answers 410 Expired, which
+            # clients treat as "restart the listing".
+            limit = q.get("limit", [None])[0]
+            token = q.get("continue", [None])[0]
             with lock:
-                items = [
-                    n for n in nodes.values()
-                    if _match_label_selector(n["metadata"]["labels"], sel)
-                ]
+                if token is not None:
+                    snap = page_snapshots.pop(token, None)
+                    if snap is None:
+                        return self._json(
+                            {"kind": "Status", "code": 410,
+                             "reason": "Expired",
+                             "message": f"continue token {token!r} expired"},
+                            410,
+                        )
+                    items, list_rv = snap
+                    offset = int(token.split(":")[-1])
+                else:
+                    items = [
+                        copy.deepcopy(n) for _, n in sorted(nodes.items())
+                        if _match_label_selector(n["metadata"]["labels"], sel)
+                    ]
+                    list_rv = str(rv[0])
+                    offset = 0
+                meta = {"resourceVersion": list_rv}
+                end = offset + max(1, int(limit)) if limit else len(items)
+                if end < len(items):
+                    page_snapshot_seq[0] += 1
+                    new_token = f"{page_snapshot_seq[0]}:{end}"
+                    page_snapshots[new_token] = (items, list_rv)
+                    meta["continue"] = new_token
+                    # Abandoned paginations must not pin snapshots forever.
+                    while len(page_snapshots) > 8:
+                        del page_snapshots[next(iter(page_snapshots))]
                 return self._json({"kind": "NodeList",
-                                   "items": items,
-                                   "metadata": {"resourceVersion": str(rv[0])}})
+                                   "items": items[offset:end],
+                                   "metadata": meta})
         lm = _LEASE_PATH_RE.match(u.path)
         if lm and lm.group(2):
             if not self._authorized("get", "leases"):
                 return self._forbid("get", "leases")
+            count_request("get")
             with lock:
                 lease = leases.get((lm.group(1), lm.group(2)))
                 if lease is None:
@@ -473,6 +557,7 @@ class Handler(BaseHTTPRequestHandler):
         if u.path == f"/api/v1/namespaces/{NS}/pods":
             if not self._authorized("list", "pods"):
                 return self._forbid("list", "pods")
+            count_request("list")
             sel = q.get("labelSelector", [None])[0]
             fsel = q.get("fieldSelector", [None])[0]
             with lock:
@@ -496,6 +581,7 @@ class Handler(BaseHTTPRequestHandler):
         if m:
             if not self._authorized("patch", "nodes"):
                 return self._forbid("patch", "nodes")
+            count_request("patch")
             with lock:
                 node = nodes.get(m.group(1))
                 if node is None:
@@ -567,6 +653,7 @@ class Handler(BaseHTTPRequestHandler):
         if lm and lm.group(2):
             if not self._authorized("update", "leases"):
                 return self._forbid("update", "leases")
+            count_request("update")
             key = (lm.group(1), lm.group(2))
             with lock:
                 stored = leases.get(key)
@@ -604,6 +691,7 @@ class Handler(BaseHTTPRequestHandler):
         if lm and lm.group(2):
             if not self._authorized("delete", "leases"):
                 return self._forbid("delete", "leases")
+            count_request("delete")
             with lock:
                 if leases.pop((lm.group(1), lm.group(2)), None) is None:
                     return self._json(
@@ -636,6 +724,7 @@ class Handler(BaseHTTPRequestHandler):
         if m:
             if not self._authorized("create", "events"):
                 return self._forbid("create", "events")
+            count_request("create")
             with lock:
                 events.append(body)
             return self._json(body, 201)
@@ -643,6 +732,7 @@ class Handler(BaseHTTPRequestHandler):
         if lm and not lm.group(2):
             if not self._authorized("create", "leases"):
                 return self._forbid("create", "leases")
+            count_request("create")
             name = ((body.get("metadata") or {}).get("name")) or ""
             if not name:
                 return self._invalid("lease create: metadata.name required")
@@ -691,6 +781,9 @@ class Handler(BaseHTTPRequestHandler):
                 else:
                     sticky_pods.discard(body["name"])
                 return self._json({"ok": True, "sticky": sorted(sticky_pods)})
+        if u.path == "/_ctl/requests":
+            with lock:
+                return self._json({"requests": dict(request_counts)})
         if u.path == "/_ctl/state":
             with lock:
                 evs = [

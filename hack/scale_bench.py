@@ -1,0 +1,422 @@
+"""Fleet-scale orchestration bench: rollout cost vs pool size.
+
+Drives a FULL rolling CC reconfiguration over a simulated fleet of
+100 / 1k / 10k nodes — thousands of simulated node agents (FakeKube
+backed, each behind its own seeded-FaultPlan chaos client) converging on
+the desired-mode labels the orchestrator writes — and measures what the
+orchestrator costs the apiserver, per verb:
+
+- **legacy** mode is the pre-informer orchestrator: every await poll and
+  window boundary re-lists the pool — O(pool) requests AND O(pool)
+  response bytes per decision;
+- **informer** mode is the watch-driven cache (ccmanager/informer.py)
+  plus sharded rollout waves: one chunked listing, one watch, awaits
+  wake on cache events — O(changes).
+
+The artifact (SCALE_r01.json) records rollout wall-clock and the
+orchestrator's per-verb apiserver request counts at each pool size, so
+the O(pool)→O(changes) drop is a measured number, not an assertion. The
+acceptance bar: ≥10× fewer list requests at 1k nodes in informer mode.
+
+Resumable: ``--partial FILE`` appends one JSON line per completed
+(mode, size) run and skips combos already recorded — the evidence ladder
+(hack/evidence_r5.sh) re-runs the script after an interruption without
+re-buying finished pools.
+
+Legacy mode at 10k nodes is skipped by default (--full enables it): its
+O(pool) listings make the run minutes-long by construction, which is the
+very pathology the informer exists to remove; the 1k comparison already
+quantifies it.
+
+Usage:
+    python hack/scale_bench.py                       # full bench
+    python hack/scale_bench.py --sizes 100,1000      # subset
+    python hack/scale_bench.py --out SCALE_r01.json --partial artifacts/scale_partial.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import os
+import random
+import sys
+import threading
+import time
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_cc_manager.ccmanager.informer import NodeInformer  # noqa: E402
+from tpu_cc_manager.ccmanager.rolling import (  # noqa: E402
+    RollingReconfigurator,
+    ZONE_LABEL,
+)
+from tpu_cc_manager.faults.kube import FaultyKubeClient  # noqa: E402
+from tpu_cc_manager.faults.plan import FaultPlan  # noqa: E402
+from tpu_cc_manager.kubeclient.api import (  # noqa: E402
+    KubeApiError,
+    classify_kube_error,
+    node_labels,
+)
+from tpu_cc_manager.kubeclient.fake import FakeKube  # noqa: E402
+from tpu_cc_manager.labels import (  # noqa: E402
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    SLICE_ID_LABEL,
+)
+from tpu_cc_manager.utils import retry as retry_mod  # noqa: E402
+
+SELECTOR = "pool=tpu"
+DEFAULT_SEED = 20260803
+
+
+class CountingKube:
+    """Pass-through wrapper counting the ORCHESTRATOR's per-verb requests
+    (FakeKube.request_counts sees the whole fleet — agents included — so
+    the orchestrator's own apiserver footprint needs its own ledger)."""
+
+    _VERBS = {
+        "get_node": "get", "list_nodes": "list", "list_nodes_page": "list",
+        "list_pods": "list", "patch_node_labels": "patch",
+        "patch_node_annotations": "patch", "patch_node_taints": "patch",
+        "watch_nodes": "watch", "watch_nodes_pool": "watch",
+        "create_event": "create", "get_lease": "get",
+        "create_lease": "create", "update_lease": "update",
+        "delete_lease": "delete",
+    }
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.retries_internally = getattr(inner, "retries_internally", False)
+
+    def _count(self, verb: str) -> None:
+        with self._lock:
+            self.counts[verb] = self.counts.get(verb, 0) + 1
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        verb = self._VERBS.get(name)
+        if verb is None or not callable(attr):
+            return attr
+
+        def counted(*args, **kwargs):
+            self._count(verb)
+            return attr(*args, **kwargs)
+
+        return counted
+
+
+class AgentSim:
+    """Thousands of simulated node agents without thousands of threads.
+
+    A FakeKube patch reactor models each agent's watch: when a node's
+    desired mode diverges from its state, the agent schedules a
+    transition (seeded per-node latency), executed by a small worker pool
+    through that node's own FaultyKubeClient — so every agent's apiserver
+    traffic rides a seeded FaultPlan, like the chaos soak's single agent,
+    and the fleet's convergence is exercised under per-node weather."""
+
+    def __init__(
+        self,
+        fake: FakeKube,
+        seed: int,
+        fault_rate: float = 0.02,
+        workers: int = 24,
+        min_delay_s: float = 0.02,
+        max_delay_s: float = 0.08,
+    ) -> None:
+        self.fake = fake
+        self.seed = seed
+        self.fault_rate = fault_rate
+        self.min_delay_s = min_delay_s
+        self.max_delay_s = max_delay_s
+        self._cond = threading.Condition()
+        self._heap: list[tuple[float, str, str]] = []
+        self._scheduled: set[str] = set()
+        self._stop = False
+        self._clients: dict[str, FaultyKubeClient] = {}
+        self._rngs: dict[str, random.Random] = {}
+        self.transitions = 0
+        self.errors = 0
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True)
+            for _ in range(workers)
+        ]
+        fake.add_patch_reactor(self._react)
+        for t in self._threads:
+            t.start()
+
+    def _client(self, node: str) -> FaultyKubeClient:
+        client = self._clients.get(node)
+        if client is None:
+            # crc32, not hash(): tuple/str hashes are randomized per
+            # process (PYTHONHASHSEED), and the whole point of a seeded
+            # FaultPlan is same-seed-same-schedule across runs.
+            node_seed = zlib.crc32(f"{self.seed}:{node}".encode())
+            plan = FaultPlan(
+                seed=node_seed,
+                rate=self.fault_rate,
+                retry_after_s=0.01,
+                slow_s=0.005,
+            )
+            client = FaultyKubeClient(self.fake, plan)
+            self._clients[node] = client
+            self._rngs[node] = random.Random(node_seed ^ 0xDE1A)
+        return client
+
+    def _react(self, name: str, node: dict) -> None:
+        labels = node_labels(node)
+        desired = labels.get(CC_MODE_LABEL)
+        state = labels.get(CC_MODE_STATE_LABEL)
+        if not desired or desired == state:
+            return
+        with self._cond:
+            if name in self._scheduled:
+                return
+            self._client(name)  # seed rng/client outside the worker
+            delay = self._rngs[name].uniform(self.min_delay_s, self.max_delay_s)
+            heapq.heappush(
+                self._heap, (time.monotonic() + delay, name, desired)
+            )
+            self._scheduled.add(name)
+            self._cond.notify()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and (
+                    not self._heap or self._heap[0][0] > time.monotonic()
+                ):
+                    timeout = (
+                        self._heap[0][0] - time.monotonic()
+                        if self._heap else 0.2
+                    )
+                    self._cond.wait(timeout=max(0.001, min(timeout, 0.2)))
+                if self._stop:
+                    return
+                _, name, desired = heapq.heappop(self._heap)
+            self._transition(name, desired)
+            with self._cond:
+                self._scheduled.discard(name)
+
+    def _transition(self, name: str, desired: str) -> None:
+        api = self._client(name)
+        policy = retry_mod.RetryPolicy(
+            max_attempts=5, base_delay_s=0.01, max_delay_s=0.1
+        )
+        try:
+            # The agent's confirm read + truthful state report — the same
+            # two requests a real reconcile's cheap path costs.
+            policy.call(
+                lambda: api.get_node(name),
+                op="agent.confirm", classify=classify_kube_error,
+            )
+            policy.call(
+                lambda: api.patch_node_labels(
+                    name, {CC_MODE_STATE_LABEL: desired}
+                ),
+                op="agent.report", classify=classify_kube_error,
+            )
+            self.transitions += 1
+        except KubeApiError:
+            # Exhausted the ladder under seeded weather: the reactor fires
+            # again on the next desired-label event; count it.
+            self.errors += 1
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=1.0)
+
+
+def build_fleet(
+    fake: FakeKube, n: int, hosts_per_slice: int = 4, zones: int = 8
+) -> None:
+    slice_count = max(1, n // hosts_per_slice)
+    for i in range(n):
+        sid = i % slice_count
+        labels = {
+            "pool": "tpu",
+            SLICE_ID_LABEL: f"scale-s{sid:05d}",
+            ZONE_LABEL: f"zone-{sid % zones}",
+            CC_MODE_STATE_LABEL: "off",
+        }
+        fake.add_node(f"scale-n{i:05d}", labels)
+
+
+def run_pool(
+    n: int,
+    mode: str,
+    seed: int = DEFAULT_SEED,
+    shards: int = 8,
+    per_shard_unavailable: int = 4,
+    poll_interval_s: float = 0.2,
+    node_timeout_s: float = 120.0,
+    hosts_per_slice: int = 4,
+) -> dict:
+    """One full rollout over an n-node fleet; returns the measured row."""
+    fake = FakeKube()
+    build_fleet(fake, n, hosts_per_slice=hosts_per_slice)
+    sim = AgentSim(fake, seed=seed)
+    counting = CountingKube(fake)
+    informer = None
+    total_unavailable = shards * per_shard_unavailable
+    try:
+        if mode == "informer":
+            informer = NodeInformer(
+                counting, SELECTOR, page_limit=500,
+            ).start(sync_timeout_s=60.0)
+            roller = RollingReconfigurator(
+                counting, SELECTOR,
+                max_unavailable=per_shard_unavailable,
+                poll_interval_s=poll_interval_s,
+                node_timeout_s=node_timeout_s,
+                informer=informer,
+                wave_shards=shards,
+            )
+        else:
+            roller = RollingReconfigurator(
+                counting, SELECTOR,
+                max_unavailable=total_unavailable,
+                poll_interval_s=poll_interval_s,
+                node_timeout_s=node_timeout_s,
+            )
+        t0 = time.monotonic()
+        result = roller.rollout("on")
+        seconds = time.monotonic() - t0
+    finally:
+        if informer is not None:
+            informer.stop()
+        sim.stop()
+    converged = all(
+        node_labels(node).get(CC_MODE_STATE_LABEL) == "on"
+        for node in fake.list_nodes(SELECTOR)
+    )
+    return {
+        "nodes": n,
+        "mode": mode,
+        "ok": bool(result.ok and converged),
+        "converged": converged,
+        "seconds": round(seconds, 2),
+        "groups": len(result.groups),
+        "wave_shards": shards if mode == "informer" else 1,
+        "max_unavailable_total": total_unavailable,
+        "orchestrator_requests": dict(sorted(counting.counts.items())),
+        "fleet_requests": dict(sorted(fake.request_counts.items())),
+        "agent_transitions": sim.transitions,
+        "agent_errors": sim.errors,
+    }
+
+
+def summarize(rows: list[dict]) -> dict:
+    by_key = {(r["mode"], r["nodes"]): r for r in rows}
+    out: dict = {
+        "bench": "scale_rollout",
+        "unit": "apiserver requests / rollout",
+        "selector": SELECTOR,
+        "pools": sorted(rows, key=lambda r: (r["nodes"], r["mode"])),
+    }
+    drops = {}
+    for n in sorted({r["nodes"] for r in rows}):
+        legacy = by_key.get(("legacy", n))
+        informer = by_key.get(("informer", n))
+        if legacy and informer:
+            llists = legacy["orchestrator_requests"].get("list", 0)
+            ilists = max(1, informer["orchestrator_requests"].get("list", 0))
+            drops[str(n)] = round(llists / ilists, 1)
+    out["list_request_drop"] = drops
+    out["ok"] = bool(
+        rows
+        and all(r["ok"] for r in rows)
+        # The acceptance bar: >=10x fewer list requests at 1k nodes.
+        and (drops.get("1000") is None or drops["1000"] >= 10.0)
+    )
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--sizes", default="100,1000,10000")
+    parser.add_argument("--modes", default="legacy,informer")
+    parser.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    parser.add_argument("--shards", type=int, default=8)
+    parser.add_argument("--out", default="SCALE_r01.json")
+    parser.add_argument(
+        "--partial", default=None,
+        help="JSONL of completed (mode,size) rows; existing rows are "
+        "skipped on re-run (resume after an interruption)",
+    )
+    parser.add_argument(
+        "--full", action="store_true",
+        help="also run legacy mode at 10k nodes (minutes of O(pool) "
+        "listings by construction; skipped by default)",
+    )
+    args = parser.parse_args(argv)
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    rows: list[dict] = []
+    done: set[tuple[str, int]] = set()
+    if args.partial and os.path.exists(args.partial):
+        dropped = 0
+        with open(args.partial, encoding="utf-8") as f:
+            for line in f:
+                if line.strip():
+                    row = json.loads(line)
+                    # Only SUCCESSFUL rows are resume-skippable: keeping
+                    # an ok:false row would pin the combo as "done", so
+                    # every later run would recompute the same failed
+                    # summary without ever re-attempting the pool.
+                    if not row.get("ok"):
+                        dropped += 1
+                        continue
+                    rows.append(row)
+                    done.add((row["mode"], row["nodes"]))
+        if done or dropped:
+            print(
+                f">>> resuming: {len(done)} completed run(s) in "
+                f"{args.partial}"
+                + (f"; re-buying {dropped} failed row(s)" if dropped
+                   else ""),
+                file=sys.stderr,
+            )
+    for n in sizes:
+        for mode in modes:
+            if (mode, n) in done:
+                continue
+            if mode == "legacy" and n >= 10000 and not args.full:
+                print(
+                    f">>> skipping legacy@{n} (O(pool) by construction; "
+                    "--full to run it anyway)", file=sys.stderr,
+                )
+                continue
+            print(f">>> rollout: {mode} mode, {n} node(s)", file=sys.stderr)
+            row = run_pool(n, mode, seed=args.seed, shards=args.shards)
+            print(
+                f">>> {mode}@{n}: ok={row['ok']} {row['seconds']}s "
+                f"requests={row['orchestrator_requests']}",
+                file=sys.stderr,
+            )
+            rows.append(row)
+            if args.partial:
+                os.makedirs(
+                    os.path.dirname(args.partial) or ".", exist_ok=True
+                )
+                with open(args.partial, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(row) + "\n")
+    summary = summarize(rows)
+    summary["seed"] = args.seed
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(summary, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

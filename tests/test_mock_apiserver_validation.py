@@ -337,3 +337,99 @@ def test_lease_lifecycle_over_http(client):
     with pytest.raises(KubeApiError) as exc:
         client.get_lease(ns, "occ-lease")
     assert exc.value.status == 404
+
+
+def test_chunked_list_pagination_over_http(client):
+    """Satellite (ISSUE 6): the mock pages big listings through the real
+    limit/continue protocol, so the informer's chunked initial sync
+    (list_nodes_chunked -> RestKube.list_nodes_page) is exercised over
+    real HTTP instead of only against FakeKube."""
+    from tpu_cc_manager.kubeclient.api import list_nodes_chunked
+
+    for i in range(7):
+        mock_apiserver.add_node(f"page-node-{i}")
+    try:
+        page = client.list_nodes_page(limit=3)
+        assert len(page["items"]) == 3
+        token = page["metadata"]["continue"]
+        assert token
+
+        # Walking every page yields exactly the unchunked listing, plus
+        # the listing's resourceVersion for a follow-up watch.
+        items, rv = list_nodes_chunked(client, limit=3)
+        names = [n["metadata"]["name"] for n in items]
+        assert names == sorted(
+            n["metadata"]["name"] for n in client.list_nodes()
+        )
+        assert rv and rv.isdigit()
+
+        # An unparseable continue token answers 410 Expired — the
+        # "restart your listing" signal the informer's relist path rides.
+        with pytest.raises(KubeApiError) as exc:
+            client.list_nodes_page(limit=3, continue_token="bogus!")
+        assert exc.value.status == 410
+    finally:
+        with mock_apiserver.lock:
+            for i in range(7):
+                mock_apiserver.nodes.pop(f"page-node-{i}", None)
+
+
+def test_selector_watch_synthesizes_deleted_on_label_change(server, client):
+    """A selector-scoped watcher (the informer cache's watch) must see a
+    node whose labels STOP matching as DELETED — the rule a real
+    apiserver applies, and what keeps the cache from serving nodes that
+    left the pool."""
+    threading.Thread(target=mock_apiserver._watch_writer, daemon=True).start()
+    mock_apiserver.add_node("pool-watch-node")
+    seen: list = []
+    done = threading.Event()
+
+    def consume():
+        try:
+            for ev in client.watch_nodes_pool(
+                "watch-pool=a", timeout_seconds=5
+            ):
+                seen.append((ev.type, ev.object["metadata"]["name"]))
+                if ev.type == "DELETED":
+                    done.set()
+                    return
+        except KubeApiError:
+            pass
+
+    try:
+        client.patch_node_labels("pool-watch-node", {"watch-pool": "a"})
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while not any(n == "pool-watch-node" for _, n in seen):
+            assert time.monotonic() < deadline, f"never saw the node: {seen}"
+            time.sleep(0.05)
+        # Leaving the selector arrives as DELETED, not MODIFIED.
+        client.patch_node_labels("pool-watch-node", {"watch-pool": "b"})
+        assert done.wait(5.0), f"no DELETED event: {seen}"
+        assert ("DELETED", "pool-watch-node") in seen
+    finally:
+        with mock_apiserver.lock:
+            mock_apiserver.nodes.pop("pool-watch-node", None)
+
+
+def test_request_counters_served_at_ctl_endpoint(server, client):
+    """Satellite (ISSUE 6): the mock counts requests per verb and serves
+    them at POST /_ctl/requests, so the scale harness and demos can read
+    the apiserver-side QPS an orchestrator generated."""
+    import urllib.request
+
+    def counters():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.server_port}/_ctl/requests",
+            data=b"{}", method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())["requests"]
+
+    before = counters()
+    client.get_node(NODE)
+    client.list_nodes()
+    after = counters()
+    assert after.get("get", 0) == before.get("get", 0) + 1
+    assert after.get("list", 0) == before.get("list", 0) + 1

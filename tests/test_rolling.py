@@ -1,6 +1,7 @@
 """Rolling pool reconfiguration (ccmanager/rolling.py)."""
 
 import threading
+import time
 
 import pytest
 
@@ -314,11 +315,14 @@ def test_interrupted_rollout_resumes_idempotently(fake_kube):
     fails = {"node-1"}
     converge_counts = {"node-0": 0, "node-1": 0}
     in_flight = set()
+    paused = threading.Event()  # set = agents stop scheduling reconciles
 
     def reactor(name, node):
         # Like the real agent: reconcile whenever desired != state (the
         # failed-reconcile backoff retry), one reconcile in flight at a
         # time.
+        if paused.is_set():
+            return
         desired = node_labels(node).get(CC_MODE_LABEL)
         state = node_labels(node).get(CC_MODE_STATE_LABEL)
         if desired and state != desired and name not in in_flight:
@@ -340,8 +344,21 @@ def test_interrupted_rollout_resumes_idempotently(fake_kube):
     assert first.ok is False  # halted on node-1
     assert [g.ok for g in first.groups] == [True, False]
 
+    # Quiesce node-1's failed-reconcile retry storm before "fixing" it:
+    # otherwise the next retry tick (50 ms cadence) converges node-1 on
+    # its own, racing the second rollout's planning — on a loaded box the
+    # plan then sees node-1 already at `on` and skips it, which is not
+    # what this test is about. Pausing the agents first makes the
+    # re-drive deterministically the second rollout's doing.
+    paused.set()
+    deadline = time.monotonic() + 5.0
+    while in_flight and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not in_flight
+
     # Operator fixes node-1; the re-run must not re-bounce node-0.
     fails.clear()
+    paused.clear()
     second = make_roller(fake_kube).rollout("on")
     assert second.ok is True
     by_group = {g.group: g for g in second.groups}

@@ -1024,3 +1024,219 @@ def test_crash_mid_rollback_leaves_no_false_done_claims(fake_kube):
         labels = node_labels(fake_kube.get_node(f"node-{i}"))
         assert labels[CC_MODE_LABEL] == "on"
         assert labels[CC_MODE_STATE_LABEL] == "on"
+
+
+# ---------------------------------------------------------------------------
+# Sharded rollout waves (format v2) + pre-refactor record compatibility
+# ---------------------------------------------------------------------------
+
+
+def add_zoned_pool(fake, n=8, zones=2):
+    """n single-host groups spread across zones (the wave partition key)."""
+    for i in range(n):
+        fake.add_node(
+            f"node-{i}",
+            {
+                "pool": "tpu",
+                "topology.kubernetes.io/zone": f"z{i % zones}",
+            },
+        )
+
+
+def test_sharded_rollout_converges_with_zone_isolated_waves(fake_kube):
+    add_zoned_pool(fake_kube, 8, zones=2)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    roller = make_roller(fake_kube, wave_shards=2, max_unavailable=1)
+    result = roller.rollout("on")
+    assert result.ok
+    assert len(result.groups) == 8
+    assert all(counts.get(f"node-{i}") == 1 for i in range(8)), counts
+
+
+def test_sharded_rollout_rejects_rollback():
+    with pytest.raises(ValueError):
+        make_roller(FakeKube(), wave_shards=2, rollback_on_failure=True)
+
+
+def test_sharded_record_is_v2_and_plain_resume_inherits_shards(fake_kube):
+    add_zoned_pool(fake_kube, 4)
+    agent_simulator(fake_kube)
+    clk = Clock()
+    lease = make_lease(fake_kube, "orch-a", clk)
+    lease.acquire()
+    roller = make_roller(fake_kube, lease=lease, wave_shards=2)
+    assert roller.rollout("on").ok
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    import json as json_mod
+
+    raw = stored["metadata"]["annotations"][rollout_state.RECORD_ANNOTATION]
+    obj = json_mod.loads(raw)
+    assert obj["version"] == rollout_state.RECORD_VERSION
+    assert obj["wave_shards"] == 2
+    record = rollout_state.RolloutRecord.from_json(raw)
+    assert record.wave_shards == 2
+
+
+def test_pre_refactor_v1_record_resumes_under_sharded_orchestrator(fake_kube):
+    """A PR4-era record — no version field, no wave_shards — must resume
+    under the sharded orchestrator: done groups skipped on the record's
+    say-so, remaining groups re-driven across waves, every node bounced
+    at most once."""
+    add_zoned_pool(fake_kube, 6, zones=2)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    # Hand-build the v1 JSON exactly as the PR4 orchestrator serialized
+    # it (to_json before this PR): no version, no wave_shards.
+    groups = [[f"node/node-{i}", [f"node-{i}"]] for i in range(6)]
+    v1 = {
+        "mode": "on",
+        "selector": POOL,
+        "generation": 1,
+        "groups": groups,
+        "done": {
+            "node/node-0": {
+                "ok": True,
+                "states": {"node-0": "on"},
+                "seconds": 0.1,
+                "skipped": False,
+            }
+        },
+        "budget_spend": [],
+        "max_unavailable": 1,
+        "failure_budget": None,
+        "status": "in-progress",
+    }
+    import json as json_mod
+
+    record = rollout_state.RolloutRecord.from_json(json_mod.dumps(v1))
+    assert record.wave_shards == 1  # v1 default
+    # node-0 converged under the dead v1 orchestrator; reflect its state
+    # (state first: the simulated agent must not read a desired/state gap
+    # as a fresh transition to perform).
+    fake_kube.set_node_label("node-0", CC_MODE_STATE_LABEL, "on")
+    fake_kube.set_node_label("node-0", CC_MODE_LABEL, "on")
+    clk = Clock()
+    lease = make_lease(fake_kube, "orch-b", clk)
+    lease.acquire()
+    roller = make_roller(
+        fake_kube, lease=lease, resume_record=record, wave_shards=3
+    )
+    result = roller.rollout("on")
+    assert result.ok and result.resumed
+    done_skipped = [g for g in result.groups if g.skipped]
+    assert any(g.group == "node/node-0" for g in done_skipped)
+    assert counts.get("node-0") is None, "done group was re-bounced"
+    for i in range(1, 6):
+        assert counts.get(f"node-{i}") == 1, counts
+    # And the resumed record re-persists at v2 with the live shard count.
+    stored = fake_kube.get_lease(NS, rollout_state.LEASE_NAME)
+    obj = json_mod.loads(
+        stored["metadata"]["annotations"][rollout_state.RECORD_ANNOTATION]
+    )
+    assert obj["version"] == rollout_state.RECORD_VERSION
+    assert obj["wave_shards"] == 3
+
+
+def test_newer_record_version_is_refused_loudly():
+    import json as json_mod
+
+    data = json_mod.dumps({
+        "version": rollout_state.RECORD_VERSION + 1,
+        "mode": "on", "selector": POOL, "generation": 1, "groups": [],
+    })
+    with pytest.raises(rollout_state.RolloutFenced):
+        rollout_state.RolloutRecord.from_json(data)
+
+
+def _run_sharded_crash_resume(kill_at: int):
+    """Kill-at-every-crash-point, sharded edition: orchestrator A runs
+    wave_shards=2 and dies at the ``kill_at``-th serialized crash point
+    (sibling waves stop at their next boundary — a kill that lands a
+    moment later); successor B resumes the same record sharded."""
+    fake = FakeKube()
+    add_zoned_pool(fake, 6, zones=2)
+    counts: dict = {}
+    agent_simulator(fake, converge_counts=counts)
+    clk = Clock()
+    metrics = MetricsRegistry()
+    hook_calls = {"n": 0}
+
+    def killer(point):
+        if hook_calls["n"] == kill_at:
+            raise OrchestratorKilled(point, hook_calls["n"])
+        hook_calls["n"] += 1
+
+    lease_a = make_lease(fake, "orch-a", clk, metrics=metrics, duration_s=30)
+    lease_a.acquire()
+    roller_a = make_roller(
+        fake, lease=lease_a, crash_hook=killer, wave_shards=2
+    )
+    killed = False
+    try:
+        result = roller_a.rollout("on")
+    except OrchestratorKilled:
+        killed = True
+        clk.advance(31)
+        lease_b = make_lease(
+            fake, "orch-b", clk, metrics=metrics, duration_s=30
+        )
+        record = lease_b.acquire()
+        assert record is not None
+        roller_b = make_roller(
+            fake, lease=lease_b, resume_record=record, metrics=metrics,
+            wave_shards=2,
+        )
+        result = roller_b.rollout(record.mode)
+        assert result.resumed is True
+    return killed, counts, result, fake
+
+
+def test_sharded_successor_converges_after_kill_at_every_crash_point():
+    """The sharded extension of the PR4 property test: across every
+    serialized crash point of a 2-wave rollout, the successor converges
+    with zero double-bounced nodes and zero dropped groups."""
+    exhausted = False
+    for kill_at in range(48):
+        killed, counts, result, fake = _run_sharded_crash_resume(kill_at)
+        assert result.ok, f"kill_at={kill_at}: successor did not converge"
+        for i in range(6):
+            name = f"node-{i}"
+            labels = node_labels(fake.get_node(name))
+            assert labels[CC_MODE_STATE_LABEL] == "on", f"kill_at={kill_at}"
+            assert counts.get(name) == 1, (
+                f"kill_at={kill_at}: {name} reconciled {counts.get(name)} "
+                "times (must be exactly once)"
+            )
+        if not killed:
+            exhausted = True
+            break
+    assert exhausted, "never exhausted the sharded crash points"
+
+
+def test_informer_backed_rollout_matches_legacy_and_stops_listing(fake_kube):
+    from tpu_cc_manager.ccmanager.informer import NodeInformer
+
+    add_zoned_pool(fake_kube, 6)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    informer = NodeInformer(fake_kube, POOL).start()
+    try:
+        roller = make_roller(fake_kube, wave_shards=2, informer=informer)
+        baseline_lists = fake_kube.request_counts.get("list", 0)
+        result = roller.rollout("on")
+        assert result.ok
+        assert all(counts.get(f"node-{i}") == 1 for i in range(6))
+        # The rollout itself performed ZERO listings: planning, awaits
+        # and boundary checks all read the cache.
+        assert fake_kube.request_counts.get("list", 0) == baseline_lists
+    finally:
+        informer.stop()
+
+
+def test_informer_selector_mismatch_is_rejected(fake_kube):
+    from tpu_cc_manager.ccmanager.informer import NodeInformer
+
+    informer = NodeInformer(fake_kube, "pool=other")
+    with pytest.raises(ValueError):
+        make_roller(fake_kube, informer=informer)

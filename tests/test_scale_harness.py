@@ -1,0 +1,59 @@
+"""Scale harness (hack/scale_bench.py) smoke: the O(pool)→O(changes)
+drop, measured in-process at CI-sized pools.
+
+The committed SCALE_r01.json carries the 100/1k/10k numbers; these tests
+keep the harness itself honest in tier-1 — a 100-node fleet of simulated
+agents converges under both orchestrators, and the informer one costs
+the apiserver an order of magnitude fewer list requests. The 10k pool
+runs behind the ``slow`` marker (minutes, by design).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
+)
+import scale_bench  # noqa: E402
+
+
+def test_100_node_fleet_converges_under_both_orchestrators():
+    legacy = scale_bench.run_pool(100, "legacy", seed=11)
+    informer = scale_bench.run_pool(100, "informer", seed=11)
+    assert legacy["ok"], legacy
+    assert informer["ok"], informer
+    assert legacy["agent_transitions"] == 100
+    assert informer["agent_transitions"] == 100
+    llists = legacy["orchestrator_requests"].get("list", 0)
+    ilists = informer["orchestrator_requests"].get("list", 0)
+    # The acceptance bar is >=10x at 1k; at 100 nodes the drop is already
+    # an order of magnitude, because the legacy orchestrator pays a
+    # listing per await poll and the informer pays one per relist.
+    assert ilists > 0
+    assert llists >= 10 * ilists, (llists, ilists)
+    # The informer orchestrator holds a watch instead.
+    assert informer["orchestrator_requests"].get("watch", 0) >= 1
+
+
+def test_summary_flags_ok_and_ratio():
+    rows = [
+        scale_bench.run_pool(60, "legacy", seed=3),
+        scale_bench.run_pool(60, "informer", seed=3),
+    ]
+    summary = scale_bench.summarize(rows)
+    assert summary["ok"] is True
+    assert summary["list_request_drop"]["60"] >= 10.0
+
+
+@pytest.mark.slow
+def test_10k_node_fleet_full_rollout_informer():
+    row = scale_bench.run_pool(10000, "informer", seed=5)
+    assert row["ok"], row
+    assert row["agent_transitions"] == 10000
+    # One chunked listing (10000/500 = 20 pages) plus chaos-triggered
+    # relists at most; nothing O(pool).
+    assert row["orchestrator_requests"].get("list", 0) <= 60

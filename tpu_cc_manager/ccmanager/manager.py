@@ -106,6 +106,7 @@ class CCManager:
         remediation=None,
         intent_journal: intent_mod.IntentJournal | None = None,
         offline_grace_s: float | None = None,
+        use_slice_informer: bool | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -163,6 +164,18 @@ class CCManager:
             )
         self.slice_barrier_timeout_s = slice_barrier_timeout_s
         self.slice_barrier_poll_interval_s = slice_barrier_poll_interval_s
+        # Slice-peer informer (ccmanager/informer.py, CC_SLICE_INFORMER):
+        # one watch over this node's slice membership label replaces the
+        # barrier's 1/s peer listings — N hosts × barrier-deadline seconds
+        # of O(slice) listings collapse to O(changes) watch events. Opt-in
+        # via env (the DaemonSet sets it); without it the barrier polls
+        # listings exactly as before.
+        if use_slice_informer is None:
+            use_slice_informer = os.environ.get(
+                "CC_SLICE_INFORMER", ""
+            ).lower() in ("true", "1", "yes")
+        self.use_slice_informer = use_slice_informer
+        self._peer_informer = None
         if allow_fake_quotes is None:
             env = os.environ.get("CC_ALLOW_FAKE_QUOTES")
             if env is not None:
@@ -655,6 +668,7 @@ class CCManager:
                 topo,
                 timeout_s=self.slice_barrier_timeout_s,
                 poll_interval_s=self.slice_barrier_poll_interval_s,
+                informer=self._slice_peer_informer(topo),
             )
         m = self.metrics.start(mode)
         try:
@@ -677,6 +691,41 @@ class CCManager:
             # only the leader's own watch loop lingers, not the drain window.
             barrier.complete(mode)
         return ok
+
+    def _slice_peer_informer(self, topo):
+        """The (lazily started, reused) informer over this node's slice
+        membership selector, or None when disabled/unsupported — the
+        barrier then falls back to polling listings, so a degraded cache
+        can never block a commit."""
+        if not self.use_slice_informer or not topo.is_multi_host:
+            return None
+        from tpu_cc_manager.ccmanager.informer import NodeInformer
+        from tpu_cc_manager.labels import SLICE_ID_LABEL, label_safe
+
+        selector = f"{SLICE_ID_LABEL}={label_safe(topo.slice_id)}"
+        if (
+            self._peer_informer is not None
+            and self._peer_informer.selector == selector
+        ):
+            return self._peer_informer
+        self._stop_peer_informer()
+        try:
+            self._peer_informer = NodeInformer(
+                self.api, selector,
+                name=f"slice-peers[{topo.slice_id}]",
+            ).start()
+        except KubeApiError as e:
+            log.warning(
+                "slice-peer informer unavailable (%s); the barrier falls "
+                "back to peer listings", e,
+            )
+            self._peer_informer = None
+        return self._peer_informer
+
+    def _stop_peer_informer(self) -> None:
+        if self._peer_informer is not None:
+            self._peer_informer.stop()
+            self._peer_informer = None
 
     def _readmit_leftover_paused(self) -> None:
         """Unpause components a previous run left paused (it died between
@@ -1268,6 +1317,14 @@ class CCManager:
             return label, rv
 
     def watch_and_apply(self, stop: threading.Event | None = None) -> None:
+        try:
+            self._watch_and_apply(stop)
+        finally:
+            # The slice-peer informer's watch thread must not outlive the
+            # agent loop (tests and clean shutdowns alike).
+            self._stop_peer_informer()
+
+    def _watch_and_apply(self, stop: threading.Event | None = None) -> None:
         """Initial apply, then watch the node label forever.
 
         Semantics preserved from the reference (main.py:600-684): rv

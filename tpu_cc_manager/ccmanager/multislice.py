@@ -196,17 +196,26 @@ def challenge_nonce_of(node: dict) -> str | None:
         return None
 
 
-def issue_pool_challenges(api: KubeApi, selector: str) -> dict[str, str]:
+def issue_pool_challenges(
+    api: KubeApi, selector: str, informer=None
+) -> dict[str, str]:
     """Publish a FRESH per-node nonce challenge on every healthy matching
     node; returns {node_name: nonce}. Per-node nonces (not one pool-wide
     value) so one node's answer can never satisfy another node's
     challenge. Quarantined hosts are skipped — their evidence is excluded
     from verification anyway. Best-effort on clients without annotation
-    support: returns {} and verification stays on the exp-only policy."""
+    support: returns {} and verification stays on the exp-only policy.
+    ``informer`` (ccmanager/informer.py, same selector) serves the
+    membership read from the watch-driven cache — the writes still go to
+    the apiserver, but the O(pool) listing per challenge round is gone."""
     from tpu_cc_manager.tpudev import attestation as attestation_mod
 
     challenges: dict[str, str] = {}
-    for node in api.list_nodes(selector):
+    # ``synced`` gate (here and below): an informer whose first listing
+    # hasn't landed reports an EMPTY pool, not an error — fall back to a
+    # real listing rather than silently challenging/collecting nothing.
+    for node in (informer.list() if informer is not None and informer.synced
+                 else api.list_nodes(selector)):
         name = node["metadata"]["name"]
         if node_labels(node).get(QUARANTINED_LABEL) == "true":
             continue
@@ -253,6 +262,7 @@ def await_challenge_answers(
     challenges: dict[str, str],
     timeout_s: float = 30.0,
     poll_interval_s: float = 1.0,
+    informer=None,
 ) -> list[str]:
     """Wait (bounded) until every challenged node republished a quote
     bound to its challenge nonce; returns the node names still
@@ -265,7 +275,11 @@ def await_challenge_answers(
         from tpu_cc_manager.kubeclient.api import classify_kube_error
 
         try:
-            nodes = api.list_nodes(selector)
+            nodes = (
+                informer.list()
+                if informer is not None and informer.synced
+                else api.list_nodes(selector)
+            )
         except KubeApiError as e:
             verdict = classify_kube_error(e)
             if verdict is None or not verdict.transient:
@@ -290,7 +304,16 @@ def await_challenge_answers(
                 del pending[name]
         return not pending
 
-    retry_mod.poll_until(all_answered, timeout_s, poll_interval_s)
+    if informer is not None:
+        # Event-driven: wake on cache changes (each answer republishes the
+        # quote annotation, which is a node MODIFIED event) instead of
+        # paying a pool listing per poll tick.
+        informer.wait_for(
+            lambda _informer: all_answered(), timeout_s,
+            recheck_interval_s=poll_interval_s,
+        )
+    else:
+        retry_mod.poll_until(all_answered, timeout_s, poll_interval_s)
     if pending:
         log.warning(
             "challenge unanswered by %s after %.0fs",
@@ -299,24 +322,32 @@ def await_challenge_answers(
     return sorted(pending)
 
 
-def collect_pool_quotes(api: KubeApi, selector: str) -> dict[str, dict]:
+def collect_pool_quotes(
+    api: KubeApi, selector: str, informer=None
+) -> dict[str, dict]:
     """slice_id -> {digest, mode, ts, nodes, missing} across matching nodes.
 
     Every host of a slice must attest, so hosts carrying the slice label but
     no quote are recorded in ``missing`` (not silently skipped), modes must
     agree across hosts (else ``mode`` becomes "MIXED"), and ``ts`` is the
-    OLDEST host's timestamp so staleness checks see the worst host."""
-    # Transient apiserver failures ride the shared jittered backoff; a pool
-    # verification gating a DCN mesh re-form should not fail on one flaky
-    # listing. One attempt when the client retries internally (RestKube).
-    policy = retry_mod.RetryPolicy(
-        max_attempts=caller_retry_attempts(api), base_delay_s=0.5
-    )
-    nodes = policy.call(
-        lambda: api.list_nodes(selector),
-        op="pool_attest.list_nodes",
-        classify=classify_kube_error,
-    )
+    OLDEST host's timestamp so staleness checks see the worst host. With
+    an ``informer`` (same selector) the whole collection is a cache read:
+    pool attestation stops costing one O(pool) listing per verification."""
+    if informer is not None and informer.synced:
+        nodes = informer.list()
+    else:
+        # Transient apiserver failures ride the shared jittered backoff; a
+        # pool verification gating a DCN mesh re-form should not fail on
+        # one flaky listing. One attempt when the client retries
+        # internally (RestKube).
+        policy = retry_mod.RetryPolicy(
+            max_attempts=caller_retry_attempts(api), base_delay_s=0.5
+        )
+        nodes = policy.call(
+            lambda: api.list_nodes(selector),
+            op="pool_attest.list_nodes",
+            classify=classify_kube_error,
+        )
     slices: dict[str, dict] = {}
     for node in nodes:
         labels = node_labels(node)
@@ -459,6 +490,7 @@ def verify_pool_attestation(
     allow_fake: bool = False,
     verify_signatures: bool = True,
     challenges: dict[str, str] | None = None,
+    informer=None,
 ) -> dict[str, dict]:
     """Check every slice attests the expected mode with one common digest,
     re-verifying each node's published quote SIGNATURE — not just the
@@ -484,7 +516,7 @@ def verify_pool_attestation(
     ) as sp:
         slices = _verify_pool_attestation(
             api, selector, expected_mode, expected_slices, max_age_s,
-            allow_fake, verify_signatures, challenges,
+            allow_fake, verify_signatures, challenges, informer,
         )
         sp.set_attribute("slices", len(slices))
         return slices
@@ -499,8 +531,9 @@ def _verify_pool_attestation(
     allow_fake: bool,
     verify_signatures: bool,
     challenges: dict[str, str] | None = None,
+    informer=None,
 ) -> dict[str, dict]:
-    slices = collect_pool_quotes(api, selector)
+    slices = collect_pool_quotes(api, selector, informer=informer)
     if challenges is not None:
         # The verifier's own challenge set overrides whatever the nodes
         # advertise — an annotation a hostile writer cleared (or never
@@ -590,9 +623,9 @@ def _verify_pool_attestation(
     return slices
 
 
-def pool_report(api: KubeApi, selector: str) -> str:
+def pool_report(api: KubeApi, selector: str, informer=None) -> str:
     """Human-readable attestation table (CLI helper)."""
-    slices = collect_pool_quotes(api, selector)
+    slices = collect_pool_quotes(api, selector, informer=informer)
     lines = [
         f"{'SLICE':<28} {'MODE':<10} {'DIGEST':<18} {'ATTESTED':<9} "
         f"{'MISSING':<8} QUAR"

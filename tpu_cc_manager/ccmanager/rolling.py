@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 
 from tpu_cc_manager.kubeclient.api import (
@@ -134,6 +135,46 @@ class RolloutResult:
         }
 
 
+#: Well-known zone label (topology.kubernetes.io/zone): the natural
+#: failure-domain boundary for sharded rollout waves — bouncing every
+#: zone's nodes from one serial queue wastes exactly the independence
+#: zones exist to provide.
+ZONE_LABEL = "topology.kubernetes.io/zone"
+
+
+def partition_waves(
+    groups: list[tuple[str, tuple[str, ...]]],
+    labels_by_name: dict[str, dict],
+    shards: int,
+) -> list[list[tuple[str, tuple[str, ...]]]]:
+    """Deterministically partition the group plan into up to ``shards``
+    concurrent waves, keeping each zone's groups in ONE wave (a zone's
+    groups stay strictly rolling relative to each other — concurrency
+    comes from independent failure domains, not from flooding one zone).
+    Groups without a zone label partition by their own id. Pure function
+    of (plan, labels, shards), which is why the record never needs to
+    store the partition (v1 records resume sharded for free). Note a
+    resume partitions the SURVIVING todo groups, so a zone may land in a
+    different wave than it did pre-crash — harmless, because the
+    invariants live elsewhere: zone affinity is re-derived per call, and
+    the budget/lease/record are shared across all waves. Only wave
+    *membership* of a zone is resume-stable, not wave numbering."""
+    keys: dict[str, str] = {}
+    for gid, names in groups:
+        zone = (labels_by_name.get(names[0]) or {}).get(ZONE_LABEL)
+        keys[gid] = f"zone/{zone}" if zone else f"group/{gid}"
+    assignment = {
+        key: i % max(1, shards)
+        for i, key in enumerate(sorted(set(keys.values())))
+    }
+    waves: list[list[tuple[str, tuple[str, ...]]]] = [
+        [] for _ in range(max(1, shards))
+    ]
+    for gid, names in groups:
+        waves[assignment[keys[gid]]].append((gid, names))
+    return [w for w in waves if w]
+
+
 def plan_groups(
     api: KubeApi, selector: str, nodes: list[dict] | None = None
 ) -> list[tuple[str, tuple[str, ...]]]:
@@ -174,6 +215,8 @@ class RollingReconfigurator:
         resume_record: "rollout_state.RolloutRecord | None" = None,
         crash_hook=None,
         metrics: metrics_mod.MetricsRegistry | None = None,
+        informer=None,
+        wave_shards: int = 1,
     ) -> None:
         # Crash safety: with a lease, every write goes through the fence
         # (a lost lease refuses further patches) and progress is
@@ -231,6 +274,37 @@ class RollingReconfigurator:
                 "continue_on_failure and rollback_on_failure are mutually "
                 "exclusive"
             )
+        # Informer-backed orchestration (ccmanager/informer.py): when set,
+        # every pool read — planning, await polls, budget re-checks —
+        # comes from the watch-driven cache, and awaits wake on cache
+        # events instead of sleeping a poll interval. The informer must be
+        # scoped to THE SAME selector (its cache IS the pool view).
+        self.informer = informer
+        if informer is not None and getattr(informer, "selector", selector) != selector:
+            raise ValueError(
+                f"informer watches {informer.selector!r} but the rollout "
+                f"targets {selector!r}; they must agree"
+            )
+        # Sharded rollout waves: up to N concurrent lease-fenced
+        # sub-rollouts partitioned by zone (fallback: by group), each
+        # running its own strictly-rolling window loop of max_unavailable
+        # groups, all under ONE failure budget, ONE lease and ONE record.
+        self.wave_shards = max(1, int(wave_shards))
+        if self.wave_shards > 1 and rollback_on_failure:
+            # A rollback racing other shards' forward progress would
+            # interleave revert and apply writes on the same pool; the
+            # sharded path keeps the record honest instead (failed groups
+            # stay failed; --resume re-drives them).
+            raise ValueError(
+                "rollback_on_failure is not supported with wave_shards > 1"
+            )
+        # Serializes record mutation + checkpoint serialization across
+        # wave threads (the lease's own write lock only covers the CAS).
+        self._record_lock = threading.RLock()
+        # FaultPlan rngs are not thread-safe; crash points from concurrent
+        # waves serialize so kill schedules stay a pure function of the
+        # seed and the (serialized) decision sequence.
+        self._crash_lock = threading.Lock()
 
     def rollout(self, mode: str) -> RolloutResult:
         mode = canonical_mode(mode)
@@ -276,7 +350,19 @@ class RollingReconfigurator:
         (FaultPlan.decide_orchestrator_kill) may raise OrchestratorKilled
         here, modeling a SIGKILL that runs no cleanup."""
         if self.crash_hook is not None:
-            self.crash_hook(point)
+            with self._crash_lock:
+                self.crash_hook(point)
+
+    def _list_pool(self) -> list[dict]:
+        """The current pool view: the informer cache when present (zero
+        apiserver round trips), else one retried selector listing."""
+        if self.informer is not None:
+            return self.informer.list()
+        return self.retry_policy.call(
+            lambda: self.api.list_nodes(self.selector),
+            op="rollout.list_nodes",
+            classify=classify_kube_error,
+        )
 
     def _checkpoint(self, record, status: str | None = None) -> None:
         """Persist plan + progress into the lease (one CAS write that also
@@ -286,13 +372,18 @@ class RollingReconfigurator:
         owns."""
         if record is None or self.lease is None:
             return
-        if status is not None:
-            record.status = status
-        self.checkpoint_policy.call(
-            lambda: self.lease.checkpoint(record),
-            op="rollout.checkpoint",
-            classify=classify_kube_error,
-        )
+        # The record lock brackets both the status write and the
+        # serialization inside lease.checkpoint (record.to_json): a wave
+        # thread mutating `done` mid-serialization would checkpoint a
+        # torn record.
+        with self._record_lock:
+            if status is not None:
+                record.status = status
+            self.checkpoint_policy.call(
+                lambda: self.lease.checkpoint(record),
+                op="rollout.checkpoint",
+                classify=classify_kube_error,
+            )
 
     def _spend(self, record, *extra_sets) -> list[str]:
         """The failure-budget spend: persisted pre-crash charges plus any
@@ -305,7 +396,16 @@ class RollingReconfigurator:
         return sorted(spend)
 
     def _rollout(self, mode: str) -> RolloutResult:
-        listing = self.api.list_nodes(self.selector)
+        if self.informer is not None and not self.informer.synced:
+            # The cache must hold a full listing before any decision reads
+            # it; an unsynced informer would plan over an empty pool.
+            self.informer.start()
+            if not self.informer.wait_for_sync(60.0):
+                raise KubeApiError(
+                    None, "informer cache never synced; refusing to plan "
+                    "a rollout over a possibly-empty pool view"
+                )
+        listing = self._list_pool()
         # Quarantined nodes are out of the rollout entirely: their agents
         # defer reconciles, so awaiting them only burns the node timeout,
         # and bouncing a condemned node's slice-mates around it helps
@@ -346,12 +446,14 @@ class RollingReconfigurator:
             # budget/concurrency must hand THOSE to its own successor.
             record.max_unavailable = self.max_unavailable
             record.failure_budget = self.failure_budget
+            record.wave_shards = self.wave_shards
         elif self.lease is not None:
             record = rollout_state.RolloutRecord(
                 mode=mode, selector=self.selector,
                 generation=self.generation or 0, groups=[],
                 max_unavailable=self.max_unavailable,
                 failure_budget=self.failure_budget,
+                wave_shards=self.wave_shards,
             )
         if record is not None:
             record.charge_budget(quarantined)
@@ -450,6 +552,11 @@ class RollingReconfigurator:
         # resumable record.
         self._checkpoint(record)
         self._crash_point("planned")
+        if self.wave_shards > 1 and len(groups) > 1:
+            return self._rollout_waves(
+                mode, groups, labels_by_name, record, results,
+                window_seconds, quarantined, resumed,
+            )
         ok = True
         # Strictly bounded concurrency: process in windows of max_unavailable.
         for i in range(0, len(groups), self.max_unavailable):
@@ -461,11 +568,7 @@ class RollingReconfigurator:
                 # also carries every pre-crash charge from the record — a
                 # node that failed before the orchestrator died still
                 # counts, even if it has since been unquarantined.
-                fresh = self._quarantined_of(self.retry_policy.call(
-                    lambda: self.api.list_nodes(self.selector),
-                    op="rollout.list_nodes",
-                    classify=classify_kube_error,
-                ))
+                fresh = self._quarantined_of(self._list_pool())
                 if record is not None:
                     record.charge_budget(fresh)
                 if self._budget_exceeded(
@@ -554,6 +657,152 @@ class RollingReconfigurator:
             resumed=resumed, generation=self.generation,
         )
 
+    # -- sharded rollout waves --------------------------------------------
+
+    def _rollout_waves(
+        self,
+        mode: str,
+        groups: list[tuple[str, tuple[str, ...]]],
+        labels_by_name: dict[str, dict],
+        record,
+        results: list[GroupResult],
+        window_seconds: list[float],
+        quarantined: list[str],
+        resumed: bool,
+    ) -> RolloutResult:
+        """Drive the plan as up to ``wave_shards`` concurrent sub-rollouts
+        (zone-partitioned, each strictly rolling at ``max_unavailable``),
+        under ONE failure budget, ONE lease and ONE checkpointed record.
+        Total in-flight disruption is bounded by wave_shards ×
+        max_unavailable; within a zone the old one-window-at-a-time
+        guarantee holds unchanged."""
+        waves = partition_waves(groups, labels_by_name, self.wave_shards)
+        log.info(
+            "sharded rollout: %d group(s) across %d wave(s) "
+            "(max_unavailable=%d per wave)",
+            len(groups), len(waves), self.max_unavailable,
+        )
+        shared = {
+            "lock": threading.Lock(),
+            "halt": threading.Event(),
+            "results": results,
+            "window_seconds": window_seconds,
+            "ok": True,
+            "halted_reason": None,
+            "initial_quarantined": list(quarantined),
+            "fresh_quarantined": set(),
+            "error": None,
+        }
+        threads = []
+        for wid, wave in enumerate(waves):
+            t = threading.Thread(
+                target=self._drive_wave_guarded,
+                args=(wid, wave, mode, record, shared),
+                name=f"rollout-wave-{wid}",
+                daemon=True,
+            )
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        if shared["error"] is not None:
+            # First wave-thread death (OrchestratorKilled in chaos runs,
+            # RolloutFenced after a lease loss, unexpected bugs alike)
+            # re-raised in the caller's thread so the crash/fence
+            # semantics match the single-shard orchestrator exactly.
+            raise shared["error"]
+        ok = shared["ok"] and not shared["halt"].is_set()
+        self._checkpoint(
+            record,
+            status=(
+                rollout_state.RECORD_COMPLETE if ok
+                else rollout_state.RECORD_HALTED
+            ),
+        )
+        return RolloutResult(
+            mode=mode, ok=ok, groups=list(results),
+            window_seconds=list(window_seconds),
+            skipped_quarantined=sorted(
+                set(quarantined) | shared["fresh_quarantined"]
+            ),
+            halted_reason=shared["halted_reason"],
+            resumed=resumed, generation=self.generation,
+        )
+
+    def _drive_wave_guarded(self, wid, wave, mode, record, shared) -> None:
+        try:
+            self._drive_wave(wid, wave, mode, record, shared)
+        except BaseException as e:  # noqa: BLE001 - first death wins, re-raised
+            with shared["lock"]:
+                if shared["error"] is None:
+                    shared["error"] = e
+            shared["halt"].set()
+
+    def _drive_wave(self, wid, wave, mode, record, shared) -> None:
+        for i in range(0, len(wave), self.max_unavailable):
+            if shared["halt"].is_set():
+                return
+            if i and self.failure_budget is not None:
+                # Same boundary re-check as the single-shard loop; with an
+                # informer this is a cache read, so N waves re-checking
+                # costs the apiserver nothing.
+                fresh = self._quarantined_of(self._list_pool())
+                with self._record_lock:
+                    if record is not None:
+                        record.charge_budget(fresh)
+                    spend = self._spend(
+                        record, shared["initial_quarantined"], fresh
+                    )
+                if self._budget_exceeded(spend):
+                    with shared["lock"]:
+                        shared["halted_reason"] = "failure-budget-exceeded"
+                        shared["fresh_quarantined"].update(fresh)
+                        shared["ok"] = False
+                    shared["halt"].set()
+                    self._checkpoint(
+                        record, status=rollout_state.RECORD_HALTED
+                    )
+                    return
+            window = wave[i : i + self.max_unavailable]
+            self._crash_point("window-start")
+            started = time.monotonic()
+            for gid, names in window:
+                self._set_desired(names, mode)
+            self._crash_point("mid-window")
+            window_failed = []
+            for gid, names in window:
+                gres = self._await_group(gid, names, mode, started)
+                with shared["lock"]:
+                    shared["results"].append(gres)
+                with self._record_lock:
+                    if record is not None:
+                        record.note_group(
+                            gid, gres.ok, gres.states, gres.seconds
+                        )
+                        if not gres.ok:
+                            record.charge_budget(
+                                n for n, s in gres.states.items()
+                                if s != mode
+                            )
+                if not gres.ok:
+                    window_failed.append(gid)
+            with shared["lock"]:
+                shared["window_seconds"].append(time.monotonic() - started)
+            self._crash_point("awaited")
+            self._checkpoint(record)
+            self._crash_point("window-boundary")
+            if window_failed:
+                with shared["lock"]:
+                    shared["ok"] = False
+                if not self.continue_on_failure:
+                    log.error(
+                        "wave %d: group(s) %s failed; halting the rollout "
+                        "(all waves stop at their next boundary)",
+                        wid, window_failed,
+                    )
+                    shared["halt"].set()
+                    return
+
     # -- internals --------------------------------------------------------
 
     def _rollback(
@@ -616,19 +865,29 @@ class RollingReconfigurator:
             self.api.patch_node_labels(name, patch)
 
     def _pending_states(self, names: list[str]) -> dict[str, str | None]:
-        """Current state-label values for ``names`` from ONE selector
-        listing (per-node GETs are O(pool) round trips per poll; the
-        listing is a single one whatever the pool size). A node missing
-        from the listing — its selector label edited mid-rollout — falls
-        back to a direct GET rather than silently reading as pending."""
-        listed: dict[str, str | None] = {
-            n["metadata"]["name"]: node_labels(n).get(CC_MODE_STATE_LABEL)
-            for n in self.retry_policy.call(
-                lambda: self.api.list_nodes(self.selector),
-                op="rollout.list_nodes",
-                classify=classify_kube_error,
-            )
-        }
+        """Current state-label values for ``names``: from the informer
+        cache when present (zero apiserver round trips per poll — the
+        O(pool)→O(changes) hinge of the whole refactor), else from ONE
+        selector listing (per-node GETs are O(pool) round trips per poll;
+        the listing is a single one whatever the pool size). A node
+        missing from the view — its selector label edited mid-rollout —
+        falls back to a direct GET rather than silently reading as
+        pending."""
+        if self.informer is not None:
+            # Indexed reads: O(group) per poll, not O(pool) — at 10k
+            # nodes, rebuilding a pool-wide dict per settle-check would
+            # reintroduce client-side the cost the cache removed
+            # server-side.
+            listed = {}
+            for name in names:
+                node = self.informer.get(name)
+                if node is not None:
+                    listed[name] = node_labels(node).get(CC_MODE_STATE_LABEL)
+        else:
+            listed: dict[str, str | None] = {
+                n["metadata"]["name"]: node_labels(n).get(CC_MODE_STATE_LABEL)
+                for n in self._list_pool()
+            }
         return {
             name: (
                 listed[name]
@@ -706,11 +965,20 @@ class RollingReconfigurator:
                     pending.discard(name)
             return not pending
 
-        retry_mod.poll_until(
-            group_settled,
-            max(0.0, started + self.node_timeout_s - time.monotonic()),
-            self.poll_interval_s,
-        )
+        remaining = max(0.0, started + self.node_timeout_s - time.monotonic())
+        if self.informer is not None:
+            # Event-driven await: wake on cache changes (plus a slow
+            # recheck tick so the stale-failed grace clock still fires on
+            # a quiet pool) instead of burning a listing per poll sleep.
+            self.informer.wait_for(
+                lambda _informer: group_settled(),
+                remaining,
+                recheck_interval_s=self.poll_interval_s,
+            )
+        else:
+            retry_mod.poll_until(
+                group_settled, remaining, self.poll_interval_s
+            )
         for name in pending:  # timed out
             states[name] = "timeout"
         seconds = time.monotonic() - started

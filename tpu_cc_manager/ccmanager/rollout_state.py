@@ -72,6 +72,15 @@ RECORD_IN_PROGRESS = "in-progress"
 RECORD_COMPLETE = "complete"
 RECORD_HALTED = "halted"
 
+#: Checkpoint format version this orchestrator writes. History:
+#: 1 (implicit, PR 4): single-shard records with no version field.
+#: 2: adds ``version`` and ``wave_shards`` (sharded rollout waves). The
+#: parser accepts every version <= the current one — v1 records resume
+#: under the sharded orchestrator unchanged (the wave partition is
+#: derived from the plan, never persisted) — and refuses newer versions
+#: loudly rather than silently dropping fields a successor relied on.
+RECORD_VERSION = 2
+
 
 def lease_namespace() -> str:
     return os.environ.get(LEASE_NAMESPACE_ENV, DEFAULT_LEASE_NAMESPACE)
@@ -113,6 +122,10 @@ class RolloutRecord:
     max_unavailable: int = 1
     failure_budget: int | None = None
     status: str = RECORD_IN_PROGRESS
+    # Sharded rollout waves (format v2): how many concurrent lease-fenced
+    # sub-rollouts the recording orchestrator ran; a plain resume inherits
+    # it like max_unavailable/failure_budget.
+    wave_shards: int = 1
 
     def charge_budget(self, nodes) -> None:
         self.budget_spend = sorted(set(self.budget_spend) | set(nodes))
@@ -131,6 +144,7 @@ class RolloutRecord:
     def to_json(self) -> str:
         return json.dumps(
             {
+                "version": RECORD_VERSION,
                 "mode": self.mode,
                 "selector": self.selector,
                 "generation": self.generation,
@@ -140,6 +154,7 @@ class RolloutRecord:
                 "max_unavailable": self.max_unavailable,
                 "failure_budget": self.failure_budget,
                 "status": self.status,
+                "wave_shards": self.wave_shards,
             },
             sort_keys=True, separators=(",", ":"),
         )
@@ -148,6 +163,15 @@ class RolloutRecord:
     def from_json(cls, data: str) -> "RolloutRecord":
         try:
             obj = json.loads(data)
+            version = int(obj.get("version") or 1)
+            if version > RECORD_VERSION:
+                # A newer orchestrator checkpointed fields this one cannot
+                # represent; resuming would silently drop them.
+                raise RolloutFenced(
+                    f"rollout record format v{version} is newer than this "
+                    f"orchestrator understands (max v{RECORD_VERSION}); "
+                    "upgrade, or --abort to discard"
+                )
             return cls(
                 mode=str(obj["mode"]),
                 selector=str(obj["selector"]),
@@ -165,7 +189,10 @@ class RolloutRecord:
                     else None
                 ),
                 status=str(obj.get("status") or RECORD_IN_PROGRESS),
+                wave_shards=int(obj.get("wave_shards") or 1),
             )
+        except RolloutFenced:
+            raise
         except (ValueError, KeyError, TypeError) as e:
             raise RolloutFenced(f"unreadable rollout record: {e}") from e
 
@@ -609,6 +636,24 @@ class FencedKube(KubeApi):
 
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         return self.inner.list_nodes(label_selector)
+
+    def list_nodes_page(
+        self,
+        label_selector: str | None = None,
+        limit: int | None = None,
+        continue_token: str | None = None,
+    ) -> dict:
+        return self.inner.list_nodes_page(label_selector, limit, continue_token)
+
+    def watch_nodes_pool(
+        self,
+        label_selector: str | None = None,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        return self.inner.watch_nodes_pool(
+            label_selector, resource_version, timeout_seconds
+        )
 
     def list_pods(
         self,

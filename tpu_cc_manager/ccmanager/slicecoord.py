@@ -174,10 +174,19 @@ class SliceBarrier:
         timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
         poll_interval_s: float = 1.0,
         complete_timeout_s: float = DEFAULT_COMPLETE_TIMEOUT_S,
+        informer=None,
     ) -> None:
         self.api = api
         self.node_name = node_name
         self.topo = topo
+        # Peer listing source (ccmanager/informer.py): with an informer
+        # scoped to this slice's membership label, every barrier poll is
+        # a local cache read — N hosts × barrier-deadline seconds of
+        # 1/s peer listings stop hitting the apiserver. The informer's
+        # slice index keys on the RAW label value, which is exactly
+        # label_safe(slice_id) — the same value the membership label
+        # carries.
+        self.informer = informer
         self.timeout_s = timeout_s
         self.poll_interval_s = poll_interval_s
         self.complete_timeout_s = complete_timeout_s
@@ -242,6 +251,15 @@ class SliceBarrier:
         )
 
     def _slice_nodes(self) -> list[dict]:
+        # Only a SYNCED cache may answer: an informer whose first listing
+        # hasn't landed (start() returns after its sync wait even on
+        # timeout) would silently report zero peers — publish_staged would
+        # enter at fence generation 0 on a slice whose real generation is
+        # higher, and every poll after sync would abort with a spurious
+        # BarrierFenced. Unsynced degrades to the legacy listing path,
+        # which raises on failure and lets callers keep last-known state.
+        if self.informer is not None and self.informer.synced:
+            return self.informer.slice_members(self.slice_label_value)
         return self.retry_policy.call(
             lambda: self.api.list_nodes(
                 f"{SLICE_ID_LABEL}={self.slice_label_value}"

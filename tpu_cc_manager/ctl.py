@@ -73,6 +73,21 @@ def build_parser() -> argparse.ArgumentParser:
         "fleet-level circuit breaker (default: no budget)",
     )
     r.add_argument(
+        "--wave-shards", type=int, default=None,
+        help="sharded rollout waves: run up to N concurrent sub-rollouts "
+        "partitioned by zone (topology.kubernetes.io/zone; groups "
+        "without a zone partition alone) under ONE failure budget and "
+        "ONE resumable record — total in-flight disruption is "
+        "wave-shards × max-unavailable (default 1: the classic strictly "
+        "rolling single queue; a resume inherits the record's value)",
+    )
+    r.add_argument(
+        "--no-informer", action="store_true",
+        help="poll with full pool listings instead of the watch-driven "
+        "informer cache (the pre-informer O(pool) behavior; the cache "
+        "needs `watch nodes` RBAC, which the DaemonSet role grants)",
+    )
+    r.add_argument(
         "--resume", action="store_true",
         help="resume an interrupted rollout from the record checkpointed "
         "in the rollout lease (converged groups are never re-bounced; "
@@ -397,6 +412,7 @@ def cmd_rollout(api, args) -> int:
     # None = flag omitted (the parser's default), distinguishable from an
     # explicit `--max-unavailable 1`.
     max_unavailable = getattr(args, "max_unavailable", None)
+    wave_shards = getattr(args, "wave_shards", None)
     if resume_record is not None:
         mode = resume_record.mode
         # The record also carries the dead orchestrator's settings: a
@@ -408,15 +424,42 @@ def cmd_rollout(api, args) -> int:
             failure_budget = resume_record.failure_budget
         if max_unavailable is None:
             max_unavailable = resume_record.max_unavailable
+        if wave_shards is None:
+            wave_shards = resume_record.wave_shards
     if max_unavailable is None:
         max_unavailable = 1
+    if wave_shards is None:
+        wave_shards = 1
     if mode is None:
         if lease is not None:
             lease.release()
         raise ValueError("--mode is required (unless --resume)")
     if lease is not None:
         lease.start_renewer()
+    informer = None
     try:
+        # Inside the try on purpose: a client whose watch connect raises
+        # eagerly (not the lazy "unsupported" probe) must hit the
+        # BaseException lease-release below — failing BEFORE the try
+        # would strand a held lease with the renewer still running, and
+        # every later invocation would be refused with LeaseHeld until
+        # the process dies.
+        if not getattr(args, "no_informer", False):
+            from tpu_cc_manager.ccmanager.informer import NodeInformer
+            from tpu_cc_manager.kubeclient.api import (
+                is_pool_watch_unsupported,
+            )
+
+            try:
+                informer = NodeInformer(api, args.selector).start()
+            except KubeApiError as e:
+                if not is_pool_watch_unsupported(e):
+                    raise
+                log.warning(
+                    "this client has no pool-watch support; the rollout "
+                    "falls back to O(pool) polling listings"
+                )
+                informer = None
         roller = RollingReconfigurator(
             api,
             args.selector,
@@ -427,6 +470,8 @@ def cmd_rollout(api, args) -> int:
             failure_budget=failure_budget,
             lease=lease,
             resume_record=resume_record,
+            informer=informer,
+            wave_shards=wave_shards,
         )
         result = roller.rollout(mode)
     except rollout_state.RolloutFenced as e:
@@ -444,6 +489,8 @@ def cmd_rollout(api, args) -> int:
             lease.release()
         raise
     finally:
+        if informer is not None:
+            informer.stop()
         if lease is not None:
             lease.stop_renewer()
     if lease is not None:
@@ -479,40 +526,71 @@ def cmd_unquarantine(api, args) -> int:
 
 def cmd_attest(api, args) -> int:
     challenges = None
-    if getattr(args, "challenge", False):
-        if getattr(args, "no_verify_signatures", False):
-            # Contradictory: challenge binding is checked inside the
-            # signed quote, which this flag says not to read — reporting
-            # "(challenged re-attestation)" over a digest-labels-only
-            # check would claim replay protection that never ran.
-            raise ValueError(
-                "--challenge cannot be combined with "
-                "--no-verify-signatures (the challenge is verified "
-                "inside the signed quote)"
-            )
-        from tpu_cc_manager.ccmanager import multislice
+    if getattr(args, "challenge", False) and getattr(
+        args, "no_verify_signatures", False
+    ):
+        # Contradictory: challenge binding is checked inside the
+        # signed quote, which this flag says not to read — reporting
+        # "(challenged re-attestation)" over a digest-labels-only
+        # check would claim replay protection that never ran.
+        raise ValueError(
+            "--challenge cannot be combined with "
+            "--no-verify-signatures (the challenge is verified "
+            "inside the signed quote)"
+        )
+    # One informer serves every membership read below (challenge fan-out,
+    # answer-await, report, verification) — the answer-await especially
+    # used to cost one O(pool) listing per poll tick. Clients without
+    # pool-watch support fall back to the legacy listing path.
+    informer = None
+    from tpu_cc_manager.ccmanager.informer import NodeInformer
+    from tpu_cc_manager.kubeclient.api import (
+        KubeApiError,
+        is_pool_watch_unsupported,
+    )
 
-        challenges = multislice.issue_pool_challenges(api, args.selector)
-        pending = multislice.await_challenge_answers(
-            api, args.selector, challenges,
-            timeout_s=getattr(args, "challenge_timeout", 30.0),
-        )
-        if pending:
-            # Not fatal here: verification below fails the unanswered
-            # nodes with the precise per-node problem.
-            print(f"WARN: challenge unanswered by: {', '.join(pending)}")
-    print(pool_report(api, args.selector))
     try:
-        verify_pool_attestation(
-            api, args.selector, args.mode,
-            expected_slices=args.slices, max_age_s=args.max_age,
-            allow_fake=getattr(args, "allow_fake", False),
-            verify_signatures=not getattr(args, "no_verify_signatures", False),
-            challenges=challenges,
-        )
-    except PoolAttestationError as e:
-        print(f"FAIL: {e}")
-        return 1
+        informer = NodeInformer(api, args.selector).start()
+    except KubeApiError as e:
+        if not is_pool_watch_unsupported(e):
+            raise
+        informer = None
+    try:
+        if getattr(args, "challenge", False):
+            from tpu_cc_manager.ccmanager import multislice
+
+            challenges = multislice.issue_pool_challenges(
+                api, args.selector, informer=informer
+            )
+            pending = multislice.await_challenge_answers(
+                api, args.selector, challenges,
+                timeout_s=getattr(args, "challenge_timeout", 30.0),
+                informer=informer,
+            )
+            if pending:
+                # Not fatal here: verification below fails the unanswered
+                # nodes with the precise per-node problem.
+                print(
+                    f"WARN: challenge unanswered by: {', '.join(pending)}"
+                )
+        print(pool_report(api, args.selector, informer=informer))
+        try:
+            verify_pool_attestation(
+                api, args.selector, args.mode,
+                expected_slices=args.slices, max_age_s=args.max_age,
+                allow_fake=getattr(args, "allow_fake", False),
+                verify_signatures=not getattr(
+                    args, "no_verify_signatures", False
+                ),
+                challenges=challenges,
+                informer=informer,
+            )
+        except PoolAttestationError as e:
+            print(f"FAIL: {e}")
+            return 1
+    finally:
+        if informer is not None:
+            informer.stop()
     print(
         "OK: pool attestation coherent"
         + (" (challenged re-attestation)" if challenges else "")

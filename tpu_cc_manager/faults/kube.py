@@ -95,6 +95,17 @@ class FaultyKubeClient(KubeApi):
         self._maybe_fault("list_nodes")
         return self.inner.list_nodes(label_selector)
 
+    def list_nodes_page(
+        self,
+        label_selector: str | None = None,
+        limit: int | None = None,
+        continue_token: str | None = None,
+    ) -> dict:
+        # Faulted under the same op as the unchunked listing: a chaos
+        # schedule that throttles lists throttles every page of them.
+        self._maybe_fault("list_nodes")
+        return self.inner.list_nodes_page(label_selector, limit, continue_token)
+
     def list_pods(
         self,
         namespace: str,
@@ -144,6 +155,26 @@ class FaultyKubeClient(KubeApi):
         resource_version: str | None = None,
         timeout_seconds: int = 300,
     ) -> Iterator[WatchEvent]:
+        return self._faulted_watch(
+            self.inner.watch_nodes(name, resource_version, timeout_seconds)
+        )
+
+    def watch_nodes_pool(
+        self,
+        label_selector: str | None = None,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        # Same fault vocabulary as the single-node watch: the informer
+        # cache's transport must prove itself against hangups, stale-rv
+        # 410s and blackouts just like the agent's watch loop does.
+        return self._faulted_watch(
+            self.inner.watch_nodes_pool(
+                label_selector, resource_version, timeout_seconds
+            )
+        )
+
+    def _faulted_watch(self, stream: Iterator[WatchEvent]) -> Iterator[WatchEvent]:
         fault = self.plan.decide_watch()
         if fault is not None and fault.kind == "stale-rv":
             log.info("chaos: injecting %s", fault.describe())
@@ -153,7 +184,6 @@ class FaultyKubeClient(KubeApi):
             # verb — no events leak through a dead apiserver.
             log.info("chaos: injecting %s", fault.describe())
             raise KubeApiError(None, f"chaos: {fault.describe()}")
-        stream = self.inner.watch_nodes(name, resource_version, timeout_seconds)
         if fault is None:
             yield from stream
             return

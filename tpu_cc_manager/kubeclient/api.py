@@ -96,6 +96,17 @@ def is_lease_unsupported(e: BaseException) -> bool:
     )
 
 
+def is_pool_watch_unsupported(e: BaseException) -> bool:
+    """Whether an error is the KubeApi default's pool-watch-unsupported
+    marker: the informer cache uses it to fail construction loudly (a
+    cache that silently never updates would be worse than no cache)."""
+    return (
+        isinstance(e, KubeApiError)
+        and e.status is None
+        and KubeApi.POOL_WATCH_UNSUPPORTED in (e.reason or "")
+    )
+
+
 def caller_retry_attempts(api: "KubeApi", default: int = 3) -> int:
     """How many attempts a CALLER-side retry policy should make against
     ``api``: 1 when the client already retries transients internally
@@ -106,6 +117,30 @@ def caller_retry_attempts(api: "KubeApi", default: int = 3) -> int:
     return 1 if getattr(api, "retries_internally", False) else default
 
 
+def list_nodes_chunked(
+    api: "KubeApi", label_selector: str | None = None,
+    limit: int | None = None,
+) -> tuple[list[dict], str]:
+    """Full listing through the chunked-list protocol: pages of ``limit``
+    via ``list_nodes_page`` until the continue token runs dry. Returns
+    (items, resourceVersion-of-the-listing) — the rv is what a follow-up
+    watch resumes from, which is why the informer cache uses this instead
+    of plain ``list_nodes`` (whose return type carries no rv)."""
+    items: list[dict] = []
+    token: str | None = None
+    rv = ""
+    while True:
+        page = api.list_nodes_page(
+            label_selector, limit=limit, continue_token=token
+        )
+        items.extend(page.get("items") or [])
+        meta = page.get("metadata") or {}
+        rv = str(meta.get("resourceVersion") or rv)
+        token = meta.get("continue") or None
+        if not token:
+            return items, rv
+
+
 class KubeApi(abc.ABC):
     """Typed facade over the apiserver operations the control plane performs."""
 
@@ -113,6 +148,8 @@ class KubeApi(abc.ABC):
     #: side policies consult caller_retry_attempts() so exactly ONE backoff
     #: ladder runs per logical call.
     retries_internally = False
+
+    POOL_WATCH_UNSUPPORTED = "pool watch not supported by this client"
 
     @abc.abstractmethod
     def get_node(self, name: str) -> dict:
@@ -159,6 +196,30 @@ class KubeApi(abc.ABC):
         """GET /api/v1/nodes, optionally filtered by an equality label
         selector ("k=v" or "k" presence, comma-separated)."""
 
+    def list_nodes_page(
+        self,
+        label_selector: str | None = None,
+        limit: int | None = None,
+        continue_token: str | None = None,
+    ) -> dict:
+        """One page of GET /api/v1/nodes with ``limit``/``continue``
+        chunking, returned NodeList-shaped: ``{"items": [...], "metadata":
+        {"resourceVersion": ..., "continue": ...}}``. An absent/empty
+        ``metadata.continue`` ends the listing. The default degrades to a
+        single unchunked page through :meth:`list_nodes` (minimal clients
+        keep working; they just pay the one big response a real 10k-node
+        listing would chunk)."""
+        if continue_token:
+            # The default never hands out a token, so receiving one back
+            # means the caller mixed clients mid-listing.
+            raise KubeApiError(
+                410, "continue token not recognized by this client"
+            )
+        return {
+            "items": self.list_nodes(label_selector),
+            "metadata": {"resourceVersion": ""},
+        }
+
     @abc.abstractmethod
     def list_pods(
         self,
@@ -185,6 +246,25 @@ class KubeApi(abc.ABC):
         returns. Transport errors raise KubeApiError; a stale
         resourceVersion raises KubeApiError(410) either immediately or as an
         ERROR event translated by the caller (reference main.py:622-638)."""
+
+    def watch_nodes_pool(
+        self,
+        label_selector: str | None = None,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        """Watch EVERY node matching a label selector (one stream for a
+        whole pool — the informer cache's transport,
+        ccmanager/informer.py).
+
+        Same event contract as :meth:`watch_nodes` (ADDED/MODIFIED/
+        DELETED/BOOKMARK/ERROR, 410 on a stale resourceVersion), plus the
+        real apiserver's selector-scoping rule: an object that STOPS
+        matching the selector is delivered as DELETED — the cache must
+        drop it, not keep serving its stale last-matching state. Optional
+        capability: the default raises the POOL_WATCH_UNSUPPORTED marker
+        so callers can degrade to polling listings."""
+        raise KubeApiError(None, self.POOL_WATCH_UNSUPPORTED)
 
     def create_event(self, namespace: str, event: dict) -> dict:
         """POST a core/v1 Event (``kubectl describe node`` visibility).

@@ -72,13 +72,30 @@ class FakeKube(KubeApi):
         self._pods: dict[tuple[str, str], dict] = {}  # (namespace, name) -> pod
         self._leases: dict[tuple[str, str], dict] = {}  # (namespace, name)
         self._node_events: list[tuple[int, WatchEvent]] = []
+        # In-flight chunked listings: continue tokens serve from the
+        # snapshot taken at the FIRST page (like the real apiserver's
+        # etcd-revision-pinned continuation), never the live store — a
+        # node changing between pages must not shift the sort and drop a
+        # neighbor from the listing. token -> (pages' items, listing rv).
+        self._page_snapshots: dict[str, tuple[list[dict], str]] = {}
+        self._page_snapshot_seq = 0
         self._watch_faults: list[Exception | WatchEvent] = []
         self._patch_reactors: list[Callable[[str, dict], None]] = []
         # Counters some tests assert on.
         self.patch_calls = 0
         self.list_pod_calls = 0
+        # Per-verb request accounting, apiserver-side (what a real
+        # apiserver's QPS dashboard would show): the scale harness
+        # (hack/scale_bench.py) reads this to prove the informer refactor
+        # turned O(pool) listings into O(changes) watch traffic.
+        self.request_counts: dict[str, int] = {}
         # Events emitted via create_event, in order (tests assert on them).
         self.events: list[dict] = []
+
+    def _count(self, verb: str) -> None:
+        # Caller need not hold the lock; GIL-atomic enough for counters
+        # read only after the workload quiesces.
+        self.request_counts[verb] = self.request_counts.get(verb, 0) + 1
 
     # ---- test harness helpers -------------------------------------------
 
@@ -160,6 +177,7 @@ class FakeKube(KubeApi):
     # ---- KubeApi ---------------------------------------------------------
 
     def get_node(self, name: str) -> dict:
+        self._count("get")
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
@@ -169,6 +187,8 @@ class FakeKube(KubeApi):
     def patch_node_labels(
         self, name: str, labels: Mapping[str, str | None], _count: bool = True
     ) -> dict:
+        if _count:
+            self._count("patch")
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
@@ -192,6 +212,7 @@ class FakeKube(KubeApi):
     def patch_node_annotations(
         self, name: str, annotations: Mapping[str, str | None]
     ) -> dict:
+        self._count("patch")
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
@@ -210,6 +231,7 @@ class FakeKube(KubeApi):
     def patch_node_taints(
         self, name: str, add: list[dict], remove_keys: list[str]
     ) -> dict:
+        self._count("patch")
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
@@ -225,6 +247,7 @@ class FakeKube(KubeApi):
             return copy.deepcopy(node)
 
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
+        self._count("list")
         with self._lock:
             return [
                 copy.deepcopy(n)
@@ -232,12 +255,66 @@ class FakeKube(KubeApi):
                 if _match_label_selector(n["metadata"].get("labels") or {}, label_selector)
             ]
 
+    def list_nodes_page(
+        self,
+        label_selector: str | None = None,
+        limit: int | None = None,
+        continue_token: str | None = None,
+    ) -> dict:
+        """Chunked listing with real ``limit``/``continue`` semantics:
+        the first page snapshots the name-sorted matching set and the
+        token walks THAT snapshot (the real apiserver serves continues
+        from the first page's etcd revision) — a label flip between pages
+        cannot shift the sort and drop a neighbor from the listing. Every
+        page reports the snapshot's resourceVersion so an informer can
+        watch from the listing it built its cache from. An unknown or
+        malformed token answers 410 Expired (client restarts the
+        listing)."""
+        self._count("list")
+        with self._lock:
+            if continue_token:
+                snap = self._page_snapshots.get(continue_token)
+                if snap is None:
+                    raise KubeApiError(
+                        410,
+                        f"continue token {continue_token!r} expired",
+                    )
+                matching, rv, offset = (
+                    snap[0], snap[1], int(continue_token.split(":")[-1])
+                )
+            else:
+                matching = [
+                    copy.deepcopy(n)
+                    for _, n in sorted(self._nodes.items())
+                    if _match_label_selector(
+                        n["metadata"].get("labels") or {}, label_selector
+                    )
+                ]
+                rv = str(self._rv)
+                offset = 0
+            end = offset + limit if limit else len(matching)
+            items = [copy.deepcopy(n) for n in matching[offset:end]]
+            meta: dict = {"resourceVersion": rv}
+            if continue_token:
+                del self._page_snapshots[continue_token]
+            if end < len(matching):
+                self._page_snapshot_seq += 1
+                token = f"{self._page_snapshot_seq}:{end}"
+                self._page_snapshots[token] = (matching, rv)
+                meta["continue"] = token
+                # Abandoned paginations must not pin snapshots forever.
+                while len(self._page_snapshots) > 8:
+                    oldest = next(iter(self._page_snapshots))
+                    del self._page_snapshots[oldest]
+            return {"kind": "NodeList", "items": items, "metadata": meta}
+
     def list_pods(
         self,
         namespace: str,
         label_selector: str | None = None,
         field_selector: str | None = None,
     ) -> list[dict]:
+        self._count("list")
         with self._lock:
             self.list_pod_calls += 1
             return [
@@ -249,6 +326,7 @@ class FakeKube(KubeApi):
             ]
 
     def create_event(self, namespace: str, event: dict) -> dict:
+        self._count("create")
         with self._lock:
             self.events.append({"namespace": namespace, **copy.deepcopy(event)})
             return copy.deepcopy(event)
@@ -258,6 +336,7 @@ class FakeKube(KubeApi):
     # rollout lease's fencing guarantee is only as strong as that CAS.
 
     def get_lease(self, namespace: str, name: str) -> dict:
+        self._count("get")
         with self._lock:
             lease = self._leases.get((namespace, name))
             if lease is None:
@@ -265,6 +344,7 @@ class FakeKube(KubeApi):
             return copy.deepcopy(lease)
 
     def create_lease(self, namespace: str, name: str, spec: dict) -> dict:
+        self._count("create")
         with self._lock:
             if (namespace, name) in self._leases:
                 raise KubeApiError(
@@ -285,6 +365,7 @@ class FakeKube(KubeApi):
             return copy.deepcopy(lease)
 
     def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        self._count("update")
         with self._lock:
             stored = self._leases.get((namespace, name))
             if stored is None:
@@ -306,6 +387,7 @@ class FakeKube(KubeApi):
             return copy.deepcopy(updated)
 
     def delete_lease(self, namespace: str, name: str) -> None:
+        self._count("delete")
         with self._lock:
             if self._leases.pop((namespace, name), None) is None:
                 raise KubeApiError(404, f"lease {namespace}/{name} not found")
@@ -326,6 +408,7 @@ class FakeKube(KubeApi):
         resource_version: str | None = None,
         timeout_seconds: int = 300,
     ) -> Iterator[WatchEvent]:
+        self._count("watch")
         if self._watch_faults:
             fault = self._watch_faults.pop(0)
             if isinstance(fault, Exception):
@@ -340,6 +423,10 @@ class FakeKube(KubeApi):
         cursor = start_rv
         while True:
             with self._lock:
+                if cursor < getattr(self, "_dropped_below_rv", 0):
+                    raise KubeApiError(
+                        410, "watch history compacted past the cursor"
+                    )
                 pending = [
                     ev
                     for rv, ev in self._node_events
@@ -358,11 +445,99 @@ class FakeKube(KubeApi):
             for ev in pending:
                 yield copy.deepcopy(ev)
 
+    def watch_nodes_pool(
+        self,
+        label_selector: str | None = None,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        """Selector-scoped pool watch with the real apiserver's view
+        semantics: a node whose labels stop matching the selector is
+        delivered as DELETED (the cache must drop it), one that starts
+        matching arrives as its change event. ``in_view`` reconstructs
+        which nodes the caller's listing (at ``resource_version``) could
+        see, from the retained event log plus the nodes unchanged since."""
+        self._count("watch")
+        if self._watch_faults:
+            fault = self._watch_faults.pop(0)
+            if isinstance(fault, Exception):
+                raise fault
+            yield fault
+            return
+        start_rv = int(resource_version) if resource_version else 0
+        in_view: set[str] = set()
+        with self._lock:
+            if start_rv and start_rv < self._compacted_before - 1:
+                raise KubeApiError(410, "resourceVersion too old")
+            for name, node in self._nodes.items():
+                if int(node["metadata"]["resourceVersion"]) <= start_rv and (
+                    _match_label_selector(
+                        node["metadata"].get("labels") or {}, label_selector
+                    )
+                ):
+                    in_view.add(name)
+            for rv, ev in self._node_events:
+                if rv > start_rv:
+                    break
+                name = ev.object["metadata"]["name"]
+                if ev.type != "DELETED" and _match_label_selector(
+                    ev.object["metadata"].get("labels") or {}, label_selector
+                ):
+                    in_view.add(name)
+                else:
+                    in_view.discard(name)
+        deadline = time.monotonic() + timeout_seconds
+        cursor = start_rv
+        while True:
+            with self._lock:
+                if cursor < getattr(self, "_dropped_below_rv", 0):
+                    raise KubeApiError(
+                        410, "watch history compacted past the cursor"
+                    )
+                pending = [ev for rv, ev in self._node_events if rv > cursor]
+                if pending:
+                    cursor = max(
+                        int(ev.object["metadata"]["resourceVersion"])
+                        for ev in pending
+                    )
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._lock.wait(timeout=min(remaining, 0.05))
+                    continue
+            for ev in pending:
+                name = ev.object["metadata"]["name"]
+                matches = ev.type != "DELETED" and _match_label_selector(
+                    ev.object["metadata"].get("labels") or {}, label_selector
+                )
+                if matches:
+                    yield copy.deepcopy(
+                        WatchEvent(
+                            "ADDED" if name not in in_view else ev.type,
+                            ev.object,
+                        )
+                    )
+                    in_view.add(name)
+                elif name in in_view:
+                    in_view.discard(name)
+                    yield copy.deepcopy(WatchEvent("DELETED", ev.object))
+
     # ---- internals -------------------------------------------------------
 
     def _record_event(self, etype: str, node: dict) -> None:
         # Caller holds the lock.
         self._node_events.append((self._rv, WatchEvent(etype, copy.deepcopy(node))))
         if len(self._node_events) > 4096:
+            # Remember the newest DROPPED rv: a watcher whose cursor is
+            # below it may have missed events, and (like a real apiserver
+            # whose history was compacted out from under a slow watcher)
+            # must get 410 Gone and relist — never a silent gap. Found
+            # while scaling to 10k nodes, where a busy fleet can outrun a
+            # momentarily-stalled watch reader.
+            self._dropped_below_rv = max(
+                getattr(self, "_dropped_below_rv", 0),
+                self._node_events[2047][0],
+            )
             del self._node_events[:2048]
         self._lock.notify_all()

@@ -146,7 +146,16 @@ class RestKube(KubeApi):
         retry_base_delay_s: float = 0.5,
         retry_policy: retry_mod.RetryPolicy | None = None,
         breaker: retry_mod.CircuitBreaker | None = None,
+        metrics=None,
     ):
+        # Per-verb apiserver request accounting
+        # (tpu_cc_apiserver_requests_total{verb}): every HTTP round trip
+        # this client performs — retries included, since each one lands on
+        # the apiserver — so the exported QPS is what the server actually
+        # absorbs, not the logical call rate.
+        from tpu_cc_manager.utils import metrics as metrics_mod
+
+        self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
         self.config = config
         self.retry_attempts = max(1, retry_attempts)
         self.retry_base_delay_s = retry_base_delay_s
@@ -211,8 +220,14 @@ class RestKube(KubeApi):
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             raise KubeApiError(None, f"{method} {path}: {e}") from e
 
+    _VERB_OF_METHOD = {
+        "GET": "get", "PATCH": "patch", "PUT": "update",
+        "POST": "create", "DELETE": "delete",
+    }
+
     def _request_json(self, method: str, path: str, query: dict | None = None,
-                      body: dict | None = None, content_type: str | None = None) -> dict:
+                      body: dict | None = None, content_type: str | None = None,
+                      verb: str | None = None) -> dict:
         """One apiserver round trip through the shared retry policy
         (utils/retry.py: full jitter, Retry-After honoring) behind the
         apiserver circuit breaker. Only idempotent verbs (GET, label
@@ -223,6 +238,7 @@ class RestKube(KubeApi):
         repetition."""
         raw = json.dumps(body).encode() if body is not None else None
         retryable_verb = method in ("GET", "PATCH")
+        counted_verb = verb or self._VERB_OF_METHOD.get(method, method.lower())
 
         def attempt() -> dict:
             try:
@@ -236,10 +252,19 @@ class RestKube(KubeApi):
                 err = KubeApiError(None, str(e))
                 err.circuit_open = True
                 raise err from e
+            # Counted only once the request demonstrably REACHED the
+            # apiserver — a 2xx response, an HTTP error status, or a
+            # failure while reading a response that started arriving. A
+            # circuit-open refusal or connect-level failure (refused,
+            # DNS, timeout: KubeApiError with status None) never got
+            # there, and counting it would export phantom server QPS at
+            # full retry speed during an outage — the exact signal the
+            # metric's HELP text tells operators to read as real load.
             try:
-                with self._open(method, path, query, raw, content_type) as resp:
-                    result = json.loads(resp.read().decode("utf-8"))
+                resp_cm = self._open(method, path, query, raw, content_type)
             except KubeApiError as e:
+                if e.status is not None:
+                    self.metrics.record_apiserver_request(counted_verb)
                 verdict = classify_kube_error(e)
                 if verdict is not None and verdict.transient:
                     self.breaker.record_failure()
@@ -247,6 +272,10 @@ class RestKube(KubeApi):
                     # A definitive 4xx proves the apiserver is answering.
                     self.breaker.record_success()
                 raise
+            self.metrics.record_apiserver_request(counted_verb)
+            try:
+                with resp_cm as resp:
+                    result = json.loads(resp.read().decode("utf-8"))
             except (OSError, ValueError, http.client.HTTPException) as e:
                 # Failures AFTER the connection opened (reset mid-body,
                 # IncompleteRead on a truncated stream, garbled JSON) are
@@ -314,7 +343,29 @@ class RestKube(KubeApi):
         query: dict = {}
         if label_selector:
             query["labelSelector"] = label_selector
-        return self._request_json("GET", "/api/v1/nodes", query).get("items", [])
+        return self._request_json(
+            "GET", "/api/v1/nodes", query, verb="list"
+        ).get("items", [])
+
+    def list_nodes_page(
+        self,
+        label_selector: str | None = None,
+        limit: int | None = None,
+        continue_token: str | None = None,
+    ) -> dict:
+        """One chunk of the apiserver's paginated LIST protocol. The
+        ``continue`` token is served from a consistent snapshot server-
+        side; an expired token answers 410, which
+        ``list_nodes_chunked``'s callers treat as "restart the listing"
+        (the informer relist path)."""
+        query: dict = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if limit:
+            query["limit"] = str(int(limit))
+        if continue_token:
+            query["continue"] = continue_token
+        return self._request_json("GET", "/api/v1/nodes", query, verb="list")
 
     def list_pods(self, namespace: str, label_selector: str | None = None,
                   field_selector: str | None = None) -> list[dict]:
@@ -324,7 +375,7 @@ class RestKube(KubeApi):
         if field_selector:
             query["fieldSelector"] = field_selector
         return self._request_json(
-            "GET", f"/api/v1/namespaces/{namespace}/pods", query
+            "GET", f"/api/v1/namespaces/{namespace}/pods", query, verb="list"
         ).get("items", [])
 
     def create_event(self, namespace: str, event: dict) -> dict:
@@ -410,9 +461,40 @@ class RestKube(KubeApi):
         }
         if resource_version:
             query["resourceVersion"] = resource_version
+        return self._watch("/api/v1/nodes", query, timeout_seconds)
+
+    def watch_nodes_pool(
+        self,
+        label_selector: str | None = None,
+        resource_version: str | None = None,
+        timeout_seconds: int = 300,
+    ) -> Iterator[WatchEvent]:
+        query = {
+            "watch": "true",
+            "timeoutSeconds": str(timeout_seconds),
+            "allowWatchBookmarks": "true",
+        }
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if resource_version:
+            query["resourceVersion"] = resource_version
+        return self._watch("/api/v1/nodes", query, timeout_seconds)
+
+    def _watch(self, path: str, query: dict,
+               timeout_seconds: int) -> Iterator[WatchEvent]:
         # Client-side read timeout a bit above the server-side one so the
-        # server closes first in the normal case.
-        resp = self._open("GET", "/api/v1/nodes", query, read_timeout=timeout_seconds + 15)
+        # server closes first in the normal case. Counted only once the
+        # connect succeeded (or the server answered an HTTP error): a
+        # refused connect never reached the apiserver.
+        try:
+            resp = self._open(
+                "GET", path, query, read_timeout=timeout_seconds + 15
+            )
+        except KubeApiError as e:
+            if e.status is not None:
+                self.metrics.record_apiserver_request("watch")
+            raise
+        self.metrics.record_apiserver_request("watch")
         try:
             while True:
                 try:

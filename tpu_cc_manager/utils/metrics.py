@@ -204,6 +204,13 @@ class MetricsRegistry:
         self._offline_seconds: float | None = None
         self._journal_replay_totals: dict[str, int] = {}
         self._deferred_patch_total = 0
+        # Client-side apiserver request accounting by verb (get / list /
+        # watch / patch / create / update / delete): every HTTP round
+        # trip RestKube performs, retries included. The fleet-scale
+        # question this answers: is this process O(changes) against the
+        # apiserver (watch-driven informer cache) or O(pool) (re-listing
+        # per decision)?
+        self._apiserver_request_totals: dict[str, int] = {}
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -333,6 +340,17 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._journal_replay_totals)
 
+    def record_apiserver_request(self, verb: str) -> None:
+        """Count one apiserver HTTP round trip by verb (kubeclient)."""
+        with self._lock:
+            self._apiserver_request_totals[verb] = (
+                self._apiserver_request_totals.get(verb, 0) + 1
+            )
+
+    def apiserver_request_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._apiserver_request_totals)
+
     def rollout_totals(self) -> dict[str, int]:
         with self._lock:
             return {
@@ -411,6 +429,7 @@ class MetricsRegistry:
             offline_seconds = self._offline_seconds
             journal_replays = dict(self._journal_replay_totals)
             deferred_patches = self._deferred_patch_total
+            apiserver_requests = dict(self._apiserver_request_totals)
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -572,6 +591,18 @@ class MetricsRegistry:
             lines.append(
                 "tpu_cc_journal_deferred_patches_total %d" % deferred_patches
             )
+        if apiserver_requests:
+            lines.append(
+                "# HELP tpu_cc_apiserver_requests_total Apiserver HTTP "
+                "round trips by verb (kubeclient; retries included — the "
+                "QPS the server actually absorbs)."
+            )
+            lines.append("# TYPE tpu_cc_apiserver_requests_total counter")
+            for verb in sorted(apiserver_requests):
+                lines.append(
+                    "tpu_cc_apiserver_requests_total%s %d"
+                    % (_labels(verb=verb), apiserver_requests[verb])
+                )
         # The cumulative per-phase sums/counts are served exclusively as
         # the histogram's _sum/_count series below — separate
         # tpu_cc_phase_seconds_total/_runs_total counters would duplicate
