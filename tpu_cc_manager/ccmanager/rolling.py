@@ -36,6 +36,7 @@ import time
 
 from tpu_cc_manager.kubeclient.api import (
     KubeApi,
+    KubeApiError,
     caller_retry_attempts,
     classify_kube_error,
     node_labels,
@@ -140,6 +141,14 @@ class RolloutResult:
 #: zone's nodes from one serial queue wastes exactly the independence
 #: zones exist to provide.
 ZONE_LABEL = "topology.kubernetes.io/zone"
+
+#: Terminal await-state for a node whose Node OBJECT vanished mid-window
+#: (cluster-autoscaler scale-down, spot reclaim). The informer delivers
+#: the DELETED event (or the fallback GET answers 404), and the await
+#: loop resolves the slot immediately instead of charging the node the
+#: full window deadline as a timeout-in-progress. A deleted node is not
+#: a CC failure: it never counts against the group's ok verdict.
+STATE_NODE_DELETED = "deleted"
 
 
 def partition_waves(
@@ -862,7 +871,19 @@ class RollingReconfigurator:
                 # at a glance whether a node's desired mode came from the
                 # live rollout or a fenced-out predecessor.
                 patch[rollout_state.ROLLOUT_GEN_LABEL] = str(self.generation)
-            self.api.patch_node_labels(name, patch)
+            try:
+                self.api.patch_node_labels(name, patch)
+            except KubeApiError as e:
+                if e.status != 404:
+                    raise
+                # Scale-down raced the window start: the Node object is
+                # already gone. Not a failure — the await's fallback GET
+                # resolves the slot as deleted on its first poll.
+                log.warning(
+                    "node %s vanished before its desired-mode write "
+                    "(autoscaler scale-down); it will be retired from "
+                    "the window", name,
+                )
 
     def _pending_states(self, names: list[str]) -> dict[str, str | None]:
         """Current state-label values for ``names``: from the informer
@@ -870,9 +891,12 @@ class RollingReconfigurator:
         O(pool)→O(changes) hinge of the whole refactor), else from ONE
         selector listing (per-node GETs are O(pool) round trips per poll;
         the listing is a single one whatever the pool size). A node
-        missing from the view — its selector label edited mid-rollout —
-        falls back to a direct GET rather than silently reading as
-        pending."""
+        missing from the view — its selector label edited mid-rollout, or
+        its Node object deleted by the autoscaler — falls back to a
+        direct GET rather than silently reading as pending; a 404 there
+        resolves the slot as :data:`STATE_NODE_DELETED` so a scale-down
+        mid-window never burns the window deadline as a phantom
+        timeout."""
         if self.informer is not None:
             # Indexed reads: O(group) per poll, not O(pool) — at 10k
             # nodes, rebuilding a pool-wide dict per settle-check would
@@ -892,16 +916,26 @@ class RollingReconfigurator:
             name: (
                 listed[name]
                 if name in listed
-                else node_labels(
-                    self.retry_policy.call(
-                        lambda name=name: self.api.get_node(name),
-                        op="rollout.get_node",
-                        classify=classify_kube_error,
-                    )
-                ).get(CC_MODE_STATE_LABEL)
+                else self._state_or_deleted(name)
             )
             for name in names
         }
+
+    def _state_or_deleted(self, name: str) -> str | None:
+        """Direct state read for a node absent from the pool view: its
+        selector label may merely have been edited (GET still answers),
+        or the Node object is gone (404 → STATE_NODE_DELETED)."""
+        try:
+            node = self.retry_policy.call(
+                lambda: self.api.get_node(name),
+                op="rollout.get_node",
+                classify=classify_kube_error,
+            )
+        except KubeApiError as e:
+            if e.status == 404:
+                return STATE_NODE_DELETED
+            raise
+        return node_labels(node).get(CC_MODE_STATE_LABEL)
 
     def _await_group(
         self, gid: str, names: tuple[str, ...], mode: str, started: float
@@ -960,6 +994,16 @@ class RollingReconfigurator:
                 if state == mode:
                     states[name] = state
                     pending.discard(name)
+                elif state == STATE_NODE_DELETED:
+                    # The Node object is gone (autoscaler scale-down):
+                    # resolve the slot immediately — it is not a CC
+                    # failure and must not wait out the window deadline.
+                    log.warning(
+                        "node %s was deleted mid-window; retiring it from "
+                        "the rollout (no failure-budget charge)", name,
+                    )
+                    states[name] = state
+                    pending.discard(name)
                 elif state == STATE_FAILED and name not in stale_failed:
                     states[name] = state
                     pending.discard(name)
@@ -982,7 +1026,11 @@ class RollingReconfigurator:
         for name in pending:  # timed out
             states[name] = "timeout"
         seconds = time.monotonic() - started
-        ok = all(s == mode for s in states.values())
+        # Deleted nodes are retired, not failed: a group whose only
+        # non-converged members were scaled away still counts converged.
+        ok = all(
+            s in (mode, STATE_NODE_DELETED) for s in states.values()
+        )
         (log.info if ok else log.error)(
             "group %s -> %s in %.1fs (states=%s)", gid,
             "converged" if ok else "FAILED", seconds, states,

@@ -155,6 +155,19 @@ class FakeKube(KubeApi):
                 del self._pods[key]
             return len(doomed)
 
+    def delete_node(self, name: str) -> None:
+        """Harness helper modeling a cluster-autoscaler scale-down: the
+        Node object disappears and watchers get a DELETED event (GETs
+        404, listings drop it) — exactly what a real apiserver serves
+        when the autoscaler deletes a node mid-rollout."""
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is None:
+                return
+            self._rv += 1
+            node["metadata"]["resourceVersion"] = str(self._rv)
+            self._record_event("DELETED", node)
+
     def add_patch_reactor(self, fn: Callable[[str, dict], None]) -> None:
         """fn(node_name, patched_node) runs (outside the lock) after each
         patch_node_labels call."""
