@@ -1,0 +1,562 @@
+"""Preemption fast-drain + handoff (spot/preemptible churn).
+
+The scenario the 300 s drain budget cannot survive: a platform preemption
+notice leaves a hard termination deadline ≪ the budget (GCE gives ~30 s).
+The stack must
+
+1. checkpoint-before-pause FIRST (the training job's unsaved state is the
+   one thing the kill destroys for good), with the deadline published as
+   a label hint so subscribers can size their checkpoint to the window;
+2. compress component eviction into whatever budget remains, proceeding
+   on timeout (the VM dies at the deadline either way);
+3. journal the interrupted transition as a ``handoff`` intent AND mirror
+   it to the node's handoff annotation — the replacement VM has a fresh
+   disk, so the apiserver copy is the only record that survives;
+4. on a multi-host slice, bump the fencing generation so peers mid-
+   barrier abort fast (BarrierFenced) instead of burning their barrier
+   deadline on the departed host's absent staged marker;
+5. let the replacement node resume the flip from the handoff record with
+   exactly ONE reset across the handoff.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager import intent_journal as ij
+from tpu_cc_manager.ccmanager.manager import CCManager, HANDOFF_ANNOTATION
+from tpu_cc_manager.drain import evict, handshake
+from tpu_cc_manager.drain.pause import is_paused
+from tpu_cc_manager.faults.plan import FaultPlan
+from tpu_cc_manager.kubeclient.api import node_annotations, node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    DRAIN_COMPONENT_LABELS,
+    MODE_ON,
+    MODE_SLICE,
+    SLICE_ID_LABEL,
+    STATE_FAILED,
+)
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "spot-node-0"
+NS = "tpu-operator"
+SLICE = "spot-slice-0"
+DP_LABEL = "google.com/tpu.deploy.device-plugin"
+DP_APP = DRAIN_COMPONENT_LABELS[DP_LABEL]
+
+
+class VmKilled(BaseException):
+    """The platform's hard kill landing at the termination deadline: no
+    Python cleanup runs in the reconcile below the kill point, exactly
+    like the SIGKILL a reclaimed VM gets."""
+
+
+def resets_of(backend) -> int:
+    return sum(1 for op, _ in backend.op_log if op == "reset")
+
+
+def operator_controller(kube) -> None:
+    """Paused component labels delete the pods; unpaused restore them."""
+
+    def reactor(name, node):
+        labels = node_labels(node)
+        for key, app in DRAIN_COMPONENT_LABELS.items():
+            if key not in labels:
+                continue
+            if is_paused(labels.get(key)):
+                kube.delete_pods_matching(NS, f"app={app}")
+            elif not kube.list_pods(NS, f"app={app}"):
+                kube.add_pod(NS, f"{app}-pod", name, labels={"app": app})
+
+    kube.add_patch_reactor(reactor)
+
+
+def make_manager(kube, backend, tmp_path, suffix, **kw):
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault(
+        "intent_journal",
+        ij.IntentJournal.from_state_dir(str(tmp_path / f"vm-{suffix}")),
+    )
+    return CCManager(
+        api=kube,
+        backend=backend,
+        node_name=kw.pop("node_name", NODE),
+        operator_namespace=NS,
+        evict_components=kw.pop("evict_components", True),
+        smoke_workload="none",
+        eviction_timeout_s=2.0,
+        eviction_poll_interval_s=0.01,
+        preemption_deadline_s=kw.pop("preemption_deadline_s", 2.0),
+        preemption_poll_s=kw.pop("preemption_poll_s", 0.0),
+        readiness_file=str(tmp_path / f"ready-{suffix}"),
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The seeded chaos acceptance test (tier-1): notice mid-flip → checkpoint
+# + handoff published before the kill → replacement resumes, ONE reset.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_preemption_mid_flip_hands_off_and_replacement_resumes_one_reset(
+    fake_kube, tmp_path,
+):
+    plan = FaultPlan(
+        seed=int(os.environ.get("CC_CHAOS_SEED", "20260803")),
+        preemption_rate=1.0, preemption_deadline_s=2.0,
+    )
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "dp-pod", NODE, labels={"app": DP_APP})
+    operator_controller(fake_kube)
+    # A registered training job: its checkpoint callback is modeled by a
+    # reactor that acks the drain cycle — recording the deadline hint the
+    # fast drain published, so "checkpoint sized to the window" is real.
+    sub = handshake.subscriber_label("trainer")
+    fake_kube.set_node_label(NODE, sub, handshake.ACTIVE)
+    checkpoint_hints: list[str | None] = []
+
+    def acker(name, node):
+        labels = node_labels(node)
+        token = handshake.request_token(
+            labels.get(handshake.DRAIN_REQUESTED_LABEL)
+        )
+        if token and labels.get(sub) == handshake.ACTIVE:
+            checkpoint_hints.append(
+                labels.get(handshake.DRAIN_DEADLINE_LABEL)
+            )
+            fake_kube.set_node_label(NODE, sub, handshake.ack_value(token))
+
+    fake_kube.add_patch_reactor(acker)
+
+    holder: dict = {}
+
+    class PreemptedBackend(FakeTpuBackend):
+        """The preemption notice lands while the transition is in flight
+        (just after staging); the VM is killed once the fast drain +
+        handoff publish finish — before its reset ever runs."""
+
+        def stage_cc_mode(self, chips, mode):
+            super().stage_cc_mode(chips, mode)
+            plan.seed_preemption(self)
+            holder["outcome"] = holder["mgr"].handle_preemption_notice()
+            # The platform kill lands here. Snapshot what the fast drain
+            # achieved INSIDE the window (the in-process VmKilled below
+            # still runs ``finally`` blocks a real SIGKILL would not, so
+            # post-kill state is not evidence).
+            holder["pods_at_kill"] = fake_kube.list_pods(NS, f"app={DP_APP}")
+            holder["dp_paused_at_kill"] = is_paused(
+                node_labels(fake_kube.get_node(NODE)).get(DP_LABEL)
+            )
+            raise VmKilled()
+
+    backend_a = PreemptedBackend(num_chips=4, accelerator_type="v5p-8")
+    registry_a = MetricsRegistry()
+    mgr_a = make_manager(
+        fake_kube, backend_a, tmp_path, "a", metrics=registry_a,
+    )
+    holder["mgr"] = mgr_a
+    fake_kube.set_node_label(NODE, CC_MODE_LABEL, MODE_ON)
+    with pytest.raises(VmKilled):
+        mgr_a.set_cc_mode(MODE_ON)
+
+    # Before the kill: checkpoint handshake ran FIRST, with the hard
+    # deadline published as the label hint…
+    assert checkpoint_hints == ["2"]
+    # …eviction completed inside the compressed window (components paused,
+    # pods gone at the moment the kill landed)…
+    assert holder["pods_at_kill"] == []
+    assert holder["dp_paused_at_kill"] is True
+    # …and the handoff record reached BOTH the local journal (crash truth
+    # for a cancelled reclaim) and the node annotation (the only copy
+    # that survives the reclaimed disk).
+    record = json.loads(
+        node_annotations(fake_kube.get_node(NODE))[HANDOFF_ANNOTATION]
+    )
+    assert record["mode"] == MODE_ON
+    assert record["from"] == NODE
+    journal_kinds = [
+        (r.get("t"), r.get("kind"))
+        for r in ij.IntentJournal.from_state_dir(
+            str(tmp_path / "vm-a")
+        ).replay().records
+    ]
+    assert ("intent", ij.KIND_HANDOFF) in journal_kinds
+    assert registry_a.preemption_totals() == {"handoff": 1}
+    assert resets_of(backend_a) == 0  # killed before its reset
+    assert len(plan.injected) == 1 and plan.injected[0].kind == "preemption"
+
+    # The replacement VM: same node name, FRESH disk (new journal dir),
+    # fresh hardware. It consumes the handoff at startup and commits the
+    # flip with exactly one reset.
+    backend_b = FakeTpuBackend(num_chips=4, accelerator_type="v5p-8")
+    registry_b = MetricsRegistry()
+    mgr_b = make_manager(
+        fake_kube, backend_b, tmp_path, "b", metrics=registry_b,
+    )
+    mgr_b.consume_handoff()
+    assert mgr_b.intents.last_desired_mode == MODE_ON  # dark-boot truth
+    assert mgr_b.set_cc_mode(MODE_ON) is True
+
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON
+    assert labels[DP_LABEL] == "true"  # components re-admitted
+    assert HANDOFF_ANNOTATION not in node_annotations(
+        fake_kube.get_node(NODE)
+    )
+    assert registry_b.preemption_totals() == {"resumed": 1}
+    total_resets = resets_of(backend_a) + resets_of(backend_b)
+    assert total_resets == 1, (
+        f"expected exactly one reset across the handoff, got {total_resets}"
+    )
+    print(
+        "PREEMPTION_SUMMARY "
+        f"seed={plan.seed} deadline_s={plan.preemption_deadline_s} "
+        f"outcome={holder['outcome']} resumed=1 resets={total_resets} "
+        f"checkpoint_hinted={checkpoint_hints == ['2']}"
+    )
+
+
+@pytest.mark.chaos
+def test_slice_peer_fences_fast_instead_of_burning_barrier_deadline(
+    fake_kube, tmp_path,
+):
+    """A host of a 2-host slice is preempted mid-flip: its handler bumps
+    the fencing generation, so the surviving peer aborts its barrier wait
+    with BarrierFenced in well under the barrier deadline instead of
+    polling the departed host's staged marker until timeout."""
+
+    def host(i, **kw):
+        backend = FakeTpuBackend(
+            num_chips=4, accelerator_type="v5p-32",
+            num_hosts=2, host_index=i, slice_id=SLICE,
+        )
+        registry = MetricsRegistry()
+        mgr = make_manager(
+            fake_kube, backend, tmp_path, f"h{i}",
+            node_name=f"spot-node-{i}", metrics=registry,
+            evict_components=False, **kw,
+        )
+        return mgr, backend, registry
+
+    fake_kube.add_node("spot-node-0", {SLICE_ID_LABEL: SLICE})
+    fake_kube.add_node("spot-node-1")
+    mgr0, _backend0, registry0 = host(0)
+    mgr1, backend1, registry1 = host(
+        1, slice_barrier_timeout_s=20.0, slice_barrier_poll_interval_s=0.02,
+    )
+
+    result: dict = {}
+
+    def drive_peer():
+        result["ok"] = mgr1.set_cc_mode(MODE_SLICE)
+
+    t = threading.Thread(target=drive_peer, daemon=True)
+    started = time.monotonic()
+    t.start()
+    time.sleep(0.3)  # the peer is now parked in its barrier wait
+    # Host 0 was preempted mid-flip (it never staged): its notice handler
+    # publishes the handoff AND fences the slice on its way out.
+    mgr0._inflight_transition = {
+        "mode": MODE_SLICE, "chips": [0, 1, 2, 3],
+        "phase": ij.PHASE_BEGUN, "slice_id": SLICE, "multi_host": True,
+    }
+    assert mgr0.handle_preemption_notice() == "handoff"
+    t.join(timeout=10.0)
+    elapsed = time.monotonic() - started
+    assert not t.is_alive(), "peer never left its barrier wait"
+    assert result["ok"] is False
+    assert elapsed < 10.0, (
+        f"peer burned {elapsed:.1f}s; fencing should abort it fast"
+    )
+    labels = node_labels(fake_kube.get_node("spot-node-1"))
+    assert labels[CC_MODE_STATE_LABEL] == STATE_FAILED
+    # The departing host counted the fence; the surviving peer recorded
+    # the fenced abort as its failure reason (not a timeout).
+    assert "tpu_cc_barrier_fenced_total 1" in registry0.render_prometheus()
+    assert 'tpu_cc_failures_total{reason="barrier-fenced"}' in (
+        registry1.render_prometheus()
+    )
+    assert resets_of(backend1) == 0  # fenced before any hardware touch
+    print(
+        f"PREEMPTION_SUMMARY scenario=slice-fence elapsed_s={elapsed:.2f} "
+        "fenced=1"
+    )
+
+
+# ---------------------------------------------------------------------------
+# The notice monitor (the production path from signal to handler)
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_polls_the_seeded_notice_and_retires(fake_kube, tmp_path):
+    plan = FaultPlan(seed=7, preemption_rate=1.0)
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    mgr = make_manager(
+        fake_kube, backend, tmp_path, "m", metrics=registry,
+        evict_components=False,
+        preemption_poll_s=0.01, preemption_deadline_s=1.0,
+    )
+    assert plan.schedule_preemption(backend) is True
+    mgr._start_preemption_monitor()
+    deadline = time.monotonic() + 5.0
+    while not registry.preemption_totals() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # No transition was in flight: a clean fast drain, and the monitor
+    # thread retires (the signal is level-triggered; one per VM lifetime).
+    assert registry.preemption_totals() == {"clean": 1}
+    mgr._preemption_thread.join(timeout=2.0)
+    assert not mgr._preemption_thread.is_alive()
+    assert mgr.handle_preemption_notice() == "duplicate"
+    assert registry.preemption_totals() == {"clean": 1}
+    mgr._stop_preemption_monitor()
+
+
+def test_monitor_disabled_by_zero_deadline(fake_kube, tmp_path):
+    mgr = make_manager(
+        fake_kube, FakeTpuBackend(), tmp_path, "d",
+        evict_components=False,
+        preemption_poll_s=0.01, preemption_deadline_s=0.0,
+    )
+    mgr._start_preemption_monitor()
+    assert mgr._preemption_thread is None
+
+
+def test_flaky_notice_source_never_kills_the_monitor(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    mgr = make_manager(
+        fake_kube, backend, tmp_path, "f", metrics=registry,
+        evict_components=False,
+        preemption_poll_s=0.01, preemption_deadline_s=1.0,
+    )
+    backend.fail_next("preemption_notice", times=3)
+    mgr._start_preemption_monitor()
+    try:
+        backend.set_preempted(True)
+        deadline = time.monotonic() + 5.0
+        while (
+            not registry.preemption_totals() and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert registry.preemption_totals() == {"clean": 1}
+    finally:
+        mgr._stop_preemption_monitor()
+
+
+def test_handoff_published_even_when_eviction_fails(fake_kube, tmp_path):
+    """The handoff publish is the part that matters most — an eviction
+    failure (any shape) must not consume its window or skip it."""
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+
+    class BrokenPods:
+        def __getattr__(self, name):
+            return getattr(fake_kube, name)
+
+        def list_pods(self, *a, **kw):
+            raise RuntimeError("pods listing wedged")
+
+    backend = FakeTpuBackend()
+    registry = MetricsRegistry()
+    mgr = make_manager(
+        BrokenPods(), backend, tmp_path, "e", metrics=registry,
+    )
+    mgr._inflight_transition = {
+        "mode": MODE_ON, "chips": [0], "phase": ij.PHASE_STAGED,
+        "slice_id": None, "multi_host": False,
+    }
+    assert mgr.handle_preemption_notice() == "handoff"
+    record = json.loads(
+        node_annotations(fake_kube.get_node(NODE))[HANDOFF_ANNOTATION]
+    )
+    assert record["phase"] == ij.PHASE_STAGED
+    assert registry.preemption_totals() == {"handoff": 1}
+
+
+def test_garbled_handoff_annotation_is_cleared_not_trusted(
+    fake_kube, tmp_path,
+):
+    fake_kube.add_node(NODE)
+    fake_kube.patch_node_annotations(
+        NODE, {HANDOFF_ANNOTATION: "not json at all"}
+    )
+    mgr = make_manager(
+        fake_kube, FakeTpuBackend(), tmp_path, "g", evict_components=False,
+    )
+    mgr.consume_handoff()
+    assert mgr._handoff is None
+    assert HANDOFF_ANNOTATION not in node_annotations(
+        fake_kube.get_node(NODE)
+    )
+    # Valid JSON that is not an object must clear too, not crash startup.
+    fake_kube.patch_node_annotations(NODE, {HANDOFF_ANNOTATION: "[]"})
+    mgr2 = make_manager(
+        fake_kube, FakeTpuBackend(), tmp_path, "g2", evict_components=False,
+    )
+    mgr2.consume_handoff()
+    assert mgr2._handoff is None
+    assert HANDOFF_ANNOTATION not in node_annotations(
+        fake_kube.get_node(NODE)
+    )
+
+
+def test_superseded_handoff_still_retires(fake_kube, tmp_path):
+    """The desired mode moved on while the VM was being replaced: the
+    replacement converges on the NEW mode and the stale handoff record is
+    still cleared (the flip it described was superseded, not lost)."""
+    fake_kube.add_node(NODE, {CC_MODE_LABEL: "devtools"})
+    fake_kube.patch_node_annotations(NODE, {
+        HANDOFF_ANNOTATION: json.dumps({
+            "mode": "on", "phase": "begun", "chips": [0],
+            "slice_id": None, "from": NODE, "ts": 1.0,
+        })
+    })
+    registry = MetricsRegistry()
+    mgr = make_manager(
+        fake_kube, FakeTpuBackend(), tmp_path, "s", metrics=registry,
+        evict_components=False,
+    )
+    mgr.consume_handoff()
+    assert mgr.set_cc_mode("devtools") is True
+    assert HANDOFF_ANNOTATION not in node_annotations(
+        fake_kube.get_node(NODE)
+    )
+    assert registry.preemption_totals() == {"resumed": 1}
+
+
+# ---------------------------------------------------------------------------
+# Fast drain vs normal drain: identical pause-label algebra
+# ---------------------------------------------------------------------------
+
+
+def _component_state(kube, node):
+    labels = node_labels(kube.get_node(node))
+    return {
+        k: labels.get(k) for k in DRAIN_COMPONENT_LABELS if k in labels
+    }
+
+
+def _fresh_node(kube_cls, values: dict):
+    kube = kube_cls()
+    kube.add_node(NODE, dict(values))
+    return kube
+
+
+def test_fast_drain_and_normal_drain_produce_identical_pause_labels():
+    """Property: over every combination of component-label presence and
+    prior pausedness, the fast drain applies EXACTLY the pause algebra of
+    the normal drain — only timings (and the deadline hint + withheld
+    readmit) differ."""
+    from tpu_cc_manager.drain.pause import pause_value
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    keys = sorted(DRAIN_COMPONENT_LABELS)
+    cases = []
+    for mask in range(2 ** len(keys)):
+        values = {}
+        for i, key in enumerate(keys):
+            if mask & (1 << i):
+                values[key] = "true"
+        cases.append(values)
+        paused = {
+            k: (pause_value(v) or v) for k, v in values.items()
+        }
+        if paused != values:
+            cases.append(paused)  # crashed-run leftovers: already paused
+    for values in cases:
+        slow = _fresh_node(FakeKube, values)
+        fast = _fresh_node(FakeKube, values)
+        original_slow = evict.evict_components(
+            slow, NODE, NS, timeout_s=0.05, poll_interval_s=0.01,
+        )
+        original_fast = evict.fast_drain_components(
+            fast, NODE, NS, deadline_s=0.05, poll_interval_s=0.01,
+        )
+        assert original_slow == original_fast, values
+        slow_state = _component_state(slow, NODE)
+        fast_state = _component_state(fast, NODE)
+        assert slow_state == fast_state, (
+            f"pause algebra diverged for {values}: "
+            f"normal={slow_state} fast={fast_state}"
+        )
+
+
+def test_fast_drain_proceeds_to_return_when_eviction_cannot_finish(
+    fake_kube,
+):
+    """Deadline exhaustion: pods never leave (no operator), the workload
+    never acks — the fast drain must still pause, wait out ONLY the
+    compressed deadline, and return so the caller gets its handoff
+    window. The drain request (and deadline hint) stay up for the
+    replacement's crash-recovery readmit."""
+    fake_kube.add_node(
+        NODE,
+        {DP_LABEL: "true", handshake.subscriber_label("wedged"): "active"},
+    )
+    fake_kube.add_pod(NS, "dp-pod", NODE, labels={"app": DP_APP})
+    started = time.monotonic()
+    original = evict.fast_drain_components(
+        fake_kube, NODE, NS, deadline_s=0.3, poll_interval_s=0.01,
+    )
+    elapsed = time.monotonic() - started
+    assert elapsed < 3.0, f"fast drain overran its deadline: {elapsed:.1f}s"
+    assert original == {DP_LABEL: "true"}
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert is_paused(labels[DP_LABEL])
+    assert handshake.request_token(
+        labels.get(handshake.DRAIN_REQUESTED_LABEL)
+    ) is not None
+    assert labels.get(handshake.DRAIN_DEADLINE_LABEL) == "1"
+    # The wedged pod is still there — the VM dies at the deadline and the
+    # kill, not the drain, removes it.
+    assert fake_kube.list_pods(NS, f"app={DP_APP}")
+
+
+def test_readmit_clears_the_deadline_hint(fake_kube):
+    """A cancelled preemption (or the replacement's crash-recovery
+    readmit) must not leak the fast drain's deadline hint into the next
+    normal drain cycle."""
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    original = evict.fast_drain_components(
+        fake_kube, NODE, NS, deadline_s=0.1, poll_interval_s=0.01,
+    )
+    evict.readmit_components(fake_kube, NODE, original)
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert handshake.DRAIN_REQUESTED_LABEL not in labels
+    assert handshake.DRAIN_DEADLINE_LABEL not in labels
+    assert labels[DP_LABEL] == "true"
+
+
+def test_subscriber_reads_the_deadline_hint(fake_kube):
+    """DrainSubscriber surfaces the fast drain's deadline so a checkpoint
+    callback can size itself to the window."""
+    seen: list[float | None] = []
+    sub = handshake.DrainSubscriber(
+        fake_kube, NODE, "job-a",
+        on_drain=lambda: seen.append(sub.drain_deadline_s),
+        on_resume=lambda: None,
+        poll_interval_s=0.01,
+    )
+    fake_kube.add_node(NODE)
+    sub.register()
+    handshake.request_drain(fake_kube, NODE, deadline_s=27.4)
+    sub.check_once()
+    assert seen == [27.0]  # whole-seconds label hint
+    # A normal drain carries no hint.
+    handshake.clear_drain_request(fake_kube, NODE)
+    sub.check_once()
+    handshake.request_drain(fake_kube, NODE)
+    sub.check_once()
+    assert sub.drain_deadline_s is None

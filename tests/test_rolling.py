@@ -1,5 +1,6 @@
 """Rolling pool reconfiguration (ccmanager/rolling.py)."""
 
+import json
 import threading
 import time
 
@@ -372,6 +373,262 @@ def test_deleted_node_resolves_under_informer(fake_kube):
     assert result.ok is True
     by_group = {g.group: g for g in result.groups}
     assert by_group["node/node-1"].states["node-1"] == "deleted"
+
+
+def test_scale_down_during_sharded_rollout_spends_no_budget(fake_kube):
+    """Chaos acceptance (tentpole b): an autoscaler scale-down DURING a
+    sharded, lease-fenced rollout retires the node with ZERO
+    failure-budget spend — with failure_budget=0, any charge would halt
+    the rollout, so ok=True proves the deleted node was never charged."""
+    from tpu_cc_manager.ccmanager import rollout_state
+
+    add_pool(
+        fake_kube, 4,
+        slice_map={i: f"s{i}" for i in range(4)},
+    )
+    for i in range(4):
+        fake_kube.set_node_label(
+            f"node-{i}", "topology.kubernetes.io/zone", f"zone-{i % 2}"
+        )
+    deleted_agent_simulator(fake_kube)
+    lease = rollout_state.RolloutLease(fake_kube, holder="t-scale-down")
+    assert lease.acquire() is None
+    roller = make_roller(
+        fake_kube, max_unavailable=2, node_timeout_s=30,
+        wave_shards=2, failure_budget=0, lease=lease,
+    )
+    result = roller.rollout("on")
+    lease.release(clear_record=result.ok)
+    assert result.ok is True
+    assert result.halted_reason is None
+    assert result.retired_deleted == ["node-1"]
+    for i in (0, 2, 3):
+        assert node_labels(fake_kube.get_node(f"node-{i}"))[
+            CC_MODE_STATE_LABEL
+        ] == "on"
+
+
+def test_scale_up_node_is_adopted_into_trailing_wave(fake_kube):
+    """Chaos acceptance (tentpole b): a node the autoscaler creates
+    mid-rollout that matches the selector is adopted into a trailing
+    wave and converges to the desired mode + generation label."""
+    from tpu_cc_manager.ccmanager import rollout_state
+
+    add_pool(fake_kube, 2)
+    agent_simulator(fake_kube)
+    created = threading.Event()
+
+    def scale_up(name, node):
+        # The autoscaler reacts to the first desired-mode write: a new
+        # node joins the pool while the rollout is mid-window.
+        if not created.is_set() and node_labels(node).get(CC_MODE_LABEL):
+            created.set()
+            fake_kube.add_node("node-9", {"pool": "tpu"})
+
+    fake_kube.add_patch_reactor(scale_up)
+    lease = rollout_state.RolloutLease(fake_kube, holder="t-scale-up")
+    assert lease.acquire() is None
+    result = make_roller(fake_kube, lease=lease).rollout("on")
+    lease.release(clear_record=result.ok)
+    assert result.ok is True
+    assert result.adopted == ["node-9"]
+    labels = node_labels(fake_kube.get_node("node-9"))
+    assert labels[CC_MODE_LABEL] == "on"
+    assert labels[CC_MODE_STATE_LABEL] == "on"
+    assert labels[rollout_state.ROLLOUT_GEN_LABEL] == str(result.generation)
+
+
+def test_adoption_disabled_leaves_new_node_alone(fake_kube):
+    add_pool(fake_kube, 1)
+    agent_simulator(fake_kube)
+    seen = threading.Event()
+
+    def scale_up(name, node):
+        if not seen.is_set() and node_labels(node).get(CC_MODE_LABEL):
+            seen.set()
+            fake_kube.add_node("node-9", {"pool": "tpu"})
+
+    fake_kube.add_patch_reactor(scale_up)
+    result = make_roller(fake_kube, adopt_new_nodes=False).rollout("on")
+    assert result.ok is True
+    assert result.adopted == []
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("node-9"))
+
+
+def surge_taints_of(fake_kube, name):
+    from tpu_cc_manager.ccmanager.rolling import SURGE_TAINT_KEY
+
+    node = fake_kube.get_node(name)
+    return [
+        t for t in (node.get("spec") or {}).get("taints") or []
+        if t.get("key") == SURGE_TAINT_KEY
+    ]
+
+
+def test_surge_rollout_flips_spares_first_and_reclaims(fake_kube):
+    """Tentpole (c): --surge N flips N spare nodes FIRST behind the
+    surge NoSchedule taint, reclaims them on convergence, and the
+    measured (non-surge) pool unavailability never exceeds
+    max_unavailable."""
+    add_pool(fake_kube, 4)
+    tainted_during_flip = {}
+
+    def reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired:
+            # Snapshot the taint the moment the flip is requested: surge
+            # spares must be unschedulable-for-workloads for their whole
+            # flip window.
+            tainted_during_flip[name] = bool(surge_taints_of(fake_kube, name))
+            t = threading.Timer(
+                0.05,
+                lambda: fake_kube.set_node_label(
+                    name, CC_MODE_STATE_LABEL, desired
+                ),
+            )
+            t.daemon = True
+            t.start()
+
+    fake_kube.add_patch_reactor(reactor)
+    result = make_roller(fake_kube, max_unavailable=1, surge=2).rollout("on")
+    assert result.ok is True
+    assert result.surged == ["node-0", "node-1"]
+    # The spares flipped behind the taint; the regular nodes did not.
+    assert tainted_during_flip == {
+        "node-0": True, "node-1": True, "node-2": False, "node-3": False,
+    }
+    # Reclaimed: no surge taint survives the rollout.
+    for i in range(4):
+        assert surge_taints_of(fake_kube, f"node-{i}") == []
+        assert node_labels(fake_kube.get_node(f"node-{i}"))[
+            CC_MODE_STATE_LABEL
+        ] == "on"
+    # Measured serving-capacity disruption: the 2 concurrent surge spares
+    # never count (they are behind the taint); the rolling remainder
+    # stays within max_unavailable.
+    assert result.max_unavailable_observed <= 1
+    assert result.summary()["surged"] == ["node-0", "node-1"]
+
+
+def test_surge_failed_spare_keeps_taint_and_halts(fake_kube):
+    """A spare that cannot flip keeps its NoSchedule taint (a node that
+    failed its transition must not receive workloads) and halts the
+    rollout before the regular waves touch serving capacity."""
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube, fail_nodes={"node-0"})
+    roller = make_roller(
+        fake_kube, max_unavailable=1, surge=1, node_timeout_s=5,
+    )
+    result = roller.rollout("on")
+    assert result.ok is False
+    assert surge_taints_of(fake_kube, "node-0"), "failed spare lost its taint"
+    # The regular groups were never attempted.
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("node-2"))
+
+
+def test_surge_failed_spare_fails_verdict_even_under_continue(fake_kube):
+    """continue_on_failure presses past a failed spare, but the rollout's
+    verdict must still be False — a node sits failed (and tainted)
+    behind it."""
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube, fail_nodes={"node-0"})
+    result = make_roller(
+        fake_kube, max_unavailable=1, surge=1, node_timeout_s=5,
+        continue_on_failure=True,
+    ).rollout("on")
+    assert result.ok is False
+    assert surge_taints_of(fake_kube, "node-0")
+    # The regular groups were still driven.
+    assert node_labels(fake_kube.get_node("node-2"))[
+        CC_MODE_STATE_LABEL
+    ] == "on"
+
+
+def test_resume_never_resurges_and_reclaims_stale_taints(fake_kube):
+    """A resumed surge rollout must NOT greedily re-pick 'spares' from
+    what are now serving nodes (a NoSchedule taint evicts nothing, so
+    that would silently exceed max_unavailable); surviving groups roll
+    normally and a stale surge taint from the interrupted surge phase is
+    reclaimed."""
+    from tpu_cc_manager.ccmanager import rollout_state
+
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube)
+    # The dead orchestrator's leftovers: node-0 done (surged, converged),
+    # node-1 crashed mid-surge with its taint still on.
+    fake_kube.set_node_label("node-0", CC_MODE_LABEL, "on")
+    fake_kube.set_node_label("node-0", CC_MODE_STATE_LABEL, "on")
+    fake_kube.patch_node_taints(
+        "node-1",
+        [{"key": "cloud.google.com/tpu-cc.surge", "value": "true",
+          "effect": "NoSchedule"}], [],
+    )
+    record = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[(f"node/node-{i}", (f"node-{i}",)) for i in range(3)],
+        surge=2,
+    )
+    record.note_group(
+        "node/node-0", ok=True, states={"node-0": "on"}, seconds=0.1,
+    )
+    # Round-trip through JSON: a surge record is format v3.
+    assert json.loads(record.to_json())["version"] == 3
+    record = rollout_state.RolloutRecord.from_json(record.to_json())
+    assert record.surge == 2
+    roller = make_roller(fake_kube, surge=record.surge, resume_record=record)
+    result = roller.rollout("on")
+    assert result.ok is True
+    assert result.surged == []  # no re-surge on resume
+    for i in range(3):
+        assert surge_taints_of(fake_kube, f"node-{i}") == []
+        assert node_labels(fake_kube.get_node(f"node-{i}"))[
+            CC_MODE_STATE_LABEL
+        ] == "on"
+
+
+def test_surge_refuses_rollback_on_failure(fake_kube):
+    """Same refusal as wave_shards: a surge halt would either have to
+    revert tainted spares or silently skip the rollback the operator
+    asked for — reject the combination up front."""
+    add_pool(fake_kube, 2)
+    with pytest.raises(ValueError, match="surge"):
+        make_roller(fake_kube, surge=1, rollback_on_failure=True)
+
+
+def test_rollback_on_failure_skips_adoption(fake_kube):
+    """Adopted nodes have no prior desired mode to revert to, so a
+    rollback-armed rollout leaves mid-rollout joiners to the NEXT
+    rollout instead of flipping what it could never restore."""
+    add_pool(fake_kube, 1)
+    for i in range(1):
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_LABEL, "off")
+        fake_kube.set_node_label(f"node-{i}", CC_MODE_STATE_LABEL, "off")
+    agent_simulator(fake_kube)
+    seen = threading.Event()
+
+    def scale_up(name, node):
+        if not seen.is_set() and node_labels(node).get(CC_MODE_LABEL) == "on":
+            seen.set()
+            fake_kube.add_node("node-9", {"pool": "tpu"})
+
+    fake_kube.add_patch_reactor(scale_up)
+    result = make_roller(fake_kube, rollback_on_failure=True).rollout("on")
+    assert result.ok is True
+    assert result.adopted == []
+    assert CC_MODE_LABEL not in node_labels(fake_kube.get_node("node-9"))
+
+
+def test_surge_larger_than_any_group_rolls_normally(fake_kube):
+    """surge smaller than the smallest (multi-host) group: nothing fits
+    the spare budget — the rollout degrades to a normal one instead of
+    splitting a slice."""
+    add_pool(fake_kube, 4, slice_map={0: "s1", 1: "s1", 2: "s1", 3: "s1"})
+    agent_simulator(fake_kube)
+    result = make_roller(fake_kube, surge=2).rollout("on")
+    assert result.ok is True
+    assert result.surged == []
+    assert surge_taints_of(fake_kube, "node-0") == []
 
 
 def test_interrupted_rollout_resumes_idempotently(fake_kube):

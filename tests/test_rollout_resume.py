@@ -1072,7 +1072,7 @@ def test_sharded_record_is_v2_and_plain_resume_inherits_shards(fake_kube):
 
     raw = stored["metadata"]["annotations"][rollout_state.RECORD_ANNOTATION]
     obj = json_mod.loads(raw)
-    assert obj["version"] == rollout_state.RECORD_VERSION
+    assert obj["version"] == rollout_state.RECORD_VERSION_NO_SURGE
     assert obj["wave_shards"] == 2
     record = rollout_state.RolloutRecord.from_json(raw)
     assert record.wave_shards == 2
@@ -1134,7 +1134,7 @@ def test_pre_refactor_v1_record_resumes_under_sharded_orchestrator(fake_kube):
     obj = json_mod.loads(
         stored["metadata"]["annotations"][rollout_state.RECORD_ANNOTATION]
     )
-    assert obj["version"] == rollout_state.RECORD_VERSION
+    assert obj["version"] == rollout_state.RECORD_VERSION_NO_SURGE
     assert obj["wave_shards"] == 3
 
 

@@ -35,7 +35,12 @@ Record grammar (the ``t`` field):
                     ``kind=drain``: the pause/readmit bracket — the paused
                     set itself lives in the node's pause-encoded labels,
                     so recovery restores it with one readmit once the
-                    apiserver answers)
+                    apiserver answers; ``kind=handoff``: a preemption
+                    notice interrupted an in-flight transition — the same
+                    record is also published to the node's handoff
+                    annotation, because a preempted VM's DISK dies with
+                    it and the replacement node can only read the
+                    apiserver copy)
 ``mark``            phase progress inside an open intent (``staged`` →
                     ``reset``), so replay knows whether the disruptive
                     reset had begun
@@ -83,6 +88,16 @@ DEFAULT_OFFLINE_GRACE_S = 60.0
 PHASE_BEGUN = "begun"
 PHASE_STAGED = "staged"
 PHASE_RESET = "reset"
+
+# Intent kinds (the ``kind`` field of t=intent records).
+KIND_TRANSITION = "transition"
+KIND_DRAIN = "drain"
+#: A preemption notice interrupted an in-flight transition: the agent
+#: journals it locally (crash truth if the preemption is cancelled) AND
+#: mirrors it to the node's handoff annotation (ccmanager/manager.py
+#: HANDOFF_ANNOTATION) — the replacement VM has a fresh disk, so the
+#: apiserver copy is the only record that survives the reclaim.
+KIND_HANDOFF = "handoff"
 
 
 class JournalCorrupt(Exception):

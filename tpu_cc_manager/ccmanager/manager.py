@@ -21,6 +21,7 @@ there is no dead ``last_label`` state (§8.2), label writes are merge-patches
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -74,6 +75,22 @@ WATCH_RECONNECT_DELAY_S = 5.0
 MAX_CONSECUTIVE_WATCH_ERRORS = 10
 DEFAULT_READY_TIMEOUT_S = 300.0
 
+# Preemption fast-drain (spot/preemptible nodes): the hard termination
+# deadline the platform gives between notice and kill, and how often the
+# monitor polls the backend's notice source (GCE: metadata-server
+# ``instance/preempted``). CC_PREEMPTION_DEADLINE_S=0 disables the
+# monitor entirely.
+DEFAULT_PREEMPTION_DEADLINE_S = 30.0
+DEFAULT_PREEMPTION_POLL_S = 5.0
+
+#: Node annotation carrying the handoff record of a transition a
+#: preemption notice interrupted: {mode, phase, chips, slice_id, from,
+#: ts} as JSON. Published by the departing agent BEFORE the kill and
+#: consumed by the replacement node's agent at startup — the preempted
+#: VM's disk (and with it the intent journal) dies in the reclaim, so
+#: the apiserver copy is the only record that reaches the successor.
+HANDOFF_ANNOTATION = "cloud.google.com/tpu-cc.handoff"
+
 
 class CCManager:
     def __init__(
@@ -107,6 +124,8 @@ class CCManager:
         intent_journal: intent_mod.IntentJournal | None = None,
         offline_grace_s: float | None = None,
         use_slice_informer: bool | None = None,
+        preemption_deadline_s: float | None = None,
+        preemption_poll_s: float | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -253,6 +272,38 @@ class CCManager:
         # patches, flushed idempotently (RMW) on reconnect.
         self.offline = intent_mod.OfflineTracker(offline_grace_s)
         self._flushing_patches = False
+        # Preemption fast-drain (spot/preemptible nodes): the hard
+        # termination deadline the platform's notice leaves us, and how
+        # often to poll the backend's notice source. deadline<=0 or
+        # poll<=0 disables the monitor.
+        if preemption_deadline_s is None:
+            preemption_deadline_s = float(
+                os.environ.get(
+                    "CC_PREEMPTION_DEADLINE_S",
+                    str(DEFAULT_PREEMPTION_DEADLINE_S),
+                )
+            )
+        self.preemption_deadline_s = preemption_deadline_s
+        if preemption_poll_s is None:
+            preemption_poll_s = float(
+                os.environ.get(
+                    "CC_PREEMPTION_POLL_S", str(DEFAULT_PREEMPTION_POLL_S)
+                )
+            )
+        self.preemption_poll_s = preemption_poll_s
+        self._preemption_stop: threading.Event | None = None
+        self._preemption_thread: threading.Thread | None = None
+        self._preemption_handled = False
+        # The transition currently in flight (mode, chip indices, phase,
+        # slice identity), maintained by _apply_direct so the preemption
+        # handler — running on the monitor thread, concurrently with a
+        # reconcile blocked in a barrier wait — knows exactly what to
+        # hand off. None outside the hardware pipeline.
+        self._inflight_transition: dict | None = None
+        # A predecessor's handoff record consumed at startup; retired
+        # (annotation cleared + outcome=resumed counted) after the first
+        # successful reconcile completes the handed-off flip.
+        self._handoff: dict | None = None
         # Event dedup state (see _emit_node_event).
         self._last_event_key: tuple[str, str, str] | None = None
         # Verifier-challenge re-attestation (multislice.py): the last
@@ -545,6 +596,10 @@ class CCManager:
                     # so a still-outstanding challenge is re-answered on
                     # the next watch event.
                     self._answered_challenge_nonce = None
+                    # A consumed handoff is complete once any reconcile
+                    # succeeds: the handed-off flip either committed or
+                    # was superseded by a newer desired mode.
+                    self._retire_handoff()
                 return ok
         finally:
             self.reconciling = False
@@ -881,15 +936,27 @@ class CCManager:
         txn = self._journal_begin(
             "transition", mode=mode, chips=[c.index for c in chips],
         )
+        # Visible to the preemption monitor thread: if a notice lands
+        # anywhere in this pipeline, the handler hands THIS transition
+        # off to the replacement node (handle_preemption_notice).
+        self._inflight_transition = {
+            "mode": mode,
+            "chips": [c.index for c in chips],
+            "phase": intent_mod.PHASE_BEGUN,
+            "slice_id": topo.slice_id,
+            "multi_host": topo.is_multi_host,
+        }
         try:
             with m.phase(metrics_mod.PHASE_STAGE):
                 self.backend.stage_cc_mode(chips, mode)
             self._journal_mark(txn, intent_mod.PHASE_STAGED)
+            self._inflight_transition["phase"] = intent_mod.PHASE_STAGED
             if barrier is not None:
                 with m.phase(metrics_mod.PHASE_BARRIER):
                     barrier.publish_staged(mode)
                     barrier.await_commit(mode)
             self._journal_mark(txn, intent_mod.PHASE_RESET)
+            self._inflight_transition["phase"] = intent_mod.PHASE_RESET
             with m.phase(metrics_mod.PHASE_RESET):
                 self.backend.reset(chips)
             with m.phase(metrics_mod.PHASE_WAIT_READY):
@@ -946,6 +1013,11 @@ class CCManager:
             )
             m.result = "failed"
             return False
+        finally:
+            # The hardware pipeline is over (committed, failed, or a
+            # modeled crash unwinding) — there is no transition left to
+            # hand off.
+            self._inflight_transition = None
         self._report_state(mode)
         # The publish patch below also withdraws this host's staged marker
         # (it is no longer mid-transition); the leader's commit-marker
@@ -1225,6 +1297,238 @@ class CCManager:
             )
 
     # ------------------------------------------------------------------
+    # Preemption fast-drain + handoff (spot/preemptible nodes)
+    # ------------------------------------------------------------------
+
+    def handle_preemption_notice(self) -> str:
+        """React to a platform preemption notice inside the hard
+        termination deadline (CC_PREEMPTION_DEADLINE_S ≪ the 300 s drain
+        budget), in strict priority order:
+
+        1. **fast drain** — workload checkpoint handshake first
+           (checkpoint-before-pause; the training job's unsaved state is
+           the one thing the kill destroys for good), then component
+           eviction compressed into the remaining budget, proceeding on
+           timeout (the VM dies at the deadline either way);
+        2. **handoff publish** — the in-flight transition (if any) is
+           journaled as a ``handoff`` intent AND mirrored to the node's
+           handoff annotation, so the replacement node — fresh disk, no
+           journal — resumes the flip instead of rediscovering it;
+        3. **slice fence** — on a multi-host slice the fencing generation
+           is bumped, so peers mid-barrier abort fast (BarrierFenced)
+           instead of burning their barrier deadline on the departing
+           host's staged marker.
+
+        Idempotent per process (the platform signal is level-triggered;
+        one fast drain per VM lifetime). Returns the recorded outcome:
+        ``handoff`` / ``clean`` / ``handoff-failed`` / ``duplicate``."""
+        if self._preemption_handled:
+            return "duplicate"
+        self._preemption_handled = True
+        started = time.monotonic()
+        inflight = self._inflight_transition
+        log.warning(
+            "PREEMPTION notice: fast-draining within %.0fs (%s)",
+            self.preemption_deadline_s,
+            f"transition to {inflight['mode']} in flight "
+            f"(phase={inflight['phase']})"
+            if inflight else "no transition in flight",
+        )
+        self._emit_node_event(
+            "Warning", "CCNodePreempted",
+            f"platform preemption notice; fast-draining within "
+            f"{self.preemption_deadline_s:.0f}s",
+        )
+        with trace_mod.root_span(
+            "preemption", journal=self.journal, node=self.node_name,
+            deadline_s=self.preemption_deadline_s,
+        ):
+            if self.evict_components:
+                try:
+                    evict.fast_drain_components(
+                        self.api,
+                        self.node_name,
+                        self.operator_namespace,
+                        deadline_s=self.preemption_deadline_s,
+                        poll_interval_s=min(
+                            self.eviction_poll_interval_s,
+                            evict.FAST_DRAIN_POLL_INTERVAL_S,
+                        ),
+                    )
+                except Exception as e:  # noqa: BLE001 - the handoff
+                    # publish below matters more than a clean drain; any
+                    # failure shape here must not consume its window.
+                    log.warning(
+                        "fast drain failed (%s); proceeding to the "
+                        "handoff publish", e,
+                    )
+            # Re-read AFTER the drain: the fast drain can run for most of
+            # the deadline, and a transition the watch loop started during
+            # it must still be handed off — while one that COMPLETED
+            # during the drain must NOT be (the pre-drain snapshot above
+            # is only for the log line; publishing it would make the
+            # replacement spuriously count a 'resumed' flip). Copy
+            # defensively — the reconcile thread keeps advancing the
+            # phase field while the publish serializes it.
+            live = self._inflight_transition
+            inflight = dict(live) if live is not None else None
+            outcome = "clean"
+            if inflight is not None:
+                outcome = self._publish_handoff(inflight)
+                if inflight.get("multi_host"):
+                    slicecoord.fence_departed_peer(
+                        self.api, self.node_name,
+                        str(inflight.get("slice_id") or ""),
+                        reason="preempted", metrics=self.metrics,
+                    )
+        self.metrics.set_fast_drain_seconds(time.monotonic() - started)
+        self.metrics.record_preemption(outcome)
+        log.warning(
+            "preemption handling finished in %.2fs (outcome=%s); awaiting "
+            "the platform kill", time.monotonic() - started, outcome,
+        )
+        return outcome
+
+    def _publish_handoff(self, inflight: dict) -> str:
+        """Journal + publish the interrupted transition for the
+        replacement node. The journal record is local crash truth (a
+        cancelled reclaim replays it as a no-op commit); the annotation
+        is what actually survives — the reclaim takes the disk."""
+        record = {
+            "mode": inflight.get("mode"),
+            "phase": inflight.get("phase"),
+            "chips": inflight.get("chips"),
+            "slice_id": inflight.get("slice_id"),
+            "from": self.node_name,
+            "ts": round(time.time(), 3),
+        }
+        txn = self._journal_begin(intent_mod.KIND_HANDOFF, **record)
+        try:
+            self.api.patch_node_annotations(
+                self.node_name,
+                {HANDOFF_ANNOTATION: json.dumps(record, sort_keys=True)},
+            )
+        except Exception as e:  # noqa: BLE001 - count + log; the kill is
+            # coming regardless and the caller still fences the slice.
+            self._journal_close(txn, ok=False, reason="publish-failed")
+            log.error("could not publish the handoff record: %s", e)
+            return "handoff-failed"
+        self._journal_close(txn, ok=True, published=True)
+        log.warning(
+            "handoff published: transition to %s (phase=%s) awaits the "
+            "replacement node", record["mode"], record["phase"],
+        )
+        return "handoff"
+
+    def consume_handoff(self) -> None:
+        """Startup (replacement-node) half of the handoff: read the
+        annotation a preempted predecessor left on this node, remember it
+        until the flip completes, and seed the journal's desired-mode
+        local truth so even a dark boot knows what it was converging on.
+        Best-effort — without the record the normal reconcile still
+        converges from the desired label; the handoff only adds intent
+        continuity (and the resumed/cleared bookkeeping)."""
+        try:
+            node = self.api.get_node(self.node_name)
+            self._note_api_ok()
+        except KubeApiError as e:
+            self._note_api_err(e)
+            log.debug("handoff check skipped (apiserver unreachable): %s", e)
+            return
+        from tpu_cc_manager.kubeclient.api import node_annotations
+
+        raw = node_annotations(node).get(HANDOFF_ANNOTATION)
+        if not raw:
+            return
+        try:
+            record = json.loads(raw)
+            mode = (
+                canonical_mode(str(record.get("mode") or ""))
+                if isinstance(record, dict)
+                else ""
+            )
+        except ValueError:
+            record, mode = None, ""
+        if not isinstance(record, dict) or mode not in VALID_MODES:
+            log.warning("garbled handoff annotation %r; clearing it", raw[:128])
+            self._clear_handoff_annotation()
+            return
+        self._handoff = record
+        log.warning(
+            "handoff record found: predecessor %s was preempted mid-flip "
+            "to %s (phase=%s); this node resumes the transition",
+            record.get("from"), mode, record.get("phase"),
+        )
+        if self.intents is not None:
+            try:
+                self.intents.note_desired(mode)
+            except intent_mod.JournalError as e:
+                log.warning("could not journal the handed-off mode: %s", e)
+
+    def _retire_handoff(self) -> None:
+        """After a successful reconcile with a consumed handoff pending:
+        the flip the predecessor started is now committed — clear the
+        annotation and count the resumption. A failed clear retries on
+        the next successful reconcile (the record is stale but harmless:
+        consume_handoff runs only at startup)."""
+        if self._handoff is None:
+            return
+        if not self._clear_handoff_annotation():
+            return
+        self.metrics.record_preemption("resumed")
+        self._emit_node_event(
+            "Normal", "CCHandoffResumed",
+            f"completed the flip to {self._handoff.get('mode')} handed "
+            f"off by preempted node agent {self._handoff.get('from')}",
+        )
+        self._handoff = None
+
+    def _clear_handoff_annotation(self) -> bool:
+        try:
+            self.api.patch_node_annotations(
+                self.node_name, {HANDOFF_ANNOTATION: None}
+            )
+            return True
+        except KubeApiError as e:
+            log.warning("could not clear the handoff annotation: %s", e)
+            return False
+
+    def _start_preemption_monitor(self) -> None:
+        """Poll the backend's preemption-notice source (GCE: metadata
+        ``instance/preempted``) on a daemon thread; the first notice runs
+        handle_preemption_notice and the thread retires (the signal is
+        level-triggered — one reclaim per VM lifetime)."""
+        if self.preemption_poll_s <= 0 or self.preemption_deadline_s <= 0:
+            return
+        if self._preemption_thread is not None:
+            return
+        stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(self.preemption_poll_s):
+                try:
+                    if self.backend.preemption_notice():
+                        self.handle_preemption_notice()
+                        return
+                except Exception as e:  # noqa: BLE001 - a flaky notice
+                    # source must never kill the monitor (or the agent).
+                    log.debug("preemption poll failed (non-fatal): %s", e)
+
+        self._preemption_stop = stop
+        self._preemption_thread = threading.Thread(
+            target=loop, name="preemption-monitor", daemon=True
+        )
+        self._preemption_thread.start()
+
+    def _stop_preemption_monitor(self) -> None:
+        if self._preemption_stop is not None:
+            self._preemption_stop.set()
+        if self._preemption_thread is not None:
+            self._preemption_thread.join(timeout=2.0)
+        self._preemption_stop = None
+        self._preemption_thread = None
+
+    # ------------------------------------------------------------------
     # Watch loop (reference call stack 3.4)
     # ------------------------------------------------------------------
 
@@ -1323,6 +1627,7 @@ class CCManager:
             # The slice-peer informer's watch thread must not outlive the
             # agent loop (tests and clean shutdowns alike).
             self._stop_peer_informer()
+            self._stop_preemption_monitor()
 
     def _watch_and_apply(self, stop: threading.Event | None = None) -> None:
         """Initial apply, then watch the node label forever.
@@ -1412,6 +1717,10 @@ class CCManager:
                 log.info("retrying failed reconcile")
                 apply_noted(last_label_value)
 
+        # The preemption monitor starts FIRST: a spot VM can be reclaimed
+        # while the agent is still booting, and the fast-drain + handoff
+        # window is too short to wait for the watch loop to settle.
+        self._start_preemption_monitor()
         # Boot ordering: journal replay and hardware-truth recovery run
         # BEFORE the first apiserver read, and that read is stale-guarded
         # and outage-tolerant (_startup_mode_read).
@@ -1420,6 +1729,10 @@ class CCManager:
         if first is None:
             return  # stopped while riding out an apiserver outage
         label, rv = first
+        # A handoff record a preempted predecessor left on this node: the
+        # first reconcile below completes (or supersedes) the handed-off
+        # flip and retires the record.
+        self.consume_handoff()
         note_result(self.set_cc_mode(self.with_default(label)))
         self.create_readiness_file()
         last_label_value = label

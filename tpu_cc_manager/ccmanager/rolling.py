@@ -94,6 +94,17 @@ class RolloutResult:
     # generation its writes carried.
     resumed: bool = False
     generation: int | None = None
+    # Autoscaler interplay: nodes whose Node object vanished mid-rollout
+    # (scale-down; retired, never charged) and nodes created mid-rollout
+    # that matched the selector and were adopted into a trailing wave.
+    retired_deleted: list[str] = dataclasses.field(default_factory=list)
+    adopted: list[str] = dataclasses.field(default_factory=list)
+    # Surge rollouts: the spare nodes flipped first behind the surge
+    # taint, and the highest concurrently-disrupted (non-surge) group
+    # count observed — the measured pool unavailability, which must stay
+    # <= max_unavailable per wave throughout a surge rollout.
+    surged: list[str] = dataclasses.field(default_factory=list)
+    max_unavailable_observed: int = 0
 
     @property
     def seconds(self) -> float:
@@ -119,6 +130,12 @@ class RolloutResult:
             "mean_seconds_per_node": round(
                 self.seconds / converged_nodes, 2
             ) if converged_nodes and self.ok else None,
+            "retired_deleted": self.retired_deleted or None,
+            "adopted": self.adopted or None,
+            "surged": self.surged or None,
+            "max_unavailable_observed": (
+                self.max_unavailable_observed or None
+            ),
             # Per-group revert outcome: a rollback that itself failed or
             # timed out must not read as "safely restored", and one that
             # could not be awaited (prior label absent → default mode
@@ -142,12 +159,23 @@ class RolloutResult:
 #: zones exist to provide.
 ZONE_LABEL = "topology.kubernetes.io/zone"
 
+#: NoSchedule taint carried by surge spares while they flip: the node is
+#: unschedulable-for-workloads for exactly the flip window, so the flip
+#: never subtracts from the pool's serving capacity. Removed ("reclaimed")
+#: the moment the spare converges, at which point it can absorb the
+#: workloads the regular waves drain off the rest of the pool.
+SURGE_TAINT_KEY = "cloud.google.com/tpu-cc.surge"
+SURGE_TAINT = {
+    "key": SURGE_TAINT_KEY, "value": "true", "effect": "NoSchedule",
+}
+
 #: Terminal await-state for a node whose Node OBJECT vanished mid-window
 #: (cluster-autoscaler scale-down, spot reclaim). The informer delivers
 #: the DELETED event (or the fallback GET answers 404), and the await
 #: loop resolves the slot immediately instead of charging the node the
 #: full window deadline as a timeout-in-progress. A deleted node is not
-#: a CC failure: it never counts against the group's ok verdict.
+#: a CC failure: it never counts against the group's ok verdict or the
+#: pool failure budget.
 STATE_NODE_DELETED = "deleted"
 
 
@@ -226,6 +254,8 @@ class RollingReconfigurator:
         metrics: metrics_mod.MetricsRegistry | None = None,
         informer=None,
         wave_shards: int = 1,
+        surge: int = 0,
+        adopt_new_nodes: bool = True,
     ) -> None:
         # Crash safety: with a lease, every write goes through the fence
         # (a lost lease refuses further patches) and progress is
@@ -307,6 +337,31 @@ class RollingReconfigurator:
             raise ValueError(
                 "rollback_on_failure is not supported with wave_shards > 1"
             )
+        # Surge rollouts: flip up to this many SPARE nodes first, behind
+        # the surge NoSchedule taint (unschedulable-for-workloads for the
+        # flip window), then reclaim them — so the regular rolling waves
+        # migrate workloads onto already-flipped capacity and measured
+        # pool unavailability stays bounded by max_unavailable.
+        self.surge = max(0, int(surge))
+        if self.surge > 0 and rollback_on_failure:
+            # A surge halt would have to revert tainted spares (and the
+            # halt path would silently skip the rollback otherwise) —
+            # refuse the combination, like wave_shards does.
+            raise ValueError(
+                "rollback_on_failure is not supported with surge > 0"
+            )
+        # Autoscaler interplay: nodes created mid-rollout that match the
+        # selector are adopted into a trailing wave (and stamped with the
+        # rollout generation) instead of being silently left at the old
+        # mode. Disable for byte-identical legacy behavior.
+        self.adopt_new_nodes = adopt_new_nodes
+        # Measured unavailability: how many non-surge groups are
+        # concurrently mid-flip, across every wave thread. The max is the
+        # rollout's observed disruption ceiling (RolloutResult
+        # .max_unavailable_observed).
+        self._inflight_lock = threading.Lock()
+        self._inflight_groups = 0
+        self._max_inflight_observed = 0
         # Serializes record mutation + checkpoint serialization across
         # wave threads (the lease's own write lock only covers the CAS).
         self._record_lock = threading.RLock()
@@ -456,6 +511,7 @@ class RollingReconfigurator:
             record.max_unavailable = self.max_unavailable
             record.failure_budget = self.failure_budget
             record.wave_shards = self.wave_shards
+            record.surge = self.surge
         elif self.lease is not None:
             record = rollout_state.RolloutRecord(
                 mode=mode, selector=self.selector,
@@ -463,6 +519,7 @@ class RollingReconfigurator:
                 max_unavailable=self.max_unavailable,
                 failure_budget=self.failure_budget,
                 wave_shards=self.wave_shards,
+                surge=self.surge,
             )
         if record is not None:
             record.charge_budget(quarantined)
@@ -480,6 +537,12 @@ class RollingReconfigurator:
                 halted_reason="failure-budget-exceeded",
                 resumed=resumed, generation=self.generation,
             )
+        # Every node present at plan time: the adoption scan at the end
+        # treats anything beyond this set (and not quarantined) as an
+        # autoscaler scale-up to fold into a trailing wave.
+        known_nodes = {n["metadata"]["name"] for n in listing} | set(
+            quarantined
+        )
         if resumed:
             groups = []
             for gid, names in record.groups:
@@ -561,15 +624,66 @@ class RollingReconfigurator:
         # resumable record.
         self._checkpoint(record)
         self._crash_point("planned")
+        surged: list[str] = []
+        surge_ok = True
+        if self.surge > 0 and resumed:
+            # A resume NEVER re-surges: the original spares are either
+            # done (skipped above) or back in the plan as ordinary
+            # groups, and greedily re-picking "spares" from what are now
+            # serving nodes would flip up to `surge` of them concurrently
+            # behind a NoSchedule taint that evicts nothing — silently
+            # exceeding the max_unavailable guarantee. Surviving groups
+            # roll at max_unavailable; stale surge taints a mid-surge
+            # crash left behind are reclaimed here.
+            stale = [
+                node["metadata"]["name"]
+                for node in listing
+                if any(
+                    t.get("key") == SURGE_TAINT_KEY
+                    for t in (node.get("spec") or {}).get("taints") or []
+                )
+            ]
+            if stale:
+                log.warning(
+                    "resume: reclaiming stale surge taint(s) from %s "
+                    "(the interrupted surge phase is not re-run)", stale,
+                )
+                self._taint_surge(tuple(stale), add=False)
+        elif self.surge > 0 and groups:
+            surge_ok, groups, surged = self._surge_first(
+                mode, groups, record, results, window_seconds
+            )
+            if not surge_ok and not self.continue_on_failure:
+                log.error(
+                    "surge group(s) failed; halting before the rolling "
+                    "waves (%d group(s) not attempted)", len(groups),
+                )
+                self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+                return RolloutResult(
+                    mode=mode, ok=False, groups=results,
+                    window_seconds=window_seconds,
+                    skipped_quarantined=quarantined,
+                    resumed=resumed, generation=self.generation,
+                    retired_deleted=self._deleted_of(results),
+                    surged=surged,
+                    max_unavailable_observed=self._max_inflight_observed,
+                )
         if self.wave_shards > 1 and len(groups) > 1:
             return self._rollout_waves(
                 mode, groups, labels_by_name, record, results,
-                window_seconds, quarantined, resumed,
+                window_seconds, quarantined, resumed, surged, known_nodes,
+                surge_ok,
             )
-        ok = True
+        # A failed spare under continue_on_failure presses on but must
+        # still fail the rollout's verdict — a node sits failed (and
+        # tainted) behind it.
+        ok = surge_ok
         # Strictly bounded concurrency: process in windows of max_unavailable.
         for i in range(0, len(groups), self.max_unavailable):
-            if i and self.failure_budget is not None:
+            # Also re-checked at i=0 when a surge phase ran: its failures
+            # are already charged, and a blown budget must not buy one
+            # more window of real disruption.
+            if (i or surged) and self.failure_budget is not None:
                 # Re-check the budget at every window boundary: remediation
                 # ladders run concurrently with the rollout, and a pool
                 # that started bleeding nodes mid-rollout must stop being
@@ -592,10 +706,14 @@ class RollingReconfigurator:
                         skipped_quarantined=sorted(set(quarantined) | set(fresh)),
                         halted_reason="failure-budget-exceeded",
                         resumed=resumed, generation=self.generation,
+                        retired_deleted=self._deleted_of(results),
+                        surged=surged,
+                        max_unavailable_observed=self._max_inflight_observed,
                     )
             window = groups[i : i + self.max_unavailable]
             self._crash_point("window-start")
             started = time.monotonic()
+            self._note_window_inflight(len(window))
             for gid, names in window:
                 self._set_desired(names, mode)
             self._crash_point("mid-window")
@@ -610,12 +728,18 @@ class RollingReconfigurator:
                 if record is not None:
                     record.note_group(gid, gres.ok, gres.states, gres.seconds)
                     if not gres.ok:
+                        # Deleted nodes are retired, not charged: the
+                        # autoscaler reclaiming a VM is not a CC failure,
+                        # and spending budget on it would let routine
+                        # scale-downs halt a healthy rollout.
                         record.charge_budget(
-                            n for n, s in gres.states.items() if s != mode
+                            n for n, s in gres.states.items()
+                            if s not in (mode, STATE_NODE_DELETED)
                         )
                 if not gres.ok:
                     ok = False
                     window_failed.append(gid)
+            self._note_window_inflight(-len(window))
             window_seconds.append(time.monotonic() - started)
             self._crash_point("awaited")
             self._checkpoint(record)
@@ -652,7 +776,21 @@ class RollingReconfigurator:
                     window_seconds=window_seconds, rolled_back=rolled_back,
                     skipped_quarantined=quarantined,
                     resumed=resumed, generation=self.generation,
+                    retired_deleted=self._deleted_of(results),
+                    surged=surged,
+                    max_unavailable_observed=self._max_inflight_observed,
                 )
+        adopted: list[str] = []
+        adopt_halted = None
+        if (
+            self.adopt_new_nodes
+            and not self.rollback_on_failure
+            and (ok or self.continue_on_failure)
+        ):
+            adopted, adopt_ok, adopt_halted = self._adopt_new_nodes(
+                mode, record, results, window_seconds, known_nodes
+            )
+            ok = ok and adopt_ok
         self._checkpoint(
             record,
             status=(
@@ -663,8 +801,228 @@ class RollingReconfigurator:
         return RolloutResult(
             mode=mode, ok=ok, groups=results, window_seconds=window_seconds,
             skipped_quarantined=quarantined,
+            halted_reason=adopt_halted,
             resumed=resumed, generation=self.generation,
+            retired_deleted=self._deleted_of(results),
+            adopted=adopted, surged=surged,
+            max_unavailable_observed=self._max_inflight_observed,
         )
+
+    # -- surge rollouts ---------------------------------------------------
+
+    def _surge_first(
+        self,
+        mode: str,
+        groups: list[tuple[str, tuple[str, ...]]],
+        record,
+        results: list[GroupResult],
+        window_seconds: list[float],
+    ) -> tuple[bool, list[tuple[str, tuple[str, ...]]], list[str]]:
+        """Flip up to ``self.surge`` spare nodes FIRST, behind the surge
+        NoSchedule taint: the spares are unschedulable-for-workloads for
+        exactly their flip window, so their disruption never subtracts
+        from the pool's serving capacity, and once reclaimed (taint
+        removed) they absorb the workloads the regular rolling waves
+        drain off the rest of the pool.
+
+        Groups are picked greedily in plan order while they fit the
+        remaining surge budget (a multi-host slice flips as one unit and
+        is skipped rather than split). All picked spares flip
+        concurrently — the taint, not ``max_unavailable``, bounds them —
+        and deliberately do NOT count toward the measured pool
+        unavailability (:meth:`_note_window_inflight`). Returns
+        (every surge group converged, the remaining plan, surged node
+        names)."""
+        spares: list[tuple[str, tuple[str, ...]]] = []
+        rest: list[tuple[str, tuple[str, ...]]] = []
+        budget = self.surge
+        for gid, names in groups:
+            if 0 < len(names) <= budget:
+                spares.append((gid, names))
+                budget -= len(names)
+            else:
+                rest.append((gid, names))
+        if not spares:
+            log.warning(
+                "surge=%d requested but no group fits the spare budget "
+                "(smallest group is larger); rolling normally", self.surge,
+            )
+            return True, list(groups), []
+        surged = sorted(n for _, names in spares for n in names)
+        log.info(
+            "surge: flipping %d spare node(s) in %d group(s) first, "
+            "behind the %s taint", len(surged), len(spares), SURGE_TAINT_KEY,
+        )
+        self._crash_point("window-start")
+        started = time.monotonic()
+        for _, names in spares:
+            self._taint_surge(names, add=True)
+            self._set_desired(names, mode)
+        self._crash_point("mid-window")
+        ok = True
+        for gid, names in spares:
+            gres = self._await_group(gid, names, mode, started)
+            results.append(gres)
+            with self._record_lock:
+                if record is not None:
+                    record.note_group(gid, gres.ok, gres.states, gres.seconds)
+                    if not gres.ok:
+                        record.charge_budget(
+                            n for n, s in gres.states.items()
+                            if s not in (mode, STATE_NODE_DELETED)
+                        )
+            if gres.ok:
+                # Reclaim: the converged spare rejoins the schedulable
+                # pool immediately — capacity the regular waves migrate
+                # workloads onto. A failed spare KEEPS its taint (a node
+                # that could not flip must not receive workloads; the
+                # operator untaints after diagnosing).
+                self._taint_surge(names, add=False)
+            else:
+                ok = False
+        window_seconds.append(time.monotonic() - started)
+        self._crash_point("awaited")
+        self._checkpoint(record)
+        self._crash_point("window-boundary")
+        return ok, rest, surged
+
+    def _taint_surge(self, names: tuple[str, ...], add: bool) -> None:
+        """Apply/remove the surge NoSchedule taint. Retried like every
+        other rollout write; a node whose object vanished (scale-down
+        racing the surge) is skipped — the await retires it."""
+        for name in names:
+            try:
+                self.retry_policy.call(
+                    lambda name=name: (
+                        self.api.patch_node_taints(
+                            name, [dict(SURGE_TAINT)], []
+                        )
+                        if add
+                        else self.api.patch_node_taints(
+                            name, [], [SURGE_TAINT_KEY]
+                        )
+                    ),
+                    op="rollout.surge_taint",
+                    classify=classify_kube_error,
+                )
+            except KubeApiError as e:
+                if e.status != 404:
+                    raise
+                log.warning(
+                    "node %s vanished before its surge taint %s "
+                    "(autoscaler scale-down); skipping",
+                    name, "write" if add else "removal",
+                )
+
+    # -- autoscaler scale-up adoption -------------------------------------
+
+    def _adopt_new_nodes(
+        self,
+        mode: str,
+        record,
+        results: list[GroupResult],
+        window_seconds: list[float],
+        known: set[str],
+    ) -> tuple[list[str], bool, str | None]:
+        """Nodes created mid-rollout (autoscaler scale-up) that match the
+        selector: adopt them into trailing windows — desired mode plus
+        the rollout generation label — instead of silently leaving them
+        at whatever mode their image booted with. Scans repeat until one
+        finds nothing new, so a node created DURING the trailing window
+        is adopted by the next scan. Returns (adopted node names, every
+        adopted group converged, halted reason or None)."""
+        adopted: list[str] = []
+        ok = True
+        while True:
+            listing = self._list_pool()
+            quarantined = set(self._quarantined_of(listing))
+            # Same boundary re-check as the other window loops: a pool
+            # that started bleeding nodes during the trailing adoption
+            # phase must stop being reconfigured — the fleet-level
+            # circuit breaker applies to adopted windows too.
+            if self.failure_budget is not None:
+                with self._record_lock:
+                    if record is not None:
+                        record.charge_budget(quarantined)
+                    spend = self._spend(record, quarantined)
+                if self._budget_exceeded(spend):
+                    self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+                    return sorted(adopted), False, "failure-budget-exceeded"
+            fresh = [
+                n for n in listing
+                if n["metadata"]["name"] not in known
+                and n["metadata"]["name"] not in quarantined
+            ]
+            known.update(quarantined)
+            if not fresh:
+                return sorted(adopted), ok, None
+            groups = plan_groups(self.api, self.selector, nodes=fresh)
+            names_flat = [n for _, ns in groups for n in ns]
+            known.update(names_flat)
+            log.warning(
+                "adopting %d node(s) created mid-rollout (autoscaler "
+                "scale-up) into a trailing wave: %s",
+                len(names_flat), names_flat,
+            )
+            self.metrics.record_node_adoption(len(names_flat))
+            with self._record_lock:
+                if record is not None:
+                    record.groups = list(record.groups) + list(groups)
+            for i in range(0, len(groups), self.max_unavailable):
+                if i and self.failure_budget is not None:
+                    # Same boundary re-check as the other window loops:
+                    # a multi-window adoption scan must not keep
+                    # flipping windows after the budget blows mid-scan.
+                    fresh = self._quarantined_of(self._list_pool())
+                    with self._record_lock:
+                        if record is not None:
+                            record.charge_budget(fresh)
+                        spend = self._spend(record, fresh)
+                    if self._budget_exceeded(spend):
+                        self._checkpoint(
+                            record, status=rollout_state.RECORD_HALTED
+                        )
+                        return (
+                            sorted(adopted), False,
+                            "failure-budget-exceeded",
+                        )
+                window = groups[i : i + self.max_unavailable]
+                self._crash_point("window-start")
+                started = time.monotonic()
+                self._note_window_inflight(len(window))
+                for gid, names in window:
+                    self._set_desired(names, mode)
+                self._crash_point("mid-window")
+                window_failed = []
+                for gid, names in window:
+                    gres = self._await_group(gid, names, mode, started)
+                    results.append(gres)
+                    with self._record_lock:
+                        if record is not None:
+                            record.note_group(
+                                gid, gres.ok, gres.states, gres.seconds
+                            )
+                            if not gres.ok:
+                                record.charge_budget(
+                                    n for n, s in gres.states.items()
+                                    if s not in (mode, STATE_NODE_DELETED)
+                                )
+                    adopted.extend(gres.nodes)
+                    if not gres.ok:
+                        window_failed.append(gid)
+                self._note_window_inflight(-len(window))
+                window_seconds.append(time.monotonic() - started)
+                self._crash_point("awaited")
+                self._checkpoint(record)
+                self._crash_point("window-boundary")
+                if window_failed:
+                    ok = False
+                    if not self.continue_on_failure:
+                        log.error(
+                            "adopted group(s) %s failed; stopping the "
+                            "trailing adoption wave", window_failed,
+                        )
+                        return sorted(adopted), ok, None
 
     # -- sharded rollout waves --------------------------------------------
 
@@ -678,6 +1036,9 @@ class RollingReconfigurator:
         window_seconds: list[float],
         quarantined: list[str],
         resumed: bool,
+        surged: list[str],
+        known_nodes: set[str],
+        surge_ok: bool = True,
     ) -> RolloutResult:
         """Drive the plan as up to ``wave_shards`` concurrent sub-rollouts
         (zone-partitioned, each strictly rolling at ``max_unavailable``),
@@ -696,7 +1057,12 @@ class RollingReconfigurator:
             "halt": threading.Event(),
             "results": results,
             "window_seconds": window_seconds,
-            "ok": True,
+            # Seeded with the surge verdict: a failed spare under
+            # continue_on_failure presses on but must fail the rollout.
+            "ok": surge_ok,
+            # A surge phase already charged the budget: every wave
+            # re-checks before its FIRST window too.
+            "surge_ran": bool(surged),
             "halted_reason": None,
             "initial_quarantined": list(quarantined),
             "fresh_quarantined": set(),
@@ -721,6 +1087,14 @@ class RollingReconfigurator:
             # semantics match the single-shard orchestrator exactly.
             raise shared["error"]
         ok = shared["ok"] and not shared["halt"].is_set()
+        adopted: list[str] = []
+        if self.adopt_new_nodes and (ok or self.continue_on_failure):
+            adopted, adopt_ok, adopt_halted = self._adopt_new_nodes(
+                mode, record, results, window_seconds, known_nodes
+            )
+            ok = ok and adopt_ok
+            if adopt_halted and shared["halted_reason"] is None:
+                shared["halted_reason"] = adopt_halted
         self._checkpoint(
             record,
             status=(
@@ -736,6 +1110,9 @@ class RollingReconfigurator:
             ),
             halted_reason=shared["halted_reason"],
             resumed=resumed, generation=self.generation,
+            retired_deleted=self._deleted_of(results),
+            adopted=adopted, surged=surged,
+            max_unavailable_observed=self._max_inflight_observed,
         )
 
     def _drive_wave_guarded(self, wid, wave, mode, record, shared) -> None:
@@ -751,7 +1128,10 @@ class RollingReconfigurator:
         for i in range(0, len(wave), self.max_unavailable):
             if shared["halt"].is_set():
                 return
-            if i and self.failure_budget is not None:
+            if (
+                (i or shared.get("surge_ran"))
+                and self.failure_budget is not None
+            ):
                 # Same boundary re-check as the single-shard loop; with an
                 # informer this is a cache read, so N waves re-checking
                 # costs the apiserver nothing.
@@ -775,6 +1155,7 @@ class RollingReconfigurator:
             window = wave[i : i + self.max_unavailable]
             self._crash_point("window-start")
             started = time.monotonic()
+            self._note_window_inflight(len(window))
             for gid, names in window:
                 self._set_desired(names, mode)
             self._crash_point("mid-window")
@@ -789,12 +1170,15 @@ class RollingReconfigurator:
                             gid, gres.ok, gres.states, gres.seconds
                         )
                         if not gres.ok:
+                            # Same retire-don't-charge rule as the
+                            # single-shard loop: scale-down ≠ CC failure.
                             record.charge_budget(
                                 n for n, s in gres.states.items()
-                                if s != mode
+                                if s not in (mode, STATE_NODE_DELETED)
                             )
                 if not gres.ok:
                     window_failed.append(gid)
+            self._note_window_inflight(-len(window))
             with shared["lock"]:
                 shared["window_seconds"].append(time.monotonic() - started)
             self._crash_point("awaited")
@@ -884,6 +1268,24 @@ class RollingReconfigurator:
                     "(autoscaler scale-down); it will be retired from "
                     "the window", name,
                 )
+
+    def _note_window_inflight(self, delta: int) -> None:
+        """Track concurrently mid-flip (non-surge) groups across every
+        wave thread; the max is the rollout's measured disruption."""
+        with self._inflight_lock:
+            self._inflight_groups += delta
+            self._max_inflight_observed = max(
+                self._max_inflight_observed, self._inflight_groups
+            )
+
+    @staticmethod
+    def _deleted_of(results: list[GroupResult]) -> list[str]:
+        return sorted({
+            n
+            for g in results
+            for n, s in g.states.items()
+            if s == STATE_NODE_DELETED
+        })
 
     def _pending_states(self, names: list[str]) -> dict[str, str | None]:
         """Current state-label values for ``names``: from the informer

@@ -74,12 +74,19 @@ RECORD_HALTED = "halted"
 
 #: Checkpoint format version this orchestrator writes. History:
 #: 1 (implicit, PR 4): single-shard records with no version field.
-#: 2: adds ``version`` and ``wave_shards`` (sharded rollout waves). The
-#: parser accepts every version <= the current one — v1 records resume
-#: under the sharded orchestrator unchanged (the wave partition is
-#: derived from the plan, never persisted) — and refuses newer versions
-#: loudly rather than silently dropping fields a successor relied on.
-RECORD_VERSION = 2
+#: 2: adds ``version`` and ``wave_shards`` (sharded rollout waves).
+#: 3: adds ``surge`` (surge rollouts) — written ONLY when surge > 0, so
+#: non-surge records stay v2 and older orchestrators keep resuming them;
+#: a surge record resumed by a surge-unaware binary would silently strand
+#: the spares' NoSchedule taints, which is exactly the silent field drop
+#: the version refusal exists to prevent. The parser accepts every
+#: version <= the current one — v1 records resume under the sharded
+#: orchestrator unchanged (the wave partition is derived from the plan,
+#: never persisted) — and refuses newer versions loudly rather than
+#: silently dropping fields a successor relied on.
+RECORD_VERSION = 3
+#: What a record WITHOUT the v3 field writes (compatibility floor).
+RECORD_VERSION_NO_SURGE = 2
 
 
 def lease_namespace() -> str:
@@ -126,6 +133,13 @@ class RolloutRecord:
     # sub-rollouts the recording orchestrator ran; a plain resume inherits
     # it like max_unavailable/failure_budget.
     wave_shards: int = 1
+    # Surge rollouts (format v3, written only when non-zero): how many
+    # spare nodes the recording orchestrator flipped first behind the
+    # surge taint. Carried for visibility and for the resume's stale-
+    # taint reclaim — a resume never re-runs the surge phase itself
+    # (rolling.py: re-picking "spares" from serving nodes would exceed
+    # max_unavailable behind a taint that evicts nothing).
+    surge: int = 0
 
     def charge_budget(self, nodes) -> None:
         self.budget_spend = sorted(set(self.budget_spend) | set(nodes))
@@ -144,7 +158,9 @@ class RolloutRecord:
     def to_json(self) -> str:
         return json.dumps(
             {
-                "version": RECORD_VERSION,
+                "version": (
+                    RECORD_VERSION if self.surge else RECORD_VERSION_NO_SURGE
+                ),
                 "mode": self.mode,
                 "selector": self.selector,
                 "generation": self.generation,
@@ -155,6 +171,7 @@ class RolloutRecord:
                 "failure_budget": self.failure_budget,
                 "status": self.status,
                 "wave_shards": self.wave_shards,
+                "surge": self.surge,
             },
             sort_keys=True, separators=(",", ":"),
         )
@@ -190,6 +207,7 @@ class RolloutRecord:
                 ),
                 status=str(obj.get("status") or RECORD_IN_PROGRESS),
                 wave_shards=int(obj.get("wave_shards") or 1),
+                surge=int(obj.get("surge") or 0),
             )
         except RolloutFenced:
             raise

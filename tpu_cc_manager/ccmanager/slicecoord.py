@@ -163,6 +163,33 @@ def fence_slice(
     return generation
 
 
+def fence_departed_peer(
+    api: KubeApi,
+    node_name: str,
+    slice_id: str,
+    reason: str = "preempted",
+    metrics: "metrics_mod.MetricsRegistry | None" = None,
+) -> int | None:
+    """Fence the slice on behalf of a host that is about to DEPART
+    (platform preemption, autoscaler reclaim): its peers mid-barrier must
+    abort fast with BarrierFenced instead of burning the barrier deadline
+    waiting for a staged marker whose owner is being reclaimed. Unlike
+    :func:`fence_slice`, failures are swallowed — the departing host is
+    racing a hard kill deadline and a fencing hiccup must not consume the
+    seconds the handoff publish still needs (peers then merely degrade to
+    the old timeout behavior). Returns the new generation, or None."""
+    try:
+        return fence_slice(
+            api, node_name, slice_id, reason=reason, metrics=metrics
+        )
+    except KubeApiError as e:
+        log.warning(
+            "could not fence slice %s for departing host %s (%s); peers "
+            "fall back to the barrier timeout", slice_id, node_name, e,
+        )
+        return None
+
+
 class SliceBarrier:
     """One host's participation in one slice-wide commit round."""
 

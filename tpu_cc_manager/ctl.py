@@ -82,6 +82,23 @@ def build_parser() -> argparse.ArgumentParser:
         "rolling single queue; a resume inherits the record's value)",
     )
     r.add_argument(
+        "--surge", type=int, default=None,
+        help="surge rollout: flip up to N spare nodes FIRST behind the "
+        "cloud.google.com/tpu-cc.surge NoSchedule taint "
+        "(unschedulable-for-workloads for exactly their flip window), "
+        "then reclaim them — the rolling waves migrate workloads onto "
+        "already-flipped capacity, so measured pool unavailability stays "
+        "<= max-unavailable throughout (default 0: no surge; a resume "
+        "inherits the record's value)",
+    )
+    r.add_argument(
+        "--no-adopt", action="store_true",
+        help="do NOT adopt nodes created mid-rollout (autoscaler "
+        "scale-up) into a trailing wave; by default new selector-matching "
+        "nodes receive the desired mode + generation label before the "
+        "rollout reports done",
+    )
+    r.add_argument(
         "--no-informer", action="store_true",
         help="poll with full pool listings instead of the watch-driven "
         "informer cache (the pre-informer O(pool) behavior; the cache "
@@ -413,6 +430,7 @@ def cmd_rollout(api, args) -> int:
     # explicit `--max-unavailable 1`.
     max_unavailable = getattr(args, "max_unavailable", None)
     wave_shards = getattr(args, "wave_shards", None)
+    surge = getattr(args, "surge", None)
     if resume_record is not None:
         mode = resume_record.mode
         # The record also carries the dead orchestrator's settings: a
@@ -426,10 +444,14 @@ def cmd_rollout(api, args) -> int:
             max_unavailable = resume_record.max_unavailable
         if wave_shards is None:
             wave_shards = resume_record.wave_shards
+        if surge is None:
+            surge = resume_record.surge
     if max_unavailable is None:
         max_unavailable = 1
     if wave_shards is None:
         wave_shards = 1
+    if surge is None:
+        surge = 0
     if mode is None:
         if lease is not None:
             lease.release()
@@ -472,6 +494,8 @@ def cmd_rollout(api, args) -> int:
             resume_record=resume_record,
             informer=informer,
             wave_shards=wave_shards,
+            surge=surge,
+            adopt_new_nodes=not getattr(args, "no_adopt", False),
         )
         result = roller.rollout(mode)
     except rollout_state.RolloutFenced as e:

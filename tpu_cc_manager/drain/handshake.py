@@ -48,6 +48,12 @@ log = logging.getLogger(__name__)
 
 DRAIN_REQUESTED_LABEL = "cloud.google.com/tpu-cc.drain"
 DRAIN_REQUESTED = "requested"  # value prefix: "requested-<cycle token>"
+# Optional deadline hint published WITH a drain request (whole seconds):
+# a preemption fast-drain carries its hard termination deadline here so a
+# subscriber's checkpoint callback can choose a partial/incremental
+# checkpoint that actually fits the window instead of starting a full one
+# the kill will truncate. Absent on a normal (300 s budget) drain.
+DRAIN_DEADLINE_LABEL = "cloud.google.com/tpu-cc.drain.deadline-s"
 SUBSCRIBER_PREFIX = "drain-subscriber.tpu-cc.gke.io/"
 ACTIVE = "active"
 ACKED = "acked"  # value prefix: "acked-<cycle token>"
@@ -118,9 +124,15 @@ def subscriber_labels_of(labels: dict[str, str]) -> dict[str, str]:
 # ---------------------------------------------------------------------------
 
 
-def request_drain(api: KubeApi, node_name: str) -> DrainCycle:
+def request_drain(
+    api: KubeApi, node_name: str, deadline_s: float | None = None
+) -> DrainCycle:
     """Publish the drain request (with a fresh cycle token) and reset every
     known subscriber to ``active``, in one merge-patch.
+
+    ``deadline_s`` (preemption fast-drain) additionally publishes the
+    hard termination deadline as a whole-seconds label hint for
+    subscribers; a normal drain clears any stale hint in the same patch.
 
     Returns the cycle token plus the subscriber keys that must ack it. The
     subscriber set is re-read AFTER the patch (the server's view), so a job
@@ -130,7 +142,12 @@ def request_drain(api: KubeApi, node_name: str) -> DrainCycle:
     """
     token = new_cycle_token()
     subscribers = subscriber_labels_of(node_labels(api.get_node(node_name)))
-    patch: dict[str, str] = {DRAIN_REQUESTED_LABEL: request_value(token)}
+    patch: dict[str, str | None] = {
+        DRAIN_REQUESTED_LABEL: request_value(token),
+        DRAIN_DEADLINE_LABEL: (
+            str(max(1, int(round(deadline_s)))) if deadline_s else None
+        ),
+    }
     patch.update({k: ACTIVE for k in subscribers})
     api.patch_node_labels(node_name, patch)
     try:
@@ -199,7 +216,10 @@ def await_workload_acks(
 def clear_drain_request(api: KubeApi, node_name: str) -> None:
     """Withdraw the drain request (after re-admission). Best-effort."""
     try:
-        api.patch_node_labels(node_name, {DRAIN_REQUESTED_LABEL: None})
+        api.patch_node_labels(node_name, {
+            DRAIN_REQUESTED_LABEL: None,
+            DRAIN_DEADLINE_LABEL: None,
+        })
     except KubeApiError as e:
         log.warning("could not clear drain request on %s: %s", node_name, e)
 
@@ -251,6 +271,10 @@ class DrainSubscriber:
         self._thread: threading.Thread | None = None
         self._acked_token: str | None = None
         self._drain_requested = False
+        # The deadline hint of the current drain cycle (None on a normal
+        # drain): read before on_drain fires so a checkpoint callback can
+        # size itself to a preemption fast-drain's hard window.
+        self.drain_deadline_s: float | None = None
 
     def register(self) -> None:
         self.api.patch_node_labels(self.node_name, {self.label: ACTIVE})
@@ -273,6 +297,14 @@ class DrainSubscriber:
         labels = node_labels(self.api.get_node(self.node_name))
         token = request_token(labels.get(DRAIN_REQUESTED_LABEL))
         self._drain_requested = token is not None
+        try:
+            self.drain_deadline_s = (
+                float(labels[DRAIN_DEADLINE_LABEL])
+                if token is not None and DRAIN_DEADLINE_LABEL in labels
+                else None
+            )
+        except (TypeError, ValueError):
+            self.drain_deadline_s = None
         if token is None:
             if self._acked_token is not None:
                 # Clear the cycle only AFTER on_resume succeeds: a failing

@@ -91,6 +91,14 @@ class FaultyKubeClient(KubeApi):
         self._maybe_fault("patch_node_taints")
         return self.inner.patch_node_taints(name, add, remove_keys)
 
+    def delete_node(self, name: str) -> None:
+        """Harness passthrough (FakeKube.delete_node): chaos scenarios
+        modeling a cluster-autoscaler scale-down delete through the same
+        faulted surface the rest of the scenario rides, so a deletion can
+        itself be throttled/5xx'd like a real autoscaler's would be."""
+        self._maybe_fault("delete_node")
+        return self.inner.delete_node(name)
+
     def list_nodes(self, label_selector: str | None = None) -> list[dict]:
         self._maybe_fault("list_nodes")
         return self.inner.list_nodes(label_selector)

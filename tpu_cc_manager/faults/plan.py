@@ -96,6 +96,14 @@ class FaultPlan:
     blackout_min_calls: int = 5
     blackout_max_calls: int = 20
     max_blackouts: int | None = None
+    # Preemption-notice mode (spot/preemptible churn): probability that
+    # schedule_preemption arms a platform preemption notice on the fake
+    # backend, and the hard termination deadline the scenario models —
+    # deliberately FAR below the 300 s drain budget (GCE gives ~30 s),
+    # which is the whole point: the normal drain cannot finish, the
+    # fast-drain path (drain/evict.py) must.
+    preemption_rate: float = 0.0
+    preemption_deadline_s: float = 30.0
     rng: random.Random = field(init=False, repr=False)
     injected: list[Fault] = field(init=False, repr=False)
     _seq: int = field(init=False, repr=False)
@@ -265,6 +273,35 @@ class FaultPlan:
         self.injected.append(Fault(kind="backend", op=op, seq=self._seq))
         backend.fail_next(op)
         return op
+
+    def schedule_preemption(self, backend) -> bool:
+        """Optionally arm a platform preemption notice on a fake device
+        backend (tpudev/fake.py ``set_preempted``), drawn from the seeded
+        main stream like every other decision — same seed, same VMs get
+        reclaimed at the same points. The armed notice carries the plan's
+        ``preemption_deadline_s`` semantics: the scenario's agent has that
+        long to fast-drain, checkpoint and publish its handoff before the
+        modeled kill. Always advances the rng (an armed schedule must not
+        reshuffle other modes' decisions). Returns whether armed."""
+        self._seq += 1
+        roll = self.rng.random()
+        if roll >= self.preemption_rate or self.exhausted:
+            return False
+        self.injected.append(
+            Fault(kind="preemption", op="preemption-notice", seq=self._seq)
+        )
+        backend.set_preempted(True)
+        return True
+
+    def seed_preemption(self, backend) -> None:
+        """Arm one preemption notice unconditionally (acceptance tests and
+        drills that need the scenario, not the odds). Recorded in the
+        injected schedule like a drawn one; does not consume rng state."""
+        self._seq += 1
+        self.injected.append(
+            Fault(kind="preemption", op="preemption-notice", seq=self._seq)
+        )
+        backend.set_preempted(True)
 
     def seed_terminal_backend_fault(self, backend, ops: tuple[str, ...]) -> str:
         """Arm one TERMINAL device fault (``times=-1``: never clears) on an

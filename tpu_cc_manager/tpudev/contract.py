@@ -190,3 +190,14 @@ class TpuCcBackend(abc.ABC):
         runtime-restart commit path and leaves the committed mode
         untouched. May raise TpuError."""
         self.reset(self.discover().chips)
+
+    def preemption_notice(self) -> bool:
+        """Whether the platform has signaled IMMINENT preemption of this
+        VM (spot/preemptible reclaim). On GCE the signal is the metadata
+        server's ``instance/preempted`` flag, delivered with a hard
+        termination deadline far shorter than the normal 300 s drain
+        budget — the manager's preemption monitor polls this and runs the
+        fast-drain + handoff path (drain/evict.py fast_drain_components,
+        ccmanager/manager.py) instead of the full drain. Default: never
+        preempted (on-demand hosts, test backends)."""
+        return False

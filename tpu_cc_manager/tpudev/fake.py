@@ -100,6 +100,10 @@ class FakeTpuBackend(TpuCcBackend):
         # mimics whichever probe tier the scenario wants reported.
         self.healthy = True
         self.health_tier = "probe-cmd"
+        # Preemption-notice control (spot/preemptible chaos): set by tests
+        # or FaultPlan.schedule_preemption; the manager's preemption
+        # monitor reads it through the contract's preemption_notice().
+        self.preempted = False
 
     # ---- fault injection helpers ----------------------------------------
 
@@ -194,6 +198,18 @@ class FakeTpuBackend(TpuCcBackend):
             self.op_log.append(
                 ("restart_runtime", tuple(c.index for c in self._chips))
             )
+
+    def set_preempted(self, preempted: bool = True) -> None:
+        """Arm (or clear) the platform preemption notice — the injectable
+        fake counterpart of the GCE metadata server's ``instance/
+        preempted`` flag flipping to TRUE."""
+        with self._lock:
+            self.preempted = preempted
+
+    def preemption_notice(self) -> bool:
+        self._maybe_fail("preemption_notice")
+        with self._lock:
+            return self.preempted
 
     def probe_runtime_health(self) -> HealthProbe:
         self._maybe_fail("probe")

@@ -687,6 +687,17 @@ class TpuVmBackend(TpuCcBackend):
             "probe command)",
         )
 
+    def preemption_notice(self) -> bool:
+        """GCE preemption signal: the metadata server flips
+        ``instance/preempted`` to TRUE when the VM has been scheduled for
+        reclaim (spot/preemptible), leaving a hard termination deadline
+        (~30 s) far below the normal 300 s drain budget. An unreachable
+        metadata server reads as NOT preempted — the notice is an
+        optimization of a death we cannot veto, so a flaky metadata path
+        must never trigger a spurious fast-drain."""
+        value = self._metadata("instance/preempted", default="FALSE")
+        return (value or "").strip().upper() == "TRUE"
+
     def fetch_attestation(self, nonce: str) -> AttestationQuote:
         committed = self._read_state("committed.json")
         modes = sorted(set(committed.values())) or [MODE_OFF]

@@ -204,6 +204,13 @@ class MetricsRegistry:
         self._offline_seconds: float | None = None
         self._journal_replay_totals: dict[str, int] = {}
         self._deferred_patch_total = 0
+        # Fleet churn (preemption fast-drain + autoscaler interplay):
+        # preemption notices handled by outcome (handoff / clean /
+        # resumed / handoff-failed), mid-rollout node adoptions, and how
+        # long the last fast drain took against its hard deadline.
+        self._preemption_totals: dict[str, int] = {}
+        self._node_adoptions_total = 0
+        self._fast_drain_seconds: float | None = None
         # Client-side apiserver request accounting by verb (get / list /
         # watch / patch / create / update / delete): every HTTP round
         # trip RestKube performs, retries included. The fleet-scale
@@ -340,6 +347,38 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._journal_replay_totals)
 
+    def record_preemption(self, outcome: str) -> None:
+        """Count one handled preemption notice by outcome: ``handoff``
+        (mid-flip, handoff record published for the replacement),
+        ``clean`` (no transition in flight), ``handoff-failed`` (the
+        publish itself failed before the kill), ``resumed`` (this agent
+        consumed a predecessor's handoff and completed the flip)."""
+        with self._lock:
+            self._preemption_totals[outcome] = (
+                self._preemption_totals.get(outcome, 0) + 1
+            )
+
+    def preemption_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._preemption_totals)
+
+    def record_node_adoption(self, count: int = 1) -> None:
+        """Count nodes created mid-rollout (autoscaler scale-up) that the
+        orchestrator adopted into a trailing wave."""
+        with self._lock:
+            self._node_adoptions_total += count
+
+    def node_adoptions_total(self) -> int:
+        with self._lock:
+            return self._node_adoptions_total
+
+    def set_fast_drain_seconds(self, seconds: float) -> None:
+        """Record how long the most recent preemption fast-drain took
+        (checkpoint handshake + compressed eviction, against the hard
+        termination deadline)."""
+        with self._lock:
+            self._fast_drain_seconds = max(0.0, seconds)
+
     def record_apiserver_request(self, verb: str) -> None:
         """Count one apiserver HTTP round trip by verb (kubeclient)."""
         with self._lock:
@@ -430,6 +469,9 @@ class MetricsRegistry:
             journal_replays = dict(self._journal_replay_totals)
             deferred_patches = self._deferred_patch_total
             apiserver_requests = dict(self._apiserver_request_totals)
+            preemption_totals = dict(self._preemption_totals)
+            node_adoptions = self._node_adoptions_total
+            fast_drain_seconds = self._fast_drain_seconds
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -590,6 +632,40 @@ class MetricsRegistry:
             )
             lines.append(
                 "tpu_cc_journal_deferred_patches_total %d" % deferred_patches
+            )
+        if preemption_totals:
+            lines.append(
+                "# HELP tpu_cc_preemptions_total Platform preemption "
+                "notices handled, by outcome (handoff / clean / resumed / "
+                "handoff-failed; docs/operations.md \"Preemption, "
+                "autoscaler & surge\")."
+            )
+            lines.append("# TYPE tpu_cc_preemptions_total counter")
+            for outcome in sorted(preemption_totals):
+                lines.append(
+                    "tpu_cc_preemptions_total%s %d"
+                    % (_labels(outcome=outcome), preemption_totals[outcome])
+                )
+        if node_adoptions:
+            lines.append(
+                "# HELP tpu_cc_node_adoptions_total Nodes created mid-"
+                "rollout (autoscaler scale-up) adopted into a trailing "
+                "rollout wave."
+            )
+            lines.append("# TYPE tpu_cc_node_adoptions_total counter")
+            lines.append(
+                "tpu_cc_node_adoptions_total %d" % node_adoptions
+            )
+        if fast_drain_seconds is not None:
+            lines.append(
+                "# HELP tpu_cc_fast_drain_seconds Duration of the most "
+                "recent preemption fast-drain (checkpoint handshake + "
+                "compressed eviction) against the hard termination "
+                "deadline."
+            )
+            lines.append("# TYPE tpu_cc_fast_drain_seconds gauge")
+            lines.append(
+                "tpu_cc_fast_drain_seconds %.3f" % fast_drain_seconds
             )
         if apiserver_requests:
             lines.append(
