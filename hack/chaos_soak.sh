@@ -23,9 +23,11 @@
 #   OUT               JSON summary artifact (default artifacts/chaos_soak.json)
 #
 # Exit 0 only when every seed converged. The summary records per-seed
-# fault/retry counts (grepped from the test's CHAOS_SOAK_SUMMARY line) and
-# remediation-ladder counters (REMEDIATION_SUMMARY) so the evidence ladder
-# can cite them.
+# fault/retry counts (grepped from the test's CHAOS_SOAK_SUMMARY line),
+# remediation-ladder counters (REMEDIATION_SUMMARY), and the fleet-churn
+# scenarios' outcomes (PREEMPTION_SUMMARY: preemption fast-drain +
+# handoff resume, slice fencing of a departed peer) so the evidence
+# ladder can cite them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -41,7 +43,10 @@ OUT="${OUT:-artifacts/chaos_soak.json}"
 mkdir -p "$(dirname "$OUT")" artifacts
 
 # The terminal-fault leg is one named test; deselect it when disabled.
-PYTEST_ARGS=(tests/test_chaos.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
+# test_preemption.py carries the churn scenarios (preemption fast-drain +
+# handoff, slice fencing of a departed peer) — seeded from the same
+# CC_CHAOS_SEED, summarized via PREEMPTION_SUMMARY lines.
+PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
 if [ "$TERMINAL" = "0" ]; then
   PYTEST_ARGS+=(--deselect \
     "tests/test_chaos.py::test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts")
@@ -66,7 +71,8 @@ for i in $(seq 0 $((ITERS - 1))); do
   summary=$(grep -ao "CHAOS_SOAK_SUMMARY.*" "$log" | tail -1 | sed 's/^CHAOS_SOAK_SUMMARY //')
   remediation=$(grep -ao "REMEDIATION_SUMMARY.*" "$log" | tail -1 | sed "s/^REMEDIATION_SUMMARY //; s/'/ /g; s/\"/ /g")
   offline=$(grep -ao "OFFLINE_SUMMARY.*" "$log" | tail -1 | sed "s/^OFFLINE_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\"}")
+  preemption=$(grep -ao "PREEMPTION_SUMMARY.*" "$log" | sed "s/^PREEMPTION_SUMMARY //; s/'/ /g; s/\"/ /g" | paste -sd'; ' -)
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\"}")
 done
 
 {

@@ -257,6 +257,12 @@ def _seeded_registry_text() -> str:
     registry.record_apiserver_request("list")
     registry.record_apiserver_request("watch")
     registry.record_apiserver_request('odd"verb')
+    # Fleet-churn families (preemption fast-drain + autoscaler interplay).
+    registry.record_preemption("handoff")
+    registry.record_preemption("clean")
+    registry.record_preemption('odd"outcome')
+    registry.record_node_adoption(3)
+    registry.set_fast_drain_seconds(1.234)
     return registry.render_prometheus()
 
 

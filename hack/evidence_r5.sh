@@ -161,6 +161,21 @@ else
   }
 fi
 
+# Mock-apiserver scale parity (PR 7): the same 1k-node rollout over real
+# HTTP through RestKube + hack/mock_apiserver.py. Cheaper than the
+# FakeKube ladder (one size), same skip/park discipline.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("SCALE_r02.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> SCALE_r02.json already captured (ok:true); skipping"
+else
+  echo "=== stage: scale-bench --apiserver (HTTP mock, no tunnel) ==="
+  python3 hack/scale_bench.py --apiserver --out SCALE_r02.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s SCALE_r02.json ] && mv SCALE_r02.json artifacts/SCALE_r02.failed.json
+    echo ">>> HTTP scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r02.failed.json)"
+    finish
+  }
+fi
+
 stage "pallas-sweep" artifacts/pallas_sweep_r05.jsonl \
   env OUT=artifacts/pallas_sweep_r05.jsonl ERRLOG=artifacts/pallas_sweep_r05.stderr.log \
   bash hack/tune_pallas.sh

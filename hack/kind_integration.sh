@@ -154,6 +154,38 @@ await_state off
 kubectl label node "$NODE" "$MODE_LABEL=on" --overwrite
 await_state on
 
+echo ">>> autoscaler scale-down drill: Node object deleted mid-rollout"
+# A phantom second Node (a real apiserver accepts Node objects with no
+# kubelet behind them) joins the pool; it can never converge, so the
+# rollout holds its window open — then "the autoscaler" deletes the Node
+# object mid-window. The orchestrator must retire it immediately (no
+# phantom timeout), spend ZERO failure budget (--failure-budget 0: any
+# charge would halt), and report the pool rollout ok.
+PHANTOM="kind-drill-phantom"
+kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Node
+metadata:
+  name: $PHANTOM
+  labels:
+    pool: tpu-it
+EOF
+( sleep 6; kubectl delete node "$PHANTOM" --ignore-not-found ) &
+DELETER_PID=$!
+SCALE_DOWN_OUT=$(PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl rollout \
+    --selector pool=tpu-it --mode off --max-unavailable 2 \
+    --failure-budget 0 --node-timeout 120) || {
+  echo "FAIL: rollout did not survive the mid-window node deletion";
+  echo "$SCALE_DOWN_OUT"; kill "$DELETER_PID" 2>/dev/null || true; exit 1; }
+wait "$DELETER_PID" 2>/dev/null || true
+echo "$SCALE_DOWN_OUT"
+echo "$SCALE_DOWN_OUT" | grep -q "$PHANTOM" || {
+  echo "FAIL: deleted node not reported as retired"; exit 1; }
+await_state off
+kubectl label node "$NODE" "$MODE_LABEL=on" --overwrite
+await_state on
+
 echo ">>> quarantine drill: the taint patch verb against real RBAC"
 PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
   python3 -m tpu_cc_manager.ctl quarantine --node "$NODE" --reason kind-drill

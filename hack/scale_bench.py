@@ -28,9 +28,16 @@ O(pool) listings make the run minutes-long by construction, which is the
 very pathology the informer exists to remove; the 1k comparison already
 quantifies it.
 
+``--apiserver`` swaps FakeKube for the real HTTP ``hack/mock_apiserver.py``
+behind RestKube — chunked listings, selector watches and lease CAS ride
+the wire — with the fleet's agents emulated server-side (ServerAgentSim);
+defaults to the 1k-node fleet and SCALE_r02.json (mock-apiserver scale
+parity, ROADMAP item 1 headroom).
+
 Usage:
     python hack/scale_bench.py                       # full bench
     python hack/scale_bench.py --sizes 100,1000      # subset
+    python hack/scale_bench.py --apiserver           # 1k nodes over HTTP
     python hack/scale_bench.py --out SCALE_r01.json --partial artifacts/scale_partial.jsonl
 """
 
@@ -235,19 +242,218 @@ class AgentSim:
             t.join(timeout=1.0)
 
 
+def fleet_labels(i: int, n: int, hosts_per_slice: int, zones: int) -> dict:
+    slice_count = max(1, n // hosts_per_slice)
+    sid = i % slice_count
+    return {
+        "pool": "tpu",
+        SLICE_ID_LABEL: f"scale-s{sid:05d}",
+        ZONE_LABEL: f"zone-{sid % zones}",
+        CC_MODE_STATE_LABEL: "off",
+    }
+
+
 def build_fleet(
     fake: FakeKube, n: int, hosts_per_slice: int = 4, zones: int = 8
 ) -> None:
-    slice_count = max(1, n // hosts_per_slice)
     for i in range(n):
-        sid = i % slice_count
-        labels = {
-            "pool": "tpu",
-            SLICE_ID_LABEL: f"scale-s{sid:05d}",
-            ZONE_LABEL: f"zone-{sid % zones}",
-            CC_MODE_STATE_LABEL: "off",
-        }
-        fake.add_node(f"scale-n{i:05d}", labels)
+        fake.add_node(
+            f"scale-n{i:05d}", fleet_labels(i, n, hosts_per_slice, zones)
+        )
+
+
+# ---------------------------------------------------------------------------
+# --apiserver mode: the SAME rollout, but the orchestrator speaks real
+# HTTP to hack/mock_apiserver.py through RestKube — chunked listings,
+# selector watches, lease CAS and merge-patches all ride the wire, so
+# the informer-vs-legacy comparison covers serialization and transport,
+# not just FakeKube method calls (ROADMAP item 1's "mock-apiserver scale
+# parity" headroom).
+# ---------------------------------------------------------------------------
+
+_MOCK_THREADS_STARTED = [False]
+
+
+def _load_mock():
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import mock_apiserver as mock
+
+    return mock
+
+
+def _reset_mock(mock) -> None:
+    with mock.lock:
+        mock.nodes.clear()
+        mock.pods.clear()
+        mock.leases.clear()
+        mock.request_counts.clear()
+        mock.page_snapshots.clear()
+        mock.events.clear()
+        mock.sticky_pods.clear()
+        mock.compacted_below[0] = 0
+
+
+class ServerAgentSim:
+    """The fleet's agents, emulated server-side: a scheduler thread scans
+    the mock's node table for desired≠state, schedules each flip after a
+    seeded per-node latency, and applies it under the mock's lock (state
+    label + rv bump + watch event) — exactly the churn a real fleet's
+    DaemonSet generates, without 1k HTTP clients. The ORCHESTRATOR is the
+    process under test here; its traffic is what rides the wire."""
+
+    def __init__(
+        self,
+        mock,
+        seed: int,
+        min_delay_s: float = 0.02,
+        max_delay_s: float = 0.08,
+        scan_interval_s: float = 0.01,
+    ) -> None:
+        self.mock = mock
+        self.seed = seed
+        self.min_delay_s = min_delay_s
+        self.max_delay_s = max_delay_s
+        self.scan_interval_s = scan_interval_s
+        self.transitions = 0
+        self._due: list[tuple[float, str, str]] = []
+        self._scheduled: set[str] = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _delay(self, name: str) -> float:
+        rng = random.Random(zlib.crc32(f"{self.seed}:{name}".encode()))
+        return rng.uniform(self.min_delay_s, self.max_delay_s)
+
+    def _loop(self) -> None:
+        mock = self.mock
+        while not self._stop.wait(self.scan_interval_s):
+            now = time.monotonic()
+            with mock.lock:
+                for name, node in mock.nodes.items():
+                    labels = node["metadata"]["labels"]
+                    desired = labels.get(CC_MODE_LABEL)
+                    state = labels.get(CC_MODE_STATE_LABEL)
+                    if (
+                        desired
+                        and desired != state
+                        and name not in self._scheduled
+                    ):
+                        self._scheduled.add(name)
+                        heapq.heappush(
+                            self._due,
+                            (now + self._delay(name), name, desired),
+                        )
+            while self._due and self._due[0][0] <= time.monotonic():
+                _, name, desired = heapq.heappop(self._due)
+                with mock.lock:
+                    node = mock.nodes.get(name)
+                    if node is None:
+                        continue
+                    node["metadata"]["labels"][CC_MODE_STATE_LABEL] = desired
+                    mock.bump_rv(node)
+                    mock.emit_watch_event(node)
+                self._scheduled.discard(name)
+                self.transitions += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def run_pool_apiserver(
+    n: int,
+    mode: str,
+    seed: int = DEFAULT_SEED,
+    shards: int = 8,
+    per_shard_unavailable: int = 4,
+    poll_interval_s: float = 0.2,
+    node_timeout_s: float = 300.0,
+    hosts_per_slice: int = 4,
+) -> dict:
+    """One full rollout over an n-node fleet served by the real HTTP mock
+    apiserver; the orchestrator runs RestKube end-to-end."""
+    from http.server import ThreadingHTTPServer
+
+    from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+    mock = _load_mock()
+    _reset_mock(mock)
+    with mock.lock:
+        for i in range(n):
+            name = f"scale-n{i:05d}"
+            mock.nodes[name] = {
+                "kind": "Node",
+                "apiVersion": "v1",
+                "metadata": {
+                    "name": name,
+                    "resourceVersion": "1",
+                    "labels": fleet_labels(i, n, hosts_per_slice, zones=8),
+                },
+            }
+    if not _MOCK_THREADS_STARTED[0]:
+        threading.Thread(target=mock._watch_writer, daemon=True).start()
+        _MOCK_THREADS_STARTED[0] = True
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), mock.Handler)
+    srv_thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    srv_thread.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    sim = ServerAgentSim(mock, seed=seed)
+    client = RestKube(ClusterConfig(server=url, token="scale-bench"))
+    counting = CountingKube(client)
+    informer = None
+    total_unavailable = shards * per_shard_unavailable
+    try:
+        if mode == "informer":
+            informer = NodeInformer(
+                counting, SELECTOR, page_limit=500,
+            ).start(sync_timeout_s=120.0)
+            roller = RollingReconfigurator(
+                counting, SELECTOR,
+                max_unavailable=per_shard_unavailable,
+                poll_interval_s=poll_interval_s,
+                node_timeout_s=node_timeout_s,
+                informer=informer,
+                wave_shards=shards,
+            )
+        else:
+            roller = RollingReconfigurator(
+                counting, SELECTOR,
+                max_unavailable=total_unavailable,
+                poll_interval_s=poll_interval_s,
+                node_timeout_s=node_timeout_s,
+            )
+        t0 = time.monotonic()
+        result = roller.rollout("on")
+        seconds = time.monotonic() - t0
+        with mock.lock:
+            converged = all(
+                node["metadata"]["labels"].get(CC_MODE_STATE_LABEL) == "on"
+                for node in mock.nodes.values()
+            )
+            server_requests = dict(sorted(mock.request_counts.items()))
+    finally:
+        if informer is not None:
+            informer.stop()
+        sim.stop()
+        srv.shutdown()
+        srv_thread.join(timeout=5.0)
+    return {
+        "nodes": n,
+        "mode": mode,
+        "transport": "http",
+        "ok": bool(result.ok and converged),
+        "converged": converged,
+        "seconds": round(seconds, 2),
+        "groups": len(result.groups),
+        "wave_shards": shards if mode == "informer" else 1,
+        "max_unavailable_total": total_unavailable,
+        "orchestrator_requests": dict(sorted(counting.counts.items())),
+        "apiserver_requests": server_requests,
+        "agent_transitions": sim.transitions,
+    }
 
 
 def run_pool(
@@ -342,11 +548,18 @@ def summarize(rows: list[dict]) -> dict:
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--sizes", default="100,1000,10000")
+    parser.add_argument("--sizes", default=None)
     parser.add_argument("--modes", default="legacy,informer")
     parser.add_argument("--seed", type=int, default=DEFAULT_SEED)
     parser.add_argument("--shards", type=int, default=8)
-    parser.add_argument("--out", default="SCALE_r01.json")
+    parser.add_argument("--out", default=None)
+    parser.add_argument(
+        "--apiserver", action="store_true",
+        help="drive the rollouts over real HTTP through "
+        "hack/mock_apiserver.py + RestKube instead of in-process FakeKube "
+        "calls (chunked listings, selector watches, lease CAS on the "
+        "wire); defaults to the 1k-node fleet and SCALE_r02.json",
+    )
     parser.add_argument(
         "--partial", default=None,
         help="JSONL of completed (mode,size) rows; existing rows are "
@@ -358,6 +571,11 @@ def main(argv: list[str] | None = None) -> int:
         "listings by construction; skipped by default)",
     )
     args = parser.parse_args(argv)
+    if args.sizes is None:
+        args.sizes = "1000" if args.apiserver else "100,1000,10000"
+    if args.out is None:
+        args.out = "SCALE_r02.json" if args.apiserver else "SCALE_r01.json"
+    runner = run_pool_apiserver if args.apiserver else run_pool
     sizes = [int(s) for s in args.sizes.split(",") if s]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
     rows: list[dict] = []
@@ -395,8 +613,12 @@ def main(argv: list[str] | None = None) -> int:
                     "--full to run it anyway)", file=sys.stderr,
                 )
                 continue
-            print(f">>> rollout: {mode} mode, {n} node(s)", file=sys.stderr)
-            row = run_pool(n, mode, seed=args.seed, shards=args.shards)
+            print(
+                f">>> rollout: {mode} mode, {n} node(s)"
+                + (" over HTTP (mock apiserver)" if args.apiserver else ""),
+                file=sys.stderr,
+            )
+            row = runner(n, mode, seed=args.seed, shards=args.shards)
             print(
                 f">>> {mode}@{n}: ok={row['ok']} {row['seconds']}s "
                 f"requests={row['orchestrator_requests']}",

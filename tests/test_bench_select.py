@@ -15,6 +15,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import pytest
+
 from bench import select_headline_smoke
 from bench_ab import summarize_ab
 
@@ -224,3 +226,49 @@ class TestSummarizeAbProperties:
             assert r["ok"] is False
         else:
             assert r["ok"] == (r["value"] <= target)
+
+
+class TestAbPowerDisclosure:
+    """Mean ± 95% CI half-width per arm + propagated loss half-width
+    (the reps>=5 power satellite: an underpowered delta must be visible
+    in the artifact, not masquerade as a measurement)."""
+
+    def test_mean_ci95_small_samples(self):
+        from bench_ab import mean_ci95
+
+        mean, hw = mean_ci95([10.0, 12.0, 11.0, 13.0, 9.0])
+        assert mean == 11.0
+        assert hw == pytest.approx(2.78 * (2.5 ** 0.5) / (5 ** 0.5), rel=1e-6)
+        # Below 2 samples there is no variance estimate — say so.
+        assert mean_ci95([5.0]) == (5.0, None)
+        assert mean_ci95([]) == (None, None)
+
+    def test_powered_loss_flagged_true(self):
+        off = [(100.0 + d, None, None) for d in (-0.2, -0.1, 0.0, 0.1, 0.2)]
+        on = [(90.0 + d, None, None) for d in (-0.2, -0.1, 0.0, 0.1, 0.2)]
+        r = summarize_ab(**_ab_inputs(["matmul"], off=off, on=on))
+        m = r["workloads"]["matmul"]
+        assert m["off"]["mean"] == 100.0
+        assert m["off"]["ci95_half_width"] is not None
+        assert m["loss_pct"] == pytest.approx(10.0)
+        assert m["loss_powered"] is True
+        assert m["loss_pct_ci95_half_width"] < 1.0
+
+    def test_underpowered_loss_flagged_false(self):
+        # The r4 failure shape: a "loss" far inside the arms' jitter.
+        off = [(100.0 + d, None, None) for d in (-8.0, -3.0, 0.0, 3.0, 8.0)]
+        on = [(99.5 + d, None, None) for d in (-8.0, -3.0, 0.0, 3.0, 8.0)]
+        r = summarize_ab(**_ab_inputs(["matmul"], off=off, on=on))
+        m = r["workloads"]["matmul"]
+        assert m["loss_powered"] is False
+        assert m["loss_pct_ci95_half_width"] > abs(m["loss_pct"])
+
+    def test_single_sample_arm_reports_unknown_power(self):
+        r = summarize_ab(**_ab_inputs(
+            ["matmul"],
+            off=[(100.0, None, None)], on=[(97.0, None, None)],
+        ))
+        m = r["workloads"]["matmul"]
+        assert m["loss_pct"] == pytest.approx(3.0)
+        assert m["loss_pct_ci95_half_width"] is None
+        assert m["loss_powered"] is None

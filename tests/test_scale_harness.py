@@ -49,6 +49,26 @@ def test_summary_flags_ok_and_ratio():
     assert summary["list_request_drop"]["60"] >= 10.0
 
 
+def test_100_node_fleet_over_real_http_apiserver():
+    """--apiserver parity smoke: the same rollout, but the orchestrator
+    speaks real HTTP (RestKube → hack/mock_apiserver.py) — chunked
+    listings, selector watches, merge-patches on the wire. The committed
+    SCALE_r02.json carries the 1k-node numbers."""
+    legacy = scale_bench.run_pool_apiserver(100, "legacy", seed=11)
+    informer = scale_bench.run_pool_apiserver(100, "informer", seed=11)
+    assert legacy["ok"], legacy
+    assert informer["ok"], informer
+    assert legacy["transport"] == informer["transport"] == "http"
+    llists = legacy["orchestrator_requests"].get("list", 0)
+    ilists = informer["orchestrator_requests"].get("list", 0)
+    assert ilists > 0
+    assert llists >= 10 * ilists, (llists, ilists)
+    # The server's own per-verb ledger agrees with the client's on the
+    # O(pool) verb (watch reconnects may differ: a shutdown-interrupted
+    # reconnect counts client-side only).
+    assert informer["apiserver_requests"].get("list") == ilists
+
+
 @pytest.mark.slow
 def test_10k_node_fleet_full_rollout_informer():
     row = scale_bench.run_pool(10000, "informer", seed=5)
