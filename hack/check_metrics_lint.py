@@ -263,6 +263,11 @@ def _seeded_registry_text() -> str:
     registry.record_preemption('odd"outcome')
     registry.record_node_adoption(3)
     registry.set_fast_drain_seconds(1.234)
+    # Pipelined-transition families (overlap gauge + smoke fast path).
+    registry.set_phase_overlap_seconds(22.5)
+    registry.record_smoke_fastpath("hit")
+    registry.record_smoke_fastpath("miss")
+    registry.record_smoke_fastpath('odd"outcome\nhere')
     return registry.render_prometheus()
 
 

@@ -43,6 +43,7 @@ mkdir -p artifacts
 ARTIFACTS=(
   artifacts/chaos_soak.json
   SCALE_r01.json
+  artifacts/smoke_cache_r06.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
   artifacts/resnet_ladder_r05.jsonl
@@ -172,6 +173,26 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s SCALE_r02.json ] && mv SCALE_r02.json artifacts/SCALE_r02.failed.json
     echo ">>> HTTP scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r02.failed.json)"
+    finish
+  }
+fi
+
+# Compilation-cache proof (VERDICT weak #2): cold vs warm smoke across a
+# simulated CC bounce. Resumable the same way as the other single-point
+# stages — skipped once the artifact records ok:true, parked as
+# .failed.json otherwise so finish() can't mistake a failed capture for
+# evidence. Runs before the tunnel-gated ladder: the measurement is
+# honest on whatever backend the smoke reaches (the artifact records it),
+# and a tunnel outage must not cost us the cache evidence.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("artifacts/smoke_cache_r06.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> artifacts/smoke_cache_r06.json already captured (ok:true); skipping"
+else
+  echo "=== stage: smoke-cache cold-vs-warm (local) ==="
+  python3 hack/smoke_cache_bench.py --out artifacts/smoke_cache_r06.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s artifacts/smoke_cache_r06.json ] && \
+      mv artifacts/smoke_cache_r06.json artifacts/smoke_cache_r06.failed.json
+    echo ">>> smoke-cache bench FAILED; stopping ladder (summary in artifacts/smoke_cache_r06.failed.json)"
     finish
   }
 fi

@@ -1,0 +1,53 @@
+"""Cold-vs-warm smoke compilation-cache bench: one JSON line, ok-gated.
+
+Proves `utils/compilation_cache.py` holds the smoke phase down across a
+CC bounce (VERDICT weak #2) as a standalone, resumable evidence stage:
+the cold run starts from an empty cache directory, the warm run reuses
+it from a FRESH subprocess — exactly what a CC bounce does to the verify
+phase (the runtime restart kills the process; only the disk cache
+survives). The delta is the compile time the cache saves.
+
+Usage:
+  python3 hack/smoke_cache_bench.py [--workload matmul] [--out FILE]
+
+Prints exactly one JSON line (also written to --out when given) with
+``ok`` true only when both runs passed and the cold run actually
+populated the cache — the evidence ladder's skip-when-ok:true gate
+(hack/evidence_r5.sh) reads it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workload", default="matmul")
+    parser.add_argument("--timeout-s", type=float, default=600.0)
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON line to this file")
+    args = parser.parse_args(argv)
+
+    import bench  # repo-root bench.py: the shared measurement helpers
+
+    tpu_usable = bench._tpu_preflight()
+    result = bench.measure_smoke_cache(
+        tpu_usable, workload=args.workload, timeout_s=args.timeout_s,
+    )
+    result["metric"] = "smoke_cache_cold_warm_s"
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,8 +29,16 @@ def test_live_registry_render_passes_lint():
     m.finish("failed")
     registry.record_failure("attestation-failed")
     registry.record_failure('hostile"reason\nhere')
+    # Pipelined-transition families: the overlap gauge (auto-fed from
+    # finish()) plus explicit smoke fast-path outcomes, hostile included.
+    registry.set_phase_overlap_seconds(3.25)
+    registry.record_smoke_fastpath("hit")
+    registry.record_smoke_fastpath('odd"outcome')
     problems = check_metrics_lint.lint(registry.render_prometheus())
     assert problems == [], problems
+    text = registry.render_prometheus()
+    assert "tpu_cc_phase_overlap_seconds" in text
+    assert 'tpu_cc_smoke_fastpath_total{outcome="hit"} 1' in text
 
 
 def test_empty_registry_render_passes_lint():

@@ -1,0 +1,632 @@
+"""Pipelined node transitions (ccmanager/manager.py): stage-during-drain,
+per-chip parallel reset, readmit-overlapped-smoke, and the attestation-
+digest smoke fast path.
+
+Three families, matching the guarantees the pipeline must NOT trade away:
+
+- **ordering**: reset never runs while any drained component's pods are
+  still on the node (the strict-eviction guarantee, checked as a seeded
+  concurrency property), and re-admission never starts before the
+  hardware verifiably holds the committed mode;
+- **crash safety**: kill-at-every-crash-point in the style of
+  tests/test_rollout_resume.py — a modeled SIGKILL at each overlap
+  boundary, then a fresh agent replaying the intent journal; every chip
+  is reset exactly once across the crash, never twice;
+- **fast path**: CC_SMOKE_DIGEST_FAST_PATH skips the full smoke ONLY on
+  an unchanged verified digest; a changed digest (or no record, or the
+  env off) always falls through to the full smoke.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager.intent_journal import IntentJournal
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.drain.pause import is_paused
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    DRAIN_COMPONENT_LABELS,
+    MODE_OFF,
+    MODE_ON,
+)
+from tpu_cc_manager.obs.journal import Journal
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend, sign_fake_quote
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "pipe-node-0"
+NS = "tpu-operator"
+DP_LABEL = "google.com/tpu.deploy.device-plugin"
+DP_APP = DRAIN_COMPONENT_LABELS[DP_LABEL]
+
+
+class AgentKilled(BaseException):
+    """Models a SIGKILL landing inside the agent (BaseException so the
+    manager's except-Exception failure handler cannot run 'cleanup' a
+    real SIGKILL would never run)."""
+
+
+def make_manager(kube, backend, **kw):
+    kw.setdefault("evict_components", True)
+    kw.setdefault("smoke_workload", "none")
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("journal", Journal(trace_file=""))
+    kw.setdefault("eviction_timeout_s", 5)
+    kw.setdefault("eviction_poll_interval_s", 0.01)
+    return CCManager(
+        api=kube, backend=backend, node_name=NODE,
+        operator_namespace=NS, **kw,
+    )
+
+
+def add_drainable_node(kube, pod_delete_delay_s: float = 0.0):
+    """One node with a drainable component whose pod the emulated operator
+    controller deletes (after a delay) once the pause label lands."""
+    kube.add_node(NODE, {DP_LABEL: "true"})
+    kube.add_pod(NS, "dp-0", NODE, labels={"app": DP_APP})
+
+    def reactor(name, patched):
+        if is_paused(node_labels(patched).get(DP_LABEL)):
+            if pod_delete_delay_s > 0:
+                t = threading.Timer(
+                    pod_delete_delay_s, kube.delete_pods_matching,
+                    (NS, f"app={DP_APP}"),
+                )
+                t.daemon = True
+                t.start()
+            else:
+                kube.delete_pods_matching(NS, f"app={DP_APP}")
+
+    kube.add_patch_reactor(reactor)
+
+
+def reset_ops(backend):
+    return [op for op, _ in backend.op_log if op == "reset"]
+
+
+def chip_reset_counts(backend):
+    counts: dict[int, int] = {}
+    for op, payload in backend.op_log:
+        if op == "reset":
+            for idx in payload:
+                counts[idx] = counts.get(idx, 0) + 1
+        elif op == "reset.chip":
+            # per-chip entries ride inside a whole-set reset() call; the
+            # whole-set entry already counted them.
+            pass
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# Ordering: stage-during-drain never resets under undrained components
+# ---------------------------------------------------------------------------
+
+
+def test_stage_overlaps_drain_but_reset_waits(fake_kube):
+    """The stage op lands while the drain is still waiting on pods, and
+    the reset only runs after every component pod left the node."""
+    add_drainable_node(fake_kube, pod_delete_delay_s=0.15)
+    backend = FakeTpuBackend()
+    observed = {}
+    real_reset = backend.reset
+
+    def observing_reset(chips):
+        observed["pods_at_reset"] = len(fake_kube.list_pods(
+            NS, label_selector=f"app={DP_APP}",
+            field_selector=f"spec.nodeName={NODE}",
+        ))
+        observed["label_at_reset"] = node_labels(
+            fake_kube.get_node(NODE)
+        ).get(DP_LABEL)
+        real_reset(chips)
+
+    backend.reset = observing_reset
+    mgr = make_manager(fake_kube, backend)
+    t0 = time.monotonic()
+    assert mgr.set_cc_mode(MODE_ON) is True
+    elapsed = time.monotonic() - t0
+    # The stage ran while the (0.15 s) pod wait was still in flight: the
+    # reconcile paid one drain, not drain + stage serialized... the real
+    # assertion is ordering, but the overlap shows up as stage finishing
+    # before the drain's pod deletion could have.
+    ops = [op for op, _ in backend.op_log]
+    assert ops.index("stage") < ops.index("reset")
+    assert observed["pods_at_reset"] == 0, "reset ran under undrained pods"
+    assert is_paused(observed["label_at_reset"]), (
+        "reset must run inside the pause bracket"
+    )
+    assert elapsed < 5, "pipeline must not serialize pathologically"
+
+
+def test_reset_never_under_undrained_components_property(fake_kube):
+    """Seeded concurrency property: across randomized pod-termination
+    delays and per-chip reset timings, the reset NEVER observes a
+    component pod still on the node, and never a component label outside
+    its paused state (the strict-eviction guarantee, pipelined or not)."""
+    rng = random.Random(1234)
+    for round_no in range(12):
+        from tpu_cc_manager.kubeclient.fake import FakeKube
+
+        kube = FakeKube()
+        add_drainable_node(kube, pod_delete_delay_s=rng.uniform(0, 0.05))
+        backend = FakeTpuBackend(
+            reset_latency_s=[rng.uniform(0, 0.01) for _ in range(4)],
+            reset_parallelism_override=rng.choice([1, 2, 4]),
+        )
+        violations = []
+        real_reset = backend.reset
+
+        def checking_reset(chips, kube=kube, backend=backend,
+                           violations=violations):
+            pods = kube.list_pods(
+                NS, label_selector=f"app={DP_APP}",
+                field_selector=f"spec.nodeName={NODE}",
+            )
+            if pods:
+                violations.append(f"{len(pods)} pod(s) at reset")
+            label = node_labels(kube.get_node(NODE)).get(DP_LABEL)
+            if not is_paused(label):
+                violations.append(f"component label {label!r} not paused")
+            real_reset(chips)
+
+        backend.reset = checking_reset
+        mgr = make_manager(kube, backend)
+        assert mgr.set_cc_mode(MODE_ON) is True, f"round {round_no} failed"
+        assert not violations, f"round {round_no}: {violations}"
+
+
+def test_readmit_overlaps_smoke(fake_kube):
+    """Re-admission runs WHILE the smoke workload executes: a smoke
+    runner that blocks until the component label is unpaused can only
+    complete if the readmit was kicked off concurrently."""
+    add_drainable_node(fake_kube)
+    backend = FakeTpuBackend()
+    state = {}
+
+    def blocking_smoke(workload):
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if node_labels(fake_kube.get_node(NODE)).get(DP_LABEL) == "true":
+                state["unpaused_during_smoke"] = True
+                # Safe-to-release check: at readmit time every chip must
+                # already hold the committed mode.
+                state["committed_at_readmit"] = dict(backend.committed)
+                return {"ok": True}
+            time.sleep(0.005)
+        state["unpaused_during_smoke"] = False
+        return {"ok": True}
+
+    mgr = make_manager(
+        fake_kube, backend, smoke_workload="matmul",
+        smoke_runner=blocking_smoke,
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert state["unpaused_during_smoke"] is True, (
+        "readmit never ran during the smoke — the overlap is gone"
+    )
+    assert all(
+        v == MODE_ON for v in state["committed_at_readmit"].values()
+    ), "readmit released the pause bracket before the mode was committed"
+
+
+def test_smoke_failure_still_readmits_and_fails(fake_kube):
+    """The overlapped readmit does not change failure semantics: a failed
+    smoke labels the node failed AND components are restored."""
+    add_drainable_node(fake_kube)
+    backend = FakeTpuBackend()
+
+    def failing_smoke(workload):
+        raise RuntimeError("numerics mismatch")
+
+    mgr = make_manager(
+        fake_kube, backend, smoke_workload="matmul",
+        smoke_runner=failing_smoke,
+    )
+    assert mgr.set_cc_mode(MODE_ON) is False
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[DP_LABEL] == "true"
+    assert labels[CC_MODE_STATE_LABEL] == "failed"
+
+
+def test_strict_drain_timeout_rolls_back_overlapped_stage(fake_kube):
+    """Strict eviction + pipelining: the overlapped stage is rolled back
+    when the drain times out — staged.json empty, intent aborted, no
+    reset, components re-admitted."""
+    fake_kube.add_node(NODE, {DP_LABEL: "true"})
+    fake_kube.add_pod(NS, "stuck", NODE, labels={"app": DP_APP})  # never drains
+    backend = FakeTpuBackend()
+    mgr = make_manager(
+        fake_kube, backend, strict_eviction=True, eviction_timeout_s=0.05,
+    )
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert backend.staged == {}, "overlapped stage must be rolled back"
+    assert not reset_ops(backend)
+    labels = node_labels(fake_kube.get_node(NODE))
+    assert labels[DP_LABEL] == "true"
+    assert labels[CC_MODE_STATE_LABEL] == "failed"
+
+
+def test_overlap_metric_exported(fake_kube):
+    """tpu_cc_phase_overlap_seconds reports the saving once phases
+    actually overlap (drain 0.15 s ∥ stage here)."""
+    add_drainable_node(fake_kube, pod_delete_delay_s=0.15)
+    backend = FakeTpuBackend()
+    orig_stage = backend.stage_cc_mode
+
+    def slow_stage(chips, mode):
+        time.sleep(0.1)  # overlapped with the 0.15 s pod wait
+        orig_stage(chips, mode)
+
+    backend.stage_cc_mode = slow_stage
+    registry = MetricsRegistry()
+    mgr = make_manager(fake_kube, backend, metrics=registry)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    text = registry.render_prometheus()
+    assert "tpu_cc_phase_overlap_seconds" in text
+    value = float([
+        line for line in text.splitlines()
+        if line.startswith("tpu_cc_phase_overlap_seconds")
+    ][0].split()[-1])
+    assert value > 0.05, f"expected real overlap, got {value}"
+
+
+def test_pipeline_disabled_restores_serial_order(fake_kube):
+    """CC_PIPELINE_TRANSITIONS=0 (the safety valve): stage strictly after
+    the drain completes, readmit strictly after the smoke."""
+    add_drainable_node(fake_kube, pod_delete_delay_s=0.05)
+    backend = FakeTpuBackend()
+    events = []
+    orig_stage = backend.stage_cc_mode
+
+    def logging_stage(chips, mode):
+        events.append(("stage_at", len(fake_kube.list_pods(
+            NS, label_selector=f"app={DP_APP}",
+            field_selector=f"spec.nodeName={NODE}",
+        ))))
+        orig_stage(chips, mode)
+
+    backend.stage_cc_mode = logging_stage
+    mgr = make_manager(fake_kube, backend, pipeline_transitions=False)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert events == [("stage_at", 0)], (
+        "serial mode must stage only after the drain emptied the node"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kill-at-every-crash-point: exactly one reset per chip, no unsafe readmit
+# ---------------------------------------------------------------------------
+
+
+def _arm_kill(backend, op_name, when="before"):
+    """Replace backend.<op_name> so the NEXT call raises AgentKilled —
+    before or after the real op runs."""
+    real = getattr(backend, op_name)
+    armed = {"live": True}
+
+    def killer(*args, **kwargs):
+        if not armed["live"]:
+            return real(*args, **kwargs)
+        armed["live"] = False
+        if when == "after":
+            real(*args, **kwargs)
+        raise AgentKilled()
+
+    setattr(backend, op_name, killer)
+    return armed
+
+
+CRASH_POINTS = [
+    # (name, op to kill in, before/after the real op)
+    ("during-overlapped-stage", "stage_cc_mode", "before"),
+    ("after-stage-before-reset", "stage_cc_mode", "after"),
+    ("before-device-reset", "reset", "before"),
+    ("after-device-reset", "reset", "after"),
+    ("during-wait-ready", "wait_ready", "before"),
+]
+
+
+@pytest.mark.parametrize("name,op,when", CRASH_POINTS)
+def test_kill_at_crash_point_exactly_one_reset(tmp_path, name, op, when):
+    """A modeled SIGKILL at each pipeline crash point, then a fresh agent
+    replaying the intent journal: the successor converges to the desired
+    mode, every chip reset EXACTLY once across the crash, and no readmit
+    ever released the pause bracket while the hardware was mid-flip."""
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    kube = FakeKube()
+    add_drainable_node(kube)
+    backend = FakeTpuBackend()
+    journal1 = IntentJournal.from_state_dir(str(tmp_path))
+
+    # Every unpause write is checked against hardware truth at that
+    # instant: either the chips all hold the final mode, or nothing
+    # disruptive ever ran (pre-reset rollback).
+    unsafe_readmits = []
+
+    def readmit_guard(node_name, patched):
+        if node_labels(patched).get(DP_LABEL) == "true":
+            committed = dict(backend.committed)
+            resets = reset_ops(backend)
+            safe = (
+                all(v == MODE_ON for v in committed.values())
+                or not resets
+            )
+            if not safe:
+                unsafe_readmits.append((committed, resets))
+
+    kube.add_patch_reactor(readmit_guard)
+
+    mgr1 = make_manager(
+        kube, backend, intent_journal=journal1, state_dir=str(tmp_path),
+    )
+    _arm_kill(backend, op, when)
+    with pytest.raises(AgentKilled):
+        mgr1.set_cc_mode(MODE_ON)
+    # mgr1 is dead. Crash truth: at most one reset so far.
+    resets_after_crash = len(reset_ops(backend))
+    assert resets_after_crash <= 1
+
+    # ---- restart: fresh journal handle, journal replay, reconcile -----
+    journal2 = IntentJournal.from_state_dir(str(tmp_path))
+    mgr2 = make_manager(
+        kube, backend, intent_journal=journal2, state_dir=str(tmp_path),
+    )
+    mgr2.recover_from_journal()
+    assert mgr2.set_cc_mode(MODE_ON) is True, f"crash point {name}"
+
+    counts = chip_reset_counts(backend)
+    assert counts and all(c == 1 for c in counts.values()), (
+        f"crash point {name}: per-chip reset counts {counts} != 1"
+    )
+    assert not journal2.open_intents("transition")
+    assert not journal2.open_intents("drain")
+    labels = node_labels(kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON
+    assert labels[DP_LABEL] == "true", "components must end re-admitted"
+    assert not unsafe_readmits, (
+        f"crash point {name}: readmit released the pause bracket "
+        f"mid-flip: {unsafe_readmits}"
+    )
+
+
+def test_kill_mid_parallel_per_chip_reset(tmp_path):
+    """A kill landing inside the per-chip reset pool (one chip's worker
+    dies before its work): the survivors' chips committed, the killed
+    chip stays staged, and the successor's re-apply resets the REMAINING
+    work without double-resetting any committed chip... the fake promotes
+    per chip, so the property is: after recovery every chip holds the
+    mode and no chip saw more than 2 reset.chip events with at most one
+    effective commit."""
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    kube = FakeKube()
+    add_drainable_node(kube)
+    backend = FakeTpuBackend(
+        reset_latency_s=[0.0, 0.0, 0.0, 0.0], reset_parallelism_override=1,
+    )
+    journal1 = IntentJournal.from_state_dir(str(tmp_path))
+    # Kill chip 2's worker before it runs: serial pool (parallelism 1)
+    # makes the cut deterministic — chips 0,1 committed, 2,3 not.
+    backend.fail["reset.chip2"] = 1
+    real_fail = backend._maybe_fail
+
+    def kill_fail(op):
+        if op == "reset.chip2" and backend.fail.get(op):
+            backend.fail[op] = 0
+            raise AgentKilled()
+        real_fail(op)
+
+    backend._maybe_fail = kill_fail
+    mgr1 = make_manager(
+        kube, backend, intent_journal=journal1, state_dir=str(tmp_path),
+    )
+    with pytest.raises(AgentKilled):
+        mgr1.set_cc_mode(MODE_ON)
+    committed_mid = dict(backend.committed)
+    assert committed_mid[0] == MODE_ON and committed_mid[1] == MODE_ON
+    # Chip 2's worker died before its commit. (Chip 3's already-queued
+    # worker may still have run — the in-process kill model cannot stop
+    # the pool's other threads the way a real SIGKILL would; the
+    # invariant under test is that the KILLED chip never half-commits.)
+    assert committed_mid[2] == MODE_OFF
+    # The journal holds the open reset-phase intent.
+    assert journal1.open_intents("transition")[0]["phase"] == "reset"
+
+    journal2 = IntentJournal.from_state_dir(str(tmp_path))
+    mgr2 = make_manager(
+        kube, backend, intent_journal=journal2, state_dir=str(tmp_path),
+    )
+    mgr2.recover_from_journal()
+    assert mgr2.set_cc_mode(MODE_ON) is True
+    assert all(v == MODE_ON for v in backend.committed.values())
+    assert not journal2.open_intents("transition")
+    labels = node_labels(kube.get_node(NODE))
+    assert labels[CC_MODE_STATE_LABEL] == MODE_ON
+
+
+# ---------------------------------------------------------------------------
+# Attestation-digest smoke fast path
+# ---------------------------------------------------------------------------
+
+
+def smoke_counter():
+    calls = []
+
+    def runner(workload):
+        calls.append(workload)
+        return {"ok": True}
+
+    return calls, runner
+
+
+def test_digest_fastpath_skips_smoke_on_unchanged_digest(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    calls, runner = smoke_counter()
+    registry = MetricsRegistry()
+    mgr = make_manager(
+        fake_kube, backend, evict_components=False,
+        smoke_workload="matmul", smoke_runner=runner,
+        smoke_digest_fastpath=True, state_dir=str(tmp_path),
+        metrics=registry,
+    )
+    # First flip: no record -> full smoke ("cold"), digest persisted.
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert calls == ["matmul"]
+    record = json.loads(
+        (tmp_path / "verified_digest.json").read_text()
+    )
+    assert record["mode"] == MODE_ON and record["digest"]
+    # Bounce through off (full smoke — no quote for mode off), then back
+    # on: unchanged digest -> attest-only verify, smoke SKIPPED.
+    assert mgr.set_cc_mode(MODE_OFF) is True
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert calls == ["matmul", "matmul"], (
+        f"expected the second 'on' flip to skip the smoke, got {calls}"
+    )
+    totals = registry.smoke_fastpath_totals()
+    assert totals.get("cold") == 1 and totals.get("hit") == 1
+    text = registry.render_prometheus()
+    assert 'tpu_cc_smoke_fastpath_total{outcome="hit"} 1' in text
+
+
+def test_digest_fastpath_changed_digest_runs_full_smoke(fake_kube, tmp_path):
+    """A CHANGED runtime digest (runtime update between flips) must always
+    fall through to the full smoke — and re-persist the new digest."""
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    calls, runner = smoke_counter()
+    registry = MetricsRegistry()
+    mgr = make_manager(
+        fake_kube, backend, evict_components=False,
+        smoke_workload="matmul", smoke_runner=runner,
+        smoke_digest_fastpath=True, state_dir=str(tmp_path),
+        metrics=registry,
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    old_digest = json.loads(
+        (tmp_path / "verified_digest.json").read_text()
+    )["digest"]
+    # The runtime updates underneath (libtpu roll): the fake's measured
+    # digest changes, re-signed so attestation still verifies.
+    real_attest = backend.fetch_attestation
+
+    def updated_runtime_attest(nonce):
+        quote = real_attest(nonce)
+        measurements = dict(quote.measurements)
+        measurements["runtime_digest"] = "updated-runtime-build"
+        return dataclasses.replace(
+            quote,
+            measurements=measurements,
+            signature=sign_fake_quote(
+                quote.slice_id, nonce, quote.mode, measurements
+            ),
+        )
+
+    backend.fetch_attestation = updated_runtime_attest
+    assert mgr.set_cc_mode(MODE_OFF) is True
+    assert mgr.set_cc_mode(MODE_ON) is True
+    # off-flip smoke + the changed-digest on-flip smoke: NO skip.
+    assert calls == ["matmul", "matmul", "matmul"]
+    assert registry.smoke_fastpath_totals().get("miss") == 1
+    new_digest = json.loads(
+        (tmp_path / "verified_digest.json").read_text()
+    )["digest"]
+    assert new_digest != old_digest, "full smoke must re-persist the digest"
+    # And the NEXT flip on the updated runtime hits.
+    assert mgr.set_cc_mode(MODE_OFF) is True
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert registry.smoke_fastpath_totals().get("hit") == 1
+
+
+def test_digest_fastpath_off_by_default(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    calls, runner = smoke_counter()
+    mgr = make_manager(
+        fake_kube, backend, evict_components=False,
+        smoke_workload="matmul", smoke_runner=runner,
+        state_dir=str(tmp_path),
+    )
+    assert mgr.smoke_digest_fastpath is False
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert mgr.set_cc_mode(MODE_OFF) is True
+    assert mgr.set_cc_mode(MODE_ON) is True
+    # Every flip ran its smoke; the persisted digest (written regardless,
+    # so enabling the env later hits immediately) skipped nothing.
+    assert calls == ["matmul"] * 3
+
+
+def test_failed_smoke_does_not_persist_digest(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+
+    def failing(workload):
+        raise RuntimeError("bad numerics")
+
+    mgr = make_manager(
+        fake_kube, backend, evict_components=False,
+        smoke_workload="matmul", smoke_runner=failing,
+        smoke_digest_fastpath=True, state_dir=str(tmp_path),
+    )
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert not os.path.exists(tmp_path / "verified_digest.json"), (
+        "a failed smoke must never mint a verified digest"
+    )
+
+
+def test_digest_fastpath_garbled_record_falls_through(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    calls, runner = smoke_counter()
+    (tmp_path / "verified_digest.json").write_text("not json{")
+    mgr = make_manager(
+        fake_kube, backend, evict_components=False,
+        smoke_workload="matmul", smoke_runner=runner,
+        smoke_digest_fastpath=True, state_dir=str(tmp_path),
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert calls == ["matmul"], "garbled record must mean full smoke"
+
+
+def test_attest_prep_overlaps_wait_ready(fake_kube):
+    """prepare_attestation (the tpuvm measured-file hash warm-up) is
+    invoked while wait_ready is still polling."""
+    add_drainable_node(fake_kube)
+    backend = FakeTpuBackend(boot_latency_s=0.1)
+    state = {}
+    real_wait = backend.wait_ready
+
+    def prep():
+        # Runs on the prep worker concurrently with tracking_wait: it
+        # must OBSERVE the boot wait in flight (0.1 s window) — a serial
+        # prep (before or after wait_ready) never sees waiting=True.
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if state.get("waiting"):
+                state["prep_during_boot"] = True
+                return
+            time.sleep(0.005)
+        state["prep_during_boot"] = False
+
+    def tracking_wait(chips, timeout_s):
+        state["waiting"] = True
+        real_wait(chips, timeout_s)
+        state["waiting"] = False
+
+    backend.prepare_attestation = prep
+    backend.wait_ready = tracking_wait
+    mgr = make_manager(fake_kube, backend)
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert state.get("prep_during_boot") is True, (
+        "attestation prep must run during the boot wait"
+    )

@@ -665,3 +665,171 @@ class TestDeviceCmdBreaker:
         assert runs["n"] == 1
         # Crash-as-retry: pending markers stayed behind.
         assert backend.query_cc_mode(topo.chips[0]) == "resetting"
+
+
+class TestPerChipReset:
+    """Per-chip parallel reset (the pipelined transition's 30 s-floor
+    attack): the fake's independently configurable per-chip delays make
+    the speedup measurable deterministically, and the tpuvm per-chip
+    command path preserves the pending/staged crash ordering."""
+
+    def test_fake_per_chip_parallel_wall_time(self):
+        backend = FakeTpuBackend(
+            reset_latency_s=[0.15, 0.15, 0.15, 0.15],
+            reset_parallelism_override=4,
+        )
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        import time as _time
+
+        t0 = _time.monotonic()
+        backend.reset(topo.chips)
+        wall = _time.monotonic() - t0
+        # 4 × 0.15 s of work in a 4-wide pool: one chip's latency of wall
+        # time, far under the 0.6 s serial sum.
+        assert wall < 0.45, f"parallel reset took {wall:.3f}s"
+        assert all(backend.query_cc_mode(c) == MODE_ON for c in topo.chips)
+        assert [op for op, _ in backend.op_log].count("reset.chip") == 4
+
+    def test_fake_per_chip_serial_with_parallelism_one(self):
+        backend = FakeTpuBackend(
+            reset_latency_s=[0.05, 0.05, 0.05, 0.05],
+            reset_parallelism_override=1,
+        )
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        import time as _time
+
+        t0 = _time.monotonic()
+        backend.reset(topo.chips)
+        wall = _time.monotonic() - t0
+        assert wall >= 0.2, f"serial walk must pay the sum, got {wall:.3f}s"
+
+    def test_fake_per_chip_boot_delays_independent(self):
+        """Per-chip wait_ready delays configurable independently of the
+        reset delays (ISSUE 8 satellite): one slow-booting chip owns the
+        wait_ready tail."""
+        backend = FakeTpuBackend(
+            reset_latency_s=[0.0, 0.0, 0.0, 0.0],
+            boot_latency_s=[0.0, 0.0, 0.0, 0.2],
+            reset_parallelism_override=4,
+        )
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        backend.reset(topo.chips)
+        import time as _time
+
+        t0 = _time.monotonic()
+        backend.wait_ready(topo.chips, timeout_s=2)
+        wall = _time.monotonic() - t0
+        assert 0.15 <= wall < 1.0
+
+    def test_fake_per_chip_failure_keeps_unreset_chips_staged(self):
+        backend = FakeTpuBackend(
+            reset_latency_s=[0.0] * 4, reset_parallelism_override=1,
+        )
+        backend.fail_next("reset.chip2")
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        with pytest.raises(TpuError):
+            backend.reset(topo.chips)
+        # Chip 2 never committed; its staged entry survives for the retry.
+        assert backend.committed[2] == MODE_OFF
+        assert backend.staged.get(2) == MODE_ON
+        # The retry converges.
+        backend.reset(topo.chips)
+        assert all(backend.query_cc_mode(c) == MODE_ON for c in topo.chips)
+
+    @pytest.fixture()
+    def vm_backend(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-8")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        monkeypatch.delenv("TPU_SLICE_ID", raising=False)
+        devdir = tmp_path / "dev"
+        devdir.mkdir()
+        for i in range(4):
+            (devdir / f"accel{i}").touch()
+        marker_dir = tmp_path / "markers"
+        marker_dir.mkdir()
+        import sys as _sys
+
+        return TpuVmBackend(
+            state_dir=str(tmp_path / "state"),
+            reset_cmd=["true"],
+            show_cmd=[],
+            metadata_url="http://127.0.0.1:1",
+            device_glob=str(devdir / "accel*"),
+            per_chip_reset_cmd=[
+                _sys.executable, "-c",
+                "import sys; open(sys.argv[1] + '/chip' + sys.argv[2], 'w')"
+                ".write(open(sys.argv[3]).read())",
+                str(marker_dir), "{index}",
+                str(tmp_path / "state" / "pending.json"),
+            ],
+        ), marker_dir
+
+    def test_tpuvm_per_chip_commands_run_per_chip(self, vm_backend):
+        backend, marker_dir = vm_backend
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        backend.reset(topo.chips)
+        # One command per chip ran, with {index} substituted.
+        markers = sorted(os.listdir(marker_dir))
+        assert markers == ["chip0", "chip1", "chip2", "chip3"]
+        # Crash ordering: every chip's command saw the PENDING markers
+        # already durable (the command copies pending.json's content).
+        import json as _json
+
+        for marker in markers:
+            pending_seen = _json.loads((marker_dir / marker).read_text())
+            assert set(pending_seen) == {"0", "1", "2", "3"}
+            assert set(pending_seen.values()) == {MODE_ON}
+        # Committed promoted, pending cleared.
+        assert all(backend.query_cc_mode(c) == MODE_ON for c in topo.chips)
+
+    def test_tpuvm_per_chip_command_failure_keeps_resetting(self, vm_backend):
+        backend, _ = vm_backend
+        import sys as _sys
+
+        backend.per_chip_reset_cmd = [
+            _sys.executable, "-c",
+            "import sys; sys.exit(1 if sys.argv[1] == '2' else 0)",
+            "{index}",
+        ]
+        backend.retry_policy.max_attempts = 1  # no classified retry here
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        with pytest.raises(TpuError, match="chip"):
+            backend.reset(topo.chips)
+        # Pending markers stayed: every chip reads 'resetting' and the
+        # reconcile's crash-as-retry re-applies.
+        assert backend.query_cc_mode(topo.chips[0]) == "resetting"
+
+    def test_tpuvm_prepare_attestation_warms_hash_cache(self, tmp_path):
+        measured = tmp_path / "libtpu.so"
+        measured.write_bytes(b"fake-libtpu" * 64)
+        backend = TpuVmBackend(
+            state_dir=str(tmp_path / "state"),
+            reset_cmd=["true"],
+            show_cmd=[],
+            metadata_url="http://127.0.0.1:1",
+            measure_globs=[str(measured)],
+        )
+        assert backend._file_hash_cache == {}
+        backend.prepare_attestation()  # overlapped with wait_ready by the manager
+        assert str(measured) in backend._file_hash_cache
+
+    def test_tpuvm_per_chip_refuses_host_global_runtime_env(
+        self, vm_backend, tmp_path
+    ):
+        """CC_RESET_PER_CHIP_CMD + CC_RUNTIME_ENV_FILE are incompatible by
+        construction (host-global mode env needs a host-global restart):
+        reset() refuses loudly BEFORE minting any 'resetting' markers."""
+        backend, _ = vm_backend
+        backend.runtime_env_file = str(tmp_path / "tpu-runtime.env")
+        topo = backend.discover()
+        backend.stage_cc_mode(topo.chips, MODE_ON)
+        with pytest.raises(TpuError, match="incompatible"):
+            backend.reset(topo.chips)
+        # No pending markers: the misconfiguration is stable, not a crash.
+        assert backend.query_cc_mode(topo.chips[0]) == MODE_OFF

@@ -88,6 +88,12 @@ DEFAULT_OFFLINE_GRACE_S = 60.0
 PHASE_BEGUN = "begun"
 PHASE_STAGED = "staged"
 PHASE_RESET = "reset"
+#: Mark on a DRAIN intent: the pipelined readmit was kicked off while
+#: the smoke workload runs (readmit ∥ smoke). Purely diagnostic — drain
+#: recovery keys on the intent being open, not its phase — but a
+#: crash-dump reader can tell "died before any readmit started" from
+#: "died with the readmit in flight".
+PHASE_READMIT = "readmit"
 
 # Intent kinds (the ``kind`` field of t=intent records).
 KIND_TRANSITION = "transition"

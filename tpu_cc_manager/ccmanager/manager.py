@@ -92,6 +92,79 @@ DEFAULT_PREEMPTION_POLL_S = 5.0
 HANDOFF_ANNOTATION = "cloud.google.com/tpu-cc.handoff"
 
 
+class _PipelineTask:
+    """One overlapped pipeline step on a worker thread, with the caller's
+    trace context propagated so its phase spans nest under the reconcile
+    root. ``join()`` re-raises whatever escaped the step — BaseException
+    included, so a modeled SIGKILL inside an overlapped step unwinds the
+    main pipeline exactly like one on the serial path (intent left open,
+    no except-Exception cleanup)."""
+
+    def __init__(self, name: str, fn: Callable[[], None]) -> None:
+        self._error: BaseException | None = None
+
+        def run() -> None:
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 - re-raised at join
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=trace_mod.in_current_context(run),
+            name=f"cc-pipeline-{name}", daemon=True,
+        )
+        self._thread.start()
+
+    def join(self) -> None:
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+
+    def join_quiet(self) -> BaseException | None:
+        """Join without raising; returns the captured error (the caller
+        is already on a failure path and must not mask its own cause)."""
+        self._thread.join()
+        return self._error
+
+
+class _ReadmitOnce:
+    """Runs the readmit bracket exactly once — either early, overlapped
+    with the smoke workload (``start_async``), or synchronously from the
+    owner's finally (``finish``). ``finish`` always represents the
+    bracket's true outcome: it joins an early run and re-raises its
+    failure, so the caller's drain-intent close still only happens after
+    a readmit that actually succeeded."""
+
+    _SYNC = object()  # claimed by finish(); any later start_async no-ops
+
+    def __init__(
+        self, fn: Callable[[], None],
+        on_start: Callable[[], None] | None = None,
+    ) -> None:
+        self._fn = fn
+        self._on_start = on_start
+        self._task: object | None = None
+        self._lock = threading.Lock()
+
+    def start_async(self) -> None:
+        with self._lock:
+            if self._task is not None:
+                return
+            if self._on_start is not None:
+                self._on_start()
+            self._task = _PipelineTask("readmit", self._fn)
+
+    def finish(self) -> None:
+        with self._lock:
+            task = self._task
+            if task is None:
+                self._task = self._SYNC
+        if isinstance(task, _PipelineTask):
+            task.join()
+        elif task is None:
+            self._fn()
+
+
 class CCManager:
     def __init__(
         self,
@@ -126,6 +199,9 @@ class CCManager:
         use_slice_informer: bool | None = None,
         preemption_deadline_s: float | None = None,
         preemption_poll_s: float | None = None,
+        pipeline_transitions: bool | None = None,
+        smoke_digest_fastpath: bool | None = None,
+        state_dir: str | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -291,6 +367,38 @@ class CCManager:
                 )
             )
         self.preemption_poll_s = preemption_poll_s
+        # Pipelined transitions (default on; CC_PIPELINE_TRANSITIONS=0
+        # restores the fully serial reference ordering): stage (and the
+        # slice barrier's staged publication) overlaps the pod-drain
+        # bracket, attestation prep overlaps wait_ready, and re-admission
+        # overlaps the smoke workload. The hard orderings are untouched —
+        # this host never resets before its own drain completed, and the
+        # drain intent closes only after readmit actually succeeded.
+        if pipeline_transitions is None:
+            pipeline_transitions = os.environ.get(
+                "CC_PIPELINE_TRANSITIONS", "1"
+            ).lower() not in ("0", "false", "no")
+        self.pipeline_transitions = pipeline_transitions
+        # Attestation-digest smoke fast path (CC_SMOKE_DIGEST_FAST_PATH,
+        # default off): when a flip lands on a runtime whose measured
+        # digest equals the last digest a FULL smoke verified, the smoke
+        # is skipped in favor of the attest-only verify. A changed digest
+        # always falls through to the full smoke.
+        if smoke_digest_fastpath is None:
+            smoke_digest_fastpath = os.environ.get(
+                "CC_SMOKE_DIGEST_FAST_PATH", ""
+            ).lower() in ("true", "1", "yes")
+        self.smoke_digest_fastpath = smoke_digest_fastpath
+        # Where the verified-digest record lives (the backend state dir,
+        # like the intent journal); None disables persistence — the fast
+        # path then never has a digest on record and every flip runs the
+        # full smoke.
+        if state_dir is None:
+            state_dir = (
+                os.environ.get("CC_STATE_DIR")
+                or getattr(backend, "state_dir", None)
+            )
+        self._state_dir = state_dir
         self._preemption_stop: threading.Event | None = None
         self._preemption_thread: threading.Thread | None = None
         self._preemption_handled = False
@@ -851,18 +959,42 @@ class CCManager:
         m: metrics_mod.ReconcileMetrics,
         barrier: slicecoord.SliceBarrier | None = None,
     ) -> bool:
-        """Drain, reconfigure, re-admit (reference main.py:544-578).
+        """Drain, reconfigure, re-admit (reference main.py:544-578),
+        pipelined (unless CC_PIPELINE_TRANSITIONS=0): staging — a pure
+        staged.json write touching no workload-visible hardware — runs
+        CONCURRENTLY with the pod-drain bracket. The hard orderings are
+        untouched: this host's reset still waits for both the drain AND
+        the stage to complete, and on multi-host slices the barrier's
+        staged marker is only published AFTER the drain (the marker means
+        "staged and drained"; publishing it mid-drain would let peers
+        half-bounce the fabric under a strict drain that then fails), so
+        no reset ever runs under undrained workloads anywhere in the
+        slice.
 
-        Re-admission runs even when the reconfigure fails, so components are
-        never left paused by a failed toggle — including a strict-mode drain
-        timeout, which fails the reconcile without touching the hardware.
+        Re-admission runs even when the reconfigure fails, so components
+        are never left paused by a failed toggle — including a strict-mode
+        drain timeout, which fails the reconcile with the staging rolled
+        back and no disruptive hardware touched. On the happy path the
+        readmit bracket is kicked off while the smoke workload runs
+        (_apply_direct), and ``readmit.finish()`` below joins it — its
+        true outcome still gates the drain-intent close.
 
         The drain bracket is journaled intent→commit around pause/readmit:
         a crash (or SIGKILL) between the pause landing and re-admission
         leaves the intent open, and journal replay restores the paused set
         at the next boot even when the apiserver read that used to reveal
-        the stranding is unavailable."""
+        the stranding is unavailable. The transition intent begins BEFORE
+        the overlapped stage (write-ahead), so a crash anywhere in the
+        drain window replays as a clean pre-reset rollback."""
         dtxn = self._journal_begin("drain", mode=mode)
+        txn = None
+        stage_task: _PipelineTask | None = None
+        if self.pipeline_transitions:
+            txn = self._begin_transition_intent(topo, chips, mode)
+            stage_task = _PipelineTask(
+                "stage",
+                lambda: self._stage_for_pipeline(chips, mode, m, txn),
+            )
         try:
             with m.phase(metrics_mod.PHASE_DRAIN):
                 original = evict.evict_components(
@@ -876,6 +1008,9 @@ class CCManager:
                 )
         except evict.EvictionTimeout as e:
             log.error("strict eviction failed: %s — not touching hardware", e)
+            self._unwind_pipelined_stage(stage_task, chips, txn,
+                                         reason="drain-timeout")
+            txn = None
             m.result = "failed"
             self._record_failure("drain-timeout")
             self._emit_node_event(
@@ -891,15 +1026,39 @@ class CCManager:
                     evict.readmit_components(self.api, self.node_name, e.original)
                 self._journal_close(dtxn, ok=True, outcome="drain-timeout")
             return False
-        # Any other exception escaping the drain (e.g. a transport error
-        # during the pod wait, AFTER the pause patch landed) leaves the
-        # intent OPEN on purpose: components may genuinely be paused, and
-        # replay's recovery readmit is a no-op when they are not.
+        except BaseException:
+            # Any other exception escaping the drain (e.g. a transport
+            # error during the pod wait, AFTER the pause patch landed)
+            # leaves the drain intent OPEN on purpose: components may
+            # genuinely be paused, and replay's recovery readmit is a
+            # no-op when they are not. The overlapped stage thread must
+            # not outlive the reconcile, and the open transition intent
+            # (phase begun/staged) replays as a clean rollback.
+            if stage_task is not None:
+                stage_err = stage_task.join_quiet()
+                if stage_err is not None:
+                    log.warning(
+                        "overlapped stage also failed during the aborted "
+                        "drain: %s", stage_err,
+                    )
+            self._inflight_transition = None
+            raise
+        # Re-admission is kicked off by _apply_direct while the smoke
+        # workload runs (readmit ∥ smoke); finish() below joins it — or
+        # runs it synchronously when the pipeline never got that far.
+        readmit = _ReadmitOnce(
+            lambda: self._readmit_bracket(m, original),
+            on_start=lambda: self._journal_mark(
+                dtxn, intent_mod.PHASE_READMIT
+            ),
+        )
         try:
-            return self._apply_direct(topo, chips, mode, m, barrier)
+            return self._apply_direct(
+                topo, chips, mode, m, barrier,
+                txn=txn, stage_task=stage_task, readmit=readmit,
+            )
         finally:
-            with m.phase(metrics_mod.PHASE_READMIT):
-                evict.readmit_components(self.api, self.node_name, original)
+            readmit.finish()
             # Only after a SUCCESSFUL readmit (a readmit aborted by an
             # apiserver error must leave the intent open for replay); the
             # restore covered any stranding, so older leftover drain
@@ -911,34 +1070,19 @@ class CCManager:
                 except intent_mod.JournalError as err:
                     log.warning("could not close drain intents: %s", err)
 
-    def _apply_direct(
+    def _begin_transition_intent(
         self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
-        m: metrics_mod.ReconcileMetrics,
-        barrier: slicecoord.SliceBarrier | None = None,
-    ) -> bool:
-        """The phased hardware transition (reference main.py:449-542,
-        restructured: slice atomicity is structural in the backend contract,
-        and verify is upgraded with attestation + smoke).
-
-        On a multi-host slice, ANY mode change disrupts the whole ICI
-        domain, so the reset is gated behind the slice-wide commit barrier
-        (``barrier``, built by set_cc_mode): no host resets before every
-        host of the slice is staged and drained — the cross-host
-        generalization of the reference's PPCIe stage-all/reset-all fabric
-        atomicity (main.py:362-368). Barrier COMPLETION (marker cleanup,
-        the leader's bounded wait for peers) happens in set_cc_mode after
-        re-admission, so it never extends the drain window."""
-        # Write-ahead intent: the journal record lands (fsync'd) BEFORE the
-        # first hardware-effecting step, so a crash anywhere in the
-        # pipeline restarts with a local record of exactly what was in
-        # flight — phase marks tell replay whether the disruptive reset
-        # had begun (roll back) or may have landed (ask the hardware).
+    ) -> str | None:
+        """Write-ahead intent: the journal record lands (fsync'd) BEFORE
+        the first hardware-effecting step, so a crash anywhere in the
+        pipeline restarts with a local record of exactly what was in
+        flight — phase marks tell replay whether the disruptive reset had
+        begun (roll back) or may have landed (ask the hardware). Also
+        publishes the in-flight record the preemption monitor thread
+        hands off to a replacement node (handle_preemption_notice)."""
         txn = self._journal_begin(
             "transition", mode=mode, chips=[c.index for c in chips],
         )
-        # Visible to the preemption monitor thread: if a notice lands
-        # anywhere in this pipeline, the handler hands THIS transition
-        # off to the replacement node (handle_preemption_notice).
         self._inflight_transition = {
             "mode": mode,
             "chips": [c.index for c in chips],
@@ -946,11 +1090,113 @@ class CCManager:
             "slice_id": topo.slice_id,
             "multi_host": topo.is_multi_host,
         }
+        return txn
+
+    def _readmit_bracket(self, m: metrics_mod.ReconcileMetrics,
+                         original: dict) -> None:
+        with m.phase(metrics_mod.PHASE_READMIT):
+            evict.readmit_components(self.api, self.node_name, original)
+
+    def _stage_for_pipeline(
+        self, chips: tuple[TpuChip, ...], mode: str,
+        m: metrics_mod.ReconcileMetrics,
+        txn: str | None,
+    ) -> None:
+        """The overlapped half of stage-during-drain: stage the chips —
+        a pure staged.json write, no workload-visible hardware.
+
+        Deliberately NOT overlapped: the slice barrier's staged-marker
+        publication. The marker means "this host is staged AND DRAINED";
+        publishing it mid-drain would let the leader commit — and peers
+        reset, disrupting the whole ICI fabric — while this host's pods
+        are still draining (or while a strict drain is about to fail
+        without ever touching hardware). It is published at drain-join
+        in _apply_direct, exactly as honest as before."""
+        with m.phase(metrics_mod.PHASE_STAGE):
+            self.backend.stage_cc_mode(chips, mode)
+        self._journal_mark(txn, intent_mod.PHASE_STAGED)
+        inflight = self._inflight_transition
+        if inflight is not None:
+            inflight["phase"] = intent_mod.PHASE_STAGED
+
+    def _unwind_pipelined_stage(
+        self, stage_task: _PipelineTask | None,
+        chips: tuple[TpuChip, ...],
+        txn: str | None,
+        reason: str,
+    ) -> None:
+        """Roll an overlapped stage back out on a pre-hardware failure
+        (strict drain timeout): nothing disruptive ran — and no barrier
+        marker was published (publication waits for the drain) — so the
+        clean exit is clear_staged + an aborted intent, the same shape
+        journal replay produces for a pre-reset crash."""
+        if stage_task is None:
+            self._journal_close(txn, ok=False, reason=reason)
+            self._inflight_transition = None
+            return
+        stage_err = stage_task.join_quiet()
+        if stage_err is not None:
+            log.warning("overlapped stage failed (%s); rolling back anyway",
+                        stage_err)
         try:
-            with m.phase(metrics_mod.PHASE_STAGE):
-                self.backend.stage_cc_mode(chips, mode)
-            self._journal_mark(txn, intent_mod.PHASE_STAGED)
-            self._inflight_transition["phase"] = intent_mod.PHASE_STAGED
+            self.backend.clear_staged(chips)
+        except TpuError as e:
+            log.warning("could not clear staged mode during unwind: %s", e)
+        self._journal_close(txn, ok=False, reason=reason)
+        self._inflight_transition = None
+
+    def _apply_direct(
+        self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
+        m: metrics_mod.ReconcileMetrics,
+        barrier: slicecoord.SliceBarrier | None = None,
+        txn: str | None = None,
+        stage_task: _PipelineTask | None = None,
+        readmit: _ReadmitOnce | None = None,
+    ) -> bool:
+        """The phased hardware transition (reference main.py:449-542,
+        restructured: slice atomicity is structural in the backend contract,
+        and verify is upgraded with attestation + smoke), pipelined where
+        the contract allows:
+
+        - ``stage_task`` (from _apply_with_eviction) means the stage (and
+          multi-host staged publication) already ran overlapped with the
+          drain; it is joined here — strictly before any barrier wait or
+          reset — so stage/publish failures surface exactly like serial
+          ones and a modeled SIGKILL in the overlapped step unwinds as a
+          crash.
+        - attestation prep (measured-file hashing) overlaps wait_ready.
+        - ``readmit`` (when provided) is kicked off right before the smoke
+          workload: re-admission is pure apiserver label writes and the
+          hardware transition is already committed and attested by then.
+        - the attestation-digest fast path (CC_SMOKE_DIGEST_FAST_PATH)
+          skips the full smoke when the verified runtime digest is
+          unchanged since the last full-smoke-verified flip.
+
+        On a multi-host slice, ANY mode change disrupts the whole ICI
+        domain, so the reset is gated behind the slice-wide commit barrier
+        (``barrier``, built by set_cc_mode): no host resets before every
+        host of the slice is staged — the cross-host generalization of the
+        reference's PPCIe stage-all/reset-all fabric atomicity
+        (main.py:362-368) — and never before its OWN drain completed.
+        Barrier COMPLETION (marker cleanup, the leader's bounded wait for
+        peers) happens in set_cc_mode after re-admission, so it never
+        extends the drain window."""
+        if txn is None:
+            # The pipelined evict path began the intent before the drain;
+            # the serial/direct path begins it here.
+            txn = self._begin_transition_intent(topo, chips, mode)
+        try:
+            if stage_task is not None:
+                # Joined strictly before the staged publication, the
+                # barrier wait and the reset: the drain has already
+                # completed by the time we are called, so the published
+                # marker's "staged and drained" claim is true.
+                stage_task.join()
+            else:
+                with m.phase(metrics_mod.PHASE_STAGE):
+                    self.backend.stage_cc_mode(chips, mode)
+                self._journal_mark(txn, intent_mod.PHASE_STAGED)
+                self._inflight_transition["phase"] = intent_mod.PHASE_STAGED
             if barrier is not None:
                 with m.phase(metrics_mod.PHASE_BARRIER):
                     barrier.publish_staged(mode)
@@ -959,8 +1205,22 @@ class CCManager:
             self._inflight_transition["phase"] = intent_mod.PHASE_RESET
             with m.phase(metrics_mod.PHASE_RESET):
                 self.backend.reset(chips)
-            with m.phase(metrics_mod.PHASE_WAIT_READY):
-                self.backend.wait_ready(chips, self.ready_timeout_s)
+            # Attestation prep (tpuvm: hashing an O(100 MB) libtpu into
+            # the measured-file memo) needs nothing from the post-reset
+            # runtime — overlap it with the boot wait. Advisory: a prep
+            # failure is swallowed; fetch_attestation re-does the work.
+            prep_task = None
+            if self.pipeline_transitions and mode != MODE_OFF:
+                prep_task = _PipelineTask("attest-prep", self._attest_prep)
+            try:
+                with m.phase(metrics_mod.PHASE_WAIT_READY):
+                    self.backend.wait_ready(chips, self.ready_timeout_s)
+            finally:
+                if prep_task is not None:
+                    prep_err = prep_task.join_quiet()
+                    if prep_err is not None:
+                        log.debug("attestation prep failed (advisory): %s",
+                                  prep_err)
             # Verify 1: committed mode matches (reference main.py:524-528).
             for chip in chips:
                 got = self.backend.query_cc_mode(chip)
@@ -989,10 +1249,27 @@ class CCManager:
                         debug_policy=(mode == MODE_DEVTOOLS),
                         allow_fake=self.allow_fake_quotes,
                     )
-            # Verify 3: end-to-end JAX smoke workload (new).
-            if self.smoke_workload and self.smoke_workload != "none":
+            # Verify 3: end-to-end JAX smoke workload (new), with the
+            # attestation-digest fast path (env-gated, default off): a
+            # flip landing on the exact runtime digest the last FULL
+            # smoke verified may skip the workload — attest-only verify.
+            run_smoke = bool(self.smoke_workload) and self.smoke_workload != "none"
+            fastpath_hit = False
+            if run_smoke and quote is not None and self.smoke_digest_fastpath:
+                fastpath_hit = self._smoke_fastpath_check(quote)
+            if readmit is not None and self.pipeline_transitions:
+                # Safe-to-release point: every chip verifiably holds the
+                # committed mode, the intent is closed, and attestation
+                # passed. Re-admission (pure apiserver label writes) runs
+                # while the smoke compiles/executes; its true outcome is
+                # joined by the owner's finish() before the drain intent
+                # closes.
+                readmit.start_async()
+            if run_smoke and not fastpath_hit:
                 with m.phase(metrics_mod.PHASE_SMOKE):
                     self._run_smoke(self.smoke_workload)
+                if quote is not None:
+                    self._store_verified_digest(quote)
         except Exception as e:  # noqa: BLE001 - reference parity:
             # any failure labels the node 'failed' and keeps the loop alive
             # (main.py:531-538). BaseExceptions (sys.exit, a modeled
@@ -1167,6 +1444,91 @@ class CCManager:
         from tpu_cc_manager.smoke.runner import run_workload_subprocess
 
         return run_workload_subprocess(workload)
+
+    def _attest_prep(self) -> None:
+        """Overlapped attestation prep (runs during wait_ready)."""
+        with trace_mod.span("attest.prep"):
+            self.backend.prepare_attestation()
+
+    # ------------------------------------------------------------------
+    # Attestation-digest smoke fast path (CC_SMOKE_DIGEST_FAST_PATH)
+    # ------------------------------------------------------------------
+
+    def _digest_store_path(self) -> str | None:
+        if not self._state_dir:
+            return None
+        return os.path.join(self._state_dir, "verified_digest.json")
+
+    def _load_verified_digest(self) -> dict | None:
+        path = self._digest_store_path()
+        if path is None:
+            return None
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                record = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            log.warning("unreadable verified-digest record %s: %s", path, e)
+            return None
+        return record if isinstance(record, dict) else None
+
+    def _store_verified_digest(self, quote) -> None:
+        """Persist the runtime measurement digest a FULL smoke just
+        verified (atomic write in the backend state dir). Best-effort:
+        the fast path degrades to 'cold' (full smoke every flip) when it
+        cannot persist — never the other way around."""
+        path = self._digest_store_path()
+        if path is None:
+            return
+        record = {
+            "digest": attestation.quote_digest(quote),
+            "mode": quote.mode,
+            "ts": round(time.time(), 3),
+        }
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(record, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("could not persist the verified digest: %s", e)
+
+    def _smoke_fastpath_check(self, quote) -> bool:
+        """Whether this flip may skip the full smoke: True only when the
+        quote's measurement digest equals the digest the last FULL smoke
+        verified (same mode included — the digest binds cc_mode, but the
+        record is double-checked so a hand-edited file cannot cross
+        modes). Any change — or no record at all — falls through to the
+        full smoke. Counted per outcome in tpu_cc_smoke_fastpath_total."""
+        digest = attestation.quote_digest(quote)
+        stored = self._load_verified_digest()
+        if stored is None:
+            outcome, hit = "cold", False
+        elif (
+            stored.get("digest") == digest
+            and stored.get("mode") == quote.mode
+        ):
+            outcome, hit = "hit", True
+        else:
+            outcome, hit = "miss", False
+        self.metrics.record_smoke_fastpath(outcome)
+        with trace_mod.span(
+            "smoke.fastpath", outcome=outcome, digest=digest[:12],
+        ):
+            if hit:
+                log.info(
+                    "smoke fast path: runtime digest %s… unchanged since "
+                    "the last full-smoke verify; skipping the %s workload "
+                    "(attest-only verify)", digest[:12], self.smoke_workload,
+                )
+            else:
+                log.info(
+                    "smoke fast path: %s (digest %s…); running the full "
+                    "smoke", outcome, digest[:12],
+                )
+        return hit
 
     # ------------------------------------------------------------------
     # Intent-journal boot recovery (before the first apiserver read)

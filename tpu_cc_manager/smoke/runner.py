@@ -57,25 +57,31 @@ def run_workload_subprocess(
     force_cpu: bool = False,
     cwd: str | None = None,
     extra_args: list[str] | None = None,
+    extra_env: dict[str, str] | None = None,
 ) -> dict:
     """Run a workload as ``python -m tpu_cc_manager.smoke`` and parse the
     final JSON line from its stdout.
 
     ``force_cpu`` pins the child to the CPU backend (and strips the image's
     TPU-tunnel trigger variable) — the bench scripts use it when the
-    accelerator failed preflight. This is the single subprocess-smoke
-    contract; bench.py and bench_ab.py import it rather than keeping
-    copies in sync.
+    accelerator failed preflight. ``extra_env`` overlays the child's
+    environment (the cold/warm compilation-cache bench points
+    JAX_COMPILATION_CACHE_DIR at its own directory this way). This is the
+    single subprocess-smoke contract; bench.py and bench_ab.py import it
+    rather than keeping copies in sync.
     """
     if name not in WORKLOADS:
         raise SmokeError(f"unknown smoke workload {name!r} (have {sorted(WORKLOADS)})")
     env = None
-    if force_cpu:
+    if force_cpu or extra_env:
         import os
 
         env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
+        if force_cpu:
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        if extra_env:
+            env.update(extra_env)
     cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", name]
     if extra_args:
         cmd.extend(extra_args)

@@ -19,7 +19,44 @@ checks it (SURVEY.md §3.4 "TPU mapping").
 from __future__ import annotations
 
 import abc
+import os
 from dataclasses import dataclass, field
+
+# Bounded worker-pool width for backends that fan per-chip reset work out
+# in parallel (tpuvm's per-chip reset commands, the fake's per-chip
+# latencies). The pool is bounded — a 8-chip host must not spawn 8
+# concurrent device commands against a driver that serializes them anyway
+# — and 1 restores the fully serial walk.
+DEFAULT_RESET_PARALLELISM = 4
+RESET_PARALLELISM_ENV = "CC_RESET_PARALLELISM"
+
+
+def reset_parallelism(default: int = DEFAULT_RESET_PARALLELISM) -> int:
+    """The configured per-chip reset fan-out width (>=1)."""
+    try:
+        value = int(os.environ.get(RESET_PARALLELISM_ENV, "") or default)
+    except ValueError:
+        value = default
+    return max(1, value)
+
+
+def raise_pool_errors(errors: list, what: str = "per-chip reset") -> None:
+    """Re-raise the worker errors from a per-chip pool with the right
+    type: a BaseException that is not an Exception (a modeled SIGKILL in
+    tests) must unwind as a CRASH — never laundered into a catchable
+    device error; device errors aggregate into ONE TpuError naming every
+    failed worker (an operator fixing only errors[0]'s chip and retrying
+    into the next failure, one bounce at a time, is the failure mode this
+    exists to avoid)."""
+    if not errors:
+        return
+    for e in errors:
+        if not isinstance(e, Exception):
+            raise e  # crash model: unwind first, diagnosis is moot
+    if len(errors) == 1 and isinstance(errors[0], TpuError):
+        raise errors[0]
+    detail = "; ".join(str(e)[:256] for e in errors)
+    raise TpuError(f"{what} failed on {len(errors)} worker(s): {detail}")
 
 
 class TpuError(Exception):
@@ -162,7 +199,18 @@ class TpuCcBackend(abc.ABC):
     def reset(self, chips: tuple[TpuChip, ...]) -> None:
         """Commit staged modes by resetting the chip set together. The whole
         set goes down at once — fabric atomicity is structural (reference
-        analogue: the reset-all loop, main.py:514-519 / :362-368)."""
+        analogue: the reset-all loop, main.py:514-519 / :362-368).
+
+        Implementations with per-chip reset work may fan it out across a
+        bounded worker pool (:func:`reset_parallelism`,
+        CC_RESET_PARALLELISM) PROVIDED the crash ordering is preserved:
+        the pending/"resetting" markers for every chip land durably
+        before ANY chip's disruptive work starts, and no chip promotes to
+        committed until its own reset verifiably finished — a crash
+        anywhere still reads "resetting" for every touched chip and
+        crash-as-retry re-applies. Per-chip workers should open their own
+        obs span (``reset.chip``) so the bench can compare the pipeline's
+        wall time against the serial-equivalent sum."""
 
     @abc.abstractmethod
     def wait_ready(self, chips: tuple[TpuChip, ...], timeout_s: float) -> None:
@@ -173,6 +221,15 @@ class TpuCcBackend(abc.ABC):
     def fetch_attestation(self, nonce: str) -> AttestationQuote:
         """Produce a quote for the slice's current state bound to ``nonce``.
         New capability — no reference counterpart (SURVEY.md §0(b))."""
+
+    def prepare_attestation(self) -> None:
+        """Warm whatever ``fetch_attestation`` can precompute without the
+        post-reset runtime state (the tpuvm backend hashes an O(100 MB)
+        libtpu into its measured-file memo here). The manager overlaps
+        this with the wait-ready poll so the attest phase after boot pays
+        only the nonce-bound work. Advisory: failures must be swallowed
+        by callers, and the quote fetched later must not depend on this
+        having run. Default: nothing to warm."""
 
     def probe_runtime_health(self) -> HealthProbe:
         """One health probe using the strongest tier this backend has

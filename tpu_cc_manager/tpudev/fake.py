@@ -10,7 +10,11 @@ the full contract with:
 - attestation quotes HMAC-signed with a shared test key, verified by
   :mod:`tpu_cc_manager.tpudev.attestation`,
 - fault injection: fail on stage/reset/wait/attest once or always,
-- latency knobs so bench.py can model realistic reset/boot times.
+- latency knobs so bench.py can model realistic reset/boot times —
+  scalar (one whole-set latency, the legacy shape) or per-chip lists, so
+  the parallel-reset pipeline's speedup is measurable and deterministic
+  in the simulated bench (per-chip work fans out across a bounded pool,
+  each chip in its own ``reset.chip`` obs span).
 """
 
 from __future__ import annotations
@@ -20,8 +24,10 @@ import hmac
 import json
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 from tpu_cc_manager.labels import MODE_OFF
+from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.tpudev.contract import (
     AttestationQuote,
     HealthProbe,
@@ -29,6 +35,8 @@ from tpu_cc_manager.tpudev.contract import (
     TpuCcBackend,
     TpuChip,
     TpuError,
+    raise_pool_errors,
+    reset_parallelism,
 )
 
 # Shared secret for fake quotes; the verifier uses the same constant.
@@ -55,8 +63,9 @@ class FakeTpuBackend(TpuCcBackend):
         cc_supported: bool | list[bool] = True,
         slice_cc_supported: bool | list[bool] = True,
         initial_mode: str = MODE_OFF,
-        reset_latency_s: float = 0.0,
-        boot_latency_s: float = 0.0,
+        reset_latency_s: float | list[float] = 0.0,
+        boot_latency_s: float | list[float] = 0.0,
+        reset_parallelism_override: int | None = None,
     ) -> None:
         def flags(spec, n):
             return list(spec) if isinstance(spec, list) else [spec] * n
@@ -84,8 +93,14 @@ class FakeTpuBackend(TpuCcBackend):
         self.committed: dict[int, str] = {c.index: initial_mode for c in self._chips}
         self.staged: dict[int, str] = {}
         self.booted: dict[int, bool] = {c.index: True for c in self._chips}
+        # Scalar = one latency for the whole set (legacy); a list is
+        # per-chip (index-aligned), independently configurable so the
+        # parallel-reset speedup is measurable deterministically.
         self.reset_latency_s = reset_latency_s
         self.boot_latency_s = boot_latency_s
+        # None -> CC_RESET_PARALLELISM (default 4); only per-chip reset
+        # latencies fan out — a scalar keeps the legacy single sleep.
+        self.reset_parallelism_override = reset_parallelism_override
         self._boot_done_at: dict[int, float] = {}
         # Fault injection: map op name -> remaining failure count (-1 = always).
         self.fail: dict[str, int] = {}
@@ -145,8 +160,56 @@ class FakeTpuBackend(TpuCcBackend):
                 ("clear_staged", tuple(c.index for c in chips))
             )
 
+    def _latency_for(self, spec: float | list[float], index: int) -> float:
+        """Per-chip latency from a scalar-or-list spec (lists are
+        index-aligned; a short list repeats its last value)."""
+        if isinstance(spec, (list, tuple)):
+            if not spec:
+                return 0.0
+            return float(spec[index] if index < len(spec) else spec[-1])
+        return float(spec)
+
+    def _reset_one_chip(self, chip: TpuChip) -> None:
+        """One chip's share of a per-chip reset: its own fault point, its
+        own latency, its own span — and its own committed promotion, so a
+        crash mid-pool leaves untouched chips still staged (crash-as-retry
+        re-applies exactly those)."""
+        self._maybe_fail(f"reset.chip{chip.index}")
+        with obs_trace.span("reset.chip", chip=chip.index):
+            delay = self._latency_for(self.reset_latency_s, chip.index)
+            if delay:
+                time.sleep(delay)
+            with self._lock:
+                if chip.index in self.staged:
+                    self.committed[chip.index] = self.staged.pop(chip.index)
+                self.booted[chip.index] = False
+                self._boot_done_at[chip.index] = time.monotonic() + (
+                    self._latency_for(self.boot_latency_s, chip.index)
+                )
+                self.op_log.append(("reset.chip", chip.index))
+
     def reset(self, chips: tuple[TpuChip, ...]) -> None:
         self._maybe_fail("reset")
+        if isinstance(self.reset_latency_s, (list, tuple)):
+            # Per-chip latencies fan out across a bounded worker pool
+            # (contract: pending state for every chip is already durable —
+            # the manager staged all chips before calling reset — and each
+            # chip promotes only after its own work finishes).
+            workers = self.reset_parallelism_override or reset_parallelism()
+            with ThreadPoolExecutor(
+                max_workers=max(1, min(workers, len(chips)))
+            ) as pool:
+                futures = [
+                    pool.submit(
+                        obs_trace.in_current_context(self._reset_one_chip, c)
+                    )
+                    for c in chips
+                ]
+            raise_pool_errors(
+                [f.exception() for f in futures if f.exception()]
+            )
+            self._finish_reset(chips)
+            return
         if self.reset_latency_s:
             time.sleep(self.reset_latency_s)
         with self._lock:
@@ -155,7 +218,15 @@ class FakeTpuBackend(TpuCcBackend):
                 if chip.index in self.staged:
                     self.committed[chip.index] = self.staged.pop(chip.index)
                 self.booted[chip.index] = False
-                self._boot_done_at[chip.index] = now + self.boot_latency_s
+                self._boot_done_at[chip.index] = now + self._latency_for(
+                    self.boot_latency_s, chip.index
+                )
+        self._finish_reset(chips)
+
+    def _finish_reset(self, chips: tuple[TpuChip, ...]) -> None:
+        """Shared reset epilogue (runtime env + the whole-set op-log entry
+        ordering tests key on)."""
+        with self._lock:
             modes = sorted(set(self.committed.values()))
             if len(modes) == 1:
                 from tpu_cc_manager.tpudev.tpuvm import runtime_env_for_mode
@@ -194,7 +265,9 @@ class FakeTpuBackend(TpuCcBackend):
             now = time.monotonic()
             for chip in self._chips:
                 self.booted[chip.index] = False
-                self._boot_done_at[chip.index] = now + self.boot_latency_s
+                self._boot_done_at[chip.index] = now + self._latency_for(
+                    self.boot_latency_s, chip.index
+                )
             self.op_log.append(
                 ("restart_runtime", tuple(c.index for c in self._chips))
             )

@@ -28,7 +28,10 @@ import time
 import urllib.error
 import urllib.request
 
+from concurrent.futures import ThreadPoolExecutor
+
 from tpu_cc_manager.labels import MODE_OFF, VALID_MODES
+from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.tpudev.contract import (
     AttestationQuote,
     HealthProbe,
@@ -36,6 +39,8 @@ from tpu_cc_manager.tpudev.contract import (
     TpuCcBackend,
     TpuChip,
     TpuError,
+    raise_pool_errors,
+    reset_parallelism,
 )
 from tpu_cc_manager.utils import retry as retry_mod
 
@@ -108,6 +113,24 @@ def runtime_env_for_mode(mode: str) -> str:
 # the CPU's security processor, alongside the metadata-server JWT.
 DEFAULT_TSM_ROOT = "/sys/kernel/config/tsm/report"
 TSM_ROOT_ENV = "CC_TSM_ROOT"
+
+# Optional per-chip reset command (space-separated template; ``{device}``
+# and ``{index}`` substitute per chip). When set, the commit point is one
+# command PER CHIP fanned out across a bounded worker pool
+# (CC_RESET_PARALLELISM) instead of the host-global runtime restart —
+# for runtimes whose chips expose individual reset entry points (vfio
+# unbind/rebind, per-accel reset nodes). Crash ordering is preserved:
+# pending markers for EVERY chip land durably before any chip's command
+# runs, and committed promotion happens only after all succeed. The
+# command's EXIT STATUS is the authority that the chip actually reset
+# (there is no host-global activation stamp to cross-check on this
+# path) — point it at something that fails when the reset did not take,
+# not at a fire-and-forget trigger. Incompatible with
+# CC_RUNTIME_ENV_FILE (host-global mode env needs a host-global
+# restart; reset() refuses the combination loudly). Unset (the default)
+# keeps the host-global restart + activation-stamp cross-check exactly
+# as before.
+PER_CHIP_RESET_CMD_ENV = "CC_RESET_PER_CHIP_CMD"
 
 # The distroless container image ships no systemctl/nsenter; host commands
 # run through a Python chroot into the host rootfs mounted at this path
@@ -198,9 +221,16 @@ class TpuVmBackend(TpuCcBackend):
         tsm_root: str | None = None,
         runtime_env_file: str | None = None,
         cc_guest_devices: tuple[str, ...] = ("/dev/tdx_guest", "/dev/sev-guest"),
+        per_chip_reset_cmd: list[str] | None = None,
     ) -> None:
         self.state_dir = state_dir
         self.reset_cmd = host_wrap(reset_cmd or list(DEFAULT_RESET_CMD))
+        if per_chip_reset_cmd is None:
+            env = os.environ.get(PER_CHIP_RESET_CMD_ENV)
+            per_chip_reset_cmd = env.split() if env else None
+        # Template, host-wrapped at run time (after {device}/{index}
+        # substitution); None keeps the host-global restart commit path.
+        self.per_chip_reset_cmd = per_chip_reset_cmd
         self.health_probe_cmd = (
             host_wrap(health_probe_cmd) if health_probe_cmd else health_probe_cmd
         )
@@ -485,6 +515,19 @@ class TpuVmBackend(TpuCcBackend):
             log.info("cleared staged mode on %d chip(s)", len(dropped))
 
     def reset(self, chips: tuple[TpuChip, ...]) -> None:
+        if self.per_chip_reset_cmd and self.runtime_env_file:
+            # The two mechanisms are incompatible by construction: the
+            # committed mode rides in a HOST-GLOBAL runtime
+            # EnvironmentFile that only a host-global runtime restart
+            # applies — per-chip commands would promote committed.json
+            # while the running runtime still holds the old mode env.
+            # Refuse before touching any state (a stable misconfiguration
+            # must not mint 'resetting' markers).
+            raise TpuError(
+                "CC_RESET_PER_CHIP_CMD is incompatible with "
+                "CC_RUNTIME_ENV_FILE: the mode env file is host-global and "
+                "only a host-global runtime restart applies it; unset one"
+            )
         staged = self._read_state("staged.json")
         pending = {}
         for chip in chips:
@@ -501,6 +544,13 @@ class TpuVmBackend(TpuCcBackend):
         self._write_state("pending.json", pending)
         self._write_state("staged.json", staged)
         self._write_runtime_env(pending)
+        if self.per_chip_reset_cmd:
+            # Per-chip commit path: the pending markers above are already
+            # durable for EVERY chip (a crash anywhere below reads
+            # "resetting" and crash-as-retry re-applies), so the chip
+            # commands may fan out across the bounded pool.
+            self._reset_per_chip(chips, pending)
+            return
         pre_stamp = self._runtime_stamp(fresh=True)
         log.info("restarting TPU runtime: %s", " ".join(self.reset_cmd))
         try:
@@ -549,6 +599,70 @@ class TpuVmBackend(TpuCcBackend):
             "runtime.json",
             {"active_state": post_stamp[0], "enter_ts": post_stamp[1]}
             if post_stamp is not None and post_stamp[1]
+            else {},
+        )
+        self._write_state("pending.json", {})
+
+    def _reset_one_chip_cmd(self, chip: TpuChip) -> None:
+        """One chip's reset command, in its own span (the bench reads the
+        per-chip spans back to compare pipeline wall vs serial sum)."""
+        cmd = host_wrap([
+            part.replace("{device}", chip.device_path)
+                .replace("{index}", str(chip.index))
+            for part in self.per_chip_reset_cmd
+        ])
+        with obs_trace.span("reset.chip", chip=chip.index) as sp:
+            sp.set_attribute("device", chip.device_path)
+            try:
+                self._run_device_cmd(
+                    cmd, op=f"tpuvm.reset.chip{chip.index}", timeout=120
+                )
+            except FileNotFoundError as e:
+                raise TpuError(f"per-chip reset command not found: {e}") from e
+            except subprocess.TimeoutExpired as e:
+                raise TpuError(f"per-chip reset timed out: {e}") from e
+            except subprocess.CalledProcessError as e:
+                raise TpuError(
+                    f"per-chip reset of {chip.name} failed rc={e.returncode}: "
+                    f"{(e.stderr or b'').decode('utf-8', 'replace')[:256]}"
+                ) from e
+            except retry_mod.CircuitOpenError as e:
+                raise TpuError(f"device-command path unavailable: {e}") from e
+
+    def _reset_per_chip(
+        self, chips: tuple[TpuChip, ...], pending: dict[str, str]
+    ) -> None:
+        """Fan the per-chip reset commands out across a bounded worker
+        pool. Committed promotion happens only after EVERY chip's command
+        succeeded — any failure leaves the pending markers behind, so
+        query_cc_mode keeps reporting 'resetting' for the whole staged set
+        and the retrying reconcile re-applies from a clean stage (the same
+        crash-as-retry contract as the host-global restart)."""
+        workers = max(1, min(reset_parallelism(), len(chips)))
+        log.info(
+            "resetting %d chip(s) via per-chip commands (%d worker(s))",
+            len(chips), workers,
+        )
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futures = [
+                pool.submit(
+                    obs_trace.in_current_context(self._reset_one_chip_cmd, c)
+                )
+                for c in chips
+            ]
+        raise_pool_errors([f.exception() for f in futures if f.exception()])
+        committed = self._read_state("committed.json")
+        committed.update(pending)
+        self._write_state("committed.json", committed)
+        # The runtime unit did not restart on this path; record the
+        # CURRENT activation stamp (when available) so the external-
+        # restart cross-check in query_cc_mode compares against fresh
+        # truth instead of a stale pre-reset record.
+        stamp = self._runtime_stamp(fresh=True)
+        self._write_state(
+            "runtime.json",
+            {"active_state": stamp[0], "enter_ts": stamp[1]}
+            if stamp is not None and stamp[1]
             else {},
         )
         self._write_state("pending.json", {})
@@ -697,6 +811,13 @@ class TpuVmBackend(TpuCcBackend):
         must never trigger a spurious fast-drain."""
         value = self._metadata("instance/preempted", default="FALSE")
         return (value or "").strip().upper() == "TRUE"
+
+    def prepare_attestation(self) -> None:
+        """Warm the measured-file hash memo (libtpu is O(100 MB)) so the
+        post-boot attest phase pays only the nonce-bound metadata fetch.
+        The manager overlaps this with wait_ready; any failure is
+        irrelevant — fetch_attestation re-hashes whatever is missing."""
+        self._measured_files()
 
     def fetch_attestation(self, nonce: str) -> AttestationQuote:
         committed = self._read_state("committed.json")

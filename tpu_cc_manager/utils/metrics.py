@@ -211,6 +211,14 @@ class MetricsRegistry:
         self._preemption_totals: dict[str, int] = {}
         self._node_adoptions_total = 0
         self._fast_drain_seconds: float | None = None
+        # Pipelined transitions (ccmanager/manager.py): how many seconds
+        # the most recent reconcile saved by overlapping phases (sum of
+        # phase latencies minus reconcile wall time, floored at 0), and
+        # smoke fast-path decisions by outcome (hit = smoke skipped on an
+        # unchanged verified digest, miss = digest changed so the full
+        # smoke ran, cold = no verified digest on record yet).
+        self._phase_overlap_seconds: float | None = None
+        self._smoke_fastpath_totals: dict[str, int] = {}
         # Client-side apiserver request accounting by verb (get / list /
         # watch / patch / create / update / delete): every HTTP round
         # trip RestKube performs, retries included. The fleet-scale
@@ -379,6 +387,26 @@ class MetricsRegistry:
         with self._lock:
             self._fast_drain_seconds = max(0.0, seconds)
 
+    def set_phase_overlap_seconds(self, seconds: float) -> None:
+        """Record how many seconds the most recent reconcile saved by
+        running phases concurrently (pipelined transitions): the sum of
+        its phase latencies minus its wall time, floored at 0."""
+        with self._lock:
+            self._phase_overlap_seconds = max(0.0, seconds)
+
+    def record_smoke_fastpath(self, outcome: str) -> None:
+        """Count one attestation-digest smoke fast-path decision by
+        outcome (``hit`` / ``miss`` / ``cold``; ccmanager/manager.py,
+        CC_SMOKE_DIGEST_FAST_PATH)."""
+        with self._lock:
+            self._smoke_fastpath_totals[outcome] = (
+                self._smoke_fastpath_totals.get(outcome, 0) + 1
+            )
+
+    def smoke_fastpath_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._smoke_fastpath_totals)
+
     def record_apiserver_request(self, verb: str) -> None:
         """Count one apiserver HTTP round trip by verb (kubeclient)."""
         with self._lock:
@@ -407,6 +435,13 @@ class MetricsRegistry:
                 tot[1] += 1
         for p in m.phases:
             self.observe_phase(m.mode, p.name, p.seconds)
+        if m.phases:
+            # Pipelined transitions: phases that ran concurrently sum to
+            # more than the reconcile's wall time; the difference is the
+            # overlap the pipeline saved (0 when fully serialized).
+            self.set_phase_overlap_seconds(
+                sum(p.seconds for p in m.phases) - m.total_seconds
+            )
 
     def result_totals(self) -> dict[str, int]:
         with self._lock:
@@ -472,6 +507,8 @@ class MetricsRegistry:
             preemption_totals = dict(self._preemption_totals)
             node_adoptions = self._node_adoptions_total
             fast_drain_seconds = self._fast_drain_seconds
+            phase_overlap_seconds = self._phase_overlap_seconds
+            smoke_fastpath_totals = dict(self._smoke_fastpath_totals)
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -667,6 +704,30 @@ class MetricsRegistry:
             lines.append(
                 "tpu_cc_fast_drain_seconds %.3f" % fast_drain_seconds
             )
+        if phase_overlap_seconds is not None:
+            lines.append(
+                "# HELP tpu_cc_phase_overlap_seconds Seconds the most "
+                "recent reconcile saved by overlapping phases (sum of "
+                "phase latencies minus wall time; pipelined transitions)."
+            )
+            lines.append("# TYPE tpu_cc_phase_overlap_seconds gauge")
+            lines.append(
+                "tpu_cc_phase_overlap_seconds %.3f" % phase_overlap_seconds
+            )
+        if smoke_fastpath_totals:
+            lines.append(
+                "# HELP tpu_cc_smoke_fastpath_total Attestation-digest "
+                "smoke fast-path decisions by outcome (hit = smoke "
+                "skipped on an unchanged verified digest, miss = digest "
+                "changed so the full smoke ran, cold = no digest on "
+                "record; CC_SMOKE_DIGEST_FAST_PATH)."
+            )
+            lines.append("# TYPE tpu_cc_smoke_fastpath_total counter")
+            for outcome in sorted(smoke_fastpath_totals):
+                lines.append(
+                    "tpu_cc_smoke_fastpath_total%s %d"
+                    % (_labels(outcome=outcome), smoke_fastpath_totals[outcome])
+                )
         if apiserver_requests:
             lines.append(
                 "# HELP tpu_cc_apiserver_requests_total Apiserver HTTP "
