@@ -1,0 +1,361 @@
+"""cclint (tpu_cc_manager/lint/): each checker catches its seeded
+known-bad fixture, the annotation escapes work, the baseline machinery
+grandfathers and flags staleness, the whole package is clean modulo the
+committed baseline, and the CC_LOCKCHECK runtime wrapper catches a
+deliberately inverted lock pair. Pure-AST on tiny fixture strings plus
+one parse of the package — tier-1 time is marginal, keep this cheap."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from tpu_cc_manager.lint import base, baseline as baseline_mod
+from tpu_cc_manager.lint import crash, journal, locks, surface, waits
+from tpu_cc_manager.utils import locks as locks_rt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ctx_of(tmp_path, files: dict[str, str]) -> base.LintContext:
+    ctx = base.LintContext(root=str(tmp_path))
+    for relpath, src in files.items():
+        full = tmp_path / relpath
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(src)
+        if relpath.endswith(".py"):
+            ctx.files.append(base.SourceFile(str(tmp_path), relpath))
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# checker 1: lock discipline
+# ---------------------------------------------------------------------------
+
+LOCKS_BAD = '''
+class C:
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._shared = 0  # cclint: guarded-by(_lock)
+
+    def bad(self):
+        self._shared += 1
+
+    def good(self):
+        with self._lock:
+            self._shared += 1
+
+    def helper(self):  # cclint: requires(_lock)
+        return self._shared
+
+    def closure_leak(self):
+        with self._lock:
+            def later():
+                return self._shared
+            return later
+
+    def waived(self):
+        return self._shared  # cclint: unlocked-ok(snapshot read for logs)
+'''
+
+
+def test_locks_checker_catches_unguarded_access(tmp_path):
+    findings = locks.check(ctx_of(tmp_path, {"m.py": LOCKS_BAD}))
+    by_symbol = {f.symbol for f in findings}
+    assert "C.bad" in by_symbol
+    # A closure defined under `with` runs later — lexical hold must not
+    # leak into it.
+    assert "C.closure_leak" in by_symbol
+    # Locked access, requires()-annotated helper, and the explicit waiver
+    # are all clean.
+    assert "C.good" not in by_symbol
+    assert "C.helper" not in by_symbol
+    assert "C.waived" not in by_symbol
+
+
+# ---------------------------------------------------------------------------
+# checker 2: no ad-hoc waits
+# ---------------------------------------------------------------------------
+
+WAITS_BAD = '''
+import time
+from time import sleep as zzz
+
+def poller():
+    time.sleep(1.0)
+
+def aliased():
+    zzz(0.1)
+
+def reference_only(cb=time.sleep):
+    return cb
+'''
+
+
+def test_waits_checker_catches_time_sleep(tmp_path):
+    findings = waits.check(ctx_of(tmp_path, {"m.py": WAITS_BAD}))
+    symbols = {f.symbol for f in findings}
+    assert symbols == {"poller", "aliased"}  # a bare reference is not a call
+
+
+def test_waits_checker_allows_retry_and_faults(tmp_path):
+    files = {
+        "tpu_cc_manager/utils/retry.py": "import time\ntime.sleep(1)\n",
+        "tpu_cc_manager/faults/kube.py": "import time\ntime.sleep(1)\n",
+    }
+    assert waits.check(ctx_of(tmp_path, files)) == []
+
+
+# ---------------------------------------------------------------------------
+# checker 3: crash stays a crash
+# ---------------------------------------------------------------------------
+
+CRASH_BAD = '''
+def swallow():
+    try:
+        work()
+    except BaseException:
+        log()
+
+def bare_swallow():
+    try:
+        work()
+    except:
+        pass
+
+def reraises():
+    try:
+        work()
+    except BaseException as e:
+        note(e)
+        raise
+
+def nested_raise_does_not_count():
+    try:
+        work()
+    except BaseException:
+        def later():
+            raise RuntimeError("not on the handler level")
+        keep(later)
+
+def trampoline():
+    try:
+        work()
+    except BaseException as e:  # cclint: crash-ok(re-raised at join)
+        store(e)
+
+def plain_exception_is_fine():
+    try:
+        work()
+    except Exception:
+        pass
+'''
+
+
+def test_crash_checker_requires_reraise(tmp_path):
+    findings = crash.check(ctx_of(tmp_path, {"m.py": CRASH_BAD}))
+    symbols = sorted(f.symbol for f in findings)
+    assert symbols == ["bare_swallow", "nested_raise_does_not_count", "swallow"]
+
+
+# ---------------------------------------------------------------------------
+# checker 4: journal-before-reset
+# ---------------------------------------------------------------------------
+
+JOURNAL_BAD = '''
+class Rogue:
+    def zap(self):
+        self.backend.reset(self.chips)
+
+    def bounce(self):
+        self.backend.restart_runtime()
+
+    def unrelated(self):
+        self.cursor.reset(token)
+'''
+
+
+def test_journal_checker_catches_unallowlisted_reset(tmp_path):
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/rogue.py": JOURNAL_BAD})
+    )
+    details = sorted(f.detail for f in findings)
+    assert details == ["reset", "restart_runtime"]  # not the cursor.reset
+
+
+def test_journal_checker_skips_device_layer(tmp_path):
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/tpudev/impl.py": JOURNAL_BAD})
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# checker 5: contract-surface drift
+# ---------------------------------------------------------------------------
+
+
+def test_surface_checker_env_and_label_drift(tmp_path):
+    files = {
+        "tpu_cc_manager/mod.py": (
+            'import os\n'
+            'A = os.environ.get("CC_DOCUMENTED", "")\n'
+            'B = os.environ.get("CC_UNDOCUMENTED", "")\n'
+            'KEY = "cloud.google.com/tpu-cc.rogue-key"\n'
+        ),
+        "tpu_cc_manager/labels.py": 'OK = "cloud.google.com/tpu-cc.fine"\n',
+        "docs/operations.md": "| `CC_DOCUMENTED` | on | documented |\n",
+        "deployments/manifests/daemonset.yaml": (
+            "env:\n"
+            "  - name: CC_DOCUMENTED\n"
+            "  - name: CC_PHANTOM\n"
+        ),
+    }
+    findings = surface.check(ctx_of(tmp_path, files))
+    by = {(f.symbol, f.detail) for f in findings}
+    assert ("env-undocumented", "CC_UNDOCUMENTED") in by
+    assert ("env-unread", "CC_PHANTOM") in by
+    assert ("label-literal", "cloud.google.com/tpu-cc.rogue-key") in by
+    # labels.py itself and the documented env are clean.
+    assert ("env-undocumented", "CC_DOCUMENTED") not in by
+    assert not any(d == "cloud.google.com/tpu-cc.fine" for (_, d) in by)
+
+
+def test_surface_checker_exempts_docstrings(tmp_path):
+    files = {
+        "tpu_cc_manager/mod.py": (
+            '"""Doc naming cloud.google.com/tpu-cc.mode is fine."""\n'
+            "X = 1\n"
+        ),
+    }
+    assert surface.check(ctx_of(tmp_path, files)) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_split_and_stale(tmp_path):
+    f1 = base.Finding("waits", "a.py", 3, "m", "f")
+    f2 = base.Finding("waits", "b.py", 9, "m", "g")
+    known = {f1.fingerprint: "reason", "waits:gone.py:h": "stale"}
+    new, old, stale = baseline_mod.split([f1, f2], known)
+    assert [f.fingerprint for f in new] == [f2.fingerprint]
+    assert [f.fingerprint for f in old] == [f1.fingerprint]
+    assert stale == ["waits:gone.py:h"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = base.Finding("crash", "x.py", 1, "m", "fn")
+    path = str(tmp_path / "b.json")
+    baseline_mod.save(str(tmp_path), [f], path)
+    loaded = baseline_mod.load(str(tmp_path), path)
+    assert f.fingerprint in loaded
+    data = json.loads((tmp_path / "b.json").read_text())
+    assert data["entries"][0]["reason"].startswith("TODO")
+
+
+# ---------------------------------------------------------------------------
+# the repo itself is clean modulo the committed baseline
+# ---------------------------------------------------------------------------
+
+
+def test_whole_package_clean_modulo_baseline():
+    from tpu_cc_manager.lint.__main__ import run
+
+    findings = run(REPO, skip_expo=True)
+    known = baseline_mod.load(REPO)
+    new, _, stale = baseline_mod.split(findings, known)
+    assert new == [], [f.to_dict() for f in new]
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+# ---------------------------------------------------------------------------
+# CC_LOCKCHECK runtime lock-order checker
+# ---------------------------------------------------------------------------
+
+
+def test_lockcheck_catches_inverted_pair(monkeypatch):
+    monkeypatch.setenv(locks_rt.LOCKCHECK_ENV, "1")
+    a = locks_rt.CheckedLock("test.A")
+    b = locks_rt.CheckedLock("test.B")
+    try:
+        with a:
+            with b:
+                pass
+        # The inversion is caught on the FIRST inverted acquisition, on
+        # the same thread, without needing the deadlock interleaving.
+        with pytest.raises(locks_rt.LockOrderError, match="inversion"):
+            with b:
+                with a:
+                    pass
+    finally:
+        locks_rt.GRAPH.reset()
+
+
+def test_lockcheck_rlock_reentry_is_not_an_inversion(monkeypatch):
+    monkeypatch.setenv(locks_rt.LOCKCHECK_ENV, "1")
+    r = locks_rt.CheckedLock("test.R", reentrant=True)
+    try:
+        with r:
+            with r:  # re-entrant: no self-edge, no error
+                pass
+    finally:
+        locks_rt.GRAPH.reset()
+
+
+def test_lockcheck_nonreentrant_self_reacquire_is_reported(monkeypatch):
+    """Re-acquiring a plain (non-reentrant) checked lock on the same
+    thread is a guaranteed self-deadlock: the checker reports it instead
+    of hanging the suite."""
+    monkeypatch.setenv(locks_rt.LOCKCHECK_ENV, "1")
+    lock = locks_rt.CheckedLock("test.self")
+    try:
+        with lock:
+            with pytest.raises(locks_rt.LockOrderError, match="self-deadlock"):
+                lock.acquire()
+    finally:
+        locks_rt.GRAPH.reset()
+
+
+def test_lockcheck_cross_thread_inversion(monkeypatch):
+    """The realistic shape: thread 1 takes A→B, thread 2 takes B→A."""
+    monkeypatch.setenv(locks_rt.LOCKCHECK_ENV, "1")
+    a = locks_rt.CheckedLock("test.X")
+    b = locks_rt.CheckedLock("test.Y")
+    caught: list[BaseException] = []
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass
+        except locks_rt.LockOrderError as e:
+            caught.append(e)
+
+    try:
+        th1 = threading.Thread(target=t1)
+        th1.start()
+        th1.join()
+        th2 = threading.Thread(target=t2)
+        th2.start()
+        th2.join()
+        assert caught, "cross-thread inversion was not detected"
+    finally:
+        locks_rt.GRAPH.reset()
+
+
+def test_make_lock_is_plain_without_env(monkeypatch):
+    monkeypatch.delenv(locks_rt.LOCKCHECK_ENV, raising=False)
+    lock = locks_rt.make_lock("prod")
+    assert not isinstance(lock, locks_rt.CheckedLock)

@@ -46,6 +46,24 @@ NS = "tpu-operator"
 pytestmark = pytest.mark.chaos
 
 
+@pytest.fixture(autouse=True)
+def _lockcheck(monkeypatch):
+    """Every chaos scenario runs with the runtime lock-order checker on
+    (utils/locks.py, CC_LOCKCHECK=1): objects built inside the test get
+    CheckedLocks, so a cycle-forming lock inversion anywhere in the
+    thread soup fails the suite deterministically instead of deadlocking
+    one run in a thousand. The process-wide order graph is reset around
+    each test — lock names are stable per class, so leaked edges from
+    one scenario's wiring could otherwise flag a cross-test 'inversion'
+    neither test exhibits alone."""
+    from tpu_cc_manager.utils import locks as locks_rt
+
+    locks_rt.GRAPH.reset()
+    monkeypatch.setenv("CC_LOCKCHECK", "1")
+    yield
+    locks_rt.GRAPH.reset()
+
+
 # ---------------------------------------------------------------------------
 # Determinism: same seed -> same fault schedule
 # ---------------------------------------------------------------------------

@@ -684,3 +684,61 @@ def test_containment_metrics_render(fake_kube):
     assert "tpu_cc_barrier_fenced_total 1" in text
     ladder.unquarantine("test")
     assert "tpu_cc_quarantined 0" in registry.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# Journal-before-reset (cclint `journal` contract): the hardware rungs
+# write a KIND_REMEDIATION intent before touching the device.
+# ---------------------------------------------------------------------------
+
+
+def test_hardware_rungs_journal_an_intent(fake_kube, tmp_path):
+    from tpu_cc_manager.ccmanager import intent_journal as intent_mod
+
+    fake_kube.add_node(NODE)
+    backend = FakeTpuBackend()
+    intents = intent_mod.IntentJournal.from_state_dir(str(tmp_path))
+    ladder, _, _ = make_ladder(fake_kube, backend, intents=intents)
+
+    for _ in range(3):
+        ladder.note_failure("apply-failed")  # -> device-reset rung
+    # The rung ran and its intent is CLOSED (begin -> reset -> commit).
+    assert any(op == "reset" for op, _ in backend.op_log)
+    assert intents.open_intents(intent_mod.KIND_REMEDIATION) == []
+    recs = intents.snapshot()["recent"]
+    begin = [r for r in recs if r.get("t") == "intent"
+             and r.get("kind") == intent_mod.KIND_REMEDIATION]
+    assert begin and begin[0]["op"] == "device-reset"
+    # Intent-before-effect: the begin record's seq exists, and a commit
+    # follows it.
+    assert any(r.get("t") == "commit" and r.get("txn") == begin[0]["txn"]
+               for r in recs)
+
+    # A FAILING rung aborts its intent instead of leaving it open.
+    backend.fail_next("restart_runtime", times=1)
+    for _ in range(2):
+        ladder.note_failure("apply-failed")  # -> runtime-restart rung
+    assert intents.open_intents(intent_mod.KIND_REMEDIATION) == []
+    aborts = [r for r in intents.snapshot()["recent"] if r.get("t") == "abort"]
+    assert aborts, "failed rung should abort its intent"
+
+
+def test_replay_closes_interrupted_remediation_intent(fake_kube, tmp_path):
+    """An agent SIGKILLed mid-rung leaves the intent open; the successor's
+    journal replay closes it and counts a rolled-back replay."""
+    from tpu_cc_manager.ccmanager import intent_journal as intent_mod
+
+    fake_kube.add_node(NODE)
+    intents = intent_mod.IntentJournal.from_state_dir(str(tmp_path))
+    intents.begin(intent_mod.KIND_REMEDIATION, op="device-reset", node=NODE)
+    del intents  # the crash
+
+    registry = MetricsRegistry()
+    successor = intent_mod.IntentJournal.from_state_dir(str(tmp_path))
+    manager = CCManager(
+        api=fake_kube, backend=FakeTpuBackend(), node_name=NODE,
+        intent_journal=successor, metrics=registry,
+    )
+    manager.recover_from_journal()
+    assert successor.open_intents(intent_mod.KIND_REMEDIATION) == []
+    assert registry.journal_replay_totals().get("rolled-back") == 1

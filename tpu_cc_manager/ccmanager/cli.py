@@ -146,6 +146,7 @@ def main(argv: list[str] | None = None) -> int:
         backend=backend,
         emit_event=manager._emit_node_event,
         metrics=manager.metrics,
+        intents=intent_journal,
     )
     if args.metrics_port:
         # Same journal the manager records to, so /tracez and /statusz

@@ -88,11 +88,11 @@ class NodeInformer:
             max_delay_s=max(reconnect_delay_s, reconnect_max_delay_s),
         )
         self._cond = threading.Condition()
-        self._nodes: dict[str, dict] = {}
-        self._by_slice: dict[str, set[str]] = {}
-        self._slice_of: dict[str, str] = {}
-        self._rv: str = ""
-        self._version = 0
+        self._nodes: dict[str, dict] = {}  # cclint: guarded-by(_cond)
+        self._by_slice: dict[str, set[str]] = {}  # cclint: guarded-by(_cond)
+        self._slice_of: dict[str, str] = {}  # cclint: guarded-by(_cond)
+        self._rv: str = ""  # cclint: guarded-by(_cond)
+        self._version = 0  # cclint: guarded-by(_cond)
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -221,10 +221,14 @@ class NodeInformer:
         consecutive_errors = 0
         while not self._stop.is_set():
             try:
-                if not self._synced.is_set() or not self._rv:
+                with self._cond:
+                    rv = self._rv
+                if not self._synced.is_set() or not rv:
                     self._relist()
+                    with self._cond:
+                        rv = self._rv
                 for event in self.api.watch_nodes_pool(
-                    self.selector, self._rv or None, self.watch_timeout_s
+                    self.selector, rv or None, self.watch_timeout_s
                 ):
                     if self._stop.is_set():
                         return
@@ -237,15 +241,16 @@ class NodeInformer:
                         )
                     consecutive_errors = 0
                     self.events_seen += 1
-                    rv = resource_version(event.object)
+                    erv = resource_version(event.object)
                     if event.type == "BOOKMARK":
                         # Bookmarks carry only metadata.resourceVersion:
                         # track it (that is their whole point) and move on
                         # — upserting would wipe the node's labels.
-                        if rv:
-                            self._rv = rv
+                        if erv:
+                            with self._cond:
+                                self._rv = erv
                         continue
-                    self._apply(event.type, event.object, rv)
+                    self._apply(event.type, event.object, erv)
                 # Stream ended normally (server-side timeout): reconnect
                 # from the tracked rv.
             except Exception as e:
@@ -258,7 +263,8 @@ class NodeInformer:
                     )
                     # Force a relist on the next loop pass; the relist
                     # itself may fail transiently and rides the ladder.
-                    self._rv = ""
+                    with self._cond:
+                        self._rv = ""
                     if consecutive_errors > 1:
                         # A LONE 410 relists immediately (the normal
                         # compaction resync). Back-to-back 410s mean the
@@ -285,7 +291,8 @@ class NodeInformer:
                         "consecutive); forcing relist", self.name,
                         consecutive_errors,
                     )
-                    self._rv = ""
+                    with self._cond:
+                        self._rv = ""
                 delay = self._reconnect_policy.delay_for(
                     min(max(0, consecutive_errors - 1), 16)
                 )
@@ -341,8 +348,7 @@ class NodeInformer:
             self._version += 1
             self._cond.notify_all()
 
-    def _rebuild_slice_index(self) -> None:
-        # Caller holds the lock.
+    def _rebuild_slice_index(self) -> None:  # cclint: requires(_cond)
         self._by_slice = {}
         self._slice_of = {}
         for name, node in self._nodes.items():
@@ -351,9 +357,9 @@ class NodeInformer:
                 self._slice_of[name] = sid
                 self._by_slice.setdefault(sid, set()).add(name)
 
-    def _rebuild_slice_entry(self, name: str, node: dict, deleted: bool) -> None:
-        # Caller holds the lock. O(1) per event via the reverse map — a
-        # 10k-node pool must not pay an O(slices) scan per watch event.
+    def _rebuild_slice_entry(self, name: str, node: dict, deleted: bool) -> None:  # cclint: requires(_cond)
+        # O(1) per event via the reverse map — a 10k-node pool must not
+        # pay an O(slices) scan per watch event.
         old = self._slice_of.pop(name, None)
         if old is not None:
             members = self._by_slice.get(old)

@@ -64,9 +64,10 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
 import zlib
+
+from tpu_cc_manager.utils import locks as locks_mod
 
 log = logging.getLogger(__name__)
 
@@ -104,6 +105,13 @@ KIND_DRAIN = "drain"
 #: HANDOFF_ANNOTATION) — the replacement VM has a fresh disk, so the
 #: apiserver copy is the only record that survives the reclaim.
 KIND_HANDOFF = "handoff"
+#: A remediation-ladder hardware rung (device re-reset / runtime restart,
+#: ccmanager/remediation.py) — journaled like any hardware-effecting
+#: operation (the cclint journal-before-reset contract). Replay found one
+#: open = the agent died mid-rung: the backend's own pending markers and
+#: the persisted ladder annotation already carry the recovery state, so
+#: the intent is simply closed and the normal reconcile re-drives.
+KIND_REMEDIATION = "remediation"
 
 
 class JournalCorrupt(Exception):
@@ -169,17 +177,17 @@ class IntentJournal:
         self.path = path
         self.max_bytes = max_bytes
         self._fsync = fsync
-        self._lock = threading.RLock()
-        self._fd: int | None = None
-        self._seq = 0
-        self._txn_counter = 0
+        self._lock = locks_mod.make_rlock("intent-journal")
+        self._fd: int | None = None  # cclint: guarded-by(_lock)
+        self._seq = 0  # cclint: guarded-by(_lock)
+        self._txn_counter = 0  # cclint: guarded-by(_lock)
         # Live state, maintained on every append so readers (the /journalz
         # endpoint, recovery) never re-parse the file.
-        self._open_intents: dict[str, dict] = {}
-        self._pending_patches: list[dict] = []  # records with t=patch
-        self._flushed_upto = 0
-        self._last_desired: str | None = None
-        self._tail: list[dict] = []  # bounded recent-record window
+        self._open_intents: dict[str, dict] = {}  # cclint: guarded-by(_lock)
+        self._pending_patches: list[dict] = []  # t=patch records  # cclint: guarded-by(_lock)
+        self._flushed_upto = 0  # cclint: guarded-by(_lock)
+        self._last_desired: str | None = None  # cclint: guarded-by(_lock)
+        self._tail: list[dict] = []  # bounded recent-record window  # cclint: guarded-by(_lock)
         self.last_replay: dict | None = None
         # Chaos hook (faults/plan.py disk-fault mode): the next N appends
         # raise JournalError as if the state-dir disk faulted mid-write.
@@ -191,7 +199,7 @@ class IntentJournal:
 
     # ---- low-level append -------------------------------------------------
 
-    def _ensure_open(self) -> int:
+    def _ensure_open(self) -> int:  # cclint: requires(_lock)
         if self._fd is None:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             self._fd = os.open(
@@ -224,7 +232,7 @@ class IntentJournal:
             self._apply(record)
             return record
 
-    def _close_fd(self) -> None:
+    def _close_fd(self) -> None:  # cclint: requires(_lock)
         if self._fd is not None:
             try:
                 os.close(self._fd)
@@ -232,7 +240,7 @@ class IntentJournal:
                 pass
             self._fd = None
 
-    def _apply(self, rec: dict) -> None:
+    def _apply(self, rec: dict) -> None:  # cclint: requires(_lock)
         """Fold one record into the live state (append and replay share
         this, so recovery sees exactly what a running agent would)."""
         t = rec.get("t")

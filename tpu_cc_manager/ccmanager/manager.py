@@ -38,6 +38,7 @@ from tpu_cc_manager.kubeclient.api import (
     node_labels,
     resource_version,
 )
+from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.labels import (
     CC_MODE_LABEL,
     MODE_DEVTOOLS,
@@ -52,6 +53,7 @@ from tpu_cc_manager.obs import journal as journal_mod
 from tpu_cc_manager.obs import trace as trace_mod
 from tpu_cc_manager.tpudev import attestation
 from tpu_cc_manager.tpudev.contract import SliceTopology, TpuCcBackend, TpuChip, TpuError
+from tpu_cc_manager.utils import locks as locks_mod
 from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import retry as retry_mod
 
@@ -89,7 +91,7 @@ DEFAULT_PREEMPTION_POLL_S = 5.0
 #: consumed by the replacement node's agent at startup — the preempted
 #: VM's disk (and with it the intent journal) dies in the reclaim, so
 #: the apiserver copy is the only record that reaches the successor.
-HANDOFF_ANNOTATION = "cloud.google.com/tpu-cc.handoff"
+HANDOFF_ANNOTATION = labels_mod.HANDOFF_ANNOTATION
 
 
 class _PipelineTask:
@@ -106,7 +108,7 @@ class _PipelineTask:
         def run() -> None:
             try:
                 fn()
-            except BaseException as e:  # noqa: BLE001 - re-raised at join
+            except BaseException as e:  # noqa: BLE001  # cclint: crash-ok(worker trampoline - join re-raises, SIGKILL unwinds the owning pipeline)
                 self._error = e
 
         self._thread = threading.Thread(
@@ -144,7 +146,7 @@ class _ReadmitOnce:
         self._fn = fn
         self._on_start = on_start
         self._task: object | None = None
-        self._lock = threading.Lock()
+        self._lock = locks_mod.make_lock("manager.readmit-once")
 
     def start_async(self) -> None:
         with self._lock:
@@ -406,8 +408,11 @@ class CCManager:
         # slice identity), maintained by _apply_direct so the preemption
         # handler — running on the monitor thread, concurrently with a
         # reconcile blocked in a barrier wait — knows exactly what to
-        # hand off. None outside the hardware pipeline.
-        self._inflight_transition: dict | None = None
+        # hand off. None outside the hardware pipeline. Shared between
+        # the reconcile thread (writes) and the preemption monitor
+        # (reads), hence the dedicated leaf lock.
+        self._transition_lock = locks_mod.make_lock("manager.transition")
+        self._inflight_transition: dict | None = None  # cclint: guarded-by(_transition_lock)
         # A predecessor's handoff record consumed at startup; retired
         # (annotation cleared + outcome=resumed counted) after the first
         # successful reconcile completes the handed-off flip.
@@ -444,7 +449,7 @@ class CCManager:
                 # kubectl-describe readers can jump from the event to the
                 # reconcile's span tree (/tracez?trace_id=...).
                 metadata["annotations"] = {
-                    "tpu-cc.gke.io/trace-id": trace_id
+                    labels_mod.TRACE_ID_ANNOTATION: trace_id
                 }
             self.api.create_event("default", {
                 "metadata": metadata,
@@ -1041,7 +1046,8 @@ class CCManager:
                         "overlapped stage also failed during the aborted "
                         "drain: %s", stage_err,
                     )
-            self._inflight_transition = None
+            with self._transition_lock:
+                self._inflight_transition = None
             raise
         # Re-admission is kicked off by _apply_direct while the smoke
         # workload runs (readmit ∥ smoke); finish() below joins it — or
@@ -1083,13 +1089,14 @@ class CCManager:
         txn = self._journal_begin(
             "transition", mode=mode, chips=[c.index for c in chips],
         )
-        self._inflight_transition = {
-            "mode": mode,
-            "chips": [c.index for c in chips],
-            "phase": intent_mod.PHASE_BEGUN,
-            "slice_id": topo.slice_id,
-            "multi_host": topo.is_multi_host,
-        }
+        with self._transition_lock:
+            self._inflight_transition = {
+                "mode": mode,
+                "chips": [c.index for c in chips],
+                "phase": intent_mod.PHASE_BEGUN,
+                "slice_id": topo.slice_id,
+                "multi_host": topo.is_multi_host,
+            }
         return txn
 
     def _readmit_bracket(self, m: metrics_mod.ReconcileMetrics,
@@ -1115,9 +1122,9 @@ class CCManager:
         with m.phase(metrics_mod.PHASE_STAGE):
             self.backend.stage_cc_mode(chips, mode)
         self._journal_mark(txn, intent_mod.PHASE_STAGED)
-        inflight = self._inflight_transition
-        if inflight is not None:
-            inflight["phase"] = intent_mod.PHASE_STAGED
+        with self._transition_lock:
+            if self._inflight_transition is not None:
+                self._inflight_transition["phase"] = intent_mod.PHASE_STAGED
 
     def _unwind_pipelined_stage(
         self, stage_task: _PipelineTask | None,
@@ -1132,7 +1139,8 @@ class CCManager:
         journal replay produces for a pre-reset crash."""
         if stage_task is None:
             self._journal_close(txn, ok=False, reason=reason)
-            self._inflight_transition = None
+            with self._transition_lock:
+                self._inflight_transition = None
             return
         stage_err = stage_task.join_quiet()
         if stage_err is not None:
@@ -1143,7 +1151,8 @@ class CCManager:
         except TpuError as e:
             log.warning("could not clear staged mode during unwind: %s", e)
         self._journal_close(txn, ok=False, reason=reason)
-        self._inflight_transition = None
+        with self._transition_lock:
+            self._inflight_transition = None
 
     def _apply_direct(
         self, topo: SliceTopology, chips: tuple[TpuChip, ...], mode: str,
@@ -1196,13 +1205,15 @@ class CCManager:
                 with m.phase(metrics_mod.PHASE_STAGE):
                     self.backend.stage_cc_mode(chips, mode)
                 self._journal_mark(txn, intent_mod.PHASE_STAGED)
-                self._inflight_transition["phase"] = intent_mod.PHASE_STAGED
+                with self._transition_lock:
+                    self._inflight_transition["phase"] = intent_mod.PHASE_STAGED
             if barrier is not None:
                 with m.phase(metrics_mod.PHASE_BARRIER):
                     barrier.publish_staged(mode)
                     barrier.await_commit(mode)
             self._journal_mark(txn, intent_mod.PHASE_RESET)
-            self._inflight_transition["phase"] = intent_mod.PHASE_RESET
+            with self._transition_lock:
+                self._inflight_transition["phase"] = intent_mod.PHASE_RESET
             with m.phase(metrics_mod.PHASE_RESET):
                 self.backend.reset(chips)
             # Attestation prep (tpuvm: hashing an O(100 MB) libtpu into
@@ -1294,7 +1305,8 @@ class CCManager:
             # The hardware pipeline is over (committed, failed, or a
             # modeled crash unwinding) — there is no transition left to
             # hand off.
-            self._inflight_transition = None
+            with self._transition_lock:
+                self._inflight_transition = None
         self._report_state(mode)
         # The publish patch below also withdraws this host's staged marker
         # (it is no longer mid-transition); the leader's commit-marker
@@ -1573,8 +1585,23 @@ class CCManager:
             return
         transitions = self.intents.open_intents("transition")
         drains = self.intents.open_intents("drain")
-        if replayed.records and not transitions and not drains:
+        remediations = self.intents.open_intents(intent_mod.KIND_REMEDIATION)
+        if replayed.records and not transitions and not drains and not remediations:
             self.metrics.record_journal_replay("clean")
+        for intent in remediations:
+            # A crash mid-remediation-rung: the backend's pending markers
+            # already force a clean re-apply if the reset never committed,
+            # and the ladder state is persisted in the node annotation —
+            # close the intent and let the normal reconcile re-drive.
+            self._journal_close(
+                intent["txn"], ok=False, recovered="remediation-interrupted"
+            )
+            self.metrics.record_journal_replay("rolled-back")
+            log.warning(
+                "journal replay: remediation %s (%s) was interrupted; the "
+                "ladder re-drives from its persisted annotation",
+                intent["txn"], intent.get("op"),
+            )
         for intent in transitions:
             self._recover_transition(intent)
         if drains:
@@ -1688,7 +1715,11 @@ class CCManager:
             return "duplicate"
         self._preemption_handled = True
         started = time.monotonic()
-        inflight = self._inflight_transition
+        with self._transition_lock:
+            inflight = (
+                dict(self._inflight_transition)
+                if self._inflight_transition is not None else None
+            )
         log.warning(
             "PREEMPTION notice: fast-draining within %.0fs (%s)",
             self.preemption_deadline_s,
@@ -1732,8 +1763,9 @@ class CCManager:
             # replacement spuriously count a 'resumed' flip). Copy
             # defensively — the reconcile thread keeps advancing the
             # phase field while the publish serializes it.
-            live = self._inflight_transition
-            inflight = dict(live) if live is not None else None
+            with self._transition_lock:
+                live = self._inflight_transition
+                inflight = dict(live) if live is not None else None
             outcome = "clean"
             if inflight is not None:
                 outcome = self._publish_handoff(inflight)
@@ -1929,10 +1961,7 @@ class CCManager:
             nonlocal attempts
             attempts += 1
             delay = self._reconnect_policy.delay_for(min(attempts - 1, 8))
-            if stop is not None:
-                return not stop.wait(delay)
-            time.sleep(delay)
-            return True
+            return not retry_mod.wait(delay, stop)
 
         while True:
             try:
@@ -2216,7 +2245,8 @@ class CCManager:
                     except KubeApiError as e2:
                         log.warning("resync GET failed: %s", e2)
                         self.metrics.record_retry("watch.resync", "apiserver")
-                        time.sleep(delay)
+                        if retry_mod.wait(delay, stop):
+                            return
                         continue
                     if value != last_label_value:
                         last_label_value = value
@@ -2227,7 +2257,8 @@ class CCManager:
                     consecutive_errors, self.max_watch_errors, e, delay,
                 )
                 self.metrics.record_retry("watch.reconnect", "watch-error")
-                time.sleep(delay)
+                if retry_mod.wait(delay, stop):
+                    return
 
     def remove_readiness_file(self) -> None:
         """Best-effort in-process counterpart of the preStop ``/bin/rm``

@@ -75,21 +75,23 @@ from tpu_cc_manager.tpudev.contract import AttestationQuote
 
 log = logging.getLogger(__name__)
 
+from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.labels import (  # noqa: E402 - shared constants
     QUARANTINED_LABEL,
     SLICE_ID_LABEL,
     label_safe,
 )
 
-QUOTE_ANNOTATION = "cloud.google.com/tpu-cc.attestation"
+# Wire names centralized in labels.py (cclint surface contract).
+QUOTE_ANNOTATION = labels_mod.QUOTE_ANNOTATION
 # The full signed quote rides in a real annotation (values up to 256 KiB;
 # label values cap at 63 chars): peers re-verify its signature instead of
 # trusting the digest labels above.
-QUOTE_FULL_ANNOTATION = "cloud.google.com/tpu-cc.quote"
+QUOTE_FULL_ANNOTATION = labels_mod.QUOTE_FULL_ANNOTATION
 # Verifier-published nonce challenge (JSON {"nonce": ..., "ts": ...}):
 # the agent re-quotes bound to this nonce, giving pool verification
 # peer-chosen-challenge freshness instead of exp-only replay protection.
-CHALLENGE_ANNOTATION = "cloud.google.com/tpu-cc.challenge"
+CHALLENGE_ANNOTATION = labels_mod.CHALLENGE_ANNOTATION
 
 
 class PoolAttestationError(Exception):

@@ -45,10 +45,10 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
 import time
 from typing import Callable
 
+from tpu_cc_manager.ccmanager import intent_journal as intent_mod
 from tpu_cc_manager.ccmanager import slicecoord
 from tpu_cc_manager.kubeclient.api import (
     KubeApi,
@@ -56,6 +56,7 @@ from tpu_cc_manager.kubeclient.api import (
     node_annotations,
     node_labels,
 )
+from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.labels import (
     CC_MODE_STATE_LABEL,
     CC_READY_STATE_LABEL,
@@ -67,6 +68,7 @@ from tpu_cc_manager.labels import (
 )
 from tpu_cc_manager.tpudev.contract import TpuCcBackend, TpuError
 from tpu_cc_manager.utils import metrics as metrics_mod
+from tpu_cc_manager.utils import locks as locks_mod
 
 log = logging.getLogger(__name__)
 
@@ -77,8 +79,9 @@ STEP_RUNTIME_RESTART = "runtime-restart"
 STEP_QUARANTINE = "quarantine"
 STEPS = (STEP_RETRY, STEP_DEVICE_RESET, STEP_RUNTIME_RESTART, STEP_QUARANTINE)
 
-#: Node annotation carrying the persisted ladder state (JSON).
-REMEDIATION_ANNOTATION = "cloud.google.com/tpu-cc.remediation"
+#: Node annotation carrying the persisted ladder state (JSON). Wire name
+#: centralized in labels.py (cclint surface contract); re-exported here.
+REMEDIATION_ANNOTATION = labels_mod.REMEDIATION_ANNOTATION
 
 #: Failure reasons that say nothing about THIS node's hardware: a fenced
 #: or timed-out barrier is a PEER's failure (escalating here would cascade
@@ -139,29 +142,35 @@ class RemediationLadder:
         emit_event: Callable[[str, str, str], None] | None = None,
         metrics: metrics_mod.MetricsRegistry | None = None,
         clock: Callable[[], float] = time.monotonic,
+        intents: "intent_mod.IntentJournal | None" = None,
     ) -> None:
         self.api = api
         self.node_name = node_name
         self.backend = backend
+        # Node-local intent WAL: the hardware rungs journal a
+        # KIND_REMEDIATION intent BEFORE touching the device (the cclint
+        # journal-before-reset contract). None = unjournaled (tests,
+        # CC_INTENT_JOURNAL=0), matching the manager's own degradation.
+        self.intents = intents
         self.failures_per_step = max(1, failures_per_step)
         self.probation_s = probation_s
         self.emit_event = emit_event or (lambda *_: None)
         self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
         self.clock = clock
-        self.failures = 0
-        self.step = STEP_RETRY
-        self.quarantined = False
-        self.last_reason = ""
+        self.failures = 0  # cclint: guarded-by(_lock)
+        self.step = STEP_RETRY  # cclint: guarded-by(_lock)
+        self.quarantined = False  # cclint: guarded-by(_lock)
+        self.last_reason = ""  # cclint: guarded-by(_lock)
         # Probation: monotonic timestamp of the first healthy probe of the
         # current healthy streak while quarantined; None = not in a streak.
         # In-memory only — an agent restart restarts probation, which errs
         # conservative (a crashing agent is itself a bad sign).
-        self._healthy_since: float | None = None
+        self._healthy_since: float | None = None  # cclint: guarded-by(_lock)
         # The ladder is mutated from two threads — the watch loop
         # (note_failure/note_success) and the watchdog (note_probe →
         # unquarantine) — so every public mutator holds this lock; a
         # probation lift can no longer interleave with a failure note.
-        self._lock = threading.RLock()
+        self._lock = locks_mod.make_rlock("remediation")
         # Whether the persisted state has been read successfully; a failed
         # startup load is retried lazily so a quarantined node cannot slip
         # back to reconciling through one apiserver blip at boot.
@@ -171,7 +180,7 @@ class RemediationLadder:
 
     # -- persistence -------------------------------------------------------
 
-    def _load(self) -> None:
+    def _load(self) -> None:  # cclint: requires(_lock)
         """Resume ladder state from the node annotation (agent restart must
         not reset a terminally bad node back to rung zero)."""
         try:
@@ -204,7 +213,7 @@ class RemediationLadder:
                 self.failures, self.step, self.quarantined,
             )
 
-    def _persist(self) -> None:
+    def _persist(self) -> None:  # cclint: requires(_lock)
         """Best-effort write-through of the ladder state; a lost write costs
         at most one rung of progress after a crash-restart."""
         value: str | None
@@ -225,7 +234,7 @@ class RemediationLadder:
         except KubeApiError as e:
             log.warning("remediation: could not persist ladder state: %s", e)
 
-    def _ensure_loaded(self) -> None:
+    def _ensure_loaded(self) -> None:  # cclint: requires(_lock)
         """Lazy retry of a failed startup load: a quarantined node whose
         agent rebooted through an apiserver blip must re-learn its
         quarantine before any ladder decision runs against clean state."""
@@ -268,7 +277,7 @@ class RemediationLadder:
             self._ensure_loaded()
             return self._note_failure_locked(reason)
 
-    def _note_failure_locked(self, reason: str) -> str:
+    def _note_failure_locked(self, reason: str) -> str:  # cclint: requires(_lock)
         if self.quarantined:
             return STEP_QUARANTINE  # already contained; nothing to escalate
         if reason in NON_ESCALATING_REASONS:
@@ -315,6 +324,34 @@ class RemediationLadder:
         self._persist()
         return step
 
+    def _journal_hardware_intent(self, op: str) -> str | None:
+        """Journal-before-reset: a KIND_REMEDIATION intent fsync'd BEFORE
+        the rung's disruptive work. No intent record, no hardware action
+        (same discipline as the manager's transition bracket); replay of
+        an open one simply closes it — the backend's pending markers and
+        the persisted ladder annotation already carry recovery."""
+        if self.intents is None:
+            return None
+        try:
+            return self.intents.begin(
+                intent_mod.KIND_REMEDIATION, op=op, node=self.node_name
+            )
+        except intent_mod.JournalError as e:
+            raise TpuError(
+                f"could not journal remediation {op} intent: {e}"
+            ) from e
+
+    def _journal_close(self, txn: str | None, ok: bool) -> None:
+        if txn is None or self.intents is None:
+            return
+        try:
+            if ok:
+                self.intents.commit(txn)
+            else:
+                self.intents.abort(txn)
+        except intent_mod.JournalError as e:
+            log.warning("could not close remediation intent %s: %s", txn, e)
+
     def _device_reset(self) -> None:
         if self.backend is None:
             raise TpuError("no backend wired for device-reset remediation")
@@ -323,13 +360,27 @@ class RemediationLadder:
             "remediation: re-resetting %d chip(s) on %s", len(chips),
             self.node_name,
         )
-        self.backend.reset(chips)
+        txn = self._journal_hardware_intent("device-reset")
+        try:
+            self.backend.reset(chips)
+        except Exception:
+            # Ordinary failures abort the intent; a modeled SIGKILL
+            # (BaseException) escapes with it OPEN — replay closes it.
+            self._journal_close(txn, ok=False)
+            raise
+        self._journal_close(txn, ok=True)
 
     def _runtime_restart(self) -> None:
         if self.backend is None:
             raise TpuError("no backend wired for runtime-restart remediation")
         log.warning("remediation: restarting TPU runtime on %s", self.node_name)
-        self.backend.restart_runtime()
+        txn = self._journal_hardware_intent("runtime-restart")
+        try:
+            self.backend.restart_runtime()
+        except Exception:
+            self._journal_close(txn, ok=False)
+            raise
+        self._journal_close(txn, ok=True)
 
     # -- quarantine --------------------------------------------------------
 
@@ -340,7 +391,7 @@ class RemediationLadder:
             self._ensure_loaded()
             self._quarantine_locked(reason, manual)
 
-    def _quarantine_locked(self, reason: str, manual: bool) -> None:
+    def _quarantine_locked(self, reason: str, manual: bool) -> None:  # cclint: requires(_lock)
         if self.quarantined:
             return
         # The label patch is the authoritative edge (rollouts, attestation
@@ -386,7 +437,7 @@ class RemediationLadder:
         with self._lock:
             self._unquarantine_locked(reason)
 
-    def _unquarantine_locked(self, reason: str) -> None:
+    def _unquarantine_locked(self, reason: str) -> None:  # cclint: requires(_lock)
         try:
             state = node_labels(self.api.get_node(self.node_name)).get(
                 CC_MODE_STATE_LABEL, ""
@@ -496,11 +547,12 @@ class RemediationLadder:
 
     def describe(self) -> str:
         """One label-safe token for `tpu-cc-ctl status` notes."""
-        if self.quarantined:
-            return "quarantined"
-        if self.failures:
-            return f"{self.step}({self.failures})"
-        return ""
+        with self._lock:
+            if self.quarantined:
+                return "quarantined"
+            if self.failures:
+                return f"{self.step}({self.failures})"
+            return ""
 
 
 def describe_annotation(raw: str | None) -> str:
@@ -526,6 +578,7 @@ def from_env(
     backend: TpuCcBackend | None = None,
     emit_event: Callable[[str, str, str], None] | None = None,
     metrics: metrics_mod.MetricsRegistry | None = None,
+    intents: "intent_mod.IntentJournal | None" = None,
 ) -> RemediationLadder | None:
     """CLI wiring: CC_REMEDIATION_FAILURES_PER_STEP (0 disables the whole
     ladder), CC_QUARANTINE_PROBATION_S."""
@@ -547,4 +600,5 @@ def from_env(
         )),
         emit_event=emit_event,
         metrics=metrics,
+        intents=intents,
     )

@@ -41,6 +41,7 @@ from tpu_cc_manager.kubeclient.api import (
     classify_kube_error,
     node_labels,
 )
+from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.labels import (
     CC_MODE_LABEL,
     CC_MODE_STATE_LABEL,
@@ -54,6 +55,7 @@ from tpu_cc_manager.ccmanager import rollout_state
 from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import retry as retry_mod
+from tpu_cc_manager.utils import locks as locks_mod
 
 log = logging.getLogger(__name__)
 
@@ -164,7 +166,7 @@ ZONE_LABEL = "topology.kubernetes.io/zone"
 #: never subtracts from the pool's serving capacity. Removed ("reclaimed")
 #: the moment the spare converges, at which point it can absorb the
 #: workloads the regular waves drain off the rest of the pool.
-SURGE_TAINT_KEY = "cloud.google.com/tpu-cc.surge"
+SURGE_TAINT_KEY = labels_mod.SURGE_TAINT_KEY
 SURGE_TAINT = {
     "key": SURGE_TAINT_KEY, "value": "true", "effect": "NoSchedule",
 }
@@ -359,16 +361,16 @@ class RollingReconfigurator:
         # concurrently mid-flip, across every wave thread. The max is the
         # rollout's observed disruption ceiling (RolloutResult
         # .max_unavailable_observed).
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = locks_mod.make_lock("rolling.inflight")
         self._inflight_groups = 0
         self._max_inflight_observed = 0
         # Serializes record mutation + checkpoint serialization across
         # wave threads (the lease's own write lock only covers the CAS).
-        self._record_lock = threading.RLock()
+        self._record_lock = locks_mod.make_rlock("rolling.record")
         # FaultPlan rngs are not thread-safe; crash points from concurrent
         # waves serialize so kill schedules stay a pure function of the
         # seed and the (serialized) decision sequence.
-        self._crash_lock = threading.Lock()
+        self._crash_lock = locks_mod.make_lock("rolling.crash")
 
     def rollout(self, mode: str) -> RolloutResult:
         mode = canonical_mode(mode)
@@ -1053,7 +1055,7 @@ class RollingReconfigurator:
             len(groups), len(waves), self.max_unavailable,
         )
         shared = {
-            "lock": threading.Lock(),
+            "lock": locks_mod.make_lock("rolling.waves-shared"),
             "halt": threading.Event(),
             "results": results,
             "window_seconds": window_seconds,
@@ -1118,7 +1120,7 @@ class RollingReconfigurator:
     def _drive_wave_guarded(self, wid, wave, mode, record, shared) -> None:
         try:
             self._drive_wave(wid, wave, mode, record, shared)
-        except BaseException as e:  # noqa: BLE001 - first death wins, re-raised
+        except BaseException as e:  # noqa: BLE001  # cclint: crash-ok(first wave death wins - rollout re-raises it after halting every wave)
             with shared["lock"]:
                 if shared["error"] is None:
                     shared["error"] = e

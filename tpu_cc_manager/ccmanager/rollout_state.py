@@ -47,8 +47,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Iterator, Mapping
 
+from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, WatchEvent
 from tpu_cc_manager.utils import metrics as metrics_mod
+from tpu_cc_manager.utils import locks as locks_mod
 
 log = logging.getLogger(__name__)
 
@@ -60,11 +62,12 @@ DEFAULT_LEASE_NAMESPACE = "tpu-operator"
 LEASE_NAME = "tpu-cc-rollout"
 
 #: Lease annotation carrying the checkpointed rollout record (JSON).
-RECORD_ANNOTATION = "cloud.google.com/tpu-cc.rollout-record"
+#: Wire names centralized in labels.py (cclint surface contract).
+RECORD_ANNOTATION = labels_mod.ROLLOUT_RECORD_ANNOTATION
 
 #: Node label stamped (with the rollout generation) alongside every
 #: desired-mode patch a fenced rollout writes.
-ROLLOUT_GEN_LABEL = "cloud.google.com/tpu-cc.rollout-gen"
+ROLLOUT_GEN_LABEL = labels_mod.ROLLOUT_GEN_LABEL
 
 DEFAULT_LEASE_DURATION_S = 15.0
 
@@ -351,15 +354,15 @@ class RolloutLease:
         #: holders because every acquisition CAS-increments it.
         self.generation: int | None = None
         self.lost = False
-        self._lease: dict | None = None
-        self._last_renew: float | None = None
-        self._lock = threading.Lock()
+        self._lease: dict | None = None  # cclint: guarded-by(_lock)
+        self._last_renew: float | None = None  # cclint: guarded-by(_lock)
+        self._lock = locks_mod.make_lock("rollout-lease.state")
         # Serializes whole lease WRITES within this process: without it
         # the renewer thread can CAS between the main thread's read and
         # write, turning every window-boundary checkpoint into a
         # conflict. (Cross-process conflicts are still resolved by
         # holder identity + retry in checkpoint().)
-        self._write_lock = threading.Lock()
+        self._write_lock = locks_mod.make_lock("rollout-lease.write")
         self._renew_stop: threading.Event | None = None
         self._renew_thread: threading.Thread | None = None
 
@@ -441,8 +444,7 @@ class RolloutLease:
         self.metrics.record_lease_transition()
         return record
 
-    def _adopt(self, lease: dict, generation: int) -> None:
-        # Caller holds self._lock.
+    def _adopt(self, lease: dict, generation: int) -> None:  # cclint: requires(_lock)
         self._lease = lease
         self.generation = generation
         self._last_renew = self.clock()

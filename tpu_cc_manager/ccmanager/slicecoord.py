@@ -73,6 +73,7 @@ from tpu_cc_manager.kubeclient.api import (
     classify_kube_error,
     node_labels,
 )
+from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.labels import (
     CC_MODE_STATE_LABEL,
     SLICE_ID_LABEL,
@@ -85,15 +86,17 @@ from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
-SLICE_STAGED_LABEL = "cloud.google.com/tpu-cc.slice.staged"
-SLICE_COMMIT_LABEL = "cloud.google.com/tpu-cc.slice.commit"
+# Wire names centralized in labels.py (cclint surface contract);
+# re-exported here so the barrier's public API is unchanged.
+SLICE_STAGED_LABEL = labels_mod.SLICE_STAGED_LABEL
+SLICE_COMMIT_LABEL = labels_mod.SLICE_COMMIT_LABEL
 # Dead-peer fencing: the slice's current fencing generation (integer),
 # bumped on the condemned node; rounds entered at an older generation
 # abort fast and can neither complete nor re-stage.
-SLICE_FENCE_LABEL = "cloud.google.com/tpu-cc.slice.fence"
+SLICE_FENCE_LABEL = labels_mod.SLICE_FENCE_LABEL
 # Which generation a host's staged / commit marker belongs to.
-SLICE_STAGED_GEN_LABEL = "cloud.google.com/tpu-cc.slice.staged-gen"
-SLICE_COMMIT_GEN_LABEL = "cloud.google.com/tpu-cc.slice.commit-gen"
+SLICE_STAGED_GEN_LABEL = labels_mod.SLICE_STAGED_GEN_LABEL
+SLICE_COMMIT_GEN_LABEL = labels_mod.SLICE_COMMIT_GEN_LABEL
 
 DEFAULT_BARRIER_TIMEOUT_S = 300.0
 # How long the leader lingers after its own transition for peers to clear

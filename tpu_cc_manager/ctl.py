@@ -26,7 +26,11 @@ from tpu_cc_manager.ccmanager.multislice import (
     pool_report,
     verify_pool_attestation,
 )
-from tpu_cc_manager.ccmanager.rolling import SLICE_ID_LABEL, RollingReconfigurator
+from tpu_cc_manager.ccmanager.rolling import (
+    SLICE_ID_LABEL,
+    SURGE_TAINT_KEY,
+    RollingReconfigurator,
+)
 from tpu_cc_manager.kubeclient.api import node_labels
 from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
 from tpu_cc_manager.labels import (
@@ -84,7 +88,7 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument(
         "--surge", type=int, default=None,
         help="surge rollout: flip up to N spare nodes FIRST behind the "
-        "cloud.google.com/tpu-cc.surge NoSchedule taint "
+        f"{SURGE_TAINT_KEY} NoSchedule taint "
         "(unschedulable-for-workloads for exactly their flip window), "
         "then reclaim them — the rolling waves migrate workloads onto "
         "already-flipped capacity, so measured pool unavailability stays "

@@ -42,19 +42,22 @@ import time
 from typing import Callable, NamedTuple
 
 from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, node_labels
+from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.labels import label_safe
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
-DRAIN_REQUESTED_LABEL = "cloud.google.com/tpu-cc.drain"
+# Wire names centralized in labels.py (cclint surface contract).
+DRAIN_REQUESTED_LABEL = labels_mod.DRAIN_REQUESTED_LABEL
 DRAIN_REQUESTED = "requested"  # value prefix: "requested-<cycle token>"
 # Optional deadline hint published WITH a drain request (whole seconds):
 # a preemption fast-drain carries its hard termination deadline here so a
 # subscriber's checkpoint callback can choose a partial/incremental
 # checkpoint that actually fits the window instead of starting a full one
 # the kill will truncate. Absent on a normal (300 s budget) drain.
-DRAIN_DEADLINE_LABEL = "cloud.google.com/tpu-cc.drain.deadline-s"
-SUBSCRIBER_PREFIX = "drain-subscriber.tpu-cc.gke.io/"
+DRAIN_DEADLINE_LABEL = labels_mod.DRAIN_DEADLINE_LABEL
+SUBSCRIBER_PREFIX = labels_mod.DRAIN_SUBSCRIBER_PREFIX
 ACTIVE = "active"
 ACKED = "acked"  # value prefix: "acked-<cycle token>"
 
@@ -187,30 +190,32 @@ def await_workload_acks(
     laggard; only those subscribers keep the r4-size stale-ack window, and
     only until their image catches up."""
     expected = ack_value(token)
-    deadline = time.monotonic() + timeout_s
-    legacy_warned = False
-    while True:
+    state: dict = {"laggards": [], "legacy_warned": False}
+
+    def all_acked() -> bool:
         labels = node_labels(api.get_node(node_name))
         subs = subscriber_labels_of(labels)
-        if not legacy_warned and any(v == ACKED for v in subs.values()):
+        if not state["legacy_warned"] and any(
+            v == ACKED for v in subs.values()
+        ):
             log.warning(
                 "subscriber(s) %s acked with the pre-token value — "
                 "upgrade their image for cycle-scoped acks",
                 sorted(k for k, v in subs.items() if v == ACKED),
             )
-            legacy_warned = True
-        laggards = sorted(
+            state["legacy_warned"] = True
+        state["laggards"] = sorted(
             k for k, v in subs.items() if v not in (expected, ACKED)
         )
-        if not laggards:
-            return []
-        if time.monotonic() >= deadline:
-            log.warning(
-                "drain ack timeout on %s: %s did not checkpoint in %.0fs — "
-                "proceeding anyway", node_name, laggards, timeout_s,
-            )
-            return laggards
-        time.sleep(poll_interval_s)
+        return not state["laggards"]
+
+    if retry_mod.poll_until(all_acked, timeout_s, poll_interval_s):
+        return []
+    log.warning(
+        "drain ack timeout on %s: %s did not checkpoint in %.0fs — "
+        "proceeding anyway", node_name, state["laggards"], timeout_s,
+    )
+    return state["laggards"]
 
 
 def clear_drain_request(api: KubeApi, node_name: str) -> None:

@@ -88,6 +88,52 @@ SLICE_ID_LABEL = "cloud.google.com/tpu-slice-id"
 QUARANTINED_LABEL = "cloud.google.com/tpu-cc.quarantined"
 QUARANTINE_TAINT_KEY = "cloud.google.com/tpu-cc.quarantined"
 
+# --- Centralized wire names (cclint surface contract) -----------------------
+# Every cloud.google.com/tpu-cc.* / tpu-cc.gke.io key the agent writes or
+# reads lives HERE; the owning modules re-export them so their public API
+# is unchanged, and the cclint label-literal check (lint/surface.py) fails
+# any new inline literal. One module owns the wire names: a renamed key is
+# one diff hunk, not a grep across the thread soup.
+
+# Slice commit barrier markers (ccmanager/slicecoord.py): staged/commit
+# markers carry "<mode>:<ts>", the fencing generation invalidates a round.
+SLICE_STAGED_LABEL = "cloud.google.com/tpu-cc.slice.staged"
+SLICE_COMMIT_LABEL = "cloud.google.com/tpu-cc.slice.commit"
+SLICE_FENCE_LABEL = "cloud.google.com/tpu-cc.slice.fence"
+SLICE_STAGED_GEN_LABEL = "cloud.google.com/tpu-cc.slice.staged-gen"
+SLICE_COMMIT_GEN_LABEL = "cloud.google.com/tpu-cc.slice.commit-gen"
+
+# Remediation-ladder persistence (ccmanager/remediation.py).
+REMEDIATION_ANNOTATION = "cloud.google.com/tpu-cc.remediation"
+
+# Crash-safe rollouts (ccmanager/rollout_state.py): the checkpointed
+# record on the Lease, and the generation stamp on rolled nodes.
+ROLLOUT_RECORD_ANNOTATION = "cloud.google.com/tpu-cc.rollout-record"
+ROLLOUT_GEN_LABEL = "cloud.google.com/tpu-cc.rollout-gen"
+
+# Surge rollouts (ccmanager/rolling.py): spares flip first behind this
+# NoSchedule taint and are reclaimed on convergence.
+SURGE_TAINT_KEY = "cloud.google.com/tpu-cc.surge"
+
+# Multi-slice attestation (ccmanager/multislice.py): summary quote,
+# full quote payload, and the verifier-challenge nonce.
+QUOTE_ANNOTATION = "cloud.google.com/tpu-cc.attestation"
+QUOTE_FULL_ANNOTATION = "cloud.google.com/tpu-cc.quote"
+CHALLENGE_ANNOTATION = "cloud.google.com/tpu-cc.challenge"
+
+# Preemption handoff record (ccmanager/manager.py): published by the
+# departing agent, consumed by the replacement node's agent.
+HANDOFF_ANNOTATION = "cloud.google.com/tpu-cc.handoff"
+
+# Workload drain handshake (drain/handshake.py): drain request + deadline
+# hint on the node; per-job ack annotations under the subscriber prefix.
+DRAIN_REQUESTED_LABEL = "cloud.google.com/tpu-cc.drain"
+DRAIN_DEADLINE_LABEL = "cloud.google.com/tpu-cc.drain.deadline-s"
+DRAIN_SUBSCRIBER_PREFIX = "drain-subscriber.tpu-cc.gke.io/"
+
+# Event → span-tree correlation (ccmanager/manager.py _emit_node_event).
+TRACE_ID_ANNOTATION = "tpu-cc.gke.io/trace-id"
+
 # Pause protocol (reference gpu_operator_eviction.py:43-95):
 #   'true'        -> PAUSED_VALUE
 #   custom 'v'    -> 'v' + PAUSED_SUFFIX

@@ -1,0 +1,44 @@
+"""cclint: contract-aware static analysis for this repo's safety invariants.
+
+Eight PRs of robustness work accumulated safety contracts that lived only
+as prose in CHANGES.md and reviewer memory. Each checker here machine-
+checks one of them, over the package's own source (stdlib ``ast`` only):
+
+``locks``
+    Shared fields annotated ``# cclint: guarded-by(<lock>)`` at their
+    ``__init__`` assignment may only be touched inside a
+    ``with self.<lock>:`` block elsewhere in the class (or in a method
+    annotated ``# cclint: requires(<lock>)``, whose callers hold it).
+``waits``
+    ``time.sleep`` outside ``utils/retry.py`` / ``faults/`` is an error —
+    every wait rides the shared retry/backoff layer (the PR 2 invariant).
+``crash``
+    A handler that can catch ``BaseException`` (bare ``except:`` or
+    explicit) must re-raise it; the kill-at-every-crash-point suites
+    depend on modeled SIGKILL escaping every cleanup path. A handler that
+    intentionally captures (worker threads re-raising at join) carries
+    ``# cclint: crash-ok(<reason>)``.
+``journal``
+    Direct calls to ``backend.reset`` / ``backend.restart_runtime``
+    outside the allowlisted journaled call sites are an error — every
+    hardware-effecting operation journals an intent first (PR 5).
+``surface``
+    Contract-surface drift: every ``CC_*`` env read must appear in the
+    docs/operations.md env table, every ``CC_*`` env the daemonset sets
+    must be read somewhere in code, every emitted metric family must be
+    seeded through the exposition lint's live-registry render and
+    documented, and every ``cloud.google.com/tpu-cc.*`` /
+    ``tpu-cc.gke.io`` label/annotation key must come from ``labels.py``,
+    never an inline literal.
+
+The driver (``python -m tpu_cc_manager.lint``) runs every checker plus
+the Prometheus exposition lint (:mod:`tpu_cc_manager.lint.expo`, the
+former ``hack/check_metrics_lint.py`` — the old entrypoint remains as a
+shim), emits human or ``--json`` output, and compares findings against
+the committed baseline (``.cclint-baseline.json``): grandfathered
+violations are explicit, each with a reason, and any NEW finding fails
+the build. The static passes pair with an opt-in runtime lock-order
+checker (``CC_LOCKCHECK=1``, :mod:`tpu_cc_manager.utils.locks`).
+"""
+
+from tpu_cc_manager.lint.base import Finding, LintContext  # noqa: F401

@@ -1,0 +1,128 @@
+"""cclint driver: ``python -m tpu_cc_manager.lint``.
+
+Runs every contract checker over the package plus the Prometheus
+exposition lint's seeded live-registry render, filters findings through
+the committed baseline, and exits non-zero on anything new. ``--json``
+emits the machine-readable report CI archives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+from tpu_cc_manager.lint import base, baseline as baseline_mod, expo
+from tpu_cc_manager.lint import crash, journal, locks, surface, waits
+from tpu_cc_manager.lint.base import Finding
+
+CHECKERS = (locks, waits, crash, journal, surface)
+
+
+def _repo_root() -> str:
+    """The repo root: the directory holding the tpu_cc_manager package."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def run(root: str, skip_expo: bool = False) -> list[Finding]:
+    ctx = base.build_context(root)
+    # One seeded render serves both the surface checker's metric-unseeded
+    # sub-check and the exposition pass below.
+    seeded = surface.seeded_render()
+    findings: list[Finding] = []
+    for checker in CHECKERS:
+        if checker is surface:
+            findings.extend(surface.check(ctx, seeded_render_text=seeded))
+        else:
+            findings.extend(checker.check(ctx))
+    if not skip_expo and seeded is not None:
+        for problem in expo.lint(seeded):
+            findings.append(
+                Finding(
+                    checker="expo",
+                    path="tpu_cc_manager/utils/metrics.py",
+                    line=1,
+                    message=f"exposition lint: {problem}",
+                    symbol="exposition",
+                    # Fingerprints are line-independent by design; the
+                    # problem text leads with the exposition line number,
+                    # which shifts whenever a family is added.
+                    detail=re.sub(r"^line \d+:\s*", "", problem)[:80],
+                )
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_cc_manager.lint",
+        description="contract-aware static analysis (see docs/cclint.md)",
+    )
+    parser.add_argument("--root", default=None, help="repo root (default: auto)")
+    parser.add_argument(
+        "--baseline", default=None, help=f"baseline path (default: <root>/{baseline_mod.BASELINE_FILE})"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable report on stdout"
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="grandfather every current finding (reasons stubbed TODO)",
+    )
+    parser.add_argument(
+        "--skip-expo", action="store_true",
+        help="skip the Prometheus exposition lint pass",
+    )
+    args = parser.parse_args(argv)
+
+    root = args.root or _repo_root()
+    started = time.monotonic()
+    findings = run(root, skip_expo=args.skip_expo)
+    if args.write_baseline:
+        path = baseline_mod.save(root, findings, args.baseline)
+        print(f"wrote {len(set(f.fingerprint for f in findings))} entries to {path}")
+        return 0
+    known = baseline_mod.load(root, args.baseline)
+    new, grandfathered, stale = baseline_mod.split(findings, known)
+    elapsed = time.monotonic() - started
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": not new,
+                    "elapsed_s": round(elapsed, 3),
+                    "new": [f.to_dict() for f in new],
+                    "grandfathered": [f.to_dict() for f in grandfathered],
+                    "stale_baseline_entries": stale,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f"{f.path}:{f.line}: [{f.checker}] {f.message}")
+            print(f"    fingerprint: {f.fingerprint}")
+        for fp in stale:
+            print(f"stale baseline entry (no longer found): {fp}")
+        print(
+            f"cclint: {len(new)} new, {len(grandfathered)} grandfathered, "
+            f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'} "
+            f"({elapsed:.2f}s)"
+        )
+        if new:
+            print(
+                "fix the findings, or (deliberate keeps only) add baseline "
+                f"entries with reasons to {baseline_mod.BASELINE_FILE}"
+            )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

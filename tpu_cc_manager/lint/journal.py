@@ -1,0 +1,101 @@
+"""Checker 4: journal-before-reset.
+
+PR 5's contract: every hardware-effecting operation journals an intent
+(``intent_journal.begin`` fsync'd to disk) BEFORE the first disruptive
+step, so a SIGKILL at any point replays to exactly-one-reset-per-chip.
+A new call site that resets chips or bounces the runtime without the
+write-ahead intent silently reopens the double-reset window — so direct
+calls to ``<...>.backend.reset(...)`` / ``<...>.backend.restart_runtime()``
+are only legal at the allowlisted, journal-bracketed sites below.
+
+The device layer itself (``tpudev/``) is out of scope: a backend
+composing its own primitives (the contract's default ``restart_runtime``
+delegating to ``reset``) is inside the bracket its caller journaled.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_cc_manager.lint.base import Finding, LintContext, qualname_of
+
+CHECKER = "journal"
+
+EXCLUDED_DIRS = ("tpu_cc_manager/tpudev/",)
+
+#: fingerprint -> why this call site is legal. Adding a site here is a
+#: reviewed act: the new caller must journal an intent first (or prove it
+#: runs inside an existing bracket).
+ALLOWLIST: dict[str, str] = {
+    # The phased transition: _begin_transition_intent ran (write-ahead,
+    # before the drain on the pipelined path) and the reset phase is
+    # marked on the txn immediately before the call.
+    "journal:tpu_cc_manager/ccmanager/manager.py:CCManager._apply_direct:reset": (
+        "inside the journaled transition bracket (PHASE_RESET marked)"
+    ),
+    # Remediation ladder rungs journal a KIND_REMEDIATION intent before
+    # the hardware action (RemediationLadder._journal_hardware_intent).
+    "journal:tpu_cc_manager/ccmanager/remediation.py:RemediationLadder._device_reset:reset": (
+        "journaled via _journal_hardware_intent (KIND_REMEDIATION intent)"
+    ),
+    "journal:tpu_cc_manager/ccmanager/remediation.py:RemediationLadder._runtime_restart:restart_runtime": (
+        "journaled via _journal_hardware_intent (KIND_REMEDIATION intent)"
+    ),
+}
+
+
+def _is_backend_hw_call(call: ast.Call) -> str | None:
+    """``<expr>.backend.reset(...)`` / ``.restart_runtime(...)`` (or a
+    bare ``backend.<op>(...)``) -> the op name, else None."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in (
+        "reset", "restart_runtime"
+    ):
+        return None
+    base = fn.value
+    if isinstance(base, ast.Name) and base.id == "backend":
+        return fn.attr
+    if isinstance(base, ast.Attribute) and base.attr == "backend":
+        return fn.attr
+    return None
+
+
+def check(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.files:
+        if src.relpath.startswith(EXCLUDED_DIRS):
+            continue
+        stack: list[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            is_scope = isinstance(
+                node, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_scope:
+                stack.append(node)
+            if isinstance(node, ast.Call):
+                op = _is_backend_hw_call(node)
+                if op is not None:
+                    symbol = qualname_of(stack)
+                    f = Finding(
+                        checker=CHECKER,
+                        path=src.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"backend.{op} in {symbol} is not an "
+                            "allowlisted journaled call site — journal an "
+                            "intent first, then add the site to "
+                            "lint/journal.py ALLOWLIST with its bracket"
+                        ),
+                        symbol=symbol,
+                        detail=op,
+                    )
+                    if f.fingerprint not in ALLOWLIST:
+                        findings.append(f)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+
+        visit(src.tree)
+    return findings

@@ -1,0 +1,179 @@
+"""Checker 1: annotated lock discipline.
+
+The agent is a thread soup — watch loop, watchdog, preemption monitor,
+informer, renewer, wave drivers, pipeline workers — and every shared
+field they touch is supposed to be lock-guarded. The convention this
+checker enforces:
+
+- A shared field declares its lock at its ``__init__`` assignment::
+
+      self._nodes = {}  # cclint: guarded-by(_cond)
+
+- Everywhere else in the class, the field may only be touched inside a
+  ``with self._cond:`` block (lexically), or in a method that declares
+  its callers hold the lock::
+
+      def _rebuild(self):  # cclint: requires(_cond)
+
+- ``__init__`` itself is exempt (no concurrency before construction
+  finishes), and a deliberate lock-free access can carry
+  ``# cclint: unlocked-ok(<reason>)`` on its line.
+
+Lexical scoping is deliberately conservative: a closure defined inside a
+``with`` block may run after the lock is released, so nested ``def`` /
+``lambda`` bodies start with no held locks (they may re-acquire, or
+declare ``requires`` on the nested def).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_cc_manager.lint.base import Finding, LintContext, SourceFile
+
+CHECKER = "locks"
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.<attr>`` -> attr name, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock names acquired by ``with self.<lock>[, ...]:`` items."""
+    locks: set[str] = set()
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None:
+            locks.add(attr)
+    return locks
+
+
+def _requires_of(fn: ast.FunctionDef, src: SourceFile) -> set[str]:
+    """Locks a ``# cclint: requires(<lock>)`` annotation on the def's
+    signature lines declares held by every caller."""
+    sig_end = fn.body[0].lineno if fn.body else fn.lineno
+    out: set[str] = set()
+    for ln in range(fn.lineno, sig_end + 1):
+        for d, arg in src.annotations.get(ln, ()):
+            if d == "requires":
+                out.update(a.strip() for a in arg.split(",") if a.strip())
+    return out
+
+
+def _guarded_fields(cls: ast.ClassDef, src: SourceFile) -> dict[str, str]:
+    """field -> lock, from ``guarded-by`` annotations on ``__init__``
+    assignments (or class-body assignments)."""
+    guarded: dict[str, str] = {}
+
+    def scan_stmt(stmt: ast.stmt) -> None:
+        targets: list[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        else:
+            return
+        arg = src.annotation(
+            stmt.lineno, "guarded-by", span_end=stmt.end_lineno
+        )
+        if arg is None:
+            return
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                guarded[attr] = arg.strip()
+
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.stmt):
+                    scan_stmt(stmt)
+    return guarded
+
+
+class _MethodWalker:
+    """Walks one method body tracking the lexically-held lock set."""
+
+    def __init__(
+        self,
+        src: SourceFile,
+        cls_name: str,
+        method: str,
+        guarded: dict[str, str],
+        findings: list[Finding],
+    ) -> None:
+        self.src = src
+        self.cls_name = cls_name
+        self.method = method
+        self.guarded = guarded
+        self.findings = findings
+
+    def walk(self, node: ast.AST, held: frozenset[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A nested def runs later, possibly lock-free: reset to its
+            # own declared requirements.
+            inner = frozenset(_requires_of(node, self.src))
+            for child in node.body:
+                self.walk(child, inner)
+            return
+        if isinstance(node, ast.Lambda):
+            self.walk(node.body, frozenset())
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = _with_locks(node)
+            for item in node.items:
+                self.walk(item.context_expr, held)
+            for child in node.body:
+                self.walk(child, held | acquired)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in self.guarded:
+            lock = self.guarded[attr]
+            if lock not in held and self.src.annotation(
+                node.lineno, "unlocked-ok"
+            ) is None:
+                self.findings.append(
+                    Finding(
+                        checker=CHECKER,
+                        path=self.src.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"self.{attr} is guarded-by({lock}) but accessed "
+                            f"outside `with self.{lock}:` in "
+                            f"{self.cls_name}.{self.method}"
+                        ),
+                        symbol=f"{self.cls_name}.{self.method}",
+                        detail=attr,
+                    )
+                )
+            # Still walk the value chain (e.g. self._nodes[k].foo).
+        for child in ast.iter_child_nodes(node):
+            self.walk(child, held)
+
+
+def check(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.files:
+        for cls in [
+            n for n in ast.walk(src.tree) if isinstance(n, ast.ClassDef)
+        ]:
+            guarded = _guarded_fields(cls, src)
+            if not guarded:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, ast.FunctionDef) or fn.name == "__init__":
+                    continue
+                held = frozenset(_requires_of(fn, src))
+                walker = _MethodWalker(
+                    src, cls.name, fn.name, guarded, findings
+                )
+                for stmt in fn.body:
+                    walker.walk(stmt, held)
+    return findings

@@ -1,0 +1,79 @@
+"""Checker 2: no ad-hoc waits.
+
+PR 2's invariant: every wait in the agent rides ``utils/retry.py``
+(jittered backoff ladders, ``poll_until`` deadlines, stop-aware waits) so
+nothing sleeps unjittered, uninterruptible, or unaccounted. A direct
+``time.sleep`` call anywhere outside ``utils/retry.py`` itself (and the
+fault-injection layer, whose job is to simulate slowness) is an error.
+
+References that merely *name* the function (``sleep=time.sleep`` default
+arguments) are not calls and are fine.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_cc_manager.lint.base import Finding, LintContext, qualname_of
+
+CHECKER = "waits"
+
+ALLOWED_FILES = ("tpu_cc_manager/utils/retry.py",)
+ALLOWED_DIRS = ("tpu_cc_manager/faults/",)
+
+
+def _is_time_sleep(call: ast.Call, from_time_names: set[str]) -> bool:
+    fn = call.func
+    if (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "sleep"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "time"
+    ):
+        return True
+    return isinstance(fn, ast.Name) and fn.id in from_time_names
+
+
+def check(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.files:
+        if src.relpath in ALLOWED_FILES or src.relpath.startswith(ALLOWED_DIRS):
+            continue
+        # Names bound by `from time import sleep [as x]`.
+        from_time: set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name == "sleep":
+                        from_time.add(alias.asname or alias.name)
+
+        stack: list[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            is_scope = isinstance(
+                node, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_scope:
+                stack.append(node)
+            if isinstance(node, ast.Call) and _is_time_sleep(node, from_time):
+                symbol = qualname_of(stack)
+                findings.append(
+                    Finding(
+                        checker=CHECKER,
+                        path=src.relpath,
+                        line=node.lineno,
+                        message=(
+                            f"time.sleep in {symbol} — waits must ride "
+                            "utils/retry.py (poll_until / RetryPolicy / "
+                            "stop-aware wait)"
+                        ),
+                        symbol=symbol,
+                    )
+                )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+
+        visit(src.tree)
+    return findings

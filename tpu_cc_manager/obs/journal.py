@@ -25,8 +25,9 @@ import collections
 import json
 import logging
 import os
-import threading
 from typing import TYPE_CHECKING, Iterable
+
+from tpu_cc_manager.utils import locks as locks_mod
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (trace imports us)
     from tpu_cc_manager.obs.trace import Span
@@ -54,12 +55,12 @@ class Journal:
         trace_file: str | None = None,
         max_file_bytes: int | None = None,
     ) -> None:
-        self._lock = threading.Lock()
-        self._finished: collections.deque[dict] = collections.deque(
+        self._lock = locks_mod.make_lock("obs.journal")
+        self._finished: collections.deque[dict] = collections.deque(  # cclint: guarded-by(_lock)
             maxlen=max(1, capacity)
         )
         # span_id -> live Span, for the /statusz in-flight tree.
-        self._active: dict[str, "Span"] = {}
+        self._active: dict[str, "Span"] = {}  # cclint: guarded-by(_lock)
         if trace_file is None:
             trace_file = os.environ.get(TRACE_FILE_ENV, "")
         self.trace_file = trace_file or None
@@ -76,7 +77,7 @@ class Journal:
                 )
                 max_file_bytes = DEFAULT_MAX_FILE_BYTES
         self.max_file_bytes = max_file_bytes
-        self._file_bytes = 0
+        self._file_bytes = 0  # cclint: guarded-by(_lock)
         if self.trace_file and os.path.exists(self.trace_file):
             try:
                 self._file_bytes = os.path.getsize(self.trace_file)

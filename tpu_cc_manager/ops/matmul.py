@@ -17,6 +17,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Compat shim for the pallas compiler-params rename: newer JAX exposes
+# ``pltpu.CompilerParams``, older releases (<= 0.4.x) only the deprecated
+# ``pltpu.TPUCompilerParams``. Same constructor signature for the fields
+# used here (dimension_semantics).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 # Measured per-generation default tilings for ``tiled_matmul``. Retuned
 # from `hack/tune_pallas.sh` sweep artifacts, not guesswork: v5e's entry
@@ -95,7 +103,7 @@ def tiled_matmul(
         # M/N tiles are independent (parallel); the K walk carries the
         # accumulator (arbitrary). Declaring this lets Mosaic pipeline the
         # K steps and reorder/parallelize output tiles.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -22,7 +22,6 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -38,6 +37,7 @@ from tpu_cc_manager.tpudev.contract import (
     raise_pool_errors,
     reset_parallelism,
 )
+from tpu_cc_manager.utils import locks as locks_mod
 
 # Shared secret for fake quotes; the verifier uses the same constant.
 FAKE_ATTESTATION_KEY = b"tpu-cc-manager-fake-attestation-key"
@@ -89,7 +89,7 @@ class FakeTpuBackend(TpuCcBackend):
             host_index=host_index,
             chips=self._chips,
         )
-        self._lock = threading.Lock()
+        self._lock = locks_mod.make_lock("fake-backend")
         self.committed: dict[int, str] = {c.index: initial_mode for c in self._chips}
         self.staged: dict[int, str] = {}
         self.booted: dict[int, bool] = {c.index: True for c in self._chips}

@@ -18,11 +18,11 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 import time
 from dataclasses import dataclass, field
 
 from tpu_cc_manager.obs import trace as obs_trace
+from tpu_cc_manager.utils import locks as locks_mod
 
 log = logging.getLogger(__name__)
 
@@ -164,68 +164,68 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._history: list[ReconcileMetrics] = []
+        self._lock = locks_mod.make_lock("metrics.registry")
+        self._history: list[ReconcileMetrics] = []  # cclint: guarded-by(_lock)
         # Cumulative counters (unbounded lifetime, unlike the history): a
         # scraper that misses a reconcile still sees its latency in the
         # totals — last-reconcile gauges alone lose data between scrapes.
-        self._result_totals: dict[str, int] = {}
-        self._phase_totals: dict[tuple[str, str], list[float]] = {}
+        self._result_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._phase_totals: dict[tuple[str, str], list[float]] = {}  # cclint: guarded-by(_lock)
         # (mode, phase) -> per-bucket cumulative-style counts; index i is
         # observations <= HISTOGRAM_BUCKETS[i], the final slot is +Inf.
-        self._phase_hist: dict[tuple[str, str], list[int]] = {}
+        self._phase_hist: dict[tuple[str, str], list[int]] = {}  # cclint: guarded-by(_lock)
         # Machine-readable failure reasons (CCManager._failure_reason and
         # the pre-apply failure paths), keyed exactly as the failed.reason
         # node label is.
-        self._failure_totals: dict[str, int] = {}
+        self._failure_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
         # (op, reason) -> retries through the shared policy (utils/retry.py).
-        self._retry_totals: dict[tuple[str, str], int] = {}
+        self._retry_totals: dict[tuple[str, str], int] = {}  # cclint: guarded-by(_lock)
         # Circuit breaker states by path name ("apiserver", "device-cmd").
-        self._breaker_states: dict[str, str] = {}
+        self._breaker_states: dict[str, str] = {}  # cclint: guarded-by(_lock)
         # Runtime-health watchdog: active probe tier + last probe verdict.
-        self._health_tier: tuple[str, int] | None = None
-        self._runtime_healthy: bool | None = None
+        self._health_tier: tuple[str, int] | None = None  # cclint: guarded-by(_lock)
+        self._runtime_healthy: bool | None = None  # cclint: guarded-by(_lock)
         # Failure containment (ccmanager/remediation.py): whether this node
         # is quarantined, ladder actions by (step, outcome), and how many
         # slice barriers were aborted with a fencing generation.
-        self._quarantined: bool | None = None
-        self._remediation_totals: dict[tuple[str, str], int] = {}
-        self._barrier_fenced_total = 0
+        self._quarantined: bool | None = None  # cclint: guarded-by(_lock)
+        self._remediation_totals: dict[tuple[str, str], int] = {}  # cclint: guarded-by(_lock)
+        self._barrier_fenced_total = 0  # cclint: guarded-by(_lock)
         # Crash-safe rollout orchestration (ccmanager/rollout_state.py):
         # resumes from a persisted record, lease acquisitions/takeovers,
         # and writes refused because the lease was lost (fencing).
-        self._rollout_resumes_total = 0
-        self._rollout_lease_transitions_total = 0
-        self._rollout_fenced_writes_total = 0
+        self._rollout_resumes_total = 0  # cclint: guarded-by(_lock)
+        self._rollout_lease_transitions_total = 0  # cclint: guarded-by(_lock)
+        self._rollout_fenced_writes_total = 0  # cclint: guarded-by(_lock)
         # Apiserver-outage autonomy (ccmanager/intent_journal.py): live
         # connectivity, how long the current outage has lasted, intent-
         # journal replays by outcome, and deferred label patches.
-        self._apiserver_connected: bool | None = None
-        self._offline_seconds: float | None = None
-        self._journal_replay_totals: dict[str, int] = {}
-        self._deferred_patch_total = 0
+        self._apiserver_connected: bool | None = None  # cclint: guarded-by(_lock)
+        self._offline_seconds: float | None = None  # cclint: guarded-by(_lock)
+        self._journal_replay_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._deferred_patch_total = 0  # cclint: guarded-by(_lock)
         # Fleet churn (preemption fast-drain + autoscaler interplay):
         # preemption notices handled by outcome (handoff / clean /
         # resumed / handoff-failed), mid-rollout node adoptions, and how
         # long the last fast drain took against its hard deadline.
-        self._preemption_totals: dict[str, int] = {}
-        self._node_adoptions_total = 0
-        self._fast_drain_seconds: float | None = None
+        self._preemption_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._node_adoptions_total = 0  # cclint: guarded-by(_lock)
+        self._fast_drain_seconds: float | None = None  # cclint: guarded-by(_lock)
         # Pipelined transitions (ccmanager/manager.py): how many seconds
         # the most recent reconcile saved by overlapping phases (sum of
         # phase latencies minus reconcile wall time, floored at 0), and
         # smoke fast-path decisions by outcome (hit = smoke skipped on an
         # unchanged verified digest, miss = digest changed so the full
         # smoke ran, cold = no verified digest on record yet).
-        self._phase_overlap_seconds: float | None = None
-        self._smoke_fastpath_totals: dict[str, int] = {}
+        self._phase_overlap_seconds: float | None = None  # cclint: guarded-by(_lock)
+        self._smoke_fastpath_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
         # Client-side apiserver request accounting by verb (get / list /
         # watch / patch / create / update / delete): every HTTP round
         # trip RestKube performs, retries included. The fleet-scale
         # question this answers: is this process O(changes) against the
         # apiserver (watch-driven informer cache) or O(pool) (re-listing
         # per decision)?
-        self._apiserver_request_totals: dict[str, int] = {}
+        self._apiserver_request_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)

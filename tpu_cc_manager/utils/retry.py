@@ -41,6 +41,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, NamedTuple
 
+from tpu_cc_manager.utils import locks as locks_mod
+
 log = logging.getLogger(__name__)
 
 
@@ -232,6 +234,19 @@ def poll_until(
         sleep(min(interval_s, remaining))
 
 
+def wait(delay_s: float, stop: "threading.Event | None" = None) -> bool:
+    """The one sanctioned bare wait outside this module (cclint's
+    ``waits`` checker forbids direct ``time.sleep`` elsewhere): sleep
+    ``delay_s``, stop-aware when the caller has a stop event. Returns
+    True when ``stop`` was set during the wait — the caller should wind
+    down instead of continuing its loop."""
+    delay_s = max(0.0, delay_s)
+    if stop is not None:
+        return stop.wait(delay_s)
+    time.sleep(delay_s)
+    return False
+
+
 # ---------------------------------------------------------------------------
 # Circuit breaker
 # ---------------------------------------------------------------------------
@@ -281,7 +296,7 @@ class CircuitBreaker:
         self.recovery_time_s = recovery_time_s
         self.clock = clock
         self._metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = locks_mod.make_lock(f"retry.breaker.{name}")
         self._state = BREAKER_CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
