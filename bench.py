@@ -152,14 +152,23 @@ def phase_names() -> tuple[str, ...]:
     )
 
 
-def phase_accounting(phase_durations: dict, wall_seconds: float) -> dict:
+def phase_accounting(
+    phase_durations: dict, wall_seconds: float,
+    smoke_compile_overlap_s: float = 0.0,
+) -> dict:
     """Wall-vs-sum accounting for the pipelined reconcile.
 
     ``sum_phase_seconds`` is the serialized-equivalent cost: the sum of
     every pipeline phase's duration, with the reset phase replaced by the
     sum of the backend's per-chip ``reset.chip`` spans when those exist
     (a parallel per-chip reset's phase wall only shows the pool's wall
-    time; the serial walk would have paid the per-chip sum). The summary
+    time; the serial walk would have paid the per-chip sum), plus
+    ``smoke_compile_overlap_s`` — the smoke warmup's compile span that
+    ran hidden inside wait_ready (smoke/runner.py dispatch gate). Only
+    the PRE-release part of the compile is added (the warmup handle
+    reports exactly that as ``warmup_overlap_s``): any compile that
+    spilled past the gate release already shows up inside the measured
+    smoke phase, so the verify cost is never double-counted. The summary
     used to implicitly assume serialized phases — wrong the moment any
     two phases overlap — so the three numbers are now explicit:
     ``wall_seconds`` (what the node actually paid),
@@ -172,6 +181,7 @@ def phase_accounting(phase_durations: dict, wall_seconds: float) -> dict:
     if chip_spans:
         reset_wall = sum(phase_durations.get("reset", ()))
         serial_sum += max(0.0, sum(chip_spans) - reset_wall)
+    serial_sum += max(0.0, smoke_compile_overlap_s)
     return {
         "wall_seconds": round(wall_seconds, 3),
         "sum_phase_seconds": round(serial_sum, 3),
@@ -204,40 +214,17 @@ def phase_histograms(runs: list[dict]) -> dict:
 
 def make_bench_kube(node_names: list[str], pod_delete_delay_s: float = 0.0):
     """Fake apiserver with one pod per drain component per node and the
-    emulated operator controller: a component's pods are deleted when its
-    deploy label flips to paused (the external behavior the protocol
-    relies on; SURVEY.md §5) — after the configured termination delay in
-    the realistic scenario (pods have grace periods; deletion is not
-    instantaneous on a real cluster). Shared by every bench scenario so
-    the drain-protocol emulation cannot diverge between them."""
-    from tpu_cc_manager.drain.pause import is_paused
-    from tpu_cc_manager.kubeclient.api import node_labels
+    emulated operator controller (tpu_cc_manager/drain/sim.py — one
+    implementation shared with the serving harness so the drain-protocol
+    emulation cannot diverge between the scenarios and artifacts)."""
+    from tpu_cc_manager.drain.sim import add_drainable_node
     from tpu_cc_manager.kubeclient.fake import FakeKube
-    from tpu_cc_manager.labels import DRAIN_COMPONENT_LABELS
 
     kube = FakeKube()
     for name in node_names:
-        kube.add_node(name, {key: "true" for key in DRAIN_COMPONENT_LABELS})
-        for key, app in DRAIN_COMPONENT_LABELS.items():
-            kube.add_pod(NS, f"{app}-{name}", name, labels={"app": app})
-
-    def reactor(name, patched):
-        for key, app in DRAIN_COMPONENT_LABELS.items():
-            if is_paused(node_labels(patched).get(key)):
-                if pod_delete_delay_s > 0:
-                    timer = threading.Timer(
-                        pod_delete_delay_s,
-                        kube.delete_pod, (NS, f"{app}-{name}"),
-                    )
-                    # Daemonize so a pending timer can't outlive its scenario
-                    # (delaying exit or firing into FakeKube after the
-                    # measurement window).
-                    timer.daemon = True
-                    timer.start()
-                else:
-                    kube.delete_pod(NS, f"{app}-{name}")
-
-    kube.add_patch_reactor(reactor)
+        add_drainable_node(
+            kube, name, NS, pod_delete_delay_s=pod_delete_delay_s,
+        )
     return kube
 
 
@@ -278,6 +265,48 @@ def run_scenario(
         smoke_detail.update(result)
         return result
 
+    class _BenchWarmup:
+        """The manager's warmup handle, bench-flavored: same real
+        subprocess + dispatch gate (smoke/runner.py SmokeWarmup), plus
+        the bench's CPU fallback and result capture. This is how the
+        realistic scenario MODELS the wait_ready∥COMPILE overlap — by
+        actually doing it: the smoke child compiles while the fake
+        backend's 20 s boot runs, and only the post-release dispatch
+        lands in the measured smoke phase."""
+
+        def __init__(self, workload: str) -> None:
+            from tpu_cc_manager.smoke.runner import SmokeWarmup
+
+            self._workload = workload
+            self._inner = SmokeWarmup(
+                workload, timeout_s=240.0, force_cpu=not tpu_usable,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+
+        def release(self) -> None:
+            self._inner.release()
+
+        def cancel(self, reason: str = "") -> None:
+            self._inner.cancel(reason)
+
+        def died_during_warmup(self) -> bool:
+            return self._inner.died_during_warmup()
+
+        def release_and_result(self) -> dict:
+            from tpu_cc_manager.smoke.runner import SmokeError
+
+            try:
+                result = self._inner.release_and_result()
+            except SmokeError:
+                # Same CPU fallback as the synchronous path: the chip
+                # failed mid-run, the bench still measures end-to-end.
+                result = _smoke_subprocess(
+                    self._workload, timeout_s=240.0, force_cpu=True
+                )
+            backend_used["backend"] = result.get("backend", "?")
+            smoke_detail.update(result)
+            return result
+
     registry = MetricsRegistry()
     # Per-scenario journal (file sink off): the bench reads the span
     # stream back to report per-phase distributions, not just one run's
@@ -298,6 +327,11 @@ def run_scenario(
         evict_components=True,
         smoke_workload="matmul",
         smoke_runner=smoke_runner,
+        # Boot-wait∥COMPILE overlap: the warmup factory launches the REAL
+        # smoke subprocess gated at its dispatch boundary while the fake
+        # backend's boot latency runs (CC_SMOKE_WARMUP path in the
+        # manager); smoke_runner stays as the spawn-failure fallback.
+        smoke_warmup_factory=_BenchWarmup,
         eviction_poll_interval_s=0.1,
         metrics=registry,
         journal=journal,
@@ -310,6 +344,11 @@ def run_scenario(
     state = node_labels(kube.get_node(node)).get(CC_MODE_STATE_LABEL)
     m = registry.last()
     durations = journal.phase_durations(phase_names() + ("reset.chip",))
+    # The warmup's pre-release compile span ran hidden inside wait_ready:
+    # add it to the serialized-equivalent sum (a serial pipeline would
+    # have paid it inside the smoke phase), never double-counting — the
+    # measured smoke phase only contains post-release work.
+    warmup_overlap = smoke_detail.get("warmup_overlap_s") or 0.0
     return {
         "seconds": round(dt, 2),
         "ok": bool(ok and state == "on"),
@@ -319,7 +358,12 @@ def run_scenario(
         # Wall-vs-serialized-sum accounting (pipelined transitions): the
         # per-phase numbers above no longer sum to the wall time once
         # phases overlap, so the saving is reported explicitly.
-        **phase_accounting(durations, dt),
+        **phase_accounting(durations, dt, smoke_compile_overlap_s=warmup_overlap),
+        "smoke_warmup": {
+            "compile_s": smoke_detail.get("warmup_compile_s"),
+            "overlap_s": smoke_detail.get("warmup_overlap_s"),
+            "dispatch_s": smoke_detail.get("warmup_dispatch_s"),
+        },
         "smoke": smoke_detail,
         "backend": backend_used["backend"],
     }
@@ -608,6 +652,9 @@ def main() -> int:
         "wall_seconds": realistic["wall_seconds"],
         "sum_phase_seconds": realistic["sum_phase_seconds"],
         "overlap_saved_s": realistic["overlap_saved_s"],
+        # Boot-wait∥COMPILE warmup (smoke/runner.py dispatch gate): how
+        # much of the smoke's compile span the wait_ready boot absorbed.
+        "smoke_warmup": realistic["smoke_warmup"],
         # Compilation-cache proof (VERDICT weak #2): cold vs warm smoke
         # wall time across a simulated CC bounce, from measurement — the
         # delta is the compile time the persistent cache holds down.

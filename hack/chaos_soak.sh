@@ -24,10 +24,12 @@
 #
 # Exit 0 only when every seed converged. The summary records per-seed
 # fault/retry counts (grepped from the test's CHAOS_SOAK_SUMMARY line),
-# remediation-ladder counters (REMEDIATION_SUMMARY), and the fleet-churn
+# remediation-ladder counters (REMEDIATION_SUMMARY), the fleet-churn
 # scenarios' outcomes (PREEMPTION_SUMMARY: preemption fast-drain +
-# handoff resume, slice fencing of a departed peer) so the evidence
-# ladder can cite them.
+# handoff resume, slice fencing of a departed peer), and the
+# serving-under-the-flip soak (SERVE_SUMMARY: rolling flip under
+# sustained traffic, zero lost requests) so the evidence ladder can
+# cite them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -46,7 +48,9 @@ mkdir -p "$(dirname "$OUT")" artifacts
 # test_preemption.py carries the churn scenarios (preemption fast-drain +
 # handoff, slice fencing of a departed peer) — seeded from the same
 # CC_CHAOS_SEED, summarized via PREEMPTION_SUMMARY lines.
-PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
+# test_serve.py carries the serving-under-the-flip soak (rolling CC flip
+# under sustained traffic, zero lost requests) — SERVE_SUMMARY lines.
+PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
 if [ "$TERMINAL" = "0" ]; then
   PYTEST_ARGS+=(--deselect \
     "tests/test_chaos.py::test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts")
@@ -72,7 +76,8 @@ for i in $(seq 0 $((ITERS - 1))); do
   remediation=$(grep -ao "REMEDIATION_SUMMARY.*" "$log" | tail -1 | sed "s/^REMEDIATION_SUMMARY //; s/'/ /g; s/\"/ /g")
   offline=$(grep -ao "OFFLINE_SUMMARY.*" "$log" | tail -1 | sed "s/^OFFLINE_SUMMARY //; s/'/ /g; s/\"/ /g")
   preemption=$(grep -ao "PREEMPTION_SUMMARY.*" "$log" | sed "s/^PREEMPTION_SUMMARY //; s/'/ /g; s/\"/ /g" | paste -sd'; ' -)
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\"}")
+  serve=$(grep -ao "SERVE_SUMMARY.*" "$log" | tail -1 | sed "s/^SERVE_SUMMARY //; s/'/ /g; s/\"/ /g")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\"}")
 done
 
 {

@@ -43,6 +43,7 @@ mkdir -p artifacts
 ARTIFACTS=(
   artifacts/chaos_soak.json
   SCALE_r01.json
+  SERVE_r01.json
   artifacts/smoke_cache_r06.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
@@ -173,6 +174,23 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s SCALE_r02.json ] && mv SCALE_r02.json artifacts/SCALE_r02.failed.json
     echo ">>> HTTP scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r02.failed.json)"
+    finish
+  }
+fi
+
+# Serving-under-the-flip evidence (ROADMAP item 3): a rolling CC flip
+# over a pool of real agents under sustained synthetic traffic — zero
+# lost requests, p50/p99 during vs steady. CPU-only (fake pool), so it
+# runs before the tunnel-gated ladder with the same skip/park
+# discipline as the other single-point stages.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("SERVE_r01.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> SERVE_r01.json already captured (ok:true); skipping"
+else
+  echo "=== stage: serve-bench (local, no tunnel) ==="
+  python3 hack/serve_bench.py --out SERVE_r01.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s SERVE_r01.json ] && mv SERVE_r01.json artifacts/SERVE_r01.failed.json
+    echo ">>> serve bench FAILED; stopping ladder (summary in artifacts/SERVE_r01.failed.json)"
     finish
   }
 fi

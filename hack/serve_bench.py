@@ -1,0 +1,109 @@
+"""Serving-under-the-flip bench: one JSON line, ok-gated (SERVE_r01).
+
+Converts the "millions of users" north star into a measurable artifact:
+a TrafficDriver sustains batched synthetic inference across a pool of
+REAL node agents while a REAL rolling CC flip runs mid-traffic
+(tpu_cc_manager/serve/). The line reports p50/p99 latency and error
+rate DURING the rollout vs steady state, and the headline claim:
+``requests_lost_per_node_bounced`` == 0 — every in-flight request
+checkpoints through the drain handshake and completes.
+
+Usage:
+  python3 hack/serve_bench.py [--nodes 3] [--traffic-s 8] [--out FILE]
+      [--calibrate-smoke]  # calibrate the executor model from a real
+                           # llama smoke run (ms_per_token, hbm_bw_util)
+
+``ok`` is true only when the rollout converged, zero requests were
+lost, and both latency buckets have data — the evidence ladder's
+skip-when-ok:true gate (hack/evidence_r5.sh) reads it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--traffic-s", type=float, default=8.0)
+    parser.add_argument("--mode", default="on")
+    parser.add_argument("--max-unavailable", type=int, default=1)
+    parser.add_argument("--calibrate-smoke", action="store_true",
+                        help="run one real llama smoke and calibrate the "
+                        "executor's latency/bandwidth model from it")
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON line to this file")
+    args = parser.parse_args(argv)
+
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)  # stdout carries ONE line
+
+    from tpu_cc_manager.serve import ServeHarness, SimulatedExecutor
+
+    executor_factory = SimulatedExecutor
+    calibration = None
+    if args.calibrate_smoke:
+        from tpu_cc_manager.smoke.runner import run_workload_subprocess
+
+        smoke = run_workload_subprocess(
+            "llama", timeout_s=600.0, cwd=repo_root,
+        )
+        calibration = {
+            "ms_per_token": smoke.get("ms_per_token"),
+            "hbm_bw_util": smoke.get("hbm_bw_util"),
+            "hbm_bw_util_lower_bound": smoke.get("hbm_bw_util_lower_bound"),
+            "backend": smoke.get("backend"),
+            "batch": smoke.get("batch"),
+        }
+        executor_factory = (
+            lambda: SimulatedExecutor.from_smoke_result(smoke)
+        )
+
+    harness = ServeHarness(
+        n_nodes=args.nodes,
+        tmp_dir=tempfile.mkdtemp(prefix="tpu-cc-serve-bench-"),
+        executor_factory=executor_factory,
+    )
+    harness.build()
+    try:
+        report = harness.run(
+            traffic_s=args.traffic_s,
+            rollout_mode=args.mode,
+            max_unavailable=args.max_unavailable,
+        )
+    finally:
+        harness.shutdown()
+
+    result = {
+        "metric": "serving_disruption_per_rollout",
+        "nodes": args.nodes,
+        "traffic_s": args.traffic_s,
+        "mode": args.mode,
+        **report,
+        "calibration": calibration,
+        "ok": bool(
+            report["rollout_ok"]
+            and report["requests_lost"] == 0
+            and report["nodes_bounced"] == args.nodes
+            and (report["latency_during_rollout"]["count"] or 0) > 0
+            and (report["latency_steady_state"]["count"] or 0) > 0
+        ),
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,8 +17,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest
 
-from bench import select_headline_smoke
+from bench import phase_accounting, select_headline_smoke
 from bench_ab import summarize_ab
+
+
+class TestPhaseAccounting:
+    """The wait_ready∥COMPILE warmup's serialized-equivalent fold-in:
+    only the PRE-release compile overlap is added to the sum (post-
+    release compile already sits inside the measured smoke phase), so
+    the verify cost is never double-counted."""
+
+    DURATIONS = {"drain": [3.0], "reset": [7.5], "wait_ready": [20.0],
+                 "smoke": [0.6]}
+
+    def test_warmup_overlap_extends_serial_sum_not_wall(self):
+        base = phase_accounting(self.DURATIONS, 31.0)
+        with_warmup = phase_accounting(
+            self.DURATIONS, 31.0, smoke_compile_overlap_s=2.2,
+        )
+        assert with_warmup["wall_seconds"] == base["wall_seconds"]
+        assert with_warmup["sum_phase_seconds"] == pytest.approx(
+            base["sum_phase_seconds"] + 2.2
+        )
+        assert with_warmup["overlap_saved_s"] == pytest.approx(
+            base["overlap_saved_s"] + 2.2
+        )
+
+    def test_zero_or_negative_overlap_is_a_noop(self):
+        base = phase_accounting(self.DURATIONS, 31.0)
+        assert phase_accounting(
+            self.DURATIONS, 31.0, smoke_compile_overlap_s=0.0,
+        ) == base
+        assert phase_accounting(
+            self.DURATIONS, 31.0, smoke_compile_overlap_s=-1.0,
+        ) == base
 
 
 def _smoke(backend, tflops, mfu=None):

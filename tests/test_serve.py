@@ -1,0 +1,280 @@
+"""Serving under the flip (tpu_cc_manager/serve/): a rolling CC flip over
+a fake pool of REAL agents under sustained traffic loses ZERO requests,
+the drain-deadline hint bounds checkpoint time, in-flight requests
+checkpoint-and-requeue with progress intact, and the batch ladder climbs
+the conservative hbm_bw_util headroom without overshooting.
+
+Chaos-marked (tier-1 runs the short soak; hack/chaos_soak.sh reruns it
+with -s and scrapes the SERVE_SUMMARY line) and — like the other chaos
+suites — everything here runs with the CC_LOCKCHECK runtime lock-order
+checker on, so the serve/ thread soup is machine-checked for inversions
+on every run.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.drain import handshake
+from tpu_cc_manager.serve.driver import TrafficDriver
+from tpu_cc_manager.serve.harness import ServeHarness
+from tpu_cc_manager.serve.server import NodeServer, Request, SimulatedExecutor
+from tpu_cc_manager.utils import retry as retry_mod
+
+pytestmark = pytest.mark.chaos
+
+NODE = "serve-test-0"
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck(monkeypatch):
+    """Chaos-suite convention (tests/test_chaos.py): the runtime
+    lock-order checker is ON for every scenario here, and the
+    process-wide order graph is reset around each test."""
+    from tpu_cc_manager.utils import locks as locks_rt
+
+    locks_rt.GRAPH.reset()
+    monkeypatch.setenv("CC_LOCKCHECK", "1")
+    yield
+    locks_rt.GRAPH.reset()
+
+
+def collecting_callbacks():
+    done, requeued = [], []
+    lock = threading.Lock()
+
+    def on_complete(node, req, util):
+        with lock:
+            done.append(req)
+
+    def on_requeue(node, reqs):
+        with lock:
+            requeued.extend(reqs)
+
+    return done, requeued, on_complete, on_requeue
+
+
+# ---------------------------------------------------------------------------
+# The headline: rolling flip under traffic, zero requests lost
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_flip_under_traffic_loses_zero_requests(tmp_path):
+    """Short soak (the long one is slow-marked): 3 real agents, live
+    driver traffic, a real rolling CC flip mid-stream. Zero requests
+    lost, every node bounced exactly once through its drain handshake,
+    and the during-rollout latency bucket actually has data."""
+    harness = ServeHarness(
+        n_nodes=3, tmp_dir=str(tmp_path), checkpoint_full_s=0.05,
+    )
+    harness.build()
+    try:
+        report = harness.run(traffic_s=3.0, rollout_mode="on")
+    finally:
+        harness.shutdown()
+    print("SERVE_SUMMARY " + json.dumps({
+        k: report[k] for k in (
+            "requests_issued", "requests_completed", "requests_lost",
+            "requests_requeued", "error_rate", "nodes_bounced",
+            "requests_lost_per_node_bounced", "latency",
+            "latency_during_rollout", "latency_steady_state",
+            "batch_ladder", "rollout_wall_s",
+        )
+    }))
+    assert report["rollout_ok"], report["rollout_summary"]
+    assert report["nodes_bounced"] == 3
+    assert report["requests_lost"] == 0, report
+    assert report["requests_lost_per_node_bounced"] == 0
+    assert report["error_rate"] == 0.0
+    assert report["requests_completed"] > 0
+    assert report["latency_during_rollout"]["count"] > 0, (
+        "the rollout window must have served traffic"
+    )
+    assert report["latency_steady_state"]["count"] > 0
+    # Every server went through exactly one drain/resume handshake.
+    for name, d in report["drains"].items():
+        assert d["drains"] == 1, report["drains"]
+        assert d["resumes"] == 1, report["drains"]
+
+
+@pytest.mark.slow
+def test_rolling_flip_long_soak(tmp_path):
+    """The long-form soak (chaos_soak.sh / manual): more nodes, longer
+    window, max_unavailable=2 so two nodes drain concurrently."""
+    harness = ServeHarness(
+        n_nodes=5, tmp_dir=str(tmp_path), checkpoint_full_s=0.1,
+    )
+    harness.build()
+    try:
+        report = harness.run(
+            traffic_s=20.0, rollout_mode="on", max_unavailable=2,
+        )
+    finally:
+        harness.shutdown()
+    print("SERVE_SUMMARY " + json.dumps(report))
+    assert report["rollout_ok"]
+    assert report["requests_lost"] == 0
+    assert report["nodes_bounced"] == 5
+
+
+# ---------------------------------------------------------------------------
+# Drain-deadline hint bounds checkpoint time
+# ---------------------------------------------------------------------------
+
+
+def test_drain_deadline_hint_bounds_checkpoint_time(fake_kube):
+    """A fast-drain deadline hint (drain.deadline-s, published by the
+    preemption path) must SIZE the checkpoint: the server writes an
+    incremental checkpoint that fits its budget share of the window
+    instead of the full write the kill would truncate."""
+    fake_kube.add_node(NODE)
+    done, requeued, on_complete, on_requeue = collecting_callbacks()
+    server = NodeServer(
+        fake_kube, NODE, on_complete, on_requeue,
+        poll_interval_s=0.02, checkpoint_full_s=0.9,
+        checkpoint_budget_fraction=0.5,
+    )
+    server.start()
+    try:
+        # The hint label carries WHOLE seconds (handshake.request_drain
+        # floors at 1) — use second-scale values like the real 30 s path.
+        cycle = handshake.request_drain(fake_kube, NODE, deadline_s=1.0)
+        assert retry_mod.poll_until(lambda: server.drains >= 1, 5.0, 0.02)
+        assert server.last_checkpoint_deadline_s == pytest.approx(1.0)
+        # Budget = 1.0 * 0.5 = 0.5 s — the 0.9 s full write was cut down.
+        assert server.last_checkpoint_s < 0.9
+        assert server.last_checkpoint_s <= 0.5 + 0.2  # bracket overhead
+        # The hinted cycle still acked (the manager's wait is satisfied).
+        from tpu_cc_manager.kubeclient.api import node_labels
+
+        labels = node_labels(fake_kube.get_node(NODE))
+        assert labels[server.subscriber.label] == handshake.ack_value(cycle.token)
+
+        # A NORMAL drain (no hint) pays the full checkpoint.
+        handshake.clear_drain_request(fake_kube, NODE)
+        assert retry_mod.poll_until(lambda: server.resumes >= 1, 5.0, 0.02)
+        handshake.request_drain(fake_kube, NODE)
+        assert retry_mod.poll_until(lambda: server.drains >= 2, 5.0, 0.02)
+        assert server.last_checkpoint_deadline_s is None
+        assert server.last_checkpoint_s >= 0.9
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-and-requeue: in-flight requests survive with progress
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_requests_checkpoint_and_requeue_with_progress(fake_kube):
+    fake_kube.add_node(NODE)
+    done, requeued, on_complete, on_requeue = collecting_callbacks()
+    server = NodeServer(
+        fake_kube, NODE, on_complete, on_requeue,
+        executor=SimulatedExecutor(base_s=0.0, per_token_s=0.01),
+        poll_interval_s=0.02, checkpoint_full_s=0.05,
+    )
+    server.start()
+    try:
+        now = time.monotonic()
+        batch = [Request(req_id=i, decode_tokens=200, submitted_at=now)
+                 for i in range(4)]
+        assert server.submit(batch)
+        # Mid-decode (200 tokens × 10 ms = 2 s of work), drain the node.
+        time.sleep(0.15)
+        handshake.request_drain(fake_kube, NODE, deadline_s=1.0)
+        assert retry_mod.poll_until(lambda: server.drains >= 1, 5.0, 0.02)
+        assert retry_mod.poll_until(lambda: len(requeued) == 4, 5.0, 0.02)
+        assert done == [], "a 2 s batch cannot have completed in 0.15 s"
+        for r in requeued:
+            assert 0 < r.tokens_done < 200, (
+                "checkpointed progress must be preserved, not reset"
+            )
+            assert r.checkpoints >= 1
+        # Draining server refuses new work — the driver must route around.
+        assert server.submit([Request(99, 8, now)]) is False
+    finally:
+        server.stop()
+
+
+def test_resume_reopens_intake(fake_kube):
+    fake_kube.add_node(NODE)
+    done, requeued, on_complete, on_requeue = collecting_callbacks()
+    server = NodeServer(
+        fake_kube, NODE, on_complete, on_requeue,
+        poll_interval_s=0.02, checkpoint_full_s=0.02,
+    )
+    server.start()
+    try:
+        handshake.request_drain(fake_kube, NODE)
+        assert retry_mod.poll_until(lambda: server.drains >= 1, 5.0, 0.02)
+        assert not server.accepting()
+        handshake.clear_drain_request(fake_kube, NODE)
+        assert retry_mod.poll_until(lambda: server.resumes >= 1, 5.0, 0.02)
+        assert server.accepting()
+        now = time.monotonic()
+        assert server.submit([Request(1, 4, now)]) is True
+        assert retry_mod.poll_until(lambda: len(done) == 1, 5.0, 0.02)
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Batch ladder: conservative headroom off the hbm_bw_util lower bound
+# ---------------------------------------------------------------------------
+
+
+def test_batch_ladder_climbs_headroom_without_overshooting(fake_kube):
+    """util(b) = 0.3 + 0.05·b: headroom up to b=12 at the 0.9 ceiling.
+    The ladder must climb one rung per interval (the util read is a
+    lower bound — smoke/llama_infer.py — so no rung-jumping) and settle
+    without blowing past the ceiling."""
+    fake_kube.add_node(NODE)
+    executor = SimulatedExecutor(
+        base_s=0.0, per_token_s=0.001, weight_frac=0.30, kv_frac=0.05,
+    )
+    done, requeued, on_complete, on_requeue = collecting_callbacks()
+    server = NodeServer(
+        fake_kube, NODE, on_complete, on_requeue, executor=executor,
+        poll_interval_s=5.0,  # no drain in this test; quiet the poller
+    )
+    driver = TrafficDriver(
+        {NODE: server}, request_tokens=4, initial_batch=1, max_batch=16,
+        util_ceiling=0.9, ladder_interval_s=0.05, submit_interval_s=0.005,
+    )
+    server._on_complete = driver.on_complete
+    server._on_requeue = driver.on_requeue
+    server.start()
+    driver.start()
+    try:
+        assert retry_mod.poll_until(
+            lambda: driver.snapshot_batches()[NODE] >= 12, 10.0, 0.05,
+        ), f"ladder stalled at {driver.snapshot_batches()}"
+        retry_mod.wait(0.3, None)
+        final = driver.snapshot_batches()[NODE]
+        # One overshoot rung is the most the ladder can carry past the
+        # ceiling before the next util read steps it back.
+        assert final <= 13, f"ladder overshot: batch={final}"
+        assert executor.hbm_bw_util(final - 1) <= 0.9
+    finally:
+        driver.stop()
+        server.stop()
+    report = driver.report()
+    assert report["requests_lost"] >= 0  # shape check
+    assert report["batch_ladder"][NODE] == final
+
+
+def test_executor_calibration_from_smoke_result():
+    smoke = {"ms_per_token": 2.5, "hbm_bw_util": 0.6, "batch": 4,
+             "hbm_bw_util_lower_bound": True}
+    ex = SimulatedExecutor.from_smoke_result(smoke)
+    assert ex.per_token_s == pytest.approx(0.0025)
+    # The measured point is reproduced at the smoke's batch.
+    assert ex.hbm_bw_util(4) == pytest.approx(0.6, abs=0.01)
+    # And the model stays a monotone, capped lower-bound shape.
+    assert ex.hbm_bw_util(8) > ex.hbm_bw_util(4)
+    assert ex.hbm_bw_util(1000) == 1.0

@@ -1,0 +1,443 @@
+"""wait_ready∥COMPILE smoke warmup: the two-phase dispatch gate
+(smoke/runner.py) and its manager wiring (ccmanager/manager.py).
+
+Invariants pinned here:
+
+- **ordering**: the warmup child is spawned BEFORE wait_ready but its
+  dispatch is released only AFTER wait_ready returned and attestation
+  passed — never earlier, on any path;
+- **no dispatch on failure**: attestation failure, digest-fast-path hit
+  and a modeled SIGKILL all CANCEL the gated child instead of releasing;
+- **orphan protection**: a child whose parent died mid-warmup exits on
+  its own (the real-SIGKILL case no finally can cover);
+- **crash recovery**: after a kill during the warmup the successor runs
+  a FULL smoke (no digest was persisted — the fast path is unaffected).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.labels import MODE_OFF, MODE_ON
+from tpu_cc_manager.obs.journal import Journal
+from tpu_cc_manager.smoke import runner as runner_mod
+from tpu_cc_manager.smoke.runner import SmokeError
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NODE = "warm-node-0"
+NS = "tpu-operator"
+
+
+class AgentKilled(BaseException):
+    """Models a SIGKILL landing inside the agent (same convention as
+    tests/test_pipeline.py)."""
+
+
+def make_manager(kube, backend, **kw):
+    kw.setdefault("evict_components", False)
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("journal", Journal(trace_file=""))
+    kw.setdefault("smoke_workload", "matmul")
+    return CCManager(
+        api=kube, backend=backend, node_name=NODE,
+        operator_namespace=NS, **kw,
+    )
+
+
+class SeqBackend(FakeTpuBackend):
+    """Appends the pipeline's observable milestones to a shared list."""
+
+    def __init__(self, seq, **kw):
+        super().__init__(**kw)
+        self._seq = seq
+
+    def wait_ready(self, chips, timeout_s):
+        super().wait_ready(chips, timeout_s)
+        self._seq.append("wait_ready")
+
+    def fetch_attestation(self, nonce):
+        quote = super().fetch_attestation(nonce)
+        self._seq.append("attest")
+        return quote
+
+
+class FakeWarmup:
+    """Records the warmup handle contract the manager drives."""
+
+    def __init__(self, seq):
+        self.seq = seq
+        self.released = False
+        self.cancelled = None
+        self.died = False
+        seq.append("spawned")
+
+    def died_during_warmup(self):
+        return self.died
+
+    def release_and_result(self):
+        self.released = True
+        self.seq.append("released")
+        return {
+            "ok": True, "workload": "matmul",
+            "warmup_compile_s": 0.0, "warmup_overlap_s": 0.0,
+            "warmup_dispatch_s": 0.0,
+        }
+
+    def cancel(self, reason=""):
+        if self.cancelled is None:
+            self.cancelled = reason or "cancelled"
+        self.seq.append(f"cancelled:{reason}")
+
+
+def warmup_recorder(seq):
+    warmups = []
+
+    def factory(workload):
+        w = FakeWarmup(seq)
+        warmups.append(w)
+        return w
+
+    return warmups, factory
+
+
+# ---------------------------------------------------------------------------
+# Manager ordering
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_released_only_after_ready_and_attestation(fake_kube):
+    """THE ordering pin for BENCH_r07: the child spawns before the boot
+    wait (that's the overlap) and dispatch releases strictly after both
+    wait_ready and the attestation verify."""
+    fake_kube.add_node(NODE)
+    seq: list[str] = []
+    backend = SeqBackend(seq)
+    warmups, factory = warmup_recorder(seq)
+    mgr = make_manager(
+        fake_kube, backend, smoke_warmup_factory=factory,
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert len(warmups) == 1 and warmups[0].released
+    assert warmups[0].cancelled is None
+    assert seq.index("spawned") < seq.index("wait_ready"), (
+        f"warmup must start before the boot wait: {seq}"
+    )
+    assert seq.index("released") > seq.index("wait_ready"), seq
+    assert seq.index("released") > seq.index("attest"), seq
+
+
+def test_warmup_disabled_keeps_synchronous_smoke(fake_kube):
+    fake_kube.add_node(NODE)
+    seq: list[str] = []
+    warmups, factory = warmup_recorder(seq)
+    calls = []
+    mgr = make_manager(
+        fake_kube, FakeTpuBackend(),
+        smoke_warmup=False, smoke_warmup_factory=factory,
+        smoke_runner=lambda w: (calls.append(w), {"ok": True})[1],
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert warmups == [] and calls == ["matmul"]
+
+
+def test_injected_smoke_runner_without_factory_disables_warmup(fake_kube):
+    """An injected smoke_runner (tests, bench fallback paths) must keep
+    its synchronous contract unless a warmup factory rides along."""
+    fake_kube.add_node(NODE)
+    calls = []
+    mgr = make_manager(
+        fake_kube, FakeTpuBackend(),
+        smoke_runner=lambda w: (calls.append(w), {"ok": True})[1],
+    )
+    assert mgr.smoke_warmup is True  # default on…
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert calls == ["matmul"]  # …but the sync runner still served
+
+
+def test_attestation_failure_cancels_warmup_without_release(fake_kube):
+    from tpu_cc_manager.tpudev import attestation
+
+    fake_kube.add_node(NODE)
+    seq: list[str] = []
+
+    class BadAttestBackend(SeqBackend):
+        def fetch_attestation(self, nonce):
+            raise attestation.AttestationError("modeled bad quote")
+
+    warmups, factory = warmup_recorder(seq)
+    mgr = make_manager(
+        fake_kube, BadAttestBackend(seq), smoke_warmup_factory=factory,
+    )
+    assert mgr.set_cc_mode(MODE_ON) is False
+    assert len(warmups) == 1
+    assert not warmups[0].released, "dispatch must NOT release on a failed attest"
+    assert warmups[0].cancelled == "pipeline-unwound"
+
+
+def test_digest_fastpath_hit_cancels_warmup(fake_kube, tmp_path):
+    fake_kube.add_node(NODE)
+    seq: list[str] = []
+    warmups, factory = warmup_recorder(seq)
+    backend = SeqBackend(seq)
+    mgr = make_manager(
+        fake_kube, backend, smoke_warmup_factory=factory,
+        smoke_digest_fastpath=True, state_dir=str(tmp_path),
+    )
+    # on (full smoke, digest persisted) → off → on (unchanged digest).
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert mgr.set_cc_mode(MODE_OFF) is True
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert warmups[0].released
+    last = warmups[-1]
+    assert not last.released, "fast-path hit must not dispatch the warmup"
+    assert last.cancelled == "digest-fastpath"
+
+
+def test_child_death_during_warmup_falls_back_to_synchronous_smoke(fake_kube):
+    """A child that died before any release (e.g. client init against
+    the mid-boot runtime) is a warmup-infrastructure failure, not a
+    smoke verdict: the manager runs the serial smoke against the
+    now-ready runtime instead of failing the flip."""
+    fake_kube.add_node(NODE)
+    seq: list[str] = []
+    warmups, factory = warmup_recorder(seq)
+    calls = []
+
+    def dying_factory(workload):
+        w = factory(workload)
+        w.died = True
+        return w
+
+    mgr = make_manager(
+        fake_kube, SeqBackend(seq), smoke_warmup_factory=dying_factory,
+        smoke_runner=lambda w: (calls.append(w), {"ok": True})[1],
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert len(warmups) == 1
+    assert not warmups[0].released
+    assert warmups[0].cancelled == "died-during-warmup"
+    assert calls == ["matmul"], "the synchronous smoke must still verify"
+
+
+def test_spawn_failure_falls_back_to_synchronous_smoke(fake_kube):
+    fake_kube.add_node(NODE)
+    calls = []
+
+    def exploding_factory(workload):
+        raise OSError("modeled fork failure")
+
+    mgr = make_manager(
+        fake_kube, FakeTpuBackend(),
+        smoke_warmup_factory=exploding_factory,
+        smoke_runner=lambda w: (calls.append(w), {"ok": True})[1],
+    )
+    assert mgr.set_cc_mode(MODE_ON) is True
+    assert calls == ["matmul"]
+
+
+# ---------------------------------------------------------------------------
+# Crash during the warmup: cancel + successor runs a FULL smoke
+# ---------------------------------------------------------------------------
+
+
+def test_kill_during_warmup_cancels_child_and_successor_runs_full_smoke(
+    fake_kube, tmp_path,
+):
+    fake_kube.add_node(NODE)
+    seq: list[str] = []
+    kill = {"armed": True}
+
+    class KillInWaitReady(SeqBackend):
+        def wait_ready(self, chips, timeout_s):
+            if kill["armed"]:
+                raise AgentKilled()
+            super().wait_ready(chips, timeout_s)
+
+    backend = KillInWaitReady(seq)
+    warmups, factory = warmup_recorder(seq)
+    registry = MetricsRegistry()
+    mgr = make_manager(
+        fake_kube, backend, smoke_warmup_factory=factory,
+        smoke_digest_fastpath=True, state_dir=str(tmp_path),
+        metrics=registry,
+    )
+    with pytest.raises(AgentKilled):
+        mgr.set_cc_mode(MODE_ON)
+    # The modeled kill unwound the pipeline: the gated child was
+    # cancelled (a REAL SIGKILL is covered child-side — see the orphan
+    # test below) and, crucially, no verified digest was persisted.
+    assert len(warmups) == 1
+    assert not warmups[0].released
+    assert warmups[0].cancelled is not None
+    assert not (tmp_path / "verified_digest.json").exists()
+
+    # Successor: the fast path has nothing on record → its next real
+    # flip (the kill landed post-reset, so 'on' is already committed;
+    # bounce through off) runs the FULL smoke, outcome "cold", never a
+    # hit — the crash could not have minted a digest.
+    kill["armed"] = False
+    registry2 = MetricsRegistry()
+    mgr2 = make_manager(
+        fake_kube, backend, smoke_warmup_factory=factory,
+        smoke_digest_fastpath=True, state_dir=str(tmp_path),
+        metrics=registry2,
+    )
+    assert mgr2.set_cc_mode(MODE_OFF) is True
+    assert mgr2.set_cc_mode(MODE_ON) is True
+    assert warmups[-1].released, "successor must run the full smoke"
+    totals = registry2.smoke_fastpath_totals()
+    assert totals.get("cold") == 1 and not totals.get("hit")
+    assert (tmp_path / "verified_digest.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Gate protocol (child side)
+# ---------------------------------------------------------------------------
+
+
+def test_gate_noop_without_env(monkeypatch):
+    monkeypatch.delenv(runner_mod.DISPATCH_GATE_ENV, raising=False)
+    assert runner_mod.await_dispatch_gate() is False
+
+
+def test_gate_timeout_raises_and_sentinel_lands(monkeypatch, tmp_path):
+    gate = str(tmp_path / "gate")
+    monkeypatch.setenv(runner_mod.DISPATCH_GATE_ENV, gate)
+    monkeypatch.setenv(runner_mod.GATE_TIMEOUT_ENV, "0.2")
+    monkeypatch.delenv(runner_mod.GATE_PARENT_PID_ENV, raising=False)
+    with pytest.raises(SmokeError, match="not released"):
+        runner_mod.await_dispatch_gate()
+    assert os.path.exists(runner_mod.compiled_sentinel(gate)), (
+        "the compiled sentinel must land before the wait"
+    )
+
+
+def test_gate_opens_when_released(monkeypatch, tmp_path):
+    gate = str(tmp_path / "gate")
+    monkeypatch.setenv(runner_mod.DISPATCH_GATE_ENV, gate)
+    monkeypatch.setenv(runner_mod.GATE_TIMEOUT_ENV, "10")
+    compiled = []
+
+    def release_soon():
+        time.sleep(0.15)
+        with open(gate, "w", encoding="utf-8") as f:
+            f.write("released")
+
+    t = threading.Thread(target=release_soon, daemon=True)
+    t.start()
+    assert runner_mod.await_dispatch_gate(
+        compile_fns=(lambda: compiled.append(True),)
+    ) is True
+    t.join()
+    assert compiled == [True], "compile fns must run before the wait"
+
+
+def test_gate_advisory_compile_failure_does_not_block(monkeypatch, tmp_path):
+    gate = str(tmp_path / "gate")
+    with open(gate, "w", encoding="utf-8") as f:
+        f.write("released")  # pre-released: wait returns immediately
+    monkeypatch.setenv(runner_mod.DISPATCH_GATE_ENV, gate)
+
+    def broken_compile():
+        raise RuntimeError("modeled AOT failure")
+
+    assert runner_mod.await_dispatch_gate(
+        compile_fns=(broken_compile,)
+    ) is True
+
+
+def test_gate_orphan_child_exits_when_parent_dies(tmp_path):
+    """A SIGKILLed manager leaves NO orphan warmup subprocess: the child's
+    gate wait watches the parent pid and exits (non-zero, no dispatch)
+    when it disappears. Real processes, real SIGKILL."""
+    gate = str(tmp_path / "gate")
+    parent = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"],
+    )
+    env = dict(os.environ)
+    env[runner_mod.DISPATCH_GATE_ENV] = gate
+    env[runner_mod.GATE_PARENT_PID_ENV] = str(parent.pid)
+    env[runner_mod.GATE_TIMEOUT_ENV] = "60"
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         "from tpu_cc_manager.smoke.runner import await_dispatch_gate; "
+         "await_dispatch_gate(); print('DISPATCHED')"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # Wait for the child to reach the gate (sentinel), then SIGKILL
+        # the fake parent — the child must notice and die on its own.
+        deadline = time.monotonic() + 30
+        sentinel = runner_mod.compiled_sentinel(gate)
+        while time.monotonic() < deadline and not os.path.exists(sentinel):
+            time.sleep(0.05)
+        assert os.path.exists(sentinel), "child never reached the gate"
+        parent.kill()
+        parent.wait()  # reap: the pid must actually disappear
+        stdout, stderr = child.communicate(timeout=30)
+        assert child.returncode != 0, (
+            f"orphaned child must exit non-zero, got rc=0: {stdout}"
+        )
+        assert "DISPATCHED" not in stdout, "orphan must never dispatch"
+        assert "orphan" in stderr.lower() or "gone" in stderr.lower()
+    finally:
+        for p in (parent, child):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+# ---------------------------------------------------------------------------
+# SmokeWarmup end-to-end: one real gated smoke subprocess
+# ---------------------------------------------------------------------------
+
+
+def test_smoke_warmup_end_to_end_real_subprocess():
+    """The full two-phase contract with a real child: compile lands while
+    the gate is closed, the child blocks (no dispatch), release() lets it
+    finish, and the parsed result carries the warmup timing."""
+    w = runner_mod.SmokeWarmup(
+        "matmul", timeout_s=240.0, force_cpu=True,
+        extra_args=["--size", "128"],
+    )
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline and w.compiled_after_s() is None:
+            assert w._proc.poll() is None, "child died during COMPILE"
+            time.sleep(0.1)
+        compile_s = w.compiled_after_s()
+        assert compile_s is not None, "compile sentinel never landed"
+        # Gated: the child must still be alive and NOT have finished.
+        time.sleep(0.3)
+        assert w._proc.poll() is None, "child must block on the gate"
+        result = w.release_and_result()
+    except BaseException:
+        w.cancel("test-failure")
+        raise
+    assert result["ok"] is True and result["workload"] == "matmul"
+    assert result["warmup_compile_s"] is not None
+    assert result["warmup_overlap_s"] >= 0.0
+    assert result["warmup_dispatch_s"] >= 0.0
+    # The whole compile ran pre-release, so the overlap covers it.
+    assert result["warmup_overlap_s"] == pytest.approx(
+        result["warmup_compile_s"], abs=0.5,
+    )
+
+
+def test_smoke_warmup_cancel_kills_child(tmp_path):
+    w = runner_mod.SmokeWarmup(
+        "matmul", timeout_s=240.0, force_cpu=True,
+        extra_args=["--size", "128"],
+    )
+    assert w._proc.poll() is None
+    w.cancel("test")
+    assert w._proc.poll() is not None, "cancel must reap the child"
+    assert not os.path.exists(w.gate_path), "gate dir cleaned up"

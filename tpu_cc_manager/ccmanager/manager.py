@@ -203,6 +203,8 @@ class CCManager:
         preemption_poll_s: float | None = None,
         pipeline_transitions: bool | None = None,
         smoke_digest_fastpath: bool | None = None,
+        smoke_warmup: bool | None = None,
+        smoke_warmup_factory: Callable[[str], object] | None = None,
         state_dir: str | None = None,
     ) -> None:
         self.api = api
@@ -391,6 +393,21 @@ class CCManager:
                 "CC_SMOKE_DIGEST_FAST_PATH", ""
             ).lower() in ("true", "1", "yes")
         self.smoke_digest_fastpath = smoke_digest_fastpath
+        # Boot-wait∥COMPILE smoke warmup (CC_SMOKE_WARMUP, default on;
+        # effective only while pipeline_transitions is on): the smoke
+        # subprocess is launched alongside wait_ready in a compile-only
+        # warmup mode (smoke/runner.py dispatch gate) and its device
+        # dispatch is released only after the runtime is ready AND
+        # attestation passed — the ~20 s boot wait absorbs the smoke's
+        # interpreter-start + jax-import + compile span. An injected
+        # smoke_runner (tests, custom harnesses) disables it unless a
+        # warmup factory is injected too.
+        if smoke_warmup is None:
+            smoke_warmup = os.environ.get(
+                "CC_SMOKE_WARMUP", "1"
+            ).lower() not in ("0", "false", "no")
+        self.smoke_warmup = smoke_warmup
+        self.smoke_warmup_factory = smoke_warmup_factory
         # Where the verified-digest record lives (the backend state dir,
         # like the intent journal); None disables persistence — the fast
         # path then never has a digest on record and every flip runs the
@@ -1194,6 +1211,7 @@ class CCManager:
             # The pipelined evict path began the intent before the drain;
             # the serial/direct path begins it here.
             txn = self._begin_transition_intent(topo, chips, mode)
+        warmup = None
         try:
             if stage_task is not None:
                 # Joined strictly before the staged publication, the
@@ -1223,6 +1241,15 @@ class CCManager:
             prep_task = None
             if self.pipeline_transitions and mode != MODE_OFF:
                 prep_task = _PipelineTask("attest-prep", self._attest_prep)
+            # Smoke warmup ∥ wait_ready: the smoke subprocess starts NOW
+            # in compile-only mode (dispatch gated), so the boot wait
+            # absorbs its interpreter-start + import + compile span. The
+            # gate is released only at the smoke phase below — after the
+            # runtime is verifiably ready and attestation passed — and
+            # every failure path cancels the child instead of releasing.
+            run_smoke = bool(self.smoke_workload) and self.smoke_workload != "none"
+            if run_smoke and self.pipeline_transitions and self.smoke_warmup:
+                warmup = self._start_smoke_warmup()
             try:
                 with m.phase(metrics_mod.PHASE_WAIT_READY):
                     self.backend.wait_ready(chips, self.ready_timeout_s)
@@ -1264,7 +1291,6 @@ class CCManager:
             # attestation-digest fast path (env-gated, default off): a
             # flip landing on the exact runtime digest the last FULL
             # smoke verified may skip the workload — attest-only verify.
-            run_smoke = bool(self.smoke_workload) and self.smoke_workload != "none"
             fastpath_hit = False
             if run_smoke and quote is not None and self.smoke_digest_fastpath:
                 fastpath_hit = self._smoke_fastpath_check(quote)
@@ -1276,11 +1302,41 @@ class CCManager:
                 # joined by the owner's finish() before the drain intent
                 # closes.
                 readmit.start_async()
+            if warmup is not None and warmup.died_during_warmup():
+                # The child died BEFORE any release — a warmup
+                # infrastructure failure (e.g. client init against the
+                # mid-boot runtime), not a smoke verdict. The serial
+                # smoke below runs against the now-ready, attested
+                # runtime, so the flip is judged by the same evidence
+                # the pre-warmup pipeline used.
+                log.warning(
+                    "smoke warmup child died before release; falling "
+                    "back to the synchronous smoke"
+                )
+                warmup.cancel("died-during-warmup")
+                warmup = None
             if run_smoke and not fastpath_hit:
                 with m.phase(metrics_mod.PHASE_SMOKE):
-                    self._run_smoke(self.smoke_workload)
+                    if warmup is not None:
+                        # Dispatch release point: ready + attested, by
+                        # construction of everything above this line.
+                        result = warmup.release_and_result()
+                        warmup = None
+                        log.info(
+                            "smoke warmup overlapped %.2fs of compile "
+                            "with the boot wait (dispatch %.2fs)",
+                            result.get("warmup_overlap_s") or 0.0,
+                            result.get("warmup_dispatch_s") or 0.0,
+                        )
+                    else:
+                        self._run_smoke(self.smoke_workload)
                 if quote is not None:
                     self._store_verified_digest(quote)
+            elif warmup is not None and fastpath_hit:
+                # The digest fast path decided the full smoke is not
+                # needed; the warmed child must never dispatch.
+                warmup.cancel("digest-fastpath")
+                warmup = None
         except Exception as e:  # noqa: BLE001 - reference parity:
             # any failure labels the node 'failed' and keeps the loop alive
             # (main.py:531-538). BaseExceptions (sys.exit, a modeled
@@ -1304,7 +1360,15 @@ class CCManager:
         finally:
             # The hardware pipeline is over (committed, failed, or a
             # modeled crash unwinding) — there is no transition left to
-            # hand off.
+            # hand off. A warmup child that was never consumed must not
+            # dispatch (failure paths, unwinding): kill it. (On a REAL
+            # SIGKILL no finally runs; the child covers that itself via
+            # the gate's parent-pid watch and exits instead of orphaning.)
+            if warmup is not None:
+                try:
+                    warmup.cancel("pipeline-unwound")
+                except Exception as e:  # noqa: BLE001 - never mask the cause
+                    log.warning("could not cancel the smoke warmup: %s", e)
             with self._transition_lock:
                 self._inflight_transition = None
         self._report_state(mode)
@@ -1449,6 +1513,32 @@ class CCManager:
             )
         except Exception as e:  # noqa: BLE001 - advisory; next event retries
             log.warning("could not answer verifier challenge: %s", e)
+
+    def _start_smoke_warmup(self):
+        """Spawn the smoke subprocess in compile-only warmup mode (the
+        dispatch gate armed), to run concurrently with wait_ready.
+
+        Returns a handle with ``release_and_result()`` / ``cancel()`` —
+        the :class:`~tpu_cc_manager.smoke.runner.SmokeWarmup` contract —
+        or None when the warmup can't apply: an injected smoke_runner
+        with no matching warmup factory (tests, custom harnesses) keeps
+        today's synchronous smoke, and a spawn failure degrades the same
+        way (advisory: the serial path still verifies end to end)."""
+        factory = self.smoke_warmup_factory
+        if factory is None:
+            if self.smoke_runner is not None:
+                return None
+            from tpu_cc_manager.smoke.runner import SmokeWarmup
+
+            factory = SmokeWarmup
+        try:
+            return factory(self.smoke_workload)
+        except Exception as e:  # noqa: BLE001 - warmup is an optimization
+            log.warning(
+                "smoke warmup spawn failed (falling back to the "
+                "synchronous smoke): %s", e,
+            )
+            return None
 
     def _run_smoke(self, workload: str) -> dict:
         if self.smoke_runner is not None:

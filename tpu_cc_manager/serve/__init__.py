@@ -1,0 +1,34 @@
+"""Continuous synthetic serving: measure what a *user* sees while a pool
+flips CC mode under load (ROADMAP item 3).
+
+Every bench before this package measured node-seconds; nothing measured
+user-visible disruption. The pieces here close that gap:
+
+- :class:`~tpu_cc_manager.serve.server.NodeServer` — a per-node batched
+  inference server that subscribes to the drain handshake
+  (``drain/handshake.py``): when its node's manager requests a drain the
+  server checkpoints in-flight requests (sized to the
+  ``drain.deadline-s`` hint when one is published) and hands them back
+  to the driver instead of dying with them.
+- :class:`~tpu_cc_manager.serve.driver.TrafficDriver` — sustains batched
+  requests across the pool, routes around draining nodes, and adapts
+  its per-node batch ladder from the reported ``hbm_bw_util`` headroom
+  (a conservative, lower-bound read — see ``smoke/llama_infer.py``).
+- :class:`~tpu_cc_manager.serve.harness.ServeHarness` — wires a fake
+  pool of REAL node agents (CCManager watch loops), the servers and the
+  driver together, runs a real rolling CC flip mid-traffic, and reports
+  p50/p99 latency + error rate during the rollout vs steady state, plus
+  requests lost per node bounced (target: zero).
+"""
+
+from tpu_cc_manager.serve.driver import TrafficDriver
+from tpu_cc_manager.serve.harness import ServeHarness
+from tpu_cc_manager.serve.server import NodeServer, Request, SimulatedExecutor
+
+__all__ = [
+    "NodeServer",
+    "Request",
+    "ServeHarness",
+    "SimulatedExecutor",
+    "TrafficDriver",
+]

@@ -1,0 +1,252 @@
+"""Traffic driver: sustained batched inference across a pool under flip.
+
+Closed-loop driver with a per-node batch ladder:
+
+- keeps each accepting server's pipe ~``pipe_depth`` batches deep,
+  routing around draining/bounced nodes (their requests come back via
+  checkpoint-and-requeue and are re-dispatched with progress intact);
+- adapts each node's batch size from its reported ``hbm_bw_util``:
+  below ``util_ceiling`` there is headroom → step the batch up ONE rung;
+  above it step down. One rung at a time, and a ceiling strictly below
+  1.0, because the utilization read is a useful-traffic LOWER bound
+  (smoke/llama_infer.py — the padded+masked KV stream makes the
+  marginal-cost model worst-case): the ladder's headroom read is
+  deliberately conservative, never optimistic;
+- stamps every request at creation and never restamps: reported latency
+  is end-to-end what a user saw, checkpoint bounces included.
+
+The report splits completions into steady-state vs a caller-marked
+rollout window and carries the headline the harness commits:
+``requests_lost_per_node_bounced`` (target: zero — a request is lost
+only if it never completed after traffic stopped and the grace drain
+expired).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from tpu_cc_manager.serve.server import NodeServer, Request
+from tpu_cc_manager.utils import locks as locks_mod
+from tpu_cc_manager.utils import retry as retry_mod
+
+log = logging.getLogger(__name__)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class TrafficDriver:
+    def __init__(
+        self,
+        servers: dict[str, NodeServer],
+        request_tokens: int = 8,
+        initial_batch: int = 2,
+        min_batch: int = 1,
+        max_batch: int = 16,
+        util_ceiling: float = 0.9,
+        ladder_interval_s: float = 0.25,
+        submit_interval_s: float = 0.01,
+        pipe_depth: int = 2,
+    ) -> None:
+        self.servers = servers
+        self.request_tokens = request_tokens
+        self.min_batch = min_batch
+        self.max_batch = max_batch
+        self.util_ceiling = util_ceiling
+        self.ladder_interval_s = ladder_interval_s
+        self.submit_interval_s = submit_interval_s
+        self.pipe_depth = pipe_depth
+        self._lock = locks_mod.make_lock("serve.driver")
+        self._pending: list[Request] = []  # cclint: guarded-by(_lock)
+        self._completed: list[Request] = []  # cclint: guarded-by(_lock)
+        self._outstanding: dict[str, int] = {  # cclint: guarded-by(_lock)
+            name: 0 for name in servers
+        }
+        self._batch: dict[str, int] = {  # cclint: guarded-by(_lock)
+            name: initial_batch for name in servers
+        }
+        self._next_id = 0  # cclint: guarded-by(_lock)
+        self._requeues = 0  # cclint: guarded-by(_lock)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- server callbacks --------------------------------------------------
+
+    def on_complete(self, node: str, req: Request, util: float) -> None:
+        with self._lock:
+            self._completed.append(req)
+            self._outstanding[node] = max(0, self._outstanding[node] - 1)
+
+    def on_requeue(self, node: str, reqs: list[Request]) -> None:
+        """Checkpointed requests coming back from a draining server:
+        front of the queue (oldest first) so the bounce delay they
+        already paid is not compounded by re-queueing behind fresh
+        traffic."""
+        with self._lock:
+            self._requeues += len(reqs)
+            self._outstanding[node] = max(
+                0, self._outstanding[node] - len(reqs)
+            )
+            self._pending[:0] = reqs
+
+    # -- driving loop ------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-driver"
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def _run(self) -> None:
+        last_ladder = time.monotonic()
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last_ladder >= self.ladder_interval_s:
+                self._ladder_step()
+                last_ladder = now
+            self._dispatch_round(top_up=True)
+            retry_mod.wait(self.submit_interval_s, self._stop)
+
+    def _dispatch_round(self, top_up: bool) -> None:
+        """Fill each accepting server's pipe to ``pipe_depth`` batches.
+        ``top_up`` mints fresh requests when the pending queue runs dry
+        (closed-loop traffic); the drain pass after stop() leaves it off
+        so only in-system requests finish."""
+        for name, server in self.servers.items():
+            if not server.accepting():
+                continue
+            with self._lock:
+                bsz = self._batch[name]
+                if self._outstanding[name] >= self.pipe_depth * bsz:
+                    continue
+                if top_up:
+                    now = time.monotonic()
+                    while len(self._pending) < bsz:
+                        self._next_id += 1
+                        self._pending.append(Request(
+                            req_id=self._next_id,
+                            decode_tokens=self.request_tokens,
+                            submitted_at=now,
+                        ))
+                batch = self._pending[:bsz]
+                if not batch:
+                    continue
+                del self._pending[:len(batch)]
+                self._outstanding[name] += len(batch)
+            if not server.submit(batch):
+                # Lost the race with a drain: keep the requests, let the
+                # next round route them to an accepting server.
+                with self._lock:
+                    self._outstanding[name] = max(
+                        0, self._outstanding[name] - len(batch)
+                    )
+                    self._pending[:0] = batch
+
+    def _ladder_step(self) -> None:
+        """One conservative rung per interval, per node, off the last
+        reported ``hbm_bw_util``: the read is a lower bound, so the
+        ceiling sits below 1.0 and the ladder never jumps rungs."""
+        for name, server in self.servers.items():
+            util = server.last_hbm_bw_util
+            if util is None:
+                continue
+            with self._lock:
+                if util < self.util_ceiling and self._batch[name] < self.max_batch:
+                    self._batch[name] += 1
+                elif util > self.util_ceiling and self._batch[name] > self.min_batch:
+                    self._batch[name] -= 1
+
+    def drain_outstanding(self, grace_s: float = 10.0) -> None:
+        """After stop(): keep dispatching ONLY in-system requests until
+        everything completed or the grace expires (whatever remains is
+        counted lost — the harness's zero-loss claim hinges here)."""
+
+        def settled() -> bool:
+            self._dispatch_round(top_up=False)
+            with self._lock:
+                return (
+                    not self._pending
+                    and all(v == 0 for v in self._outstanding.values())
+                )
+
+        retry_mod.poll_until(settled, grace_s, 0.02)
+
+    # -- reporting ---------------------------------------------------------
+
+    def snapshot_batches(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._batch)
+
+    def report(
+        self,
+        rollout_window: tuple[float, float] | None = None,
+        nodes_bounced: int = 0,
+    ) -> dict:
+        """Latency/loss summary. ``rollout_window`` is (start, end) on
+        the driver's monotonic clock; the during-rollout bucket is every
+        request whose in-system interval [submitted_at, completed_at]
+        OVERLAPS the window — exactly the requests a user had in flight
+        while the pool flipped. (Bucketing by completion time alone
+        would park a request bounced by the LAST node's drain — which
+        completes just after the rollout returns — in the steady bucket,
+        inflating steady p99 and understating the disruption the
+        artifact headlines.)"""
+        with self._lock:
+            completed = list(self._completed)
+            in_system = len(self._pending) + sum(
+                self._outstanding.values()
+            )
+            requeues = self._requeues
+            issued = self._next_id
+        lat_all, lat_roll, lat_steady = [], [], []
+        for r in completed:
+            if r.completed_at is None:
+                continue
+            lat = r.completed_at - r.submitted_at
+            lat_all.append(lat)
+            if rollout_window and (
+                r.completed_at >= rollout_window[0]
+                and r.submitted_at <= rollout_window[1]
+            ):
+                lat_roll.append(lat)
+            else:
+                lat_steady.append(lat)
+        lat_all.sort(); lat_roll.sort(); lat_steady.sort()
+        lost = in_system  # after drain_outstanding: nothing should remain
+
+        def stats(vals: list[float]) -> dict:
+            return {
+                "count": len(vals),
+                "p50_ms": round(1e3 * _percentile(vals, 0.50), 2) if vals else None,
+                "p99_ms": round(1e3 * _percentile(vals, 0.99), 2) if vals else None,
+                "max_ms": round(1e3 * vals[-1], 2) if vals else None,
+            }
+
+        denom = len(completed) + lost
+        return {
+            "requests_issued": issued,
+            "requests_completed": len(completed),
+            "requests_lost": lost,
+            "requests_requeued": requeues,
+            "error_rate": round(lost / denom, 6) if denom else 0.0,
+            "nodes_bounced": nodes_bounced,
+            "requests_lost_per_node_bounced": (
+                round(lost / nodes_bounced, 6) if nodes_bounced else lost
+            ),
+            "latency": stats(lat_all),
+            "latency_during_rollout": stats(lat_roll),
+            "latency_steady_state": stats(lat_steady),
+            "batch_ladder": self.snapshot_batches(),
+        }

@@ -1,0 +1,240 @@
+"""Serving-under-the-flip harness: REAL agents, real rollout, live traffic.
+
+Wires together, against one in-memory apiserver fake:
+
+- a pool of REAL node agents (:class:`CCManager` ``watch_and_apply``
+  loops, fake TPU backends, component pods + the emulated operator
+  controller reacting to pause labels) — the same full reconcile
+  pipeline every other bench drives;
+- one :class:`~tpu_cc_manager.serve.server.NodeServer` per node,
+  registered on the drain handshake;
+- a :class:`~tpu_cc_manager.serve.driver.TrafficDriver` sustaining
+  batched traffic across the pool;
+- a REAL rolling CC flip (``ccmanager/rolling.py`` — the orchestrator
+  ``ctl rollout`` drives) running mid-traffic.
+
+The report is the ROADMAP item 3 artifact: p50/p99 and error rate
+*during* the rollout vs steady state, and requests lost per node
+bounced (the zero-loss claim).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.drain.sim import add_drainable_node
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import (
+    CC_MODE_STATE_LABEL,
+    MODE_OFF,
+    SLICE_ID_LABEL,
+)
+from tpu_cc_manager.obs.journal import Journal
+from tpu_cc_manager.serve.driver import TrafficDriver
+from tpu_cc_manager.serve.server import NodeServer, SimulatedExecutor
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils import retry as retry_mod
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+NS = "tpu-operator"
+POOL_LABEL = "pool"
+POOL_VALUE = "tpu-serve"
+POOL_SELECTOR = f"{POOL_LABEL}={POOL_VALUE}"
+
+
+def add_serving_node(
+    kube: FakeKube, name: str, pod_delete_delay_s: float = 0.0
+) -> None:
+    """One drainable node with the serving pool label — the SAME
+    emulated operator controller the main bench drives
+    (drain/sim.py), so SERVE and BENCH artifacts can never measure
+    diverging drain emulations."""
+    add_drainable_node(
+        kube, name, NS, pod_delete_delay_s=pod_delete_delay_s,
+        extra_labels={POOL_LABEL: POOL_VALUE},
+    )
+
+
+class ServeHarness:
+    """Build the pool, run traffic, flip it, report what users saw."""
+
+    def __init__(
+        self,
+        n_nodes: int = 3,
+        tmp_dir: str = "/tmp/tpu-cc-serve",
+        executor_factory=None,
+        drain_ack_timeout_s: float = 10.0,
+        pod_delete_delay_s: float = 0.0,
+        checkpoint_full_s: float = 0.1,
+        reset_latency_s: float = 0.0,
+        boot_latency_s: float = 0.0,
+        driver_kwargs: dict | None = None,
+    ) -> None:
+        self.n_nodes = n_nodes
+        self.nodes = [f"serve-node-{i}" for i in range(n_nodes)]
+        self.tmp_dir = tmp_dir
+        self.executor_factory = (
+            executor_factory if executor_factory is not None
+            else SimulatedExecutor
+        )
+        self.drain_ack_timeout_s = drain_ack_timeout_s
+        self.pod_delete_delay_s = pod_delete_delay_s
+        self.checkpoint_full_s = checkpoint_full_s
+        self.reset_latency_s = reset_latency_s
+        self.boot_latency_s = boot_latency_s
+        self.driver_kwargs = driver_kwargs or {}
+        self.kube = FakeKube()
+        self.backends: dict[str, FakeTpuBackend] = {}
+        self.agents: list[CCManager] = []
+        self.servers: dict[str, NodeServer] = {}
+        self.driver: TrafficDriver | None = None
+        self._agent_threads: list[threading.Thread] = []
+        self._agent_stop = threading.Event()
+
+    # -- pool construction -------------------------------------------------
+
+    def build(self) -> None:
+        os.makedirs(self.tmp_dir, exist_ok=True)
+        for i, name in enumerate(self.nodes):
+            add_serving_node(self.kube, name, self.pod_delete_delay_s)
+            backend = FakeTpuBackend(
+                num_chips=2,
+                accelerator_type="v5p-8",
+                slice_id=f"serve-slice-{i}",
+                reset_latency_s=self.reset_latency_s,
+                boot_latency_s=self.boot_latency_s,
+            )
+            self.backends[name] = backend
+            mgr = CCManager(
+                api=self.kube,
+                backend=backend,
+                node_name=name,
+                default_mode=MODE_OFF,
+                operator_namespace=NS,
+                evict_components=True,
+                smoke_workload="none",
+                metrics=MetricsRegistry(),
+                journal=Journal(trace_file=""),
+                eviction_timeout_s=30,
+                eviction_poll_interval_s=0.02,
+                drain_ack_timeout_s=self.drain_ack_timeout_s,
+                watch_timeout_s=1,
+                reconnect_delay_s=0.0,
+                readiness_file=f"{self.tmp_dir}/ready-{name}",
+            )
+            self.agents.append(mgr)
+            t = threading.Thread(
+                target=mgr.watch_and_apply, args=(self._agent_stop,),
+                daemon=True, name=f"agent-{name}",
+            )
+            self._agent_threads.append(t)
+        for t in self._agent_threads:
+            t.start()
+        if not self._await_settled():
+            raise RuntimeError("serving pool agents never settled")
+        # Forwarding closures break the server↔driver construction cycle
+        # (nothing fires before run() starts the servers, by which time
+        # the driver exists).
+        self.servers = {
+            name: NodeServer(
+                self.kube, name,
+                on_complete=lambda n, r, u: self.driver.on_complete(n, r, u),
+                on_requeue=lambda n, rs: self.driver.on_requeue(n, rs),
+                executor=self.executor_factory(),
+                checkpoint_full_s=self.checkpoint_full_s,
+            )
+            for name in self.nodes
+        }
+        self.driver = TrafficDriver(self.servers, **self.driver_kwargs)
+
+    def _await_settled(self, timeout_s: float = 30.0) -> bool:
+        def settled() -> bool:
+            for name in self.nodes:
+                labels = node_labels(self.kube.get_node(name))
+                if labels.get(CC_MODE_STATE_LABEL) != MODE_OFF:
+                    return False
+                if not labels.get(SLICE_ID_LABEL):
+                    return False
+            return True
+
+        return retry_mod.poll_until(settled, timeout_s, 0.05)
+
+    # -- run ---------------------------------------------------------------
+
+    def run(
+        self,
+        traffic_s: float = 6.0,
+        rollout_mode: str = "on",
+        warmup_frac: float = 0.25,
+        max_unavailable: int = 1,
+        rollout_timeout_s: float = 60.0,
+    ) -> dict:
+        """Sustain traffic for ``traffic_s`` (plus however long the flip
+        needs), run the rolling CC flip after ``warmup_frac`` of it, and
+        report. The steady-state buckets are the pre-flip warmup and the
+        post-flip tail."""
+        assert self.driver is not None, "call build() first"
+        for server in self.servers.values():
+            server.start()
+        self.driver.start()
+        try:
+            retry_mod.wait(traffic_s * warmup_frac, None)
+            roller = RollingReconfigurator(
+                self.kube, POOL_SELECTOR,
+                max_unavailable=max_unavailable,
+                node_timeout_s=rollout_timeout_s,
+                poll_interval_s=0.02,
+            )
+            t_roll_0 = time.monotonic()
+            result = roller.rollout(rollout_mode)
+            t_roll_1 = time.monotonic()
+            # Post-flip steady tail: the rest of the traffic budget, at
+            # least a second so the tail bucket has data.
+            tail = max(1.0, traffic_s * (1.0 - warmup_frac))
+            retry_mod.wait(tail, None)
+        finally:
+            self.driver.stop()
+        # Everything still in the system must complete: the zero-loss
+        # claim is checked AFTER the grace drain, not before.
+        self.driver.drain_outstanding(grace_s=15.0)
+        bounced = sum(
+            1 for name in self.nodes
+            if node_labels(self.kube.get_node(name)).get(
+                CC_MODE_STATE_LABEL
+            ) == rollout_mode
+        )
+        report = self.driver.report(
+            rollout_window=(t_roll_0, t_roll_1), nodes_bounced=bounced,
+        )
+        report["rollout_ok"] = bool(result.ok)
+        report["rollout_wall_s"] = round(t_roll_1 - t_roll_0, 3)
+        report["rollout_summary"] = result.summary()
+        report["drains"] = {
+            name: {
+                "drains": s.drains,
+                "resumes": s.resumes,
+                "last_checkpoint_s": (
+                    round(s.last_checkpoint_s, 4)
+                    if s.last_checkpoint_s is not None else None
+                ),
+                "last_checkpoint_deadline_s": s.last_checkpoint_deadline_s,
+                "requeued": s.last_checkpoint_requeued,
+            }
+            for name, s in self.servers.items()
+        }
+        return report
+
+    def shutdown(self) -> None:
+        for server in self.servers.values():
+            server.stop()
+        self._agent_stop.set()
+        for t in self._agent_threads:
+            t.join(timeout=10)

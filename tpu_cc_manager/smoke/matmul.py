@@ -63,8 +63,6 @@ def run(size: int | None = None, iters: int | None = None, seed: int = 0,
         b = jax.random.normal(k2, (size, size), dtype=jnp.bfloat16)
         return a, b
 
-    key = jax.random.PRNGKey(seed)
-
     # One product definition shared by the numerics path and the timed
     # chain, so kernel dispatch and block sizing can't diverge.
     if kernel == "pallas":
@@ -147,6 +145,22 @@ def run(size: int | None = None, iters: int | None = None, seed: int = 0,
             _sync(mm_chain(key, n))
             times.append(time.perf_counter() - t0)
         return statistics.median(times)
+
+    # COMPILE→DISPATCH boundary: everything above is host-side build
+    # (client init + tracing, no computation dispatched); everything
+    # below executes on the device. Under a warmup gate
+    # (CC_SMOKE_DISPATCH_GATE, set by the manager while wait_ready runs)
+    # the AOT compile of the timed chain happens NOW — overlapped with
+    # the runtime boot, from an ABSTRACT key so nothing dispatches — and
+    # execution blocks until the manager releases dispatch (runtime
+    # ready + attestation passed). Without the gate this is a no-op.
+    from tpu_cc_manager.smoke.runner import await_dispatch_gate
+
+    key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(seed))
+    await_dispatch_gate(compile_fns=(
+        lambda: mm_chain.lower(key_aval, iters).compile(),
+    ))
+    key = jax.random.PRNGKey(seed)
 
     diff = _timed(4 * iters, reps=5) - _timed(iters, reps=5)
     # A non-positive differential means overhead variance swamped 3N iters

@@ -54,6 +54,13 @@ def run(size: str | None = None, batch: int | None = None, steps: int = 6,
     class State(train_state.TrainState):
         batch_stats: dict
 
+    # COMPILE→DISPATCH boundary (see smoke/runner.py): model/config build
+    # above is host-side; the key generation and device_put below are the
+    # first device work. Under a warmup gate the child blocks here until
+    # dispatch releases.
+    from tpu_cc_manager.smoke.runner import await_dispatch_gate
+
+    await_dispatch_gate()
     key = jax.random.PRNGKey(seed)
     images = jax.device_put(
         jax.random.normal(key, (batch, image_size, image_size, 3), jnp.float32),

@@ -12,10 +12,15 @@ from __future__ import annotations
 import importlib
 import json
 import logging
+import os
+import shutil
 import subprocess
 import sys
+import tempfile
+import time
 
 from tpu_cc_manager.obs import trace as obs_trace
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -38,6 +43,109 @@ class SmokeConfigError(SmokeError, ValueError):
     bench) keep the stdlib-idiomatic contract."""
 
 
+# ---------------------------------------------------------------------------
+# Two-phase COMPILE→DISPATCH warmup gate
+# ---------------------------------------------------------------------------
+# A CC flip's ~20 s wait_ready boot-wait and the smoke's compile span are
+# both serial, device-free stretches — the gate lets the manager overlap
+# them: the smoke subprocess is launched while the runtime is still
+# booting, does everything up to (but not including) its first device
+# dispatch, then BLOCKS until the parent releases the gate — which the
+# manager does only after wait_ready returned and attestation passed, so
+# no device work ever runs on an unready or unattested runtime.
+
+#: Path of the gate file; its EXISTENCE releases dispatch. Set by the
+#: parent (SmokeWarmup) in the child's environment; unset = no gate.
+DISPATCH_GATE_ENV = "CC_SMOKE_DISPATCH_GATE"
+#: Pid of the process that owns the gate. If it dies before releasing,
+#: the child exits instead of waiting out the timeout as an orphan — a
+#: SIGKILLed manager must not leave warmup subprocesses behind.
+GATE_PARENT_PID_ENV = "CC_SMOKE_GATE_PARENT_PID"
+#: Upper bound on the gate wait (seconds); a gate never released within
+#: it fails the workload loudly rather than hanging the child forever.
+GATE_TIMEOUT_ENV = "CC_SMOKE_GATE_TIMEOUT_S"
+
+DEFAULT_GATE_TIMEOUT_S = 600.0
+GATE_POLL_S = 0.05
+_COMPILED_SUFFIX = ".compiled"
+
+
+def compiled_sentinel(gate_path: str) -> str:
+    """Sentinel file the child touches when its COMPILE phase is done
+    (imports, model build, AOT compiles) and it is about to block on the
+    gate — the parent reads its mtime as the compile-span end."""
+    return gate_path + _COMPILED_SUFFIX
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def await_dispatch_gate(compile_fns: tuple = ()) -> bool:
+    """Workload-side gate: called at the COMPILE→DISPATCH boundary (after
+    imports and setup, strictly before the first device dispatch).
+
+    No-op (returns False) unless the parent armed the gate via
+    ``CC_SMOKE_DISPATCH_GATE``. Otherwise: run ``compile_fns`` (advisory
+    AOT compiles — with the persistent XLA cache on, the dispatch-path
+    recompile is a disk hit), touch the compiled sentinel, then block
+    until the gate file appears. Raises :class:`SmokeError` — the child
+    exits with the one-JSON-line failure — when the gate times out or
+    the parent pid named in ``CC_SMOKE_GATE_PARENT_PID`` died without
+    releasing (orphan protection: a SIGKILLed manager's warmup child
+    must terminate itself, never dispatch, and never linger)."""
+    gate = os.environ.get(DISPATCH_GATE_ENV)
+    if not gate:
+        return False
+    for fn in compile_fns:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - AOT warm is advisory
+            log.warning("warmup AOT compile failed (advisory): %s", e)
+    try:
+        with open(compiled_sentinel(gate), "w", encoding="utf-8") as f:
+            f.write(str(os.getpid()))
+    except OSError as e:
+        log.warning("could not touch compiled sentinel for %s: %s", gate, e)
+    try:
+        timeout_s = float(
+            os.environ.get(GATE_TIMEOUT_ENV) or DEFAULT_GATE_TIMEOUT_S
+        )
+    except ValueError:
+        timeout_s = DEFAULT_GATE_TIMEOUT_S
+    parent = os.environ.get(GATE_PARENT_PID_ENV, "")
+    parent_pid = int(parent) if parent.isdigit() else None
+    state = {"orphan": False}
+
+    def released_or_orphaned() -> bool:
+        if os.path.exists(gate):
+            return True
+        if parent_pid is not None and not _pid_alive(parent_pid):
+            state["orphan"] = True
+            return True
+        return False
+
+    opened = retry_mod.poll_until(
+        released_or_orphaned, timeout_s, GATE_POLL_S
+    )
+    if state["orphan"]:
+        raise SmokeError(
+            f"dispatch gate abandoned: parent pid {parent_pid} is gone — "
+            "exiting instead of dispatching as an orphan"
+        )
+    if not opened:
+        raise SmokeError(
+            f"dispatch gate {gate} not released within {timeout_s:.0f}s"
+        )
+    return True
+
+
 def run_workload(name: str, **kwargs) -> dict:
     """Run a workload in-process (tests, bench)."""
     if name not in WORKLOADS:
@@ -49,6 +157,53 @@ def run_workload(name: str, **kwargs) -> dict:
         if not result.get("ok"):
             raise SmokeError(f"workload {name} reported failure: {result}")
     return result
+
+
+def _subprocess_cmd_env(
+    name: str,
+    force_cpu: bool,
+    extra_args: list[str] | None,
+    extra_env: dict[str, str] | None,
+) -> tuple[list[str], dict[str, str] | None]:
+    """The shared ``python -m tpu_cc_manager.smoke`` command + child env
+    (one place, so the blocking and warmup spawns can never diverge)."""
+    if name not in WORKLOADS:
+        raise SmokeError(f"unknown smoke workload {name!r} (have {sorted(WORKLOADS)})")
+    env = None
+    if force_cpu or extra_env:
+        env = dict(os.environ)
+        if force_cpu:
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        if extra_env:
+            env.update(extra_env)
+    cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", name]
+    if extra_args:
+        cmd.extend(extra_args)
+    return cmd, env
+
+
+def _parse_smoke_stdout(
+    name: str, stdout: str, returncode: int, stderr: str
+) -> dict:
+    """Parse the final JSON line of a smoke child's stdout; raises
+    :class:`SmokeError` unless the child exited 0 with an ok result."""
+    last_json = None
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                last_json = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if returncode != 0:
+        raise SmokeError(
+            f"workload {name} exited rc={returncode}: "
+            f"{(stderr or '')[-512:]}"
+        )
+    if not last_json or not last_json.get("ok"):
+        raise SmokeError(f"workload {name} produced no passing result: {last_json}")
+    return last_json
 
 
 def run_workload_subprocess(
@@ -70,21 +225,7 @@ def run_workload_subprocess(
     single subprocess-smoke contract; bench.py and bench_ab.py import it
     rather than keeping copies in sync.
     """
-    if name not in WORKLOADS:
-        raise SmokeError(f"unknown smoke workload {name!r} (have {sorted(WORKLOADS)})")
-    env = None
-    if force_cpu or extra_env:
-        import os
-
-        env = dict(os.environ)
-        if force_cpu:
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-        if extra_env:
-            env.update(extra_env)
-    cmd = [sys.executable, "-m", "tpu_cc_manager.smoke", "--workload", name]
-    if extra_args:
-        cmd.extend(extra_args)
+    cmd, env = _subprocess_cmd_env(name, force_cpu, extra_args, extra_env)
     log.info("running smoke workload: %s", " ".join(cmd))
     with obs_trace.span(
         "smoke.subprocess", workload=name, force_cpu=force_cpu
@@ -96,21 +237,190 @@ def run_workload_subprocess(
             )
         except subprocess.TimeoutExpired as e:
             raise SmokeError(f"workload {name} timed out after {timeout_s:.0f}s") from e
-        last_json = None
-        for line in proc.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    last_json = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-        if proc.returncode != 0:
-            raise SmokeError(
-                f"workload {name} exited rc={proc.returncode}: "
-                f"{(proc.stderr or '')[-512:]}"
-            )
-        if not last_json or not last_json.get("ok"):
-            raise SmokeError(f"workload {name} produced no passing result: {last_json}")
+        last_json = _parse_smoke_stdout(
+            name, proc.stdout, proc.returncode, proc.stderr or ""
+        )
         sp.set_attribute("backend", last_json.get("backend"))
     log.info("smoke workload %s passed: %s", name, last_json)
     return last_json
+
+
+class SmokeWarmup:
+    """Parent-side handle on a two-phase smoke subprocess.
+
+    The child is spawned immediately with the dispatch gate armed: it
+    runs its COMPILE phase (interpreter start, jax import, model build,
+    advisory AOT compiles) concurrently with whatever the caller is
+    doing — the manager starts it alongside ``wait_ready`` so the boot
+    wait absorbs the compile span — and then blocks. :meth:`release`
+    opens the gate (the manager calls it only after the runtime is ready
+    AND attestation passed); :meth:`result` joins the child and returns
+    the parsed result with the warmup timing folded in; :meth:`cancel`
+    kills the child on any path where its dispatch must never run
+    (fast-path hit, verify failure, pipeline unwinding). A parent that
+    dies without any of these (real SIGKILL) is covered child-side: the
+    gate wait watches the parent pid and exits instead of orphaning
+    (:func:`await_dispatch_gate`).
+
+    Timing fields injected into the result dict:
+
+    - ``warmup_compile_s`` — spawn → compiled-sentinel (the span a serial
+      pipeline would have paid inside its smoke phase);
+    - ``warmup_overlap_s`` — the part of that span that ran before
+      :meth:`release` (what the overlap actually saved; the remainder, if
+      any, still shows up inside the caller's smoke phase);
+    - ``warmup_dispatch_s`` — release → exit.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        timeout_s: float = 900.0,
+        force_cpu: bool = False,
+        cwd: str | None = None,
+        extra_args: list[str] | None = None,
+        extra_env: dict[str, str] | None = None,
+        gate_timeout_s: float | None = None,
+    ) -> None:
+        cmd, env = _subprocess_cmd_env(name, force_cpu, extra_args, extra_env)
+        if env is None:
+            env = dict(os.environ)
+        self.name = name
+        self._timeout_s = timeout_s
+        self._tmp = tempfile.mkdtemp(prefix="tpu-cc-smoke-gate-")
+        self._gate = os.path.join(self._tmp, "dispatch-gate")
+        env[DISPATCH_GATE_ENV] = self._gate
+        env[GATE_PARENT_PID_ENV] = str(os.getpid())
+        if gate_timeout_s is not None:
+            env[GATE_TIMEOUT_ENV] = str(gate_timeout_s)
+        self._stdout_path = os.path.join(self._tmp, "stdout")
+        self._stderr_path = os.path.join(self._tmp, "stderr")
+        log.info("starting warmup smoke (gated dispatch): %s", " ".join(cmd))
+        # File-backed stdio: no pipe to drain, so the parent never blocks
+        # on child output and a killed parent can't wedge the child on a
+        # full pipe either.
+        try:
+            with open(self._stdout_path, "w", encoding="utf-8") as out, open(
+                self._stderr_path, "w", encoding="utf-8"
+            ) as err:
+                self._proc = subprocess.Popen(
+                    cmd, stdout=out, stderr=err, env=env, cwd=cwd, text=True,
+                )
+        except BaseException:
+            # A failed spawn (fork/exec pressure) must not strand the
+            # gate directory — the caller degrades to the sync smoke and
+            # would never reach cancel()/result() on this handle.
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            raise
+        self._t0 = time.monotonic()
+        # Wall-clock twin of _t0: the compiled sentinel's mtime is wall
+        # time, so EVERY span compared against it must be wall-clock too
+        # (mixing in monotonic deltas would let an NTP step inflate
+        # warmup_overlap_s and every downstream overlap_saved_s claim).
+        # Monotonic time is used only for the subprocess timeout budget.
+        self._t0_wall = time.time()
+        self._released_at: float | None = None
+        self._released_wall: float | None = None
+        self._done = False
+
+    @property
+    def gate_path(self) -> str:
+        return self._gate
+
+    def compiled_after_s(self) -> float | None:
+        """Seconds from spawn to the child's compiled sentinel (None while
+        the COMPILE phase is still running or the sentinel never landed)."""
+        try:
+            mtime = os.path.getmtime(compiled_sentinel(self._gate))
+        except OSError:
+            return None
+        return max(0.0, mtime - self._t0_wall)
+
+    def died_during_warmup(self) -> bool:
+        """True when the child exited before the gate was ever released —
+        a warmup-infrastructure failure (e.g. the backend client choking
+        on a mid-boot runtime), NOT a smoke verdict. The caller should
+        fall back to the synchronous smoke instead of failing the flip on
+        a run the serial path would have passed."""
+        return self._released_at is None and self._proc.poll() is not None
+
+    def release(self) -> None:
+        """Open the dispatch gate. Idempotent; the caller must have
+        established safe-to-dispatch (runtime ready, attestation passed)."""
+        if self._released_at is not None:
+            return
+        with open(self._gate, "w", encoding="utf-8") as f:
+            f.write("released")
+        self._released_at = time.monotonic()
+        self._released_wall = time.time()
+
+    def cancel(self, reason: str = "") -> None:
+        """Kill the child (no dispatch must run). Safe on any state —
+        a child that already exited is just reaped."""
+        if self._done:
+            return
+        self._done = True
+        if self._proc.poll() is None:
+            log.info(
+                "cancelling warmup smoke %s%s", self.name,
+                f" ({reason})" if reason else "",
+            )
+            self._proc.kill()
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover - kill() sent
+            log.warning("warmup smoke %s did not reap after kill", self.name)
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+    def result(self) -> dict:
+        """Join the released child and return its parsed result (raises
+        :class:`SmokeError` exactly like ``run_workload_subprocess``)."""
+        if self._released_at is None:
+            self.release()
+        remaining = max(1.0, self._timeout_s - (time.monotonic() - self._t0))
+        try:
+            rc = self._proc.wait(timeout=remaining)
+        except subprocess.TimeoutExpired as e:
+            self.cancel("timeout")
+            raise SmokeError(
+                f"workload {self.name} timed out after {self._timeout_s:.0f}s"
+            ) from e
+        # All three spans on the WALL clock, like the sentinel mtime they
+        # are compared against — one clock, so a step skews measurements
+        # proportionally instead of letting min(wall, monotonic) pick an
+        # inflated bound. (Measurement only; gate control flow never
+        # depends on these.)
+        compile_s = self.compiled_after_s()
+        released_delta = max(0.0, self._released_wall - self._t0_wall)
+        dispatch_s = max(0.0, time.time() - self._released_wall)
+        try:
+            with open(self._stdout_path, encoding="utf-8") as f:
+                stdout = f.read()
+            with open(self._stderr_path, encoding="utf-8") as f:
+                stderr = f.read()
+        except OSError:
+            stdout, stderr = "", ""
+        self._done = True
+        shutil.rmtree(self._tmp, ignore_errors=True)
+        last_json = _parse_smoke_stdout(self.name, stdout, rc, stderr)
+        last_json["warmup_compile_s"] = (
+            round(compile_s, 3) if compile_s is not None else None
+        )
+        # Only the pre-release part of the compile span was actually
+        # hidden by the overlap; compile work after release shows up in
+        # the caller's (timed) smoke phase and must not be double-counted
+        # as saved. A missing sentinel (the child's write failed) means
+        # the span is UNKNOWN: claim zero, never the maximum — an
+        # inflated overlap would overstate every downstream
+        # overlap_saved_s number.
+        overlap = 0.0 if compile_s is None else min(
+            compile_s, released_delta
+        )
+        last_json["warmup_overlap_s"] = round(max(0.0, overlap), 3)
+        last_json["warmup_dispatch_s"] = round(dispatch_s, 3)
+        log.info("warmup smoke %s passed: %s", self.name, last_json)
+        return last_json
+
+    def release_and_result(self) -> dict:
+        self.release()
+        return self.result()
