@@ -28,8 +28,9 @@
 # scenarios' outcomes (PREEMPTION_SUMMARY: preemption fast-drain +
 # handoff resume, slice fencing of a departed peer), and the
 # serving-under-the-flip soak (SERVE_SUMMARY: rolling flip under
-# sustained traffic, zero lost requests) so the evidence ladder can
-# cite them.
+# sustained traffic, zero lost requests), and the flight-recorder crash
+# leg (OBS_SUMMARY: events written across kill+resume at every crash
+# point, zero torn JSONL lines) so the evidence ladder can cite them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -50,7 +51,10 @@ mkdir -p "$(dirname "$OUT")" artifacts
 # CC_CHAOS_SEED, summarized via PREEMPTION_SUMMARY lines.
 # test_serve.py carries the serving-under-the-flip soak (rolling CC flip
 # under sustained traffic, zero lost requests) — SERVE_SUMMARY lines.
-PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
+# test_flight.py carries the flight-recorder crash leg (kill the
+# orchestrator at every crash point, resume, assert ONE exactly-once
+# timeline with zero torn JSONL lines) — OBS_SUMMARY lines.
+PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py tests/test_flight.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
 if [ "$TERMINAL" = "0" ]; then
   PYTEST_ARGS+=(--deselect \
     "tests/test_chaos.py::test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts")
@@ -77,7 +81,8 @@ for i in $(seq 0 $((ITERS - 1))); do
   offline=$(grep -ao "OFFLINE_SUMMARY.*" "$log" | tail -1 | sed "s/^OFFLINE_SUMMARY //; s/'/ /g; s/\"/ /g")
   preemption=$(grep -ao "PREEMPTION_SUMMARY.*" "$log" | sed "s/^PREEMPTION_SUMMARY //; s/'/ /g; s/\"/ /g" | paste -sd'; ' -)
   serve=$(grep -ao "SERVE_SUMMARY.*" "$log" | tail -1 | sed "s/^SERVE_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\"}")
+  obs=$(grep -ao "OBS_SUMMARY.*" "$log" | tail -1 | sed "s/^OBS_SUMMARY //; s/'/ /g; s/\"/ /g")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"obs\": \"${obs}\"}")
 done
 
 {

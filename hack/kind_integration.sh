@@ -76,8 +76,10 @@ echo ">>> seeding a drainable component label (exercises pods-list RBAC)"
 kubectl label node "$NODE" google.com/tpu.deploy.device-plugin=true --overwrite
 
 echo ">>> starting the agent as the ServiceAccount (fake device layer)"
+AGENT_METRICS_PORT=9188
 NODE_NAME="$NODE" KUBECONFIG="$SA_KUBECONFIG" JAX_PLATFORMS=cpu \
   PALLAS_AXON_POOL_IPS= CC_READINESS_FILE=$(mktemp -u) \
+  CC_METRICS_PORT="$AGENT_METRICS_PORT" CC_METRICS_BIND=127.0.0.1 \
   OPERATOR_NAMESPACE="$NS" PYTHONPATH="$REPO" \
   python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
 AGENT_PID=$!
@@ -140,6 +142,7 @@ PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
 echo ">>> restarting the agent; resuming the rollout after lease expiry"
 NODE_NAME="$NODE" KUBECONFIG="$SA_KUBECONFIG" JAX_PLATFORMS=cpu \
   PALLAS_AXON_POOL_IPS= CC_READINESS_FILE=$(mktemp -u) \
+  CC_METRICS_PORT="$AGENT_METRICS_PORT" CC_METRICS_BIND=127.0.0.1 \
   OPERATOR_NAMESPACE="$NS" PYTHONPATH="$REPO" \
   python3 -m tpu_cc_manager --tpu-backend fake --smoke-workload none --debug &
 AGENT_PID=$!
@@ -172,16 +175,41 @@ metadata:
 EOF
 ( sleep 6; kubectl delete node "$PHANTOM" --ignore-not-found ) &
 DELETER_PID=$!
+# Observability drill (ISSUE 12): while the phantom holds the window
+# open, scrape the ORCHESTRATOR's /rolloutz (live flight-recorder
+# snapshot, served by --metrics-port) and the node agent's /metrics
+# MID-ROLLOUT, and assert the rollout/reconcile families are present.
+ORCH_METRICS_PORT=9189
+OBS_DIR=$(mktemp -d)
+( sleep 3
+  curl -fsS "http://127.0.0.1:$ORCH_METRICS_PORT/rolloutz" \
+    > "$OBS_DIR/rolloutz.json" 2>/dev/null || true
+  curl -fsS "http://127.0.0.1:$AGENT_METRICS_PORT/metrics" \
+    > "$OBS_DIR/node_metrics.txt" 2>/dev/null || true ) &
+SCRAPER_PID=$!
 SCALE_DOWN_OUT=$(PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
   python3 -m tpu_cc_manager.ctl rollout \
     --selector pool=tpu-it --mode off --max-unavailable 2 \
-    --failure-budget 0 --node-timeout 120) || {
+    --failure-budget 0 --node-timeout 120 \
+    --metrics-port "$ORCH_METRICS_PORT") || {
   echo "FAIL: rollout did not survive the mid-window node deletion";
   echo "$SCALE_DOWN_OUT"; kill "$DELETER_PID" 2>/dev/null || true; exit 1; }
 wait "$DELETER_PID" 2>/dev/null || true
+wait "$SCRAPER_PID" 2>/dev/null || true
 echo "$SCALE_DOWN_OUT"
 echo "$SCALE_DOWN_OUT" | grep -q "$PHANTOM" || {
   echo "FAIL: deleted node not reported as retired"; exit 1; }
+grep -q '"enabled": *true' "$OBS_DIR/rolloutz.json" || {
+  echo "FAIL: /rolloutz not served mid-rollout"; exit 1; }
+grep -q '"plan"' "$OBS_DIR/rolloutz.json" || {
+  echo "FAIL: /rolloutz snapshot carries no rollout events"; exit 1; }
+grep -q 'tpu_cc_reconciles_total' "$OBS_DIR/node_metrics.txt" || {
+  echo "FAIL: node /metrics not scrapeable mid-rollout"; exit 1; }
+echo ">>> rollout-timeline reconstructs the drill from the flight file"
+PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl rollout-timeline --selector pool=tpu-it \
+  | grep -q "node-retired-deleted" || {
+  echo "FAIL: rollout-timeline does not show the retired phantom"; exit 1; }
 await_state off
 kubectl label node "$NODE" "$MODE_LABEL=on" --overwrite
 await_state on
@@ -310,4 +338,4 @@ echo "$JOURNALZ" | grep -q "deferred label patches: 0" || {
   echo "FAIL: deferred label patches were not flushed after reconnect"
   exit 1; }
 
-echo ">>> kind integration OK (RBAC incl. taints + leases + real watch + merge-patch + rollout + SIGKILL/resume + quarantine + apiserver-outage drill verified)"
+echo ">>> kind integration OK (RBAC incl. taints + leases + real watch + merge-patch + rollout + SIGKILL/resume + quarantine + apiserver-outage + mid-rollout /rolloutz+/metrics drill verified)"

@@ -1,0 +1,506 @@
+"""Rollout flight recorder (tpu_cc_manager/obs/flight.py) + cross-
+process trace stitching.
+
+The acceptance bars (ISSUE 12):
+
+- a kill-at-a-crash-point rollout followed by ``--resume`` yields ONE
+  flight-recorder timeline from which ``ctl rollout-timeline``
+  reconstructs every wave/window/node event exactly once, with zero
+  torn JSONL lines;
+- a single trace id links the orchestrator's rollout span to a node
+  agent's reconcile span (real fake-pool agents, real watch loops);
+- ``ctl status`` surfaces the last-reconcile trace id as a TRACE
+  column.
+
+The crash/resume suite is chaos-marked and prints the OBS_SUMMARY line
+hack/chaos_soak.sh scrapes (events written/replayed, torn lines).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.faults.plan import OrchestratorKilled
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import CC_MODE_LABEL, CC_MODE_STATE_LABEL
+from tpu_cc_manager.obs import flight as flight_mod
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+POOL = "pool=tpu"
+NS = "tpu-operator"
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def add_pool(fake, n=4, slice_map=None):
+    for i in range(n):
+        labels = {"pool": "tpu"}
+        if slice_map and i in slice_map:
+            labels["cloud.google.com/tpu-slice-id"] = slice_map[i]
+        fake.add_node(f"node-{i}", labels)
+
+
+def agent_simulator(fake, fail_nodes=()):
+    in_flight = set()
+
+    def reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired and name not in in_flight:
+            in_flight.add(name)
+
+            def fire():
+                target = "failed" if name in fail_nodes else desired
+                in_flight.discard(name)
+                fake.set_node_label(name, CC_MODE_STATE_LABEL, target)
+
+            t = threading.Timer(0.03, fire)
+            t.daemon = True
+            t.start()
+
+    fake.add_patch_reactor(reactor)
+
+
+def make_lease(fake, holder, clk, metrics=None, duration_s=30.0):
+    return rollout_state.RolloutLease(
+        fake, holder=holder, namespace=NS, duration_s=duration_s,
+        metrics=metrics or MetricsRegistry(), wall=clk, clock=clk,
+    )
+
+
+def make_roller(fake, **kw):
+    kw.setdefault("node_timeout_s", 5)
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("metrics", MetricsRegistry())
+    return RollingReconfigurator(fake, POOL, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Recorder mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_appends_flushed_jsonl_and_reads_back(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    fr = flight_mod.FlightRecorder(path, generation=3, trace_id="abc")
+    fr.record("plan", mode="on", groups=2)
+    fr.record("window-open", wave=0, window=0)
+    events, torn = flight_mod.read_events(path)
+    assert torn == 0
+    assert [e["event"] for e in events] == ["plan", "window-open"]
+    assert events[0]["gen"] == 3
+    assert events[0]["trace_id"] == "abc"
+    assert [e["seq"] for e in events] == [1, 2]
+
+
+def test_torn_tail_is_tolerated_and_counted(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    fr = flight_mod.FlightRecorder(path)
+    fr.record("plan", mode="on")
+    fr.record("complete", ok=True)
+    # A SIGKILL mid-write tears the final line.
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"event": "window-open", "truncat')
+    events, torn = flight_mod.read_events(path)
+    assert [e["event"] for e in events] == ["plan", "complete"]
+    assert torn == 1
+
+
+def test_successor_continues_the_sequence(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    a = flight_mod.FlightRecorder(path)
+    a.record("plan")
+    a.record("halt", reason="x")
+    b = flight_mod.FlightRecorder(path)  # the resumed orchestrator
+    b.record("resume")
+    events, _ = flight_mod.read_events(path)
+    assert [e["seq"] for e in events] == [1, 2, 3]
+
+
+def test_missing_file_is_an_empty_timeline(tmp_path):
+    events, torn = flight_mod.read_events(str(tmp_path / "nope.jsonl"))
+    assert events == [] and torn == 0
+
+
+def test_write_failure_degrades_without_raising(tmp_path):
+    fr = flight_mod.FlightRecorder(str(tmp_path / "dir-not-file"))
+    os.makedirs(str(tmp_path / "dir-not-file"))
+    fr.record("plan")  # open() fails; must not raise
+    assert fr.events_written == 0
+
+
+def test_reconstruct_sorts_mixed_int_and_string_wave_ids():
+    """A surge (wave="surge") or adoption (wave="adopt") rollout also
+    emits numeric waves; the reconstruction must render, not TypeError
+    on int-vs-str comparison."""
+    events = [
+        {"event": "window-open", "wave": "surge", "window": 0,
+         "groups": ["g0"]},
+        {"event": "window-close", "wave": "surge", "window": 0,
+         "seconds": 1.0},
+        {"event": "window-open", "wave": 0, "window": 0, "groups": ["g1"]},
+        {"event": "window-close", "wave": 0, "window": 0, "seconds": 0.5},
+        {"event": "window-open", "wave": "adopt", "window": 0,
+         "groups": ["g2"]},
+    ]
+    rec = flight_mod.reconstruct(events)
+    waves = [w["wave"] for w in rec["windows"]]
+    assert waves == [0, "adopt", "surge"]  # numeric first, then named
+    # The human renderer survives the same mix.
+    assert "surge" in flight_mod.render_timeline(events)
+
+
+def test_redrive_of_failed_node_is_not_a_duplicate():
+    """The designed resume path re-drives a FAILED group after the
+    operator re-runs the rollout; the later real terminal supersedes
+    (flagged `redriven`), while a second real drive of a CONVERGED node
+    stays a forbidden duplicate."""
+    redrive = [
+        {"event": "node-failed", "node": "n0", "state": "timeout"},
+        {"event": "node-converged", "node": "n0", "state": "on"},
+    ]
+    rec = flight_mod.reconstruct(redrive)
+    assert rec["duplicate_node_events"] == []
+    assert rec["nodes"]["n0"]["outcome"] == "node-converged"
+    assert rec["nodes"]["n0"]["redriven"] is True
+    double_bounce = [
+        {"event": "node-converged", "node": "n0", "state": "on"},
+        {"event": "node-converged", "node": "n0", "state": "on"},
+    ]
+    rec = flight_mod.reconstruct(double_bounce)
+    assert len(rec["duplicate_node_events"]) == 1
+
+
+def test_snapshot_serves_from_memory_and_counts_prior_events(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    a = flight_mod.FlightRecorder(path)
+    a.record("plan", mode="on")
+    b = flight_mod.FlightRecorder(path)  # successor loads the file once
+    b.record("resume")
+    snap = b.snapshot()
+    assert snap["events_in_file"] == 2
+    assert [e["event"] for e in snap["recent"]] == ["plan", "resume"]
+    # The snapshot is served from memory: deleting the file under the
+    # recorder does not blank a live /rolloutz poll.
+    os.unlink(path)
+    assert [e["event"] for e in b.snapshot()["recent"]] == [
+        "plan", "resume",
+    ]
+
+
+def test_flight_path_for_is_deterministic(monkeypatch, tmp_path):
+    monkeypatch.setenv("CC_FLIGHT_DIR", str(tmp_path))
+    p1 = flight_mod.flight_path_for("pool=tpu")
+    p2 = flight_mod.flight_path_for("pool=tpu")
+    assert p1 == p2 and p1.startswith(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# A full rollout writes a reconstructable timeline
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_timeline_covers_every_decision(tmp_path, fake_kube):
+    add_pool(fake_kube, 4, slice_map={0: "s1", 1: "s1"})
+    agent_simulator(fake_kube)
+    flight = flight_mod.FlightRecorder(str(tmp_path / "f.jsonl"))
+    roller = make_roller(fake_kube, flight=flight)
+    result = roller.rollout("on")
+    assert result.ok
+    events, torn = flight_mod.read_events(flight.path)
+    assert torn == 0
+    names = [e["event"] for e in events]
+    assert names[0] == "plan"
+    assert names[-1] == "complete"
+    assert "window-open" in names and "window-close" in names
+    # 3 groups (s1 pair + 2 singles) = one desired patch per node.
+    desired = [e for e in events if e["event"] == "node-desired-patch"]
+    assert sorted(e["node"] for e in desired) == [
+        f"node-{i}" for i in range(4)
+    ]
+    rec = flight_mod.reconstruct(events)
+    assert rec["plan"]["mode"] == "on"
+    assert set(rec["nodes"]) == {f"node-{i}" for i in range(4)}
+    assert all(
+        n["outcome"] == "node-converged" for n in rec["nodes"].values()
+    )
+    assert rec["duplicate_node_events"] == []
+    # Every event shares the rollout's trace id.
+    assert len({e["trace_id"] for e in events}) == 1
+
+
+def test_failed_group_and_halt_are_in_the_timeline(tmp_path, fake_kube):
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube, fail_nodes={"node-1"})
+    flight = flight_mod.FlightRecorder(str(tmp_path / "f.jsonl"))
+    roller = make_roller(fake_kube, flight=flight)
+    result = roller.rollout("on")
+    assert not result.ok
+    events, _ = flight_mod.read_events(flight.path)
+    rec = flight_mod.reconstruct(events)
+    assert rec["nodes"]["node-1"]["outcome"] == "node-failed"
+    assert any(h["reason"] == "group-failed" for h in rec["halts"])
+
+
+# ---------------------------------------------------------------------------
+# The acceptance bar: kill + --resume = ONE exactly-once timeline
+# ---------------------------------------------------------------------------
+
+
+def _run_crash_resume_with_flight(kill_at: int, flight_path: str):
+    fake = FakeKube()
+    add_pool(fake, 4, slice_map={0: "s1", 1: "s1"})
+    agent_simulator(fake)
+    clk = Clock()
+    metrics = MetricsRegistry()
+    hook_calls = {"n": 0}
+
+    def killer(point):
+        if hook_calls["n"] == kill_at:
+            raise OrchestratorKilled(point, hook_calls["n"])
+        hook_calls["n"] += 1
+
+    lease_a = make_lease(fake, "orch-a", clk, metrics=metrics)
+    lease_a.acquire()
+    flight_a = flight_mod.FlightRecorder(
+        flight_path, generation=lease_a.generation
+    )
+    roller_a = make_roller(
+        fake, lease=lease_a, crash_hook=killer, flight=flight_a,
+    )
+    killed = False
+    try:
+        result = roller_a.rollout("on")
+    except OrchestratorKilled:
+        killed = True
+        clk.advance(31)
+        lease_b = make_lease(fake, "orch-b", clk, metrics=metrics)
+        record = lease_b.acquire()
+        assert record is not None
+        # The successor opens the SAME file — one timeline spans the
+        # crash (this is exactly what ctl's selector-derived default
+        # path does).
+        flight_b = flight_mod.FlightRecorder(
+            flight_path, generation=lease_b.generation
+        )
+        roller_b = make_roller(
+            fake, lease=lease_b, resume_record=record, metrics=metrics,
+            flight=flight_b,
+        )
+        result = roller_b.rollout(record.mode)
+    return killed, result, fake
+
+
+@pytest.mark.chaos
+def test_kill_resume_yields_one_exactly_once_timeline(tmp_path):
+    """Kill the orchestrator at EVERY crash point in turn; the combined
+    (pre-kill + post-resume) timeline must reconstruct every node's
+    outcome exactly once, with zero torn lines and zero real duplicate
+    drives, at every kill point."""
+    exhausted = False
+    total_events = 0
+    resumes = 0
+    for kill_at in range(32):
+        flight_path = str(tmp_path / f"kill{kill_at}.jsonl")
+        killed, result, fake = _run_crash_resume_with_flight(
+            kill_at, flight_path
+        )
+        assert result.ok, f"kill_at={kill_at}"
+        events, torn = flight_mod.read_events(flight_path)
+        total_events += len(events)
+        assert torn == 0, f"kill_at={kill_at}: torn lines in the timeline"
+        rec = flight_mod.reconstruct(events)
+        assert set(rec["nodes"]) == {f"node-{i}" for i in range(4)}, (
+            f"kill_at={kill_at}: reconstruction lost a node"
+        )
+        assert rec["duplicate_node_events"] == [], (
+            f"kill_at={kill_at}: node driven twice"
+        )
+        for name, n in rec["nodes"].items():
+            assert n["outcome"] == "node-converged", (
+                f"kill_at={kill_at}: {name} -> {n}"
+            )
+        if killed:
+            resumes += 1
+            assert rec["resumes"] == 1, f"kill_at={kill_at}"
+            assert len(rec["generations"]) == 2, f"kill_at={kill_at}"
+        else:
+            exhausted = True
+            break
+    assert exhausted, "never exhausted the crash points; raise the range"
+    print("OBS_SUMMARY " + json.dumps({
+        "kill_points": kill_at, "resumes": resumes,
+        "events_written": total_events, "torn_lines": 0,
+    }))
+
+
+@pytest.mark.chaos
+def test_ctl_rollout_timeline_renders_the_crash_spanning_file(
+    tmp_path, capsys
+):
+    flight_path = str(tmp_path / "f.jsonl")
+    killed, result, fake = _run_crash_resume_with_flight(4, flight_path)
+    assert killed and result.ok
+    from tpu_cc_manager import ctl
+
+    class Args:
+        flight_file = flight_path
+        selector = None
+        as_json = False
+        trace = False
+        spans = None
+
+    assert ctl.cmd_rollout_timeline(fake, Args()) == 0
+    out = capsys.readouterr().out
+    assert "reconstruction:" in out
+    assert "resumes=1" in out
+    for i in range(4):
+        assert f"node-{i}" in out
+    assert "WARNING" not in out  # no torn lines, no duplicates
+
+    Args.as_json = True
+    assert ctl.cmd_rollout_timeline(fake, Args()) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["torn_lines"] == 0
+    assert len(payload["reconstruction"]["nodes"]) == 4
+
+
+def test_ctl_rollout_timeline_requires_a_source(fake_kube):
+    from tpu_cc_manager import ctl
+
+    class Args:
+        flight_file = None
+        selector = None
+
+    with pytest.raises(ValueError):
+        ctl.cmd_rollout_timeline(fake_kube, Args())
+
+
+# ---------------------------------------------------------------------------
+# Cross-process trace stitching: one causal tree, orchestrator -> agent
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_trace_parents_agent_reconcile_spans(tmp_path):
+    """The other acceptance bar: across a REAL fake-pool rollout (real
+    CCManager watch loops, real drain/reset pipeline), the orchestrator
+    trace id appears as the trace of — and the orchestrator span as the
+    parent of — each node agent's reconcile root span; the agent
+    republishes the trace id to its node annotation; and `ctl status`
+    surfaces it as the TRACE column."""
+    from tpu_cc_manager import ctl
+    from tpu_cc_manager import labels as labels_mod
+    from tpu_cc_manager.kubeclient.api import node_annotations
+    from tpu_cc_manager.serve.harness import POOL_SELECTOR, ServeHarness
+    from tpu_cc_manager.utils import retry as retry_mod
+
+    harness = ServeHarness(n_nodes=2, tmp_dir=str(tmp_path))
+    harness.build()
+    try:
+        flight = flight_mod.FlightRecorder(str(tmp_path / "f.jsonl"))
+        roller = RollingReconfigurator(
+            harness.kube, POOL_SELECTOR, node_timeout_s=30,
+            poll_interval_s=0.02, flight=flight,
+        )
+        result = roller.rollout("on")
+        assert result.ok
+        trace_id = flight.trace_id
+        assert trace_id
+
+        # The rollout's desired patches stamped <trace>.<span> on the
+        # nodes; the root span's identity is recoverable from them.
+        stamped = {
+            name: node_labels(harness.kube.get_node(name)).get(
+                labels_mod.ROLLOUT_TRACE_LABEL
+            )
+            for name in harness.nodes
+        }
+        assert all(stamped.values())
+        assert all(v.split(".")[0] == trace_id for v in stamped.values())
+        rollout_span_ids = {v.split(".")[1] for v in stamped.values()}
+
+        def stitched() -> bool:
+            for mgr in harness.agents:
+                spans = [
+                    s for s in mgr.journal.spans()
+                    if s["name"] == "reconcile"
+                    and s["trace_id"] == trace_id
+                ]
+                if not spans:
+                    return False
+            return True
+
+        assert retry_mod.poll_until(stitched, 10.0, 0.05), (
+            "agent reconcile spans never joined the rollout trace"
+        )
+        for mgr in harness.agents:
+            spans = [
+                s for s in mgr.journal.spans()
+                if s["name"] == "reconcile" and s["trace_id"] == trace_id
+            ]
+            # The reconcile root's parent IS the orchestrator span that
+            # wrote the desired patch — one causal tree.
+            assert all(
+                s["parent_id"] in rollout_span_ids for s in spans
+            ), spans
+
+        # Last-reconcile trace id republished to the node annotation.
+        def annotated() -> bool:
+            return all(
+                node_annotations(harness.kube.get_node(name)).get(
+                    labels_mod.TRACE_ID_ANNOTATION
+                ) == trace_id
+                for name in harness.nodes
+            )
+
+        assert retry_mod.poll_until(annotated, 10.0, 0.05)
+
+        # ctl status surfaces it as the TRACE column.
+        class Args:
+            selector = POOL_SELECTOR
+            lease_namespace = None
+
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ctl.cmd_status(harness.kube, Args()) == 0
+        out = buf.getvalue()
+        assert "TRACE" in out.splitlines()[0]
+        assert trace_id in out
+    finally:
+        harness.shutdown()
+
+
+def test_unstitched_reconcile_keeps_its_own_root_trace(fake_kube):
+    """A reconcile NOT driven by a rollout (no stamped label) must mint
+    its own root trace — stitching is opt-in per patch, never sticky
+    across pools."""
+    from tpu_cc_manager.obs import trace as trace_mod
+
+    assert trace_mod.parse_parent(None) is None
+    assert trace_mod.parse_parent("garbled") is None
+    assert trace_mod.parse_parent("a.b.c") is None
+    assert trace_mod.parse_parent("abc.def") == ("abc", "def")
+    with trace_mod.root_span("reconcile") as sp:
+        assert sp.parent_id is None
+    with trace_mod.root_span("reconcile", parent=("t1", "s1")) as sp:
+        assert sp.trace_id == "t1"
+        assert sp.parent_id == "s1"

@@ -34,11 +34,42 @@ def test_live_registry_render_passes_lint():
     registry.set_phase_overlap_seconds(3.25)
     registry.record_smoke_fastpath("hit")
     registry.record_smoke_fastpath('odd"outcome')
+    # Live serving telemetry (tpu_cc_serve_*; serve/ + obs/slo.py),
+    # hostile node names included — the per-node histogram must escape
+    # like everything else.
+    registry.observe_serve_request("serve-node-0", 0.042)
+    registry.observe_serve_request('odd"node', 3.0)
+    registry.set_serve_queue_depth("serve-node-0", 5)
+    registry.set_serve_inflight("serve-node-0", 2)
+    registry.record_serve_outcome("serve-node-0", "completed", 3)
+    registry.record_serve_outcome("serve-node-0", "bounced")
+    registry.record_serve_lost(2)
+    registry.set_serve_goodput(123.4)
+    registry.set_serve_slo(30.0, 0.08, 1.5)
+    registry.set_serve_slo(300.0, None, 0.0)  # empty window: burn only
     problems = check_metrics_lint.lint(registry.render_prometheus())
     assert problems == [], problems
     text = registry.render_prometheus()
     assert "tpu_cc_phase_overlap_seconds" in text
     assert 'tpu_cc_smoke_fastpath_total{outcome="hit"} 1' in text
+    assert (
+        'tpu_cc_serve_request_seconds_bucket{node="serve-node-0",le="0.05"} 1'
+        in text
+    )
+    assert 'tpu_cc_serve_request_seconds_count{node="serve-node-0"} 1' in text
+    assert 'tpu_cc_serve_queue_depth{node="serve-node-0"} 5' in text
+    assert 'tpu_cc_serve_inflight{node="serve-node-0"} 2' in text
+    assert (
+        'tpu_cc_serve_requests_total{node="serve-node-0",outcome="completed"} 3'
+        in text
+    )
+    assert "tpu_cc_serve_lost_total 2" in text
+    assert "tpu_cc_serve_goodput_rps 123.400" in text
+    assert 'tpu_cc_serve_slo_p99_seconds{window="30"} 0.080000' in text
+    assert 'tpu_cc_serve_error_budget_burn{window="30"} 1.500000' in text
+    # The empty window exports burn (0) but NO invented p99 sample.
+    assert 'tpu_cc_serve_error_budget_burn{window="300"} 0.000000' in text
+    assert 'tpu_cc_serve_slo_p99_seconds{window="300"}' not in text
 
 
 def test_empty_registry_render_passes_lint():

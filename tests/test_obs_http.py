@@ -232,3 +232,41 @@ def test_unknown_path_is_404(served):
     server, _, _ = served
     status, _ = _get(server, "/nope")
     assert status == 404
+
+
+def test_rolloutz_disabled_without_a_flight_recorder(served):
+    server, _, _ = served
+    status, text = _get(server, "/rolloutz")
+    assert status == 200
+    assert json.loads(text) == {"enabled": False}
+
+
+def test_rolloutz_serves_the_live_flight_snapshot(tmp_path):
+    from tpu_cc_manager.obs.flight import FlightRecorder
+
+    flight = FlightRecorder(
+        str(tmp_path / "f.jsonl"), generation=2, trace_id="deadbeef"
+    )
+    flight.record("plan", mode="on", groups=3)
+    flight.record("window-open", wave=0, window=0)
+    server = start_metrics_server(
+        0, MetricsRegistry(), bind="127.0.0.1",
+        journal=Journal(trace_file=""), flight=flight,
+    )
+    try:
+        status, text = _get(server, "/rolloutz")
+        assert status == 200
+        payload = json.loads(text)
+        assert payload["enabled"] is True
+        assert payload["generation"] == 2
+        assert payload["trace_id"] == "deadbeef"
+        assert payload["torn_lines"] == 0
+        assert [e["event"] for e in payload["recent"]] == [
+            "plan", "window-open",
+        ]
+        # Live: a later event appears on the next scrape.
+        flight.record("window-close", wave=0, window=0, seconds=1.0)
+        _, text = _get(server, "/rolloutz")
+        assert "window-close" in text
+    finally:
+        server.shutdown()

@@ -1,0 +1,212 @@
+"""SLO window math (tpu_cc_manager/obs/slo.py): property tests.
+
+The evaluator is the single implementation behind both the
+``tpu_cc_serve_slo_*`` gauges and the poll contract a latency-gated
+rollout will use, so its math gets held to invariants, not examples:
+
+- p99 is MONOTONE under added slow requests (a latency-gated rollout
+  must never read "better" after the pool got slower);
+- error counts are CONSERVED across window splits (budget accounting
+  cannot double-count or drop errors at a boundary);
+- an empty window reports no p99 and zero burn (no evidence is not bad
+  evidence — a traffic pause must not halt a rollout).
+
+Seeded-rng property loops (the repo's deterministic-property idiom; no
+hypothesis dependency).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_cc_manager.obs.slo import SloEvaluator, merge_p99, percentile
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def make(clock=None, **kw):
+    kw.setdefault("windows_s", (10.0, 60.0))
+    return SloEvaluator(clock=clock or Clock(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# p99 monotonicity
+# ---------------------------------------------------------------------------
+
+
+def test_p99_monotone_under_added_slow_requests():
+    """Property: appending requests at or above the current p99 can
+    never LOWER the reported p99. 50 seeded rounds."""
+    rng = random.Random(20260804)
+    for round_i in range(50):
+        clk = Clock()
+        ev = make(clock=clk)
+        n = rng.randint(1, 200)
+        for _ in range(n):
+            ev.observe(rng.uniform(0.001, 1.0))
+        before = ev.stats(10.0)["p99_s"]
+        assert before is not None
+        # Add strictly-slower traffic.
+        extra = rng.randint(1, 50)
+        for _ in range(extra):
+            ev.observe(before + rng.uniform(0.0, 2.0))
+        after = ev.stats(10.0)["p99_s"]
+        assert after >= before, (
+            f"round {round_i}: p99 dropped {before} -> {after} after "
+            "adding slower requests"
+        )
+
+
+def test_merge_p99_matches_percentile_of_union():
+    rng = random.Random(7)
+    for _ in range(20):
+        a = sorted(rng.uniform(0, 1) for _ in range(rng.randint(0, 40)))
+        b = sorted(rng.uniform(0, 2) for _ in range(rng.randint(0, 40)))
+        expect = percentile(sorted(a + b), 0.99)
+        assert merge_p99(a, b) == expect
+
+
+# ---------------------------------------------------------------------------
+# burn-rate conservation across window splits
+# ---------------------------------------------------------------------------
+
+
+def test_error_counts_conserved_across_window_splits():
+    """Property: (samples, errors) over [t0, t2) equals the sum over
+    [t0, t1) + [t1, t2) for EVERY split point t1 — the conservation the
+    budget accounting rests on. 30 seeded rounds."""
+    rng = random.Random(42)
+    for round_i in range(30):
+        clk = Clock(0.0)
+        ev = make(clock=clk, windows_s=(100.0,))
+        t_end = rng.uniform(5.0, 50.0)
+        n = rng.randint(1, 300)
+        times = sorted(rng.uniform(0.0, t_end) for _ in range(n))
+        for t in times:
+            ev.observe(
+                rng.uniform(0.001, 0.2), ok=rng.random() > 0.3, now=t
+            )
+        clk.t = t_end  # pruning horizon covers everything
+        whole = ev.counts_between(0.0, t_end + 1.0)
+        for _ in range(5):
+            t1 = rng.uniform(0.0, t_end)
+            left = ev.counts_between(0.0, t1)
+            right = ev.counts_between(t1, t_end + 1.0)
+            assert (
+                left[0] + right[0], left[1] + right[1]
+            ) == whole, f"round {round_i}: split at {t1} not conserved"
+
+
+def test_burn_rate_is_weighted_mean_of_split_burn_rates():
+    """The whole window's burn rate equals the sample-count-weighted
+    mean of any split's burn rates (directly implied by count
+    conservation; asserted explicitly because THIS is the number the
+    pacing loop acts on)."""
+    clk = Clock(0.0)
+    ev = make(clock=clk, windows_s=(100.0,), error_budget=0.01)
+    rng = random.Random(3)
+    for i in range(200):
+        ev.observe(0.05, ok=rng.random() > 0.2, now=i * 0.1)
+    clk.t = 20.0
+    t1 = 10.0
+    (n_all, e_all) = ev.counts_between(0.0, 20.0)
+    (n_l, e_l) = ev.counts_between(0.0, t1)
+    (n_r, e_r) = ev.counts_between(t1, 20.0)
+    burn = (e_all / n_all) / ev.error_budget
+    burn_l = (e_l / n_l) / ev.error_budget
+    burn_r = (e_r / n_r) / ev.error_budget
+    weighted = (burn_l * n_l + burn_r * n_r) / (n_l + n_r)
+    assert burn == pytest.approx(weighted)
+
+
+# ---------------------------------------------------------------------------
+# empty-window behavior
+# ---------------------------------------------------------------------------
+
+
+def test_empty_window_reports_no_p99_and_zero_burn():
+    ev = make()
+    s = ev.stats(10.0)
+    assert s["count"] == 0
+    assert s["p99_s"] is None
+    assert s["error_rate"] == 0.0
+    assert s["burn_rate"] == 0.0
+    assert s["goodput_rps"] == 0.0
+    # And the halt predicate does NOT fire on no evidence.
+    assert ev.breached(max_burn_rate=1.0) is False
+
+
+def test_window_expiry_empties_the_readout():
+    clk = Clock()
+    ev = make(clock=clk)
+    for _ in range(10):
+        ev.observe(0.05, ok=False)
+    assert ev.stats(10.0)["burn_rate"] > 0
+    clk.advance(61.0)  # past the longest window; observe prunes
+    ev.observe(0.01)
+    s = ev.stats(10.0)
+    assert s["errors"] == 0
+    assert s["count"] == 1
+    # Lifetime totals survive the window.
+    snap = ev.snapshot()
+    assert snap["errors_total"] == 10
+    assert snap["total"] == 11
+
+
+# ---------------------------------------------------------------------------
+# the poll contract
+# ---------------------------------------------------------------------------
+
+
+def test_breached_on_burn_and_p99_target():
+    clk = Clock()
+    ev = SloEvaluator(
+        windows_s=(10.0,), error_budget=0.01, p99_target_s=0.5, clock=clk,
+    )
+    for _ in range(99):
+        ev.observe(0.01)
+    assert ev.breached() is False
+    # One error in 100 = 1% error rate = burn 1.0 exactly (not > 1.0).
+    ev.observe_error()
+    assert ev.breached(max_burn_rate=1.0) is False
+    ev.observe_error()
+    assert ev.breached(max_burn_rate=1.0) is True
+    # p99 over target trips it even with zero errors.
+    ev2 = SloEvaluator(
+        windows_s=(10.0,), error_budget=0.01, p99_target_s=0.5, clock=clk,
+    )
+    for _ in range(100):
+        ev2.observe(0.9)
+    assert ev2.breached() is True
+
+
+def test_snapshot_shape_is_the_documented_contract():
+    ev = make()
+    ev.observe(0.1)
+    snap = ev.snapshot()
+    assert set(snap) == {
+        "error_budget", "p99_target_s", "windows", "total", "errors_total",
+    }
+    for w in snap["windows"]:
+        assert {
+            "window_s", "count", "errors", "ok", "error_rate",
+            "burn_rate", "p99_s", "p50_s", "goodput_rps",
+        } <= set(w)
+
+
+def test_constructor_rejects_degenerate_configs():
+    with pytest.raises(ValueError):
+        SloEvaluator(windows_s=())
+    with pytest.raises(ValueError):
+        SloEvaluator(error_budget=0.0)

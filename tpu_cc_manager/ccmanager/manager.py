@@ -436,6 +436,17 @@ class CCManager:
         self._handoff: dict | None = None
         # Event dedup state (see _emit_node_event).
         self._last_event_key: tuple[str, str, str] | None = None
+        # Cross-process trace stitching (labels.ROLLOUT_TRACE_LABEL):
+        # the orchestrator span identity stamped into the most recent
+        # desired-mode patch, adopted as the reconcile root span's
+        # remote parent so /tracez renders one causal tree from `ctl
+        # rollout` down through this node's drain/reset/smoke. Written
+        # and read on the watch-loop thread only (the reconcile runs
+        # inline in it); a stale value is truthful — it names the
+        # rollout that most recently set the desired mode, which IS the
+        # causal parent of every reconcile converging toward it,
+        # retries included.
+        self._rollout_trace_parent: tuple[str, str] | None = None
         # Verifier-challenge re-attestation (multislice.py): the last
         # challenge nonce this agent answered, so the MODIFIED event our
         # own answer generates doesn't loop into another answer.
@@ -493,6 +504,23 @@ class CCManager:
         remediation ladder."""
         self.last_failure_reason = reason
         self.metrics.record_failure(reason)
+
+    def _publish_trace_annotation(self, trace_id: str) -> None:
+        """Advertise the last reconcile's trace id on the node
+        (labels.TRACE_ID_ANNOTATION) so operators can jump from `ctl
+        status` to /tracez?trace_id=. Best-effort, like every other
+        coordination metadata write: a minimal client without
+        annotation patching (or an apiserver blip) must never fail a
+        verified mode change."""
+        try:
+            self.api.patch_node_annotations(
+                self.node_name,
+                {labels_mod.TRACE_ID_ANNOTATION: trace_id},
+            )
+        except Exception as e:  # noqa: BLE001 - advisory metadata only
+            log.debug(
+                "could not publish trace-id annotation (non-fatal): %s", e
+            )
 
     # ------------------------------------------------------------------
     # Apiserver connectivity + intent journal (disconnected mode)
@@ -673,13 +701,24 @@ class CCManager:
             return self.default_mode
         return canonical_mode(label_value)
 
+    def _note_rollout_trace(self, labels: dict) -> None:
+        """Remember the orchestrator trace identity riding in the
+        desired-mode patch (tentpole: cross-process stitching). Garbled
+        values parse to None — a stitching hint must never fail a
+        reconcile."""
+        self._rollout_trace_parent = trace_mod.parse_parent(
+            labels.get(labels_mod.ROLLOUT_TRACE_LABEL)
+        )
+
     def get_node_cc_mode_label(self) -> tuple[str | None, str]:
         """Read the desired-mode label and the node's resourceVersion.
 
         Apiserver errors propagate — at startup that is fatal by design
         (reference main.py:596-598, crash-as-retry)."""
         node = self.api.get_node(self.node_name)
-        return node_labels(node).get(CC_MODE_LABEL), resource_version(node)
+        labels = node_labels(node)
+        self._note_rollout_trace(labels)
+        return labels.get(CC_MODE_LABEL), resource_version(node)
 
     def create_readiness_file(self) -> None:
         """Touch the readiness file after the first successful apply; failures
@@ -709,15 +748,25 @@ class CCManager:
                 log.warning("could not journal desired mode: %s", e)
         try:
             # One reconcile = one trace: every phase span, drain step,
-            # barrier wait and log line below nests under this root.
+            # barrier wait and log line below nests under this root —
+            # and when the desired mode came from a rolling orchestrator
+            # the root itself adopts the ROLLOUT trace as its remote
+            # parent (labels.ROLLOUT_TRACE_LABEL), so the orchestrator's
+            # /tracez renders `ctl rollout` and this node's
+            # drain/reset/smoke as one causal tree.
             with trace_mod.root_span(
                 "reconcile", journal=self.journal,
+                parent=self._rollout_trace_parent,
                 mode=mode, node=self.node_name,
             ) as sp:
                 ok = self._set_cc_mode(mode)
                 sp.set_attribute("ok", ok)
                 if not ok:
                     sp.status = trace_mod.STATUS_ERROR
+                # Republish this reconcile's trace id on the node so
+                # `ctl status` can surface a TRACE column (the event
+                # annotation alone dies with the event's TTL).
+                self._publish_trace_annotation(sp.trace_id)
                 if ok:
                     # A reconcile republishes the quote under a fresh
                     # self-chosen nonce, so any verifier challenge this
@@ -2267,7 +2316,11 @@ class CCManager:
                         # from 410-expiring) and move on.
                         maybe_retry()
                         continue
-                    value = node_labels(event.object).get(CC_MODE_LABEL)
+                    event_labels = node_labels(event.object)
+                    value = event_labels.get(CC_MODE_LABEL)
+                    # The stitching hint rides in the SAME patch as the
+                    # desired mode, so this event carries both.
+                    self._note_rollout_trace(event_labels)
                     self._maybe_answer_challenge(event.object)
                     if value != last_label_value:
                         log.info(

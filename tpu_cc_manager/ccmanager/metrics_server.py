@@ -14,7 +14,11 @@ subsystem (obs/) is served at two debug endpoints:
   nested tree) and boundable by ``?limit=``;
 - ``/journalz`` — JSON: the live node-local intent journal
   (ccmanager/intent_journal.py): open intents, deferred label patches,
-  last replay outcome — what ``tpu-cc-ctl journal <node>`` reads.
+  last replay outcome — what ``tpu-cc-ctl journal <node>`` reads;
+- ``/rolloutz`` — JSON: the rollout flight recorder's live snapshot
+  (obs/flight.py): generation, trace id, recent decision events, torn-
+  line count — the orchestrator's (``ctl rollout --metrics-port``) and
+  the serve harness's mid-rollout observability surface.
 """
 
 from __future__ import annotations
@@ -98,8 +102,10 @@ def start_metrics_server(
     bind: str | None = None,
     journal: journal_mod.Journal | None = None,
     intent_journal=None,
+    flight=None,
 ) -> http.server.ThreadingHTTPServer:
-    """Serve /metrics, /healthz, /statusz and /tracez on ``bind``:``port``.
+    """Serve /metrics, /healthz, /statusz, /tracez and /rolloutz on
+    ``bind``:``port``.
 
     The endpoint is unauthenticated (Prometheus-style). The default bind
     IS all-interfaces (0.0.0.0) — inside a pod that is the pod IP, which
@@ -143,6 +149,14 @@ def start_metrics_server(
                 payload = (
                     intent_journal.snapshot()
                     if intent_journal is not None
+                    else {"enabled": False}
+                )
+                body = (json.dumps(payload, indent=1) + "\n").encode()
+                code = 200
+            elif path == "/rolloutz":
+                payload = (
+                    flight.snapshot()
+                    if flight is not None
                     else {"enabled": False}
                 )
                 body = (json.dumps(payload, indent=1) + "\n").encode()

@@ -52,6 +52,7 @@ from tpu_cc_manager.labels import (
 
 from tpu_cc_manager.labels import SLICE_ID_LABEL  # noqa: F401 - re-export
 from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.obs import flight as flight_mod
 from tpu_cc_manager.obs import trace as obs_trace
 from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import retry as retry_mod
@@ -258,6 +259,7 @@ class RollingReconfigurator:
         wave_shards: int = 1,
         surge: int = 0,
         adopt_new_nodes: bool = True,
+        flight: "flight_mod.FlightRecorder | None" = None,
     ) -> None:
         # Crash safety: with a lease, every write goes through the fence
         # (a lost lease refuses further patches) and progress is
@@ -371,6 +373,45 @@ class RollingReconfigurator:
         # waves serialize so kill schedules stay a pure function of the
         # seed and the (serialized) decision sequence.
         self._crash_lock = locks_mod.make_lock("rolling.crash")
+        # Flight recorder (obs/flight.py): every decision below lands as
+        # one appended+flushed JSONL event, stamped with the rollout
+        # generation and trace id. None = no timeline (tests, embedded
+        # callers). A resumed rollout appends to the SAME file, so one
+        # timeline spans the crash.
+        self.flight = flight
+        if flight is not None and self.generation is not None:
+            flight.set_generation(self.generation)
+
+    def _fl(self, event: str, **fields) -> None:
+        """One flight-recorder event (no-op without a recorder)."""
+        if self.flight is not None:
+            self.flight.record(event, **fields)
+
+    def _fl_group(
+        self, gres: GroupResult, mode: str,
+        wave: int | str | None, window: int | str | None,
+        skipped: bool = False,
+    ) -> None:
+        """Terminal flight events for one awaited group: converged /
+        failed / retired-deleted per node. ``skipped=True`` marks the
+        idempotency-skip path (the node was VERIFIED at target, not
+        driven) — the timeline reconstruction merges a skipped terminal
+        with a real one instead of flagging a double-bounce."""
+        if self.flight is None:
+            return
+        for name, state in gres.states.items():
+            if state == STATE_NODE_DELETED:
+                event = flight_mod.EVENT_NODE_RETIRED
+            elif state == mode:
+                event = flight_mod.EVENT_NODE_CONVERGED
+            else:
+                event = flight_mod.EVENT_NODE_FAILED
+            self.flight.record(
+                event, node=name, group=gres.group, state=state,
+                wave=wave, window=window,
+                skipped=skipped or None,
+                seconds=round(gres.seconds, 3),
+            )
 
     def rollout(self, mode: str) -> RolloutResult:
         mode = canonical_mode(mode)
@@ -381,18 +422,31 @@ class RollingReconfigurator:
             raise ValueError(
                 f"invalid CC mode {mode!r} (valid: {VALID_MODES})"
             )
-        # One rollout = one trace (the per-node agents run their own
-        # reconcile traces in their own processes; this trace covers the
-        # orchestrator's window/await structure).
+        # One rollout = one trace — and, unlike the pre-stitching era,
+        # NOT a disjoint one: every desired-mode patch below carries
+        # this trace's identity (labels.ROLLOUT_TRACE_LABEL), each node
+        # agent adopts it as the remote parent of its reconcile root
+        # span, and /tracez?trace_id=<this id> renders one causal tree
+        # from this span down through every node's drain/reset/smoke.
         with obs_trace.root_span(
             "rollout", mode=mode, selector=self.selector,
             max_unavailable=self.max_unavailable,
         ) as sp:
+            if self.flight is not None:
+                self.flight.set_trace(sp.trace_id)
             result = self._rollout(mode)
             sp.set_attribute("ok", result.ok)
             sp.set_attribute("groups", len(result.groups))
             if not result.ok:
                 sp.status = obs_trace.STATUS_ERROR
+            self._fl(
+                flight_mod.EVENT_COMPLETE, ok=result.ok,
+                halted=result.halted_reason,
+                groups=len(result.groups),
+                retired_deleted=result.retired_deleted or None,
+                adopted=result.adopted or None,
+                surged=result.surged or None,
+            )
             return result
 
     def _quarantined_of(self, listing: list[dict]) -> list[str]:
@@ -483,6 +537,9 @@ class RollingReconfigurator:
             log.warning(
                 "skipping quarantined node(s): %s", quarantined
             )
+            self._fl(
+                flight_mod.EVENT_QUARANTINE_SKIP, nodes=list(quarantined)
+            )
             listing = [
                 n for n in listing
                 if n["metadata"]["name"] not in quarantined
@@ -501,6 +558,12 @@ class RollingReconfigurator:
                 "%d/%d group(s) already recorded done",
                 record.mode, record.generation, self.generation,
                 len(record.done), len(record.groups),
+            )
+            self._fl(
+                flight_mod.EVENT_RESUME, mode=record.mode,
+                prior_generation=record.generation,
+                done_groups=len(record.done),
+                total_groups=len(record.groups),
             )
             # A HALTED record being resumed is live again: every mid-
             # flight checkpoint must say in-progress, or a crash of THIS
@@ -533,6 +596,10 @@ class RollingReconfigurator:
             # node was ever reconfigured.
             if record is not None and record.groups:
                 self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+            self._fl(
+                flight_mod.EVENT_HALT, reason="failure-budget-exceeded",
+                spend=self._spend(record, quarantined), at="pre-plan",
+            )
             return RolloutResult(
                 mode=mode, ok=False, groups=[],
                 skipped_quarantined=quarantined,
@@ -560,6 +627,13 @@ class RollingReconfigurator:
             mode, len(groups),
             sum(len(n) for _, n in groups), self.max_unavailable,
         )
+        self._fl(
+            flight_mod.EVENT_PLAN, mode=mode, groups=len(groups),
+            nodes=sum(len(n) for _, n in groups),
+            max_unavailable=self.max_unavailable,
+            wave_shards=self.wave_shards, surge=self.surge or None,
+            resumed=resumed or None,
+        )
         results: list[GroupResult] = []
         window_seconds: list[float] = []
         # Idempotent resume (an interrupted rollout re-run must not re-bounce
@@ -586,6 +660,14 @@ class RollingReconfigurator:
                     group=gid, nodes=names, ok=True, seconds=0.0,
                     states={n: mode for n in names}, skipped=True,
                 ))
+                # The terminal per-node events were written before the
+                # record checkpointed this group done (events precede
+                # every checkpoint), so the timeline already has them:
+                # only the skip decision itself is new information.
+                self._fl(
+                    flight_mod.EVENT_GROUP_SKIPPED, group=gid,
+                    nodes=list(names), why="record-done",
+                )
                 continue
             if done is not None:
                 # A group the dead orchestrator saw FAIL: re-drive it (the
@@ -598,10 +680,22 @@ class RollingReconfigurator:
                 for n in names
             ):
                 log.info("group %s already at %s; skipping", gid, mode)
-                results.append(GroupResult(
+                gres = GroupResult(
                     group=gid, nodes=names, ok=True, seconds=0.0,
                     states={n: mode for n in names}, skipped=True,
-                ))
+                )
+                results.append(gres)
+                self._fl(
+                    flight_mod.EVENT_GROUP_SKIPPED, group=gid,
+                    nodes=list(names), why="already-at-target",
+                )
+                # skipped=True: these nodes were VERIFIED at target, not
+                # driven — a successor re-observing a group whose
+                # terminal events outran the dead orchestrator's last
+                # checkpoint merges in the reconstruction instead of
+                # reading as a double bounce.
+                self._fl_group(gres, mode, wave=None, window=None,
+                               skipped=True)
                 if record is not None:
                     record.note_group(
                         gid, ok=True, states={n: mode for n in names},
@@ -660,6 +754,10 @@ class RollingReconfigurator:
                     "surge group(s) failed; halting before the rolling "
                     "waves (%d group(s) not attempted)", len(groups),
                 )
+                self._fl(
+                    flight_mod.EVENT_HALT, reason="surge-failed",
+                    not_attempted=len(groups),
+                )
                 self._checkpoint(record, status=rollout_state.RECORD_HALTED)
                 return RolloutResult(
                     mode=mode, ok=False, groups=results,
@@ -702,6 +800,12 @@ class RollingReconfigurator:
                     self._checkpoint(
                         record, status=rollout_state.RECORD_HALTED
                     )
+                    self._fl(
+                        flight_mod.EVENT_HALT,
+                        reason="failure-budget-exceeded",
+                        spend=self._spend(record, quarantined, fresh),
+                        at="window-boundary",
+                    )
                     return RolloutResult(
                         mode=mode, ok=False, groups=results,
                         window_seconds=window_seconds,
@@ -713,11 +817,16 @@ class RollingReconfigurator:
                         max_unavailable_observed=self._max_inflight_observed,
                     )
             window = groups[i : i + self.max_unavailable]
+            window_id = i // self.max_unavailable
             self._crash_point("window-start")
             started = time.monotonic()
             self._note_window_inflight(len(window))
+            self._fl(
+                flight_mod.EVENT_WINDOW_OPEN, wave=0, window=window_id,
+                groups=[gid for gid, _ in window],
+            )
             for gid, names in window:
-                self._set_desired(names, mode)
+                self._set_desired(names, mode, wave=0, window=window_id)
             self._crash_point("mid-window")
             # Always await the FULL window even after a failure: every group
             # in it already received its desired label and is transitioning —
@@ -727,6 +836,7 @@ class RollingReconfigurator:
             for gid, names in window:
                 gres = self._await_group(gid, names, mode, started)
                 results.append(gres)
+                self._fl_group(gres, mode, wave=0, window=window_id)
                 if record is not None:
                     record.note_group(gid, gres.ok, gres.states, gres.seconds)
                     if not gres.ok:
@@ -734,15 +844,25 @@ class RollingReconfigurator:
                         # autoscaler reclaiming a VM is not a CC failure,
                         # and spending budget on it would let routine
                         # scale-downs halt a healthy rollout.
-                        record.charge_budget(
+                        charged = [
                             n for n, s in gres.states.items()
                             if s not in (mode, STATE_NODE_DELETED)
+                        ]
+                        record.charge_budget(charged)
+                        self._fl(
+                            flight_mod.EVENT_BUDGET_CHARGE, nodes=charged,
+                            group=gid, wave=0, window=window_id,
                         )
                 if not gres.ok:
                     ok = False
                     window_failed.append(gid)
             self._note_window_inflight(-len(window))
             window_seconds.append(time.monotonic() - started)
+            self._fl(
+                flight_mod.EVENT_WINDOW_CLOSE, wave=0, window=window_id,
+                seconds=round(time.monotonic() - started, 3),
+                failed=window_failed or None,
+            )
             self._crash_point("awaited")
             self._checkpoint(record)
             self._crash_point("window-boundary")
@@ -750,6 +870,11 @@ class RollingReconfigurator:
                 log.error(
                     "group(s) %s failed; halting rollout (%d group(s) not "
                     "attempted)", window_failed, len(groups) - i - len(window),
+                )
+                self._fl(
+                    flight_mod.EVENT_HALT, reason="group-failed",
+                    failed=window_failed, wave=0, window=window_id,
+                    not_attempted=len(groups) - i - len(window),
                 )
                 if self.rollback_on_failure and record is not None:
                     # A rolled-back group is NOT done: its desired label
@@ -855,16 +980,25 @@ class RollingReconfigurator:
             "surge: flipping %d spare node(s) in %d group(s) first, "
             "behind the %s taint", len(surged), len(spares), SURGE_TAINT_KEY,
         )
+        self._fl(
+            flight_mod.EVENT_SURGE_PICK, nodes=surged,
+            groups=[gid for gid, _ in spares],
+        )
         self._crash_point("window-start")
         started = time.monotonic()
+        self._fl(
+            flight_mod.EVENT_WINDOW_OPEN, wave="surge", window=0,
+            groups=[gid for gid, _ in spares],
+        )
         for _, names in spares:
             self._taint_surge(names, add=True)
-            self._set_desired(names, mode)
+            self._set_desired(names, mode, wave="surge", window=0)
         self._crash_point("mid-window")
         ok = True
         for gid, names in spares:
             gres = self._await_group(gid, names, mode, started)
             results.append(gres)
+            self._fl_group(gres, mode, wave="surge", window=0)
             with self._record_lock:
                 if record is not None:
                     record.note_group(gid, gres.ok, gres.states, gres.seconds)
@@ -883,6 +1017,11 @@ class RollingReconfigurator:
             else:
                 ok = False
         window_seconds.append(time.monotonic() - started)
+        self._fl(
+            flight_mod.EVENT_WINDOW_CLOSE, wave="surge", window=0,
+            seconds=round(time.monotonic() - started, 3),
+            failed=None if ok else [g for g, _ in spares],
+        )
         self._crash_point("awaited")
         self._checkpoint(record)
         self._crash_point("window-boundary")
@@ -949,6 +1088,11 @@ class RollingReconfigurator:
                     spend = self._spend(record, quarantined)
                 if self._budget_exceeded(spend):
                     self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+                    self._fl(
+                        flight_mod.EVENT_HALT,
+                        reason="failure-budget-exceeded",
+                        spend=spend, at="adoption-scan",
+                    )
                     return sorted(adopted), False, "failure-budget-exceeded"
             fresh = [
                 n for n in listing
@@ -966,6 +1110,10 @@ class RollingReconfigurator:
                 "scale-up) into a trailing wave: %s",
                 len(names_flat), names_flat,
             )
+            for name in names_flat:
+                self._fl(
+                    flight_mod.EVENT_NODE_ADOPTED, node=name, wave="adopt",
+                )
             self.metrics.record_node_adoption(len(names_flat))
             with self._record_lock:
                 if record is not None:
@@ -984,21 +1132,34 @@ class RollingReconfigurator:
                         self._checkpoint(
                             record, status=rollout_state.RECORD_HALTED
                         )
+                        self._fl(
+                            flight_mod.EVENT_HALT,
+                            reason="failure-budget-exceeded",
+                            spend=spend, at="adoption-window",
+                        )
                         return (
                             sorted(adopted), False,
                             "failure-budget-exceeded",
                         )
                 window = groups[i : i + self.max_unavailable]
+                window_id = i // self.max_unavailable
                 self._crash_point("window-start")
                 started = time.monotonic()
                 self._note_window_inflight(len(window))
+                self._fl(
+                    flight_mod.EVENT_WINDOW_OPEN, wave="adopt",
+                    window=window_id, groups=[gid for gid, _ in window],
+                )
                 for gid, names in window:
-                    self._set_desired(names, mode)
+                    self._set_desired(
+                        names, mode, wave="adopt", window=window_id
+                    )
                 self._crash_point("mid-window")
                 window_failed = []
                 for gid, names in window:
                     gres = self._await_group(gid, names, mode, started)
                     results.append(gres)
+                    self._fl_group(gres, mode, wave="adopt", window=window_id)
                     with self._record_lock:
                         if record is not None:
                             record.note_group(
@@ -1014,6 +1175,12 @@ class RollingReconfigurator:
                         window_failed.append(gid)
                 self._note_window_inflight(-len(window))
                 window_seconds.append(time.monotonic() - started)
+                self._fl(
+                    flight_mod.EVENT_WINDOW_CLOSE, wave="adopt",
+                    window=window_id,
+                    seconds=round(time.monotonic() - started, 3),
+                    failed=window_failed or None,
+                )
                 self._crash_point("awaited")
                 self._checkpoint(record)
                 self._crash_point("window-boundary")
@@ -1023,6 +1190,11 @@ class RollingReconfigurator:
                         log.error(
                             "adopted group(s) %s failed; stopping the "
                             "trailing adoption wave", window_failed,
+                        )
+                        self._fl(
+                            flight_mod.EVENT_HALT, reason="group-failed",
+                            failed=window_failed, wave="adopt",
+                            window=window_id,
                         )
                         return sorted(adopted), ok, None
 
@@ -1073,8 +1245,16 @@ class RollingReconfigurator:
         threads = []
         for wid, wave in enumerate(waves):
             t = threading.Thread(
-                target=self._drive_wave_guarded,
-                args=(wid, wave, mode, record, shared),
+                # in_current_context: thread targets do not inherit
+                # contextvars, and without the snapshot every span a
+                # wave opens (rollout.group and the agents stitched
+                # under it) would mint its own root trace instead of
+                # nesting under the rollout root — /tracez could never
+                # render the sharded rollout as one tree.
+                target=obs_trace.in_current_context(
+                    self._drive_wave_guarded, wid, wave, mode, record,
+                    shared,
+                ),
                 name=f"rollout-wave-{wid}",
                 daemon=True,
             )
@@ -1153,19 +1333,30 @@ class RollingReconfigurator:
                     self._checkpoint(
                         record, status=rollout_state.RECORD_HALTED
                     )
+                    self._fl(
+                        flight_mod.EVENT_HALT,
+                        reason="failure-budget-exceeded",
+                        spend=spend, wave=wid, at="wave-boundary",
+                    )
                     return
             window = wave[i : i + self.max_unavailable]
+            window_id = i // self.max_unavailable
             self._crash_point("window-start")
             started = time.monotonic()
             self._note_window_inflight(len(window))
+            self._fl(
+                flight_mod.EVENT_WINDOW_OPEN, wave=wid, window=window_id,
+                groups=[gid for gid, _ in window],
+            )
             for gid, names in window:
-                self._set_desired(names, mode)
+                self._set_desired(names, mode, wave=wid, window=window_id)
             self._crash_point("mid-window")
             window_failed = []
             for gid, names in window:
                 gres = self._await_group(gid, names, mode, started)
                 with shared["lock"]:
                     shared["results"].append(gres)
+                self._fl_group(gres, mode, wave=wid, window=window_id)
                 with self._record_lock:
                     if record is not None:
                         record.note_group(
@@ -1183,6 +1374,11 @@ class RollingReconfigurator:
             self._note_window_inflight(-len(window))
             with shared["lock"]:
                 shared["window_seconds"].append(time.monotonic() - started)
+            self._fl(
+                flight_mod.EVENT_WINDOW_CLOSE, wave=wid, window=window_id,
+                seconds=round(time.monotonic() - started, 3),
+                failed=window_failed or None,
+            )
             self._crash_point("awaited")
             self._checkpoint(record)
             self._crash_point("window-boundary")
@@ -1194,6 +1390,10 @@ class RollingReconfigurator:
                         "wave %d: group(s) %s failed; halting the rollout "
                         "(all waves stop at their next boundary)",
                         wid, window_failed,
+                    )
+                    self._fl(
+                        flight_mod.EVENT_HALT, reason="group-failed",
+                        failed=window_failed, wave=wid, window=window_id,
                     )
                     shared["halt"].set()
                     return
@@ -1247,10 +1447,22 @@ class RollingReconfigurator:
             )
         return rolled_back
 
-    def _set_desired(self, names: tuple[str, ...], mode: str) -> None:
+    def _set_desired(
+        self, names: tuple[str, ...], mode: str,
+        wave: int | str | None = None, window: int | str | None = None,
+    ) -> None:
+        # Cross-process trace stitching: the current span (the rollout
+        # root, or a wave thread's context snapshot of it) rides in the
+        # SAME patch as the desired mode, so the node agent's reconcile
+        # adopts it as its root span's remote parent — one causal tree
+        # from `ctl rollout` down through each node's drain/reset/smoke.
+        sp = obs_trace.current_span()
+        parent = obs_trace.format_parent(sp) if sp is not None else None
         for name in names:
             log.info("setting %s=%s on %s", CC_MODE_LABEL, mode, name)
             patch: dict = {CC_MODE_LABEL: mode}
+            if parent is not None:
+                patch[labels_mod.ROLLOUT_TRACE_LABEL] = parent
             if self.generation is not None:
                 # Every fenced write records which rollout generation
                 # drove it — a successor (or `tpu-cc-ctl status`) can see
@@ -1259,6 +1471,10 @@ class RollingReconfigurator:
                 patch[rollout_state.ROLLOUT_GEN_LABEL] = str(self.generation)
             try:
                 self.api.patch_node_labels(name, patch)
+                self._fl(
+                    flight_mod.EVENT_NODE_DESIRED, node=name, mode=mode,
+                    wave=wave, window=window,
+                )
             except KubeApiError as e:
                 if e.status != 404:
                     raise

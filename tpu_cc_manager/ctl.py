@@ -145,6 +145,58 @@ def build_parser() -> argparse.ArgumentParser:
         help="namespace of the rollout lease (default: "
         "$CC_ROLLOUT_LEASE_NAMESPACE or tpu-operator)",
     )
+    r.add_argument(
+        "--flight-file", default=None,
+        help="rollout flight-recorder JSONL path (default: a selector-"
+        "derived file under $CC_FLIGHT_DIR, so a crash+--resume on the "
+        "same host appends to the interrupted timeline; read it back "
+        "with `rollout-timeline`)",
+    )
+    r.add_argument(
+        "--no-flight", action="store_true",
+        help="do not record the flight-recorder timeline",
+    )
+    r.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve the orchestrator's /metrics + /rolloutz (live "
+        "flight-recorder snapshot) on this port for the rollout's "
+        "duration (0 = off)",
+    )
+
+    tl = sub.add_parser(
+        "rollout-timeline",
+        help="render a rollout's flight-recorder timeline (obs/flight.py)"
+        ": every orchestrator decision in order — plan, waves, windows, "
+        "per-node outcomes, budget charges, halts, resumes — plus the "
+        "exactly-once reconstruction; the answer to 'why did wave 3 "
+        "halt', after the fact and across a crash+--resume",
+    )
+    tl.add_argument(
+        "--selector", default=None,
+        help="pool selector the rollout used (derives the default "
+        "flight-file path, like `rollout` does)",
+    )
+    tl.add_argument(
+        "--file", dest="flight_file", default=None,
+        help="read this flight-recorder JSONL file instead of the "
+        "selector-derived default",
+    )
+    tl.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="print raw events + reconstruction as JSON",
+    )
+    tl.add_argument(
+        "--trace", action="store_true",
+        help="also render the stitched causal trace tree for the "
+        "rollout's trace id, read from a span JSONL file (--spans; the "
+        "CC_TRACE_FILE sink's format) — the offline twin of "
+        "/tracez?trace_id=",
+    )
+    tl.add_argument(
+        "--spans", default=None,
+        help="span JSONL file (CC_TRACE_FILE format) to stitch --trace "
+        "from; agents' and the orchestrator's sinks can be concatenated",
+    )
 
     a = sub.add_parser("attest", help="verify cross-slice attestation coherence")
     a.add_argument("--selector", required=True)
@@ -460,16 +512,47 @@ def cmd_rollout(api, args) -> int:
         if lease is not None:
             lease.release()
         raise ValueError("--mode is required (unless --resume)")
+    # Flight recorder: on by default (an appended JSONL line per
+    # decision costs nothing next to an apiserver round trip), at a
+    # selector-derived path so a --resume finds the interrupted
+    # timeline without flag plumbing.
+    from tpu_cc_manager.obs import flight as flight_mod
+
+    flight = None
+    if not getattr(args, "no_flight", False):
+        flight = flight_mod.FlightRecorder(
+            getattr(args, "flight_file", None)
+            or flight_mod.flight_path_for(args.selector),
+            generation=lease.generation if lease is not None else None,
+        )
+        if lease is not None:
+            flight.record(
+                flight_mod.EVENT_LEASE_ACQUIRED,
+                holder=lease.holder,
+                resumed=resume_record is not None or None,
+            )
+    metrics_server = None
+    metrics_port = getattr(args, "metrics_port", 0)
     if lease is not None:
         lease.start_renewer()
     informer = None
     try:
-        # Inside the try on purpose: a client whose watch connect raises
-        # eagerly (not the lazy "unsupported" probe) must hit the
-        # BaseException lease-release below — failing BEFORE the try
-        # would strand a held lease with the renewer still running, and
-        # every later invocation would be refused with LeaseHeld until
-        # the process dies.
+        # Inside the try on purpose (metrics server AND informer): a
+        # bind failure (port in use) or a client whose watch connect
+        # raises eagerly (not the lazy "unsupported" probe) must hit
+        # the BaseException lease-release below — failing BEFORE the
+        # try would strand a held lease with the renewer still running,
+        # and every later invocation would be refused with LeaseHeld
+        # until the process dies.
+        if metrics_port:
+            from tpu_cc_manager.ccmanager.metrics_server import (
+                start_metrics_server,
+            )
+            from tpu_cc_manager.utils import metrics as metrics_mod
+
+            metrics_server = start_metrics_server(
+                metrics_port, metrics_mod.REGISTRY, flight=flight,
+            )
         if not getattr(args, "no_informer", False):
             from tpu_cc_manager.ccmanager.informer import NodeInformer
             from tpu_cc_manager.kubeclient.api import (
@@ -500,6 +583,7 @@ def cmd_rollout(api, args) -> int:
             wave_shards=wave_shards,
             surge=surge,
             adopt_new_nodes=not getattr(args, "no_adopt", False),
+            flight=flight,
         )
         result = roller.rollout(mode)
     except rollout_state.RolloutFenced as e:
@@ -507,6 +591,8 @@ def cmd_rollout(api, args) -> int:
             "rollout fenced out mid-flight (%s); a successor owns the pool "
             "now — this process wrote nothing after losing the lease", e,
         )
+        if flight is not None:
+            flight.record(flight_mod.EVENT_FENCED, error=str(e))
         return 1
     except BaseException:
         # Any unexpected failure (usage error, apiserver crash mid-plan,
@@ -521,6 +607,8 @@ def cmd_rollout(api, args) -> int:
             informer.stop()
         if lease is not None:
             lease.stop_renewer()
+        if metrics_server is not None:
+            metrics_server.shutdown()
     if lease is not None:
         # A finished rollout clears its record (nothing to resume); a
         # failed/halted one keeps it so `--resume` can pick up after the
@@ -529,6 +617,95 @@ def cmd_rollout(api, args) -> int:
         lease.release(clear_record=result.ok)
     print(json.dumps(result.summary()))
     return 0 if result.ok else 1
+
+
+def cmd_rollout_timeline(api, args) -> int:
+    """Render a rollout flight-recorder timeline (obs/flight.py): the
+    raw decision stream in order plus the exactly-once reconstruction —
+    and, with ``--trace``, the stitched orchestrator→agents span tree
+    read from a CC_TRACE_FILE-format span JSONL."""
+    from tpu_cc_manager.obs import flight as flight_mod
+
+    path = getattr(args, "flight_file", None)
+    if not path:
+        if not getattr(args, "selector", None):
+            raise ValueError(
+                "rollout-timeline: --selector (to derive the default "
+                "flight-file path) or --file is required"
+            )
+        path = flight_mod.flight_path_for(args.selector)
+    events, torn = flight_mod.read_events(path)
+    if not events:
+        log.error("no flight-recorder events in %s", path)
+        return 1
+    if getattr(args, "as_json", False):
+        print(json.dumps({
+            "path": path,
+            "torn_lines": torn,
+            "events": events,
+            "reconstruction": flight_mod.reconstruct(events),
+        }, indent=1))
+        return 0
+    print(f"flight recorder: {path} ({len(events)} event(s))")
+    print(flight_mod.render_timeline(events, torn=torn))
+    if getattr(args, "trace", False):
+        trace_ids = sorted({
+            e["trace_id"] for e in events if e.get("trace_id")
+        })
+        print(f"\nrollout trace id(s): {', '.join(trace_ids) or '-'}")
+        spans_path = getattr(args, "spans", None)
+        if not spans_path:
+            print(
+                "(pass --spans <CC_TRACE_FILE jsonl> to render the "
+                "stitched orchestrator->agent span tree offline, or "
+                "query /tracez?trace_id=<id> on a live agent)"
+            )
+            return 0
+        _print_stitched_trace(spans_path, trace_ids)
+    return 0
+
+
+def _print_stitched_trace(spans_path: str, trace_ids: list[str]) -> None:
+    """Nest every span of the rollout's trace(s) from a span JSONL file
+    (the CC_TRACE_FILE sink format; agent + orchestrator files can be
+    concatenated) and print the tree — `ctl rollout` down through each
+    node's drain/reset/smoke."""
+    from tpu_cc_manager.obs.journal import Journal
+
+    spans: list[dict] = []
+    with open(spans_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                s = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(s, dict) and s.get("trace_id") in trace_ids:
+                spans.append(s)
+    if not spans:
+        print(f"no spans for trace(s) {trace_ids} in {spans_path}")
+        return
+    journal = Journal(trace_file="")
+
+    def render(node: dict, depth: int) -> None:
+        attrs = node.get("attributes") or {}
+        where = attrs.get("node") or attrs.get("group") or ""
+        print(
+            "  " * depth
+            + f"{node['name']} ({node.get('seconds', 0):.3f}s, "
+            f"{node.get('status')})" + (f" [{where}]" if where else "")
+        )
+        for child in sorted(
+            node.get("children", []), key=lambda c: c.get("start_ts") or 0
+        ):
+            render(child, depth + 1)
+
+    for root in sorted(
+        journal.span_tree(spans), key=lambda r: r.get("start_ts") or 0
+    ):
+        render(root, 0)
 
 
 def cmd_quarantine(api, args) -> int:
@@ -651,6 +828,7 @@ def _rollout_status_line(api, namespace: str | None = None) -> str | None:
 
 
 def cmd_status(api, args) -> int:
+    from tpu_cc_manager import labels as labels_mod
     from tpu_cc_manager.ccmanager import remediation as remediation_mod
     from tpu_cc_manager.ccmanager.rollout_state import ROLLOUT_GEN_LABEL
     from tpu_cc_manager.ccmanager.slicecoord import (
@@ -669,10 +847,16 @@ def cmd_status(api, args) -> int:
         print(rollout_line)
     rows = [
         f"{'NODE':<24} {'SLICE':<20} {'DESIRED':<10} {'STATE':<10} "
-        f"{'READY':<6} NOTE"
+        f"{'READY':<6} {'TRACE':<17} NOTE"
     ]
     for node in api.list_nodes(args.selector):
         labels = node_labels(node)
+        # The last reconcile's trace id, republished by the agent into
+        # the node annotation — the jump-off point from status to
+        # /tracez?trace_id=<TRACE> on that node's agent.
+        trace = node_annotations(node).get(
+            labels_mod.TRACE_ID_ANNOTATION
+        ) or "-"
         # Transient barrier markers / failure reason / remediation ladder:
         # the things an operator staring at a stuck rollout needs first.
         notes = []
@@ -709,6 +893,7 @@ def cmd_status(api, args) -> int:
             f"{labels.get(CC_MODE_LABEL, '-'):<10} "
             f"{labels.get(CC_MODE_STATE_LABEL, '-'):<10} "
             f"{labels.get(CC_READY_STATE_LABEL, '-'):<6} "
+            f"{trace:<17} "
             f"{' '.join(notes) or '-'}"
         )
     print("\n".join(rows))
@@ -891,16 +1076,24 @@ def cmd_drain_subscribe(api, args) -> int:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(debug=args.debug)
-    try:
-        api = RestKube(ClusterConfig.load(args.kubeconfig))
-    except Exception as e:  # noqa: BLE001 - any config failure is fatal here
-        log.error("could not configure kubernetes client: %s", e)
-        return 1
+    api = None
+    if args.command != "rollout-timeline":
+        # rollout-timeline reads only the local flight file (and an
+        # optional span JSONL): no apiserver, no kubeconfig — and no
+        # client-construction INFO line on stdout, which would corrupt
+        # its --json output (logging goes to stdout by reference
+        # parity).
+        try:
+            api = RestKube(ClusterConfig.load(args.kubeconfig))
+        except Exception as e:  # noqa: BLE001 - any config failure is fatal here
+            log.error("could not configure kubernetes client: %s", e)
+            return 1
     from tpu_cc_manager.kubeclient.api import KubeApiError
 
     try:
         return {
             "rollout": cmd_rollout,
+            "rollout-timeline": cmd_rollout_timeline,
             "attest": cmd_attest,
             "status": cmd_status,
             "quarantine": cmd_quarantine,

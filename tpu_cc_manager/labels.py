@@ -131,8 +131,19 @@ DRAIN_REQUESTED_LABEL = "cloud.google.com/tpu-cc.drain"
 DRAIN_DEADLINE_LABEL = "cloud.google.com/tpu-cc.drain.deadline-s"
 DRAIN_SUBSCRIBER_PREFIX = "drain-subscriber.tpu-cc.gke.io/"
 
-# Event → span-tree correlation (ccmanager/manager.py _emit_node_event).
+# Event → span-tree correlation (ccmanager/manager.py _emit_node_event),
+# and the node annotation the agent republishes its LAST reconcile's
+# trace id into (ctl status surfaces it as the TRACE column, so an
+# operator can jump from status straight to /tracez?trace_id=...).
 TRACE_ID_ANNOTATION = "tpu-cc.gke.io/trace-id"
+
+# Cross-process trace stitching (ccmanager/rolling.py → manager.py): the
+# orchestrator stamps "<trace_id>.<span_id>" of its rollout trace into
+# every desired-mode patch; the node agent adopts it as the REMOTE
+# parent of its reconcile root span, so /tracez renders one causal tree
+# from `ctl rollout` down through each node's drain/reset/smoke
+# (obs/trace.py format_parent/parse_parent).
+ROLLOUT_TRACE_LABEL = "cloud.google.com/tpu-cc.rollout-trace"
 
 # Pause protocol (reference gpu_operator_eviction.py:43-95):
 #   'true'        -> PAUSED_VALUE

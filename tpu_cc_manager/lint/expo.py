@@ -274,6 +274,21 @@ def _seeded_registry_text() -> str:
     registry.record_smoke_fastpath("hit")
     registry.record_smoke_fastpath("miss")
     registry.record_smoke_fastpath('odd"outcome\nhere')
+    # Live serving telemetry (serve/ + obs/slo.py), awkward node name
+    # included.
+    registry.observe_serve_request("serve-node-0", 0.042)
+    registry.observe_serve_request("serve-node-0", 0.180)
+    registry.observe_serve_request('odd"node\nname', 1.5)
+    registry.set_serve_queue_depth("serve-node-0", 7)
+    registry.set_serve_inflight("serve-node-0", 4)
+    registry.record_serve_outcome("serve-node-0", "completed", 2)
+    registry.record_serve_outcome("serve-node-0", "bounced")
+    registry.record_serve_outcome("serve-node-0", "requeued")
+    registry.record_serve_outcome('odd"node', 'odd"outcome')
+    registry.record_serve_lost(1)
+    registry.set_serve_goodput(812.5)
+    registry.set_serve_slo(30.0, 0.059, 0.2)
+    registry.set_serve_slo(300.0, None, 0.0)  # empty window: no p99
     return registry.render_prometheus()
 
 

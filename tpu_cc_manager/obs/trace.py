@@ -16,8 +16,19 @@ plane needs exactly four things the stdlib gives for free:
 Context propagation: ``contextvars`` flow through generators and async
 code, but NOT into ``threading.Thread`` targets. Code that fans work out
 to threads under one trace wraps the target with :func:`in_current_context`
-(the rolling orchestrator does not need it — each node agent runs its own
-reconcile trace — but tests and future fan-out do).
+(the rolling orchestrator's wave threads do exactly this, so sharded-wave
+spans nest under the rollout root).
+
+Cross-PROCESS propagation: a root span may be opened with an explicit
+``parent=(trace_id, span_id)`` — the remote-parent contract the rolling
+orchestrator uses to stitch its rollout trace to each node agent's
+reconcile trace. The orchestrator stamps
+:func:`format_parent`'s ``<trace>.<span>`` value into the desired-mode
+patch (labels.ROLLOUT_TRACE_LABEL, dot-separated because label values
+cannot carry ``:``), the agent parses it back with :func:`parse_parent`
+and opens its reconcile root under it, and ``/tracez?trace_id=`` then
+renders ONE causal tree from ``ctl rollout`` down through each node's
+drain/reset/smoke.
 """
 
 from __future__ import annotations
@@ -109,27 +120,51 @@ def current_span_id() -> str | None:
     return span.span_id if span is not None else None
 
 
+def format_parent(s: Span) -> str:
+    """``<trace_id>.<span_id>``: the label-safe wire form of a span's
+    identity (dot, not colon — k8s label values reject ``:``)."""
+    return f"{s.trace_id}.{s.span_id}"
+
+
+def parse_parent(value: str | None) -> tuple[str, str] | None:
+    """Parse a :func:`format_parent` value back to (trace_id, span_id);
+    None for absent/garbled input — a stitching hint must never fail a
+    reconcile."""
+    if not value:
+        return None
+    parts = value.split(".")
+    if len(parts) != 2 or not all(parts):
+        return None
+    return parts[0], parts[1]
+
+
 @contextlib.contextmanager
 def span(
     name: str,
     journal: "journal_mod.Journal | None" = None,
     root: bool = False,
+    parent: tuple[str, str] | None = None,
     **attributes,
 ):
     """Open a span under the current one (or a new root trace).
 
     - nested under :func:`current_span` unless ``root=True``;
+    - ``parent=(trace_id, span_id)`` adopts a REMOTE parent (only
+      meaningful with ``root=True``): the span joins that trace instead
+      of minting its own — cross-process stitching;
     - ``journal`` defaults to the parent's journal, then the process-wide
       :data:`~tpu_cc_manager.obs.journal.JOURNAL`;
     - an escaping exception marks the span ``error`` (message recorded) and
       propagates.
     """
-    parent = None if root else _CURRENT.get()
-    if parent is not None:
-        trace_id = parent.trace_id
-        parent_id = parent.span_id
+    ambient = None if root else _CURRENT.get()
+    if ambient is not None:
+        trace_id = ambient.trace_id
+        parent_id = ambient.span_id
         if journal is None:
-            journal = parent.journal
+            journal = ambient.journal
+    elif parent is not None:
+        trace_id, parent_id = parent
     else:
         trace_id = new_id()
         parent_id = None
@@ -162,11 +197,16 @@ def span(
 
 
 def root_span(
-    name: str, journal: "journal_mod.Journal | None" = None, **attributes
+    name: str,
+    journal: "journal_mod.Journal | None" = None,
+    parent: tuple[str, str] | None = None,
+    **attributes,
 ):
     """A new root trace, ignoring any ambient span — one reconcile, one
-    rollout, one pool verification each get their own trace id."""
-    return span(name, journal=journal, root=True, **attributes)
+    rollout, one pool verification each get their own trace id. With
+    ``parent`` the root joins a REMOTE trace instead (the agent adopting
+    the orchestrator's rollout trace)."""
+    return span(name, journal=journal, root=True, parent=parent, **attributes)
 
 
 def in_current_context(fn: Callable, *args, **kwargs) -> Callable[[], object]:

@@ -19,6 +19,15 @@ user-visible disruption. The pieces here close that gap:
   driver together, runs a real rolling CC flip mid-traffic, and reports
   p50/p99 latency + error rate during the rollout vs steady state, plus
   requests lost per node bounced (target: zero).
+
+The layer is live-observable, not just report-observable: servers and
+driver export the ``tpu_cc_serve_*`` metric families through one shared
+``utils/metrics.py`` registry (latency histogram, queue depth,
+in-flight, outcome/loss counters, goodput) and feed an
+``obs/slo.py`` :class:`~tpu_cc_manager.obs.slo.SloEvaluator` whose
+windowed p99 / error-budget burn readout is both exported as gauges and
+pollable in-process — the contract a latency-gated rollout reads at
+wave boundaries (ROADMAP item 1).
 """
 
 from tpu_cc_manager.serve.driver import TrafficDriver

@@ -28,8 +28,10 @@ import logging
 import threading
 import time
 
+from tpu_cc_manager.obs import slo as slo_mod
 from tpu_cc_manager.serve.server import NodeServer, Request
 from tpu_cc_manager.utils import locks as locks_mod
+from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
@@ -54,8 +56,17 @@ class TrafficDriver:
         ladder_interval_s: float = 0.25,
         submit_interval_s: float = 0.01,
         pipe_depth: int = 2,
+        metrics: metrics_mod.MetricsRegistry | None = None,
+        slo: slo_mod.SloEvaluator | None = None,
     ) -> None:
         self.servers = servers
+        # Live serving telemetry: completions feed the per-node latency
+        # histogram + outcome counters (tpu_cc_serve_*) and the SLO
+        # evaluator; the ladder tick exports the windowed p99 /
+        # burn-rate / goodput gauges, so a scrape DURING a flip reads
+        # the live SLO — the contract the latency-gated rollout polls.
+        self.metrics = metrics
+        self.slo = slo
         self.request_tokens = request_tokens
         self.min_batch = min_batch
         self.max_batch = max_batch
@@ -83,6 +94,13 @@ class TrafficDriver:
         with self._lock:
             self._completed.append(req)
             self._outstanding[node] = max(0, self._outstanding[node] - 1)
+        if req.completed_at is not None:
+            lat = max(0.0, req.completed_at - req.submitted_at)
+            if self.metrics is not None:
+                self.metrics.observe_serve_request(node, lat)
+                self.metrics.record_serve_outcome(node, "completed")
+            if self.slo is not None:
+                self.slo.observe(lat, ok=True)
 
     def on_requeue(self, node: str, reqs: list[Request]) -> None:
         """Checkpointed requests coming back from a draining server:
@@ -95,6 +113,8 @@ class TrafficDriver:
                 0, self._outstanding[node] - len(reqs)
             )
             self._pending[:0] = reqs
+        if self.metrics is not None:
+            self.metrics.record_serve_outcome(node, "bounced", len(reqs))
 
     # -- driving loop ------------------------------------------------------
 
@@ -153,11 +173,33 @@ class TrafficDriver:
                         0, self._outstanding[name] - len(batch)
                     )
                     self._pending[:0] = batch
+                if self.metrics is not None:
+                    self.metrics.record_serve_outcome(
+                        name, "requeued", len(batch)
+                    )
+
+    def _export_slo(self) -> None:
+        """Export the live windowed SLO readout + goodput gauges —
+        piggybacked on the ladder tick so the gauges stay fresh at the
+        ladder's cadence without a dedicated timer thread."""
+        if self.slo is None:
+            return
+        snap = self.slo.snapshot()
+        for w in snap["windows"]:
+            if self.metrics is not None:
+                self.metrics.set_serve_slo(
+                    w["window_s"], w["p99_s"], w["burn_rate"]
+                )
+        if self.metrics is not None and snap["windows"]:
+            self.metrics.set_serve_goodput(
+                snap["windows"][0]["goodput_rps"]
+            )
 
     def _ladder_step(self) -> None:
         """One conservative rung per interval, per node, off the last
         reported ``hbm_bw_util``: the read is a lower bound, so the
         ceiling sits below 1.0 and the ladder never jumps rungs."""
+        self._export_slo()
         for name, server in self.servers.items():
             util = server.last_hbm_bw_util
             if util is None:
@@ -182,6 +224,18 @@ class TrafficDriver:
                 )
 
         retry_mod.poll_until(settled, grace_s, 0.02)
+        with self._lock:
+            lost = len(self._pending) + sum(self._outstanding.values())
+        if lost:
+            # Each lost request is a counted SLO error AND a counter
+            # bump — the zero-loss contract's violation is visible both
+            # in the burn-rate gauge and in tpu_cc_serve_lost_total.
+            if self.metrics is not None:
+                self.metrics.record_serve_lost(lost)
+            if self.slo is not None:
+                for _ in range(lost):
+                    self.slo.observe_error()
+        self._export_slo()
 
     # -- reporting ---------------------------------------------------------
 
@@ -249,4 +303,5 @@ class TrafficDriver:
             "latency_during_rollout": stats(lat_roll),
             "latency_steady_state": stats(lat_steady),
             "batch_ladder": self.snapshot_batches(),
+            "slo": self.slo.snapshot() if self.slo is not None else None,
         }

@@ -26,6 +26,7 @@ import threading
 import time
 
 from tpu_cc_manager.ccmanager.manager import CCManager
+from tpu_cc_manager.ccmanager.metrics_server import start_metrics_server
 from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
 from tpu_cc_manager.drain.sim import add_drainable_node
 from tpu_cc_manager.kubeclient.api import node_labels
@@ -35,7 +36,9 @@ from tpu_cc_manager.labels import (
     MODE_OFF,
     SLICE_ID_LABEL,
 )
+from tpu_cc_manager.obs.flight import FlightRecorder
 from tpu_cc_manager.obs.journal import Journal
+from tpu_cc_manager.obs.slo import SloEvaluator
 from tpu_cc_manager.serve.driver import TrafficDriver
 from tpu_cc_manager.serve.server import NodeServer, SimulatedExecutor
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend
@@ -77,10 +80,26 @@ class ServeHarness:
         reset_latency_s: float = 0.0,
         boot_latency_s: float = 0.0,
         driver_kwargs: dict | None = None,
+        metrics_port: int | None = None,
+        slo_windows_s: tuple[float, ...] = (5.0, 30.0),
+        slo_error_budget: float = 1e-3,
     ) -> None:
         self.n_nodes = n_nodes
         self.nodes = [f"serve-node-{i}" for i in range(n_nodes)]
         self.tmp_dir = tmp_dir
+        # ONE shared registry + SLO evaluator for the serving layer
+        # (the per-agent registries below stay per-agent on purpose —
+        # each models a separate node process): every server's gauges
+        # and the driver's histogram/SLO land here, and metrics_port
+        # (0 = ephemeral) serves it live at /metrics + /rolloutz —
+        # scrapeable DURING the flip, which is the whole point.
+        self.metrics = MetricsRegistry()
+        self.slo = SloEvaluator(
+            windows_s=slo_windows_s, error_budget=slo_error_budget,
+        )
+        self.metrics_port = metrics_port
+        self.metrics_server = None
+        self.flight = FlightRecorder(os.path.join(tmp_dir, "flight.jsonl"))
         self.executor_factory = (
             executor_factory if executor_factory is not None
             else SimulatedExecutor
@@ -150,10 +169,19 @@ class ServeHarness:
                 on_requeue=lambda n, rs: self.driver.on_requeue(n, rs),
                 executor=self.executor_factory(),
                 checkpoint_full_s=self.checkpoint_full_s,
+                metrics=self.metrics,
             )
             for name in self.nodes
         }
-        self.driver = TrafficDriver(self.servers, **self.driver_kwargs)
+        self.driver = TrafficDriver(
+            self.servers, metrics=self.metrics, slo=self.slo,
+            **self.driver_kwargs,
+        )
+        if self.metrics_port is not None:
+            self.metrics_server = start_metrics_server(
+                self.metrics_port, self.metrics,
+                bind="127.0.0.1", flight=self.flight,
+            )
 
     def _await_settled(self, timeout_s: float = 30.0) -> bool:
         def settled() -> bool:
@@ -176,11 +204,15 @@ class ServeHarness:
         warmup_frac: float = 0.25,
         max_unavailable: int = 1,
         rollout_timeout_s: float = 60.0,
+        rollout_hook=None,
     ) -> dict:
         """Sustain traffic for ``traffic_s`` (plus however long the flip
         needs), run the rolling CC flip after ``warmup_frac`` of it, and
         report. The steady-state buckets are the pre-flip warmup and the
-        post-flip tail."""
+        post-flip tail. ``rollout_hook`` is passed to the orchestrator's
+        named crash points ("window-start"/"mid-window"/...) — the
+        mid-flip scrape tests hang their assertions there, so "scraped
+        during the flip" is true by construction, not by sleep-timing."""
         assert self.driver is not None, "call build() first"
         for server in self.servers.values():
             server.start()
@@ -192,6 +224,8 @@ class ServeHarness:
                 max_unavailable=max_unavailable,
                 node_timeout_s=rollout_timeout_s,
                 poll_interval_s=0.02,
+                crash_hook=rollout_hook,
+                flight=self.flight,
             )
             t_roll_0 = time.monotonic()
             result = roller.rollout(rollout_mode)
@@ -232,9 +266,19 @@ class ServeHarness:
         }
         return report
 
+    def metrics_address(self) -> str | None:
+        """host:port of the live serve /metrics endpoint (None when
+        metrics_port was not given)."""
+        if self.metrics_server is None:
+            return None
+        host, port = self.metrics_server.server_address[:2]
+        return f"{host}:{port}"
+
     def shutdown(self) -> None:
         for server in self.servers.values():
             server.stop()
         self._agent_stop.set()
         for t in self._agent_threads:
             t.join(timeout=10)
+        if self.metrics_server is not None:
+            self.metrics_server.shutdown()

@@ -36,6 +36,7 @@ import time
 from tpu_cc_manager.drain import handshake
 from tpu_cc_manager.kubeclient.api import KubeApi
 from tpu_cc_manager.utils import locks as locks_mod
+from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
@@ -153,9 +154,16 @@ class NodeServer:
         checkpoint_full_s: float = 0.2,
         checkpoint_budget_fraction: float = DEFAULT_CHECKPOINT_BUDGET_FRACTION,
         restore_s: float = 0.0,
+        metrics: metrics_mod.MetricsRegistry | None = None,
     ) -> None:
         self.api = api
         self.node_name = node_name
+        # Live telemetry (tpu_cc_serve_* families): queue depth and
+        # in-flight gauges plus the bounced counter come from the
+        # server — it is the only component that knows both. None =
+        # unexported (unit tests); the harness passes ONE shared
+        # registry across servers + driver so /metrics shows the pool.
+        self.metrics = metrics
         self.executor = executor if executor is not None else SimulatedExecutor()
         self._on_complete = on_complete  # (node_name, Request, util)
         self._on_requeue = on_requeue    # (node_name, list[Request])
@@ -218,6 +226,18 @@ class NodeServer:
         with self._lock:
             return self._state == STATE_ACCEPTING
 
+    def _export_gauges(self) -> None:
+        """Push the queue-depth / in-flight gauges (tpu_cc_serve_*) —
+        called at every transition that changes either, so a mid-flip
+        scrape sees the live pipeline, not an end-of-run summary."""
+        if self.metrics is None:
+            return
+        with self._lock:
+            depth = sum(len(b) for b in self._queue)
+            inflight = len(self._inflight)
+        self.metrics.set_serve_queue_depth(self.node_name, depth)
+        self.metrics.set_serve_inflight(self.node_name, inflight)
+
     def submit(self, batch: list[Request]) -> bool:
         """Accept one batch for execution; False while draining/drained
         (the driver keeps the requests and routes them elsewhere)."""
@@ -230,6 +250,7 @@ class NodeServer:
                 r.attempts += 1
             self._queue.append(list(batch))
             self._work.set()
+        self._export_gauges()
         return True
 
     # -- serving loop ------------------------------------------------------
@@ -248,6 +269,7 @@ class NodeServer:
                     self._work.clear()
             if batch is None:
                 continue
+            self._export_gauges()
             util = self.executor.execute(batch, self._drain_break, self._stop)
             now = time.monotonic()
             with self._lock:
@@ -264,6 +286,7 @@ class NodeServer:
                     self._parked.extend(partial)
                     partial = []
                 self._idle.set()
+            self._export_gauges()
             self.last_hbm_bw_util = util
             for r in done:
                 r.completed_at = now
@@ -331,6 +354,7 @@ class NodeServer:
         self.last_checkpoint_deadline_s = deadline
         self.last_checkpoint_requeued = len(to_requeue)
         self.drains += 1
+        self._export_gauges()
         if to_requeue:
             self._on_requeue(self.node_name, to_requeue)
         log.info(

@@ -45,6 +45,15 @@ HISTOGRAM_BUCKETS = (
     60.0, 90.0, 120.0, 300.0,
 )
 
+# Serving request-latency buckets (seconds): inference latencies live
+# orders of magnitude below the reconcile phases — ms-scale resolution
+# at the bottom, the checkpoint-bounce tail (~100-500 ms in SERVE_r01)
+# in the middle, and multi-second outliers at the top. Fixed like
+# HISTOGRAM_BUCKETS so fleet-wide aggregation never mixes bucket sets.
+SERVE_HISTOGRAM_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
 
 def _escape_label_value(value: str) -> str:
     """Prometheus text-exposition label-value escaping: backslash, double
@@ -226,6 +235,21 @@ class MetricsRegistry:
         # apiserver (watch-driven informer cache) or O(pool) (re-listing
         # per decision)?
         self._apiserver_request_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        # Live serving telemetry (tpu_cc_serve_* families; serve/ +
+        # obs/slo.py): per-node request-latency histogram (+_sum), queue
+        # depth and in-flight gauges, request outcomes
+        # (completed/bounced/requeued), requests lost (the zero-loss
+        # headline), goodput, and the windowed SLO readout the
+        # latency-gated rollout will poll.
+        self._serve_hist: dict[str, list[int]] = {}  # cclint: guarded-by(_lock)
+        self._serve_hist_sum: dict[str, float] = {}  # cclint: guarded-by(_lock)
+        self._serve_queue_depth: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._serve_inflight: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._serve_outcome_totals: dict[tuple[str, str], int] = {}  # cclint: guarded-by(_lock)
+        self._serve_lost_total = 0  # cclint: guarded-by(_lock)
+        self._serve_goodput: float | None = None  # cclint: guarded-by(_lock)
+        # window_s -> (p99_s or None, burn_rate)
+        self._serve_slo: dict[float, tuple[float | None, float]] = {}  # cclint: guarded-by(_lock)
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -418,6 +442,79 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._apiserver_request_totals)
 
+    # -- live serving telemetry (serve/, obs/slo.py) -----------------------
+
+    def observe_serve_request(self, node: str, seconds: float) -> None:
+        """Fold one completed request's end-to-end latency (bounces
+        included — the latency the user saw) into the per-node serve
+        histogram."""
+        with self._lock:
+            hist = self._serve_hist.setdefault(
+                node, [0] * (len(SERVE_HISTOGRAM_BUCKETS) + 1)
+            )
+            for i, bound in enumerate(SERVE_HISTOGRAM_BUCKETS):
+                if seconds <= bound:
+                    hist[i] += 1
+            hist[-1] += 1  # +Inf
+            self._serve_hist_sum[node] = (
+                self._serve_hist_sum.get(node, 0.0) + max(0.0, seconds)
+            )
+
+    def set_serve_queue_depth(self, node: str, depth: int) -> None:
+        """Requests queued (accepted, not yet executing) on a node."""
+        with self._lock:
+            self._serve_queue_depth[node] = max(0, int(depth))
+
+    def set_serve_inflight(self, node: str, inflight: int) -> None:
+        """Requests in the executing batch on a node."""
+        with self._lock:
+            self._serve_inflight[node] = max(0, int(inflight))
+
+    def record_serve_outcome(
+        self, node: str, outcome: str, count: int = 1
+    ) -> None:
+        """Count request dispositions per node: ``completed`` (finished
+        and returned), ``bounced`` (checkpoint-and-requeued by a drain
+        bracket, progress intact), ``requeued`` (returned unsubmitted
+        after losing the submit race with a drain)."""
+        with self._lock:
+            key = (node, outcome)
+            self._serve_outcome_totals[key] = (
+                self._serve_outcome_totals.get(key, 0) + count
+            )
+
+    def record_serve_lost(self, count: int = 1) -> None:
+        """Count requests that never completed after traffic stopped
+        and the grace drain expired — the zero-loss headline's counter
+        (not per-node: a lost request by definition has no owner)."""
+        with self._lock:
+            self._serve_lost_total += count
+
+    def set_serve_goodput(self, rps: float) -> None:
+        """Completed-requests-per-second over the SLO window."""
+        with self._lock:
+            self._serve_goodput = max(0.0, rps)
+
+    def set_serve_slo(
+        self, window_s: float, p99_s: float | None, burn_rate: float
+    ) -> None:
+        """Record one SLO window's readout (obs/slo.py): rolling p99
+        (None while the window is empty — no sample beats a fake one)
+        and error-budget burn rate."""
+        with self._lock:
+            self._serve_slo[float(window_s)] = (p99_s, burn_rate)
+
+    def serve_totals(self) -> dict:
+        with self._lock:
+            return {
+                "outcomes": dict(self._serve_outcome_totals),
+                "lost": self._serve_lost_total,
+                "queue_depth": dict(self._serve_queue_depth),
+                "inflight": dict(self._serve_inflight),
+                "goodput_rps": self._serve_goodput,
+                "slo": dict(self._serve_slo),
+            }
+
     def rollout_totals(self) -> dict[str, int]:
         with self._lock:
             return {
@@ -509,6 +606,14 @@ class MetricsRegistry:
             fast_drain_seconds = self._fast_drain_seconds
             phase_overlap_seconds = self._phase_overlap_seconds
             smoke_fastpath_totals = dict(self._smoke_fastpath_totals)
+            serve_hist = {k: list(v) for k, v in self._serve_hist.items()}
+            serve_hist_sum = dict(self._serve_hist_sum)
+            serve_queue_depth = dict(self._serve_queue_depth)
+            serve_inflight = dict(self._serve_inflight)
+            serve_outcomes = dict(self._serve_outcome_totals)
+            serve_lost = self._serve_lost_total
+            serve_goodput = self._serve_goodput
+            serve_slo = dict(self._serve_slo)
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -739,6 +844,109 @@ class MetricsRegistry:
                 lines.append(
                     "tpu_cc_apiserver_requests_total%s %d"
                     % (_labels(verb=verb), apiserver_requests[verb])
+                )
+        if serve_hist:
+            lines.append(
+                "# HELP tpu_cc_serve_request_seconds End-to-end serving "
+                "request latency per node (submission to completion, "
+                "checkpoint bounces included — what the user saw)."
+            )
+            lines.append("# TYPE tpu_cc_serve_request_seconds histogram")
+            for node in sorted(serve_hist):
+                hist = serve_hist[node]
+                for i, bound in enumerate(SERVE_HISTOGRAM_BUCKETS):
+                    lines.append(
+                        "tpu_cc_serve_request_seconds_bucket%s %d"
+                        % (_labels(node=node, le=_bucket_le(bound)), hist[i])
+                    )
+                lines.append(
+                    "tpu_cc_serve_request_seconds_bucket%s %d"
+                    % (_labels(node=node, le="+Inf"), hist[-1])
+                )
+                lines.append(
+                    "tpu_cc_serve_request_seconds_sum%s %.6f"
+                    % (_labels(node=node), serve_hist_sum.get(node, 0.0))
+                )
+                lines.append(
+                    "tpu_cc_serve_request_seconds_count%s %d"
+                    % (_labels(node=node), hist[-1])
+                )
+        if serve_queue_depth:
+            lines.append(
+                "# HELP tpu_cc_serve_queue_depth Requests accepted but "
+                "not yet executing on a node."
+            )
+            lines.append("# TYPE tpu_cc_serve_queue_depth gauge")
+            for node in sorted(serve_queue_depth):
+                lines.append(
+                    "tpu_cc_serve_queue_depth%s %d"
+                    % (_labels(node=node), serve_queue_depth[node])
+                )
+        if serve_inflight:
+            lines.append(
+                "# HELP tpu_cc_serve_inflight Requests in the executing "
+                "batch on a node."
+            )
+            lines.append("# TYPE tpu_cc_serve_inflight gauge")
+            for node in sorted(serve_inflight):
+                lines.append(
+                    "tpu_cc_serve_inflight%s %d"
+                    % (_labels(node=node), serve_inflight[node])
+                )
+        if serve_outcomes:
+            lines.append(
+                "# HELP tpu_cc_serve_requests_total Serving request "
+                "dispositions per node: completed, bounced (checkpoint-"
+                "and-requeued by a drain with progress intact), requeued "
+                "(returned unsubmitted after losing the submit race)."
+            )
+            lines.append("# TYPE tpu_cc_serve_requests_total counter")
+            for (node, outcome), count in sorted(serve_outcomes.items()):
+                lines.append(
+                    "tpu_cc_serve_requests_total%s %d"
+                    % (_labels(node=node, outcome=outcome), count)
+                )
+        if serve_lost:
+            lines.append(
+                "# HELP tpu_cc_serve_lost_total Requests that never "
+                "completed after traffic stopped and the grace drain "
+                "expired (the zero-loss serving contract's violation "
+                "counter)."
+            )
+            lines.append("# TYPE tpu_cc_serve_lost_total counter")
+            lines.append("tpu_cc_serve_lost_total %d" % serve_lost)
+        if serve_goodput is not None:
+            lines.append(
+                "# HELP tpu_cc_serve_goodput_rps Completed requests per "
+                "second over the SLO window."
+            )
+            lines.append("# TYPE tpu_cc_serve_goodput_rps gauge")
+            lines.append("tpu_cc_serve_goodput_rps %.3f" % serve_goodput)
+        if serve_slo:
+            lines.append(
+                "# HELP tpu_cc_serve_slo_p99_seconds Rolling-window p99 "
+                "request latency (obs/slo.py; absent while the window "
+                "is empty)."
+            )
+            lines.append("# TYPE tpu_cc_serve_slo_p99_seconds gauge")
+            p99_lines = [
+                "tpu_cc_serve_slo_p99_seconds%s %.6f"
+                % (_labels(window=_bucket_le(w)), p99)
+                for w, (p99, _burn) in sorted(serve_slo.items())
+                if p99 is not None
+            ]
+            lines.extend(p99_lines)
+            lines.append(
+                "# HELP tpu_cc_serve_error_budget_burn Error-budget burn "
+                "rate over the rolling window (error rate / budget; 1.0 "
+                "= spending exactly as provisioned — the halt signal a "
+                "latency-gated rollout polls)."
+            )
+            lines.append("# TYPE tpu_cc_serve_error_budget_burn gauge")
+            for w, (_p99, burn) in sorted(serve_slo.items()):
+                lines.append(
+                    "tpu_cc_serve_error_budget_burn%s %.6f"
+                    % (_labels(window=_bucket_le(w)), burn)
                 )
         # The cumulative per-phase sums/counts are served exclusively as
         # the histogram's _sum/_count series below — separate
