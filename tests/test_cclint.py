@@ -1,9 +1,12 @@
 """cclint (tpu_cc_manager/lint/): each checker catches its seeded
-known-bad fixture, the annotation escapes work, the baseline machinery
-grandfathers and flags staleness, the whole package is clean modulo the
-committed baseline, and the CC_LOCKCHECK runtime wrapper catches a
-deliberately inverted lock pair. Pure-AST on tiny fixture strings plus
-one parse of the package — tier-1 time is marginal, keep this cheap."""
+known-bad fixture — including the v2 flow-aware rules (journal
+typestate on the CFG, fenced-write taint, interprocedural guarded-by,
+crash-point coverage) — the annotation escapes work, the baseline
+machinery grandfathers and hard-errors staleness, the whole package is
+clean modulo the committed baseline, and the CC_LOCKCHECK runtime
+wrapper catches a deliberately inverted lock pair. Pure-AST on tiny
+fixture strings plus one parse of the package — tier-1 time is
+marginal, keep this cheap."""
 
 from __future__ import annotations
 
@@ -14,7 +17,15 @@ import threading
 import pytest
 
 from tpu_cc_manager.lint import base, baseline as baseline_mod
-from tpu_cc_manager.lint import crash, journal, locks, surface, waits
+from tpu_cc_manager.lint import (
+    crash,
+    crashpoints,
+    fenced,
+    journal,
+    locks,
+    surface,
+    waits,
+)
 from tpu_cc_manager.utils import locks as locks_rt
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -27,7 +38,11 @@ def ctx_of(tmp_path, files: dict[str, str]) -> base.LintContext:
         full.parent.mkdir(parents=True, exist_ok=True)
         full.write_text(src)
         if relpath.endswith(".py"):
-            ctx.files.append(base.SourceFile(str(tmp_path), relpath))
+            sf = base.SourceFile(str(tmp_path), relpath)
+            if relpath.startswith("tests/"):
+                ctx.test_files.append(sf)
+            else:
+                ctx.files.append(sf)
     return ctx
 
 
@@ -77,6 +92,92 @@ def test_locks_checker_catches_unguarded_access(tmp_path):
     assert "C.waived" not in by_symbol
 
 
+LOCKS_INTERPROC = '''
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shared = 0  # cclint: guarded-by(_lock)
+
+    def locked_caller(self):
+        with self._lock:
+            self._mutate()
+
+    def lockfree_caller(self):
+        self._mutate()
+
+    def _mutate(self):
+        self._shared += 1
+
+    def _always_locked(self):
+        self._shared -= 1
+
+    def only_locked_caller(self):
+        with self._lock:
+            self._always_locked()
+
+    def helper_needs(self):  # cclint: requires(_lock)
+        return self._shared
+
+    def bad_requires_call(self):
+        return self.helper_needs()
+
+    def good_requires_call(self):
+        with self._lock:
+            return self.helper_needs()
+
+    def thread_target(self):
+        return threading.Thread(target=self.helper_needs)
+'''
+
+
+def test_locks_helper_judged_by_caller_lock_context(tmp_path):
+    """The ISSUE fixture: a helper mutating a guarded field lock-free via
+    two call paths — one locked, one not — is a finding naming the
+    lock-free path, while a helper whose every caller holds the lock is
+    proven clean with no annotation."""
+    findings = locks.check(ctx_of(tmp_path, {"m.py": LOCKS_INTERPROC}))
+    mutate = [f for f in findings if f.symbol == "C._mutate"]
+    assert len(mutate) == 1
+    assert "lockfree_caller" in mutate[0].message
+    assert not any(f.symbol == "C._always_locked" for f in findings)
+
+
+def test_locks_requires_is_verified_at_call_sites(tmp_path):
+    findings = locks.check(ctx_of(tmp_path, {"m.py": LOCKS_INTERPROC}))
+    by = {(f.symbol, f.detail) for f in findings}
+    assert ("C.bad_requires_call", "call-helper_needs") in by
+    assert ("C.thread_target", "ref-helper_needs") in by
+    assert not any(s == "C.good_requires_call" for (s, _) in by)
+
+
+def test_locks_thread_target_escaping_from_init_is_flagged(tmp_path):
+    """__init__ is exempt for field ACCESSES (single-threaded
+    construction) but not for escapes: a thread built in __init__
+    targeting a requires() method runs it holding nothing."""
+    src = '''
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # cclint: guarded-by(_lock)
+        self._seed()  # direct construction-time call: exempt
+        self._t = threading.Thread(target=self._run)
+
+    def _seed(self):  # cclint: requires(_lock)
+        self._n = 1
+
+    def _run(self):  # cclint: requires(_lock)
+        return self._n
+'''
+    findings = locks.check(ctx_of(tmp_path, {"m.py": src}))
+    assert [(f.symbol, f.detail) for f in findings] == [
+        ("C.__init__", "ref-_run")
+    ]
+
+
 # ---------------------------------------------------------------------------
 # checker 2: no ad-hoc waits
 # ---------------------------------------------------------------------------
@@ -108,6 +209,51 @@ def test_waits_checker_allows_retry_and_faults(tmp_path):
         "tpu_cc_manager/faults/kube.py": "import time\ntime.sleep(1)\n",
     }
     assert waits.check(ctx_of(tmp_path, files)) == []
+
+
+def test_waits_checker_covers_tests_with_waiver(tmp_path):
+    files = {
+        "tests/test_x.py": (
+            "import time\n"
+            "def test_flaky():\n"
+            "    time.sleep(0.5)\n"
+            "def test_deliberate():\n"
+            "    # cclint: test-sleep-ok(the real-clock TTL must lapse)\n"
+            "    time.sleep(0.5)\n"
+        ),
+    }
+    findings = waits.check(ctx_of(tmp_path, files))
+    assert [f.symbol for f in findings] == ["test_flaky"]
+    assert "flake factory" in findings[0].message
+
+
+def test_waits_waiver_does_not_bleed_onto_the_next_sleep(tmp_path):
+    """A waiver trailing one sleep's line must not cover the sleep on
+    the following line — the line-above lookup only honors pure comment
+    lines."""
+    files = {
+        "tests/test_x.py": (
+            "import time\n"
+            "def test_two():\n"
+            "    time.sleep(1)  # cclint: test-sleep-ok(the first one)\n"
+            "    time.sleep(2)\n"
+        ),
+    }
+    findings = waits.check(ctx_of(tmp_path, files))
+    assert [f.line for f in findings] == [4]
+
+
+def test_waits_waiver_is_not_honored_in_package_code(tmp_path):
+    files = {
+        "tpu_cc_manager/mod.py": (
+            "import time\n"
+            "def f():\n"
+            "    # cclint: test-sleep-ok(nope)\n"
+            "    time.sleep(0.5)\n"
+        ),
+    }
+    findings = waits.check(ctx_of(tmp_path, files))
+    assert [f.symbol for f in findings] == ["f"]
 
 
 # ---------------------------------------------------------------------------
@@ -163,7 +309,7 @@ def test_crash_checker_requires_reraise(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# checker 4: journal-before-reset
+# checker 4: journal typestate (begin-dominates-reset, close-at-exit)
 # ---------------------------------------------------------------------------
 
 JOURNAL_BAD = '''
@@ -179,7 +325,7 @@ class Rogue:
 '''
 
 
-def test_journal_checker_catches_unallowlisted_reset(tmp_path):
+def test_journal_checker_catches_unjournaled_reset(tmp_path):
     findings = journal.check(
         ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/rogue.py": JOURNAL_BAD})
     )
@@ -192,6 +338,356 @@ def test_journal_checker_skips_device_layer(tmp_path):
         ctx_of(tmp_path, {"tpu_cc_manager/tpudev/impl.py": JOURNAL_BAD})
     )
     assert findings == []
+
+
+JOURNAL_BRANCH_BAD = '''
+class M:
+    def flip(self, fast):
+        if fast:
+            txn = self.intents.begin("transition")
+        else:
+            txn = None  # one branch reaches the reset UNJOURNALED
+        self.backend.reset(self.chips)
+        self.intents.commit(txn)
+'''
+
+
+def test_journal_branch_without_begin_is_a_finding(tmp_path):
+    """The dominance proof, not a call-site grep: a begin on ONE branch
+    does not dominate the reset."""
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": JOURNAL_BRANCH_BAD})
+    )
+    assert [f.detail for f in findings] == ["reset"]
+    assert "dominated" in findings[0].message
+
+
+JOURNAL_INTERPROC_OK = '''
+class M:
+    def _begin(self):
+        return self.intents.begin("transition")
+
+    def outer_pipelined(self):
+        txn = self._begin()
+        self._reset_bracketed(txn=txn)
+
+    def outer_serial(self):
+        self._reset_bracketed()
+
+    def _reset_bracketed(self, txn=None):
+        if txn is None:
+            txn = self._begin()
+        self.backend.reset(self.chips)
+        self.intents.commit(txn)
+'''
+
+
+def test_journal_interprocedural_token_proves_the_bracket(tmp_path):
+    """The real pipeline's shape: the token begun in the caller (or the
+    if-None fallback) proves the callee's reset on BOTH call paths —
+    with no allowlist entry."""
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": JOURNAL_INTERPROC_OK})
+    )
+    assert findings == []
+
+
+def test_journal_interprocedural_unproven_caller_is_a_finding(tmp_path):
+    """Same callee, but one caller hands over a token it never began:
+    the merge degrades and the reset is no longer proven."""
+    bad = JOURNAL_INTERPROC_OK.replace(
+        "        txn = self._begin()\n        self._reset_bracketed(txn=txn)",
+        "        txn = object()\n        self._reset_bracketed(txn=txn)",
+    )
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": bad})
+    )
+    assert [f.detail for f in findings] == ["reset"]
+
+
+JOURNAL_OPEN_EXIT = '''
+class M:
+    def flip(self, ok):
+        txn = self.intents.begin("transition")
+        self.backend.reset(self.chips)
+        if ok:
+            self.intents.commit(txn)
+        return ok
+'''
+
+
+def test_journal_open_intent_at_exit_is_a_finding(tmp_path):
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": JOURNAL_OPEN_EXIT})
+    )
+    assert [f.detail for f in findings] == ["open-txn"]
+    assert "non-crash exit" in findings[0].message
+
+
+def test_journal_open_exit_waiver(tmp_path):
+    waived = JOURNAL_OPEN_EXIT.replace(
+        'txn = self.intents.begin("transition")',
+        'txn = self.intents.begin("transition")  '
+        "# cclint: intent-open-ok(replay owns it)",
+    )
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": waived})
+    )
+    assert findings == []
+
+
+def test_journal_close_in_finally_covers_returns(tmp_path):
+    src = '''
+class M:
+    def flip(self):
+        txn = self.intents.begin("transition")
+        try:
+            self.backend.reset(self.chips)
+            return True
+        finally:
+            self.intents.abort(txn)
+'''
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": src})
+    )
+    assert findings == []
+
+
+def test_journal_drain_token_does_not_prove_hardware(tmp_path):
+    """Replay of a drain intent readmits components — it does not
+    resolve a reset. An open drain-bracket token must not satisfy the
+    dominance proof."""
+    src = '''
+class M:
+    def flip(self):
+        dtxn = self.intents.begin("drain")
+        self.backend.reset(self.chips)
+        self.intents.commit(dtxn)
+'''
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": src})
+    )
+    assert [f.detail for f in findings] == ["reset"]
+
+
+def test_journal_closure_and_module_level_resets_are_flagged(tmp_path):
+    """v1 parity: a hardware call the flow engine cannot place on a CFG
+    (a closure that runs later, module level) degrades to a finding,
+    never to silent cleanliness."""
+    src = '''
+backend.reset([])
+
+class M:
+    def flip(self):
+        txn = self.intents.begin("transition")
+        def later():
+            self.backend.reset(self.chips)
+        self.retry(later)
+        self.intents.commit(txn)
+'''
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/m.py": src})
+    )
+    assert sorted(f.symbol for f in findings) == ["<module>", "M.flip.later"]
+    assert all("cannot prove" in f.message for f in findings)
+
+
+def test_journal_ok_line_waiver(tmp_path):
+    waived = JOURNAL_BAD.replace(
+        "self.backend.reset(self.chips)",
+        "self.backend.reset(self.chips)  # cclint: journal-ok(fixture)",
+    )
+    findings = journal.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/rogue.py": waived})
+    )
+    assert [f.detail for f in findings] == ["restart_runtime"]
+
+
+# ---------------------------------------------------------------------------
+# checker 6: fenced-write taint
+# ---------------------------------------------------------------------------
+
+FENCED_BRACKET = '''
+def cmd_rollout(api, args):
+    lease = RolloutLease(api, holder="me")
+    record = lease.acquire()
+    api.patch_node_labels("n0", {"k": "v"})  # RAW write inside the bracket
+    fenced = FencedKube(api, lease)
+    fenced.patch_node_labels("n0", {"k": "v"})  # fenced: fine
+    lease.release()
+    api.patch_node_labels("n0", {"k": "v"})  # after release: fine
+'''
+
+
+def test_fenced_raw_write_inside_bracket(tmp_path):
+    findings = fenced.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ctl.py": FENCED_BRACKET})
+    )
+    assert len(findings) == 1
+    assert findings[0].line == 5
+    assert "raw-client write" in findings[0].message
+
+
+FENCED_HELPER = '''
+def _retag(client, name):
+    client.patch_node_labels(name, {"k": "v"})
+
+def cmd_rollout(api, args):
+    lease = RolloutLease(api, holder="me")
+    lease.acquire()
+    _retag(api, "n0")  # raw client handed to a writing helper
+    lease.release()
+'''
+
+
+def test_fenced_write_through_helper_inside_bracket(tmp_path):
+    findings = fenced.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ctl.py": FENCED_HELPER})
+    )
+    assert [f.detail for f in findings] == ["_retag"]
+    assert "writes through that parameter" in findings[0].message
+
+
+FENCED_CLASS = '''
+class Roller:
+    def __init__(self, api, lease=None):
+        if lease is not None:
+            api = FencedKube(api, lease)
+        self.api = api
+        self._stash = api
+
+    def good(self):
+        self.api.patch_node_labels("n", {"k": "v"})
+
+    def bad(self):
+        self._stash.patch_node_labels("n", {"k": "v"})
+'''
+
+
+def test_fenced_self_fencing_class_stashed_client(tmp_path):
+    findings = fenced.check(
+        ctx_of(tmp_path, {"tpu_cc_manager/ccmanager/roll.py": FENCED_CLASS})
+    )
+    assert [f.symbol for f in findings] == ["Roller.bad"]
+
+
+def test_fenced_lease_handoff_to_self_fencing_class_is_sanctioned(tmp_path):
+    files = {
+        "tpu_cc_manager/ccmanager/roll.py": FENCED_CLASS,
+        "tpu_cc_manager/ctl.py": '''
+def cmd_rollout(api, args):
+    lease = RolloutLease(api, holder="me")
+    lease.acquire()
+    roller = Roller(api, lease=lease)  # sanctioned: client + lease
+    lease.release()
+''',
+    }
+    findings = fenced.check(ctx_of(tmp_path, files))
+    # Only the fixture class's own stashed-client bug remains.
+    assert [f.symbol for f in findings] == ["Roller.bad"]
+
+
+def test_fenced_closure_write_inside_bracket(tmp_path):
+    """A callback defined between acquire and release most plausibly
+    runs inside the bracket: its raw-client writes are findings too."""
+    src = '''
+def cmd_rollout(api, args):
+    lease = RolloutLease(api, holder="me")
+    lease.acquire()
+    def on_halt():
+        api.patch_node_labels("n0", {"k": "v"})
+    register(on_halt)
+    lease.release()
+'''
+    findings = fenced.check(ctx_of(tmp_path, {"tpu_cc_manager/ctl.py": src}))
+    assert [f.detail for f in findings] == ["patch_node_labels"]
+
+
+# ---------------------------------------------------------------------------
+# checker 7: crash-point coverage
+# ---------------------------------------------------------------------------
+
+CRASHPOINT_PKG = '''
+class Roller:
+    def _crash_point(self, point):
+        pass
+
+    def drive(self):
+        self._crash_point("window-start")
+        self._crash_point("lonely-point")
+'''
+
+CRASHPOINT_TEST = '''
+MY_CRASH_POINTS = ["window-start", "retired-point"]
+'''
+
+
+def test_crashpoints_orphaned_and_stale(tmp_path):
+    files = {
+        "tpu_cc_manager/ccmanager/roll.py": CRASHPOINT_PKG,
+        "tests/test_roll.py": CRASHPOINT_TEST,
+    }
+    findings = crashpoints.check(ctx_of(tmp_path, files))
+    by = {(f.symbol, f.detail) for f in findings}
+    # A package point no test names fails the build...
+    assert ("orphaned-point", "lonely-point") in by
+    # ...and a point only tests still claim is stale.
+    assert ("stale-point", "retired-point") in by
+    # The covered point is clean in both directions.
+    assert not any(d == "window-start" for (_, d) in by)
+
+
+def test_crashpoints_phase_marks_covered_by_constant_name(tmp_path):
+    files = {
+        "tpu_cc_manager/ccmanager/ij.py": 'PHASE_RESET = "reset"\n',
+        "tpu_cc_manager/ccmanager/m.py": (
+            "from tpu_cc_manager.ccmanager import ij\n"
+            "def go(j, txn):\n"
+            "    j.intents.mark(txn, ij.PHASE_RESET)\n"
+        ),
+        "tests/test_m.py": "def test():\n    assert ij.PHASE_RESET\n",
+    }
+    assert crashpoints.check(ctx_of(tmp_path, files)) == []
+
+
+def test_crashpoints_uncovered_phase_mark_is_orphaned(tmp_path):
+    files = {
+        "tpu_cc_manager/ccmanager/ij.py": 'PHASE_RESET = "reset"\n',
+        "tpu_cc_manager/ccmanager/m.py": (
+            "from tpu_cc_manager.ccmanager import ij\n"
+            "def go(j, txn):\n"
+            "    j.intents.mark(txn, ij.PHASE_RESET)\n"
+        ),
+        "tests/test_m.py": "def test():\n    pass\n",
+    }
+    findings = crashpoints.check(ctx_of(tmp_path, files))
+    assert [(f.symbol, f.detail) for f in findings] == [
+        ("orphaned-point", "reset")
+    ]
+
+
+def test_crashpoints_waiver(tmp_path):
+    pkg = CRASHPOINT_PKG.replace(
+        'self._crash_point("lonely-point")',
+        'self._crash_point("lonely-point")  # cclint: crash-point-ok(fixture)',
+    )
+    files = {
+        "tpu_cc_manager/ccmanager/roll.py": pkg,
+        "tests/test_roll.py": 'MY_CRASH_POINTS = ["window-start"]\n',
+    }
+    assert crashpoints.check(ctx_of(tmp_path, files)) == []
+
+
+def test_repo_crash_points_match_the_declared_suite_list():
+    """The package↔suite↔lint triangle on the REAL repo: the canonical
+    rolling.CRASH_POINTS tuple, the literals the kill-at suite declares,
+    and what the coverage checker extracts must all agree."""
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    ctx = base.build_context(REPO)
+    phase_consts = crashpoints._phase_constants(ctx.files)
+    points = crashpoints._package_points(ctx.files, phase_consts)
+    assert set(rolling_mod.CRASH_POINTS) <= set(points)
 
 
 # ---------------------------------------------------------------------------
@@ -258,6 +754,48 @@ def test_baseline_roundtrip(tmp_path):
     assert f.fingerprint in loaded
     data = json.loads((tmp_path / "b.json").read_text())
     assert data["entries"][0]["reason"].startswith("TODO")
+
+
+def test_write_baseline_preserves_reasons_and_prunes_fixed(tmp_path):
+    """Regeneration is not a bare skeleton: entries that survive keep
+    their hand-written reasons, and entries whose violations are gone
+    are pruned."""
+    keep = base.Finding("waits", "a.py", 3, "m", "f")
+    gone = base.Finding("waits", "b.py", 9, "m", "g")
+    path = str(tmp_path / "b.json")
+    baseline_mod.save(str(tmp_path), [keep, gone], path)
+    data = json.loads((tmp_path / "b.json").read_text())
+    for e in data["entries"]:
+        e["reason"] = f"hand-written for {e['fingerprint']}"
+    (tmp_path / "b.json").write_text(json.dumps(data))
+    # The `gone` violation is fixed; regenerate.
+    baseline_mod.save(str(tmp_path), [keep], path)
+    loaded = baseline_mod.load(str(tmp_path), path)
+    assert loaded == {
+        keep.fingerprint: f"hand-written for {keep.fingerprint}"
+    }
+
+
+def test_stale_baseline_entry_is_a_hard_error(tmp_path):
+    """The driver exits non-zero on a stale entry even with zero
+    findings — fixed violations must shed their grandfathering in the
+    same change."""
+    from tpu_cc_manager.lint.__main__ import main
+
+    root = tmp_path / "emptyrepo"
+    root.mkdir()
+    bl = tmp_path / "stale.json"
+    bl.write_text(json.dumps({
+        "entries": [{"fingerprint": "waits:gone.py:f", "reason": "old"}],
+    }))
+    rc = main([
+        "--root", str(root), "--baseline", str(bl), "--skip-expo",
+    ])
+    assert rc == 1
+    bl.write_text(json.dumps({"entries": []}))
+    assert main(
+        ["--root", str(root), "--baseline", str(bl), "--skip-expo"]
+    ) == 0
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,7 @@ from tpu_cc_manager.labels import (
     QUARANTINED_LABEL,
 )
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils import retry as retry_mod
 from tpu_cc_manager.utils.metrics import MetricsRegistry
 
 NODE = "chaos-node-0"
@@ -188,16 +189,17 @@ def operator_controller(kube: FakeKube) -> None:
 
 
 def await_state(kube, desired: str, timeout_s: float = 20.0) -> None:
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        labels = node_labels(kube.get_node(NODE))
-        if labels.get(CC_MODE_STATE_LABEL) == desired:
-            return
-        time.sleep(0.02)
-    raise AssertionError(
-        f"node never converged to {desired}; labels="
-        f"{node_labels(kube.get_node(NODE))}"
+    converged = retry_mod.poll_until(
+        lambda: node_labels(kube.get_node(NODE)).get(
+            CC_MODE_STATE_LABEL
+        ) == desired,
+        timeout_s, 0.02,
     )
+    if not converged:
+        raise AssertionError(
+            f"node never converged to {desired}; labels="
+            f"{node_labels(kube.get_node(NODE))}"
+        )
 
 
 def test_chaos_soak_converges_with_bounded_retries(fake_kube, tmp_path):
@@ -256,7 +258,7 @@ def test_chaos_soak_converges_with_bounded_retries(fake_kube, tmp_path):
                 mgr.watch_and_apply(stop)
                 return
             except (KubeApiError, RuntimeError):
-                time.sleep(0.01)  # pod restart latency
+                time.sleep(0.01)  # cclint: test-sleep-ok(simulated pod restart latency of the crashed DaemonSet agent)
 
     thread = threading.Thread(target=agent, daemon=True)
     thread.start()
@@ -272,21 +274,23 @@ def test_chaos_soak_converges_with_bounded_retries(fake_kube, tmp_path):
 
         # Watchdog demote→restore cycle mid-soak, with faults still flying.
         backend.healthy = False
-        for _ in range(200):
+
+        def tick_until_degraded() -> bool:
             watchdog.tick()
-            if watchdog.degraded:
-                break
-            time.sleep(0.005)
+            return watchdog.degraded
+
+        retry_mod.poll_until(tick_until_degraded, 2.0, 0.005)
         assert watchdog.degraded
         assert node_labels(fake_kube.get_node(NODE))[
             CC_READY_STATE_LABEL
         ] == "false"
         backend.healthy = True
-        for _ in range(200):
+
+        def tick_until_healthy() -> bool:
             watchdog.tick()
-            if not watchdog.degraded:
-                break
-            time.sleep(0.005)
+            return not watchdog.degraded
+
+        retry_mod.poll_until(tick_until_healthy, 2.0, 0.005)
         assert not watchdog.degraded
         assert node_labels(fake_kube.get_node(NODE))[
             CC_READY_STATE_LABEL
@@ -309,13 +313,10 @@ def test_chaos_soak_converges_with_bounded_retries(fake_kube, tmp_path):
                 ))
             )
 
-        deadline = time.monotonic() + 20.0
-        while not fully_converged():
-            assert time.monotonic() < deadline, (
-                "node never fully converged (state+ready+unpaused+pods); "
-                f"labels={node_labels(fake_kube.get_node(NODE))}"
-            )
-            time.sleep(0.02)
+        assert retry_mod.poll_until(fully_converged, 20.0, 0.02), (
+            "node never fully converged (state+ready+unpaused+pods); "
+            f"labels={node_labels(fake_kube.get_node(NODE))}"
+        )
     finally:
         stop.set()
         thread.join(timeout=10)
@@ -362,10 +363,9 @@ def test_chaos_soak_converges_with_bounded_retries(fake_kube, tmp_path):
 
 
 def await_cond(cond, what: str, timeout_s: float = 30.0) -> None:
-    deadline = time.monotonic() + timeout_s
-    while not cond():
-        assert time.monotonic() < deadline, f"never reached: {what}"
-        time.sleep(0.02)
+    assert retry_mod.poll_until(cond, timeout_s, 0.02), (
+        f"never reached: {what}"
+    )
 
 
 def test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts(
@@ -803,7 +803,7 @@ def test_blackout_soak_serves_last_known_mode_and_flushes(
                 mgr.watch_and_apply(stop)
                 return
             except (KubeApiError, RuntimeError):
-                time.sleep(0.01)  # DaemonSet crash-restart semantics
+                time.sleep(0.01)  # cclint: test-sleep-ok(simulated DaemonSet crash-restart latency)
 
     thread = threading.Thread(target=agent, daemon=True)
     thread.start()

@@ -8,6 +8,7 @@ from tpu_cc_manager.ccmanager.rolling import SLICE_ID_LABEL
 from tpu_cc_manager.kubeclient.api import node_labels
 from tpu_cc_manager.labels import CC_MODE_LABEL, CC_MODE_STATE_LABEL
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils import retry as retry_mod
 
 
 def ns(**kw):
@@ -126,11 +127,10 @@ def test_drain_subscribe_sidecar(fake_kube, tmp_path):
     t.start()
     try:
         sub_label = handshake.subscriber_label("side-job")
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline:
-            if sub_label in node_labels(fake_kube.get_node("n0")):
-                break
-            time.sleep(0.01)
+        retry_mod.poll_until(
+            lambda: sub_label in node_labels(fake_kube.get_node("n0")),
+            5.0, 0.01,
+        )
         cycle = handshake.request_drain(fake_kube, "n0")
         assert handshake.await_workload_acks(
             fake_kube, "n0", timeout_s=5, poll_interval_s=0.01,
@@ -138,10 +138,7 @@ def test_drain_subscribe_sidecar(fake_kube, tmp_path):
         ) == []
         assert marker.exists()  # the checkpoint command actually ran
         handshake.clear_drain_request(fake_kube, "n0")
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline and not resume_marker.exists():
-            time.sleep(0.01)
-        assert resume_marker.exists()
+        assert retry_mod.poll_until(resume_marker.exists, 5.0, 0.01)
     finally:
         # What the SIGTERM handler does in a real pod shutdown.
         args.subscriber.stop(timeout_s=0)
@@ -194,17 +191,12 @@ def test_attest_challenge_round(fake_kube, capsys):
     publish_quote(fake_kube, "n0", backend.fetch_attestation("stale"))
 
     def answer_when_challenged():
-        import time
-
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline:
+        if retry_mod.poll_until(
+            lambda: multislice.challenge_nonce_of(fake_kube.get_node("n0")),
+            5.0, 0.01,
+        ):
             nonce = multislice.challenge_nonce_of(fake_kube.get_node("n0"))
-            if nonce:
-                publish_quote(
-                    fake_kube, "n0", backend.fetch_attestation(nonce)
-                )
-                return
-            time.sleep(0.01)
+            publish_quote(fake_kube, "n0", backend.fetch_attestation(nonce))
 
     t = threading.Thread(target=answer_when_challenged, daemon=True)
     t.start()

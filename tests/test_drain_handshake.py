@@ -141,6 +141,7 @@ def test_unregistered_subscriber_counts_as_done(fake_kube):
     fake_kube.add_node(NODE, {sub_label: handshake.ACTIVE})
 
     def finish_job():
+        # cclint: test-sleep-ok(deliberate delay: the subscriber finishes AFTER the await starts)
         time.sleep(0.05)
         fake_kube.patch_node_labels(NODE, {sub_label: None})
 

@@ -20,6 +20,7 @@ import pytest
 from tpu_cc_manager.ccmanager.informer import NodeInformer
 from tpu_cc_manager.faults.kube import FaultyKubeClient
 from tpu_cc_manager.faults.plan import FaultPlan
+from tpu_cc_manager.utils import retry as retry_mod
 from tpu_cc_manager.kubeclient.api import (
     KubeApi,
     KubeApiError,
@@ -53,12 +54,9 @@ def cache_view(informer):
 
 
 def await_consistent(fake, informer, timeout_s=8.0):
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        if cache_view(informer) == pool_view(fake):
-            return True
-        time.sleep(0.02)
-    return False
+    return retry_mod.poll_until(
+        lambda: cache_view(informer) == pool_view(fake), timeout_s, 0.02
+    )
 
 
 def test_initial_sync_is_paginated_and_selector_scoped():
@@ -167,6 +165,7 @@ def test_wait_wakes_on_change_not_poll():
         t0 = time.monotonic()
 
         def fire():
+            # cclint: test-sleep-ok(deliberate delay proving the wait wakes on the event, not a poll)
             time.sleep(0.05)
             fake.set_node_label("n0", "poke", "1")
 
@@ -220,7 +219,7 @@ def test_cache_equals_fresh_list_under_seeded_chaos(seed):
                     rng.choice(["on", "off"]),
                 )
             if rng.random() < 0.1:
-                time.sleep(0.005)
+                time.sleep(0.005)  # cclint: test-sleep-ok(seeded timing jitter is part of the chaos weather)
         plan.end_blackout()  # clean weather to converge in
         assert await_consistent(fake, inf), (
             f"seed {seed}: cache diverged from the pool listing\n"

@@ -27,6 +27,7 @@ from tpu_cc_manager.labels import (
 )
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend
 from tpu_cc_manager.utils.metrics import MetricsRegistry
+from tpu_cc_manager.utils import retry as retry_mod
 
 POOL = {  # two 2-host slices
     "slice-a": ("node-a0", "node-a1"),
@@ -95,19 +96,23 @@ def test_rollout_over_multi_host_slices_with_real_agents(fake_kube, tmp_path):
     try:
         # Agents settle at the default mode and publish slice membership
         # (the orchestrator's group-by-slice needs the labels agents write).
-        deadline = time.monotonic() + 30
         all_nodes = [n for nodes in POOL.values() for n in nodes]
-        while time.monotonic() < deadline:
-            labels = {n: node_labels(fake_kube.get_node(n)) for n in all_nodes}
-            if all(
+
+        def settled() -> bool:
+            labels = {
+                n: node_labels(fake_kube.get_node(n)) for n in all_nodes
+            }
+            return all(
                 l.get(CC_MODE_STATE_LABEL) == MODE_OFF
                 and l.get(SLICE_ID_LABEL)
                 for l in labels.values()
-            ):
-                break
-            time.sleep(0.02)
-        else:
-            pytest.fail(f"agents never settled: {labels}")
+            )
+
+        if not retry_mod.poll_until(settled, 30.0, 0.02):
+            pytest.fail(
+                "agents never settled: "
+                f"{ {n: node_labels(fake_kube.get_node(n)) for n in all_nodes} }"
+            )
 
         roller = RollingReconfigurator(
             fake_kube, "pool=tpu", max_unavailable=1,

@@ -136,6 +136,29 @@ def test_compaction_preserves_live_state(tmp_path):
     assert j2.pending_patches() == {"b": "2"}
 
 
+def test_kill_between_readmit_start_and_close_replays_open_drain(tmp_path):
+    """Kill-at-PHASE_READMIT: the manager marks the drain intent
+    PHASE_READMIT when re-admission STARTS (_ReadmitOnce on_start) and
+    closes it only after readmit succeeded — so a SIGKILL in between
+    must replay to an OPEN drain intent at phase readmit (the successor
+    re-runs the idempotent readmit), and the successful close retires
+    it."""
+    j = make_journal(tmp_path)
+    dtxn = j.begin("drain", mode="on")
+    j.mark(dtxn, ij.PHASE_READMIT)
+    # modeled SIGKILL here: no commit reaches the journal
+    j2 = make_journal(tmp_path)
+    j2.replay()
+    opens = j2.open_intents()
+    assert [i["kind"] for i in opens] == ["drain"]
+    assert opens[0]["phase"] == ij.PHASE_READMIT
+    # The successor's successful readmit closes every recovered drain.
+    j2.close_open("drain", recovered="readmitted")
+    j3 = make_journal(tmp_path)
+    j3.replay()
+    assert j3.open_intents() == []
+
+
 def test_newline_less_tail_is_torn_even_when_crc_verifies(tmp_path):
     """A crash that cuts the final append exactly one byte short (frame
     minus the trailing newline) leaves a CRC-valid fragment. Replay must
@@ -558,7 +581,7 @@ def test_confirm_read_api_error_is_fatal_and_outage_waits_the_ladder(
         daemon=True,
     )
     t.start()
-    time.sleep(0.2)
+    time.sleep(0.2)  # cclint: test-sleep-ok(negative assertion: the read must STILL be parked after this window)
     assert t.is_alive()
     stop.set()
     t.join(timeout=5)
@@ -595,7 +618,7 @@ def test_boot_waits_out_outage_with_local_truth(fake_kube, tmp_path):
 
     t = threading.Thread(target=boot, daemon=True)
     t.start()
-    time.sleep(0.15)
+    time.sleep(0.15)  # cclint: test-sleep-ok(negative assertion: boot must STILL be riding out the outage)
     assert t.is_alive(), "boot must ride out the outage, not crash"
     stop.set()
     t.join(timeout=5)
@@ -634,7 +657,7 @@ def engaged_offline_manager(fake_kube, backend, tmp_path, **kwargs):
     )
     api.dark = True
     mgr.offline.note_failure()
-    time.sleep(0.02)  # outlast the grace window
+    time.sleep(0.02)  # cclint: test-sleep-ok(must outlast the real-clock offline grace window)
     assert mgr.offline.engaged
     return api, mgr
 

@@ -22,6 +22,7 @@ from _hypothesis_compat import given, st
 
 from tpu_cc_manager.kubeclient.api import KubeApiError, node_annotations, node_labels
 from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+from tpu_cc_manager.utils import retry as retry_mod
 
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
@@ -185,10 +186,11 @@ def test_watch_carries_bookmark_events(server, client):
 
     t = threading.Thread(target=consume, daemon=True)
     t.start()
-    deadline = time.monotonic() + 5.0
-    while "event" not in seen and time.monotonic() < deadline:
+    def pump() -> bool:
         mock_apiserver._event_queue.put((mock_apiserver._BOOKMARK, b""))
-        time.sleep(0.1)
+        return "event" in seen
+
+    retry_mod.poll_until(pump, 5.0, 0.1)
     t.join(timeout=5)
     assert "event" in seen, "no BOOKMARK event reached the watch client"
     ev = seen["event"]
@@ -226,6 +228,7 @@ def test_watch_without_optin_gets_no_bookmarks(server):
     t.start()
     for _ in range(10):
         mock_apiserver._event_queue.put((mock_apiserver._BOOKMARK, b""))
+        # cclint: test-sleep-ok(paced pumping for a NEGATIVE assertion — no bookmark may reach the client)
         time.sleep(0.05)
     t.join(timeout=10)
     assert types and "BOOKMARK" not in types, types
@@ -400,10 +403,9 @@ def test_selector_watch_synthesizes_deleted_on_label_change(server, client):
         client.patch_node_labels("pool-watch-node", {"watch-pool": "a"})
         t = threading.Thread(target=consume, daemon=True)
         t.start()
-        deadline = time.monotonic() + 5.0
-        while not any(n == "pool-watch-node" for _, n in seen):
-            assert time.monotonic() < deadline, f"never saw the node: {seen}"
-            time.sleep(0.05)
+        assert retry_mod.poll_until(
+            lambda: any(n == "pool-watch-node" for _, n in seen), 5.0, 0.05
+        ), f"never saw the node: {seen}"
         # Leaving the selector arrives as DELETED, not MODIFIED.
         client.patch_node_labels("pool-watch-node", {"watch-pool": "b"})
         assert done.wait(5.0), f"no DELETED event: {seen}"

@@ -41,6 +41,7 @@ from tpu_cc_manager.labels import (
 from tpu_cc_manager.obs.journal import Journal
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend, sign_fake_quote
 from tpu_cc_manager.utils.metrics import MetricsRegistry
+from tpu_cc_manager.utils import retry as retry_mod
 
 NODE = "pipe-node-0"
 NS = "tpu-operator"
@@ -200,6 +201,7 @@ def test_readmit_overlaps_smoke(fake_kube):
                 # already hold the committed mode.
                 state["committed_at_readmit"] = dict(backend.committed)
                 return {"ok": True}
+            # cclint: test-sleep-ok(bounded poll that must snapshot committed-state at the observation instant)
             time.sleep(0.005)
         state["unpaused_during_smoke"] = False
         return {"ok": True}
@@ -262,7 +264,8 @@ def test_overlap_metric_exported(fake_kube):
     orig_stage = backend.stage_cc_mode
 
     def slow_stage(chips, mode):
-        time.sleep(0.1)  # overlapped with the 0.15 s pod wait
+        # cclint: test-sleep-ok(simulated stage latency — the overlap under test)
+        time.sleep(0.1)
         orig_stage(chips, mode)
 
     backend.stage_cc_mode = slow_stage
@@ -324,7 +327,10 @@ def _arm_kill(backend, op_name, when="before"):
     return armed
 
 
-CRASH_POINTS = [
+# (Test-local kill specs, not orchestrator crash-point names: the
+# cclint crash-point checker reserves *CRASH_POINTS* list names for
+# declarations of package point literals.)
+PIPELINE_KILL_SPECS = [
     # (name, op to kill in, before/after the real op)
     ("during-overlapped-stage", "stage_cc_mode", "before"),
     ("after-stage-before-reset", "stage_cc_mode", "after"),
@@ -334,7 +340,7 @@ CRASH_POINTS = [
 ]
 
 
-@pytest.mark.parametrize("name,op,when", CRASH_POINTS)
+@pytest.mark.parametrize("name,op,when", PIPELINE_KILL_SPECS)
 def test_kill_at_crash_point_exactly_one_reset(tmp_path, name, op, when):
     """A modeled SIGKILL at each pipeline crash point, then a fresh agent
     replaying the intent journal: the successor converges to the desired
@@ -610,13 +616,9 @@ def test_attest_prep_overlaps_wait_ready(fake_kube):
         # Runs on the prep worker concurrently with tracking_wait: it
         # must OBSERVE the boot wait in flight (0.1 s window) — a serial
         # prep (before or after wait_ready) never sees waiting=True.
-        deadline = time.monotonic() + 2.0
-        while time.monotonic() < deadline:
-            if state.get("waiting"):
-                state["prep_during_boot"] = True
-                return
-            time.sleep(0.005)
-        state["prep_during_boot"] = False
+        state["prep_during_boot"] = retry_mod.poll_until(
+            lambda: bool(state.get("waiting")), 2.0, 0.005
+        )
 
     def tracking_wait(chips, timeout_s):
         state["waiting"] = True

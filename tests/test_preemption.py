@@ -45,6 +45,7 @@ from tpu_cc_manager.labels import (
 )
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend
 from tpu_cc_manager.utils.metrics import MetricsRegistry
+from tpu_cc_manager.utils import retry as retry_mod
 
 NODE = "spot-node-0"
 NS = "tpu-operator"
@@ -263,7 +264,8 @@ def test_slice_peer_fences_fast_instead_of_burning_barrier_deadline(
     t = threading.Thread(target=drive_peer, daemon=True)
     started = time.monotonic()
     t.start()
-    time.sleep(0.3)  # the peer is now parked in its barrier wait
+    # cclint: test-sleep-ok(settle window: the peer thread has no observable parked-in-barrier hook)
+    time.sleep(0.3)
     # Host 0 was preempted mid-flip (it never staged): its notice handler
     # publishes the handoff AND fences the slice on its way out.
     mgr0._inflight_transition = {
@@ -310,9 +312,9 @@ def test_monitor_polls_the_seeded_notice_and_retires(fake_kube, tmp_path):
     )
     assert plan.schedule_preemption(backend) is True
     mgr._start_preemption_monitor()
-    deadline = time.monotonic() + 5.0
-    while not registry.preemption_totals() and time.monotonic() < deadline:
-        time.sleep(0.01)
+    retry_mod.poll_until(
+        lambda: bool(registry.preemption_totals()), 5.0, 0.01
+    )
     # No transition was in flight: a clean fast drain, and the monitor
     # thread retires (the signal is level-triggered; one per VM lifetime).
     assert registry.preemption_totals() == {"clean": 1}
@@ -346,11 +348,9 @@ def test_flaky_notice_source_never_kills_the_monitor(fake_kube, tmp_path):
     mgr._start_preemption_monitor()
     try:
         backend.set_preempted(True)
-        deadline = time.monotonic() + 5.0
-        while (
-            not registry.preemption_totals() and time.monotonic() < deadline
-        ):
-            time.sleep(0.01)
+        retry_mod.poll_until(
+            lambda: bool(registry.preemption_totals()), 5.0, 0.01
+        )
         assert registry.preemption_totals() == {"clean": 1}
     finally:
         mgr._stop_preemption_monitor()

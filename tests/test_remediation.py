@@ -366,6 +366,7 @@ def test_fenced_peers_fail_fast(fake_kube):
 
     t = threading.Thread(target=wait)
     t.start()
+    # cclint: test-sleep-ok(settle window: the waiter thread has no observable parked-in-barrier hook)
     time.sleep(0.05)
     # Host 1 is condemned: it bumps the fencing generation.
     slicecoord.fence_slice(

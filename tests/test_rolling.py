@@ -17,6 +17,7 @@ from tpu_cc_manager.labels import (
     CC_MODE_STATE_LABEL,
     STATE_FAILED,
 )
+from tpu_cc_manager.utils import retry as retry_mod
 
 POOL = "pool=tpu"
 
@@ -674,9 +675,7 @@ def test_interrupted_rollout_resumes_idempotently(fake_kube):
     # what this test is about. Pausing the agents first makes the
     # re-drive deterministically the second rollout's doing.
     paused.set()
-    deadline = time.monotonic() + 5.0
-    while in_flight and time.monotonic() < deadline:
-        time.sleep(0.01)
+    retry_mod.poll_until(lambda: not in_flight, 5.0, 0.01)
     assert not in_flight
 
     # Operator fixes node-1; the re-run must not re-bounce node-0.

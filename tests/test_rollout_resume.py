@@ -26,6 +26,21 @@ from tpu_cc_manager.utils.metrics import MetricsRegistry
 POOL = "pool=tpu"
 NS = "tpu-operator"
 
+#: The orchestrator's named crash points, spelled out as literals on
+#: purpose (not imported): the cclint crash-point coverage checker keys
+#: on these strings, and the runtime assertion in
+#: test_successor_converges_after_kill_at_every_crash_point keeps the
+#: list honest against rolling.CRASH_POINTS — a new point added to the
+#: orchestrator fails lint until it is named here, and fails this suite
+#: until the kill loop actually reaches it.
+ROLLING_CRASH_POINTS = [
+    "planned",
+    "window-start",
+    "mid-window",
+    "awaited",
+    "window-boundary",
+]
+
 
 class Clock:
     """Injectable wall/monotonic clock for deterministic lease expiry."""
@@ -253,11 +268,13 @@ def test_fenced_rollout_checkpoints_and_stamps_generation(fake_kube):
     )
 
 
-def _run_crash_resume(kill_at: int):
+def _run_crash_resume(kill_at: int, points_seen: set | None = None):
     """One crash/resume cycle: orchestrator A is SIGKILLed at the
     ``kill_at``-th crash point (no cleanup, lease not released), successor
     B takes over after lease expiry and resumes from the checkpoint.
-    Returns (killed, counts, result, fake)."""
+    Returns (killed, counts, result, fake). ``points_seen`` (when given)
+    accumulates every crash-point NAME the hook observed — the coverage
+    evidence the exhaustive test asserts against ROLLING_CRASH_POINTS."""
     fake = FakeKube()
     add_pool(fake, 4, slice_map={0: "s1", 1: "s1"})  # s1 + 2 singles
     counts: dict = {}
@@ -267,6 +284,8 @@ def _run_crash_resume(kill_at: int):
     hook_calls = {"n": 0}
 
     def killer(point):
+        if points_seen is not None:
+            points_seen.add(point)
         if hook_calls["n"] == kill_at:
             raise OrchestratorKilled(point, hook_calls["n"])
         hook_calls["n"] += 1
@@ -301,10 +320,22 @@ def test_successor_converges_after_kill_at_every_crash_point():
     """The ISSUE's property test: kill the orchestrator at EVERY crash
     point (checkpoint boundaries, inside windows, between windows) in
     turn; the successor must converge the pool with each node bounced
-    exactly once and no group dropped."""
+    exactly once and no group dropped. Also the crash-point COVERAGE
+    proof: the run must visit every declared point name, and the
+    declared list must equal rolling.CRASH_POINTS — so a new point
+    cannot land without this suite exercising it."""
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    assert set(ROLLING_CRASH_POINTS) == set(rolling_mod.CRASH_POINTS), (
+        "ROLLING_CRASH_POINTS is out of date with rolling.CRASH_POINTS — "
+        "update the list (the cclint coverage checker keys on it)"
+    )
+    points_seen: set = set()
     exhausted = False
     for kill_at in range(32):
-        killed, counts, result, fake = _run_crash_resume(kill_at)
+        killed, counts, result, fake = _run_crash_resume(
+            kill_at, points_seen=points_seen
+        )
         assert result.ok, f"kill_at={kill_at}: successor did not converge"
         for i in range(4):
             name = f"node-{i}"
@@ -318,6 +349,11 @@ def test_successor_converges_after_kill_at_every_crash_point():
             exhausted = True  # ran past the last crash point: all covered
             break
     assert exhausted, "never exhausted the crash points; raise the range"
+    assert points_seen == set(ROLLING_CRASH_POINTS), (
+        f"kill loop never reached {set(ROLLING_CRASH_POINTS) - points_seen} "
+        "— a declared crash point with no coverage is exactly what the "
+        "crash-point lint exists to prevent"
+    )
 
 
 def test_resume_skips_done_groups_without_relisting_their_state(fake_kube):
@@ -549,7 +585,8 @@ def test_ctl_rollout_resume_and_status(fake_kube, capsys):
         assert "groups=0/2 done" in out and "EXPIRED (resumable)" in out
 
         import time as _time
-        _time.sleep(0.01)  # the dead holder's 1ms lease lapses in real time
+        # cclint: test-sleep-ok(the 1ms lease TTL must lapse on the real clock)
+        _time.sleep(0.01)
         rc = ctl.cmd_rollout(fake_kube, ns())
         out = capsys.readouterr().out
         assert rc == 0
@@ -688,7 +725,8 @@ def test_corrupt_record_is_a_clean_ctl_error(fake_kube, capsys):
     ] = "{truncated"
     fake_kube.update_lease(NS, rollout_state.LEASE_NAME, stored)
     import time as _time
-    _time.sleep(0.01)  # the seed holder's 1ms lease lapses
+    # cclint: test-sleep-ok(the 1ms lease TTL must lapse on the real clock)
+    _time.sleep(0.01)
     args = argparse.Namespace(
         selector=POOL, mode="on", max_unavailable=1, node_timeout=5.0,
         continue_on_failure=False, rollback_on_failure=False,
@@ -723,6 +761,7 @@ def test_resume_restores_persisted_budget_and_concurrency(fake_kube, capsys):
     )
     seed.checkpoint(rec)
     import time as _time
+    # cclint: test-sleep-ok(the 1ms lease TTL must lapse on the real clock)
     _time.sleep(0.01)
     args = argparse.Namespace(
         selector=POOL, mode="on",

@@ -273,7 +273,7 @@ def test_inflight_requests_checkpoint_and_requeue_with_progress(fake_kube):
                  for i in range(4)]
         assert server.submit(batch)
         # Mid-decode (200 tokens × 10 ms = 2 s of work), drain the node.
-        time.sleep(0.15)
+        time.sleep(0.15)  # cclint: test-sleep-ok(real decode time must elapse so the drain lands mid-batch)
         handshake.request_drain(fake_kube, NODE, deadline_s=1.0)
         assert retry_mod.poll_until(lambda: server.drains >= 1, 5.0, 0.02)
         assert retry_mod.poll_until(lambda: len(requeued) == 4, 5.0, 0.02)

@@ -31,6 +31,7 @@ from tpu_cc_manager.smoke import runner as runner_mod
 from tpu_cc_manager.smoke.runner import SmokeError
 from tpu_cc_manager.tpudev.fake import FakeTpuBackend
 from tpu_cc_manager.utils.metrics import MetricsRegistry
+from tpu_cc_manager.utils import retry as retry_mod
 
 NODE = "warm-node-0"
 NS = "tpu-operator"
@@ -327,6 +328,7 @@ def test_gate_opens_when_released(monkeypatch, tmp_path):
     compiled = []
 
     def release_soon():
+        # cclint: test-sleep-ok(deliberate delay: the gate must open only when released)
         time.sleep(0.15)
         with open(gate, "w", encoding="utf-8") as f:
             f.write("released")
@@ -375,11 +377,10 @@ def test_gate_orphan_child_exits_when_parent_dies(tmp_path):
     try:
         # Wait for the child to reach the gate (sentinel), then SIGKILL
         # the fake parent — the child must notice and die on its own.
-        deadline = time.monotonic() + 30
         sentinel = runner_mod.compiled_sentinel(gate)
-        while time.monotonic() < deadline and not os.path.exists(sentinel):
-            time.sleep(0.05)
-        assert os.path.exists(sentinel), "child never reached the gate"
+        assert retry_mod.poll_until(
+            lambda: os.path.exists(sentinel), 30.0, 0.05
+        ), "child never reached the gate"
         parent.kill()
         parent.wait()  # reap: the pid must actually disappear
         stdout, stderr = child.communicate(timeout=30)
@@ -409,14 +410,15 @@ def test_smoke_warmup_end_to_end_real_subprocess():
         extra_args=["--size", "128"],
     )
     try:
-        deadline = time.monotonic() + 180
-        while time.monotonic() < deadline and w.compiled_after_s() is None:
+        def compiled_or_dead() -> bool:
             assert w._proc.poll() is None, "child died during COMPILE"
-            time.sleep(0.1)
+            return w.compiled_after_s() is not None
+
+        retry_mod.poll_until(compiled_or_dead, 180.0, 0.1)
         compile_s = w.compiled_after_s()
         assert compile_s is not None, "compile sentinel never landed"
         # Gated: the child must still be alive and NOT have finished.
-        time.sleep(0.3)
+        time.sleep(0.3)  # cclint: test-sleep-ok(negative assertion: the child must STILL be blocked on the gate)
         assert w._proc.poll() is None, "child must block on the gate"
         result = w.release_and_result()
     except BaseException:

@@ -212,6 +212,7 @@ def test_failed_reconcile_retries_without_label_change(kube, fake_tpu, tmp_path)
     fake_tpu.fail_next("reset")  # first apply fails transiently
 
     def idle_past_backoff():
+        # cclint: test-sleep-ok(simulated idle watch-stream segment outlasting the backoff)
         time.sleep(0.08)
         return []
 
@@ -242,6 +243,7 @@ def test_stable_misconfiguration_retries_only_at_slow_cadence(kube, tmp_path):
     kube.set_node_label(NODE, CC_MODE_LABEL, "slice")
 
     def idle():
+        # cclint: test-sleep-ok(simulated idle watch-stream segment)
         time.sleep(0.08)
         return []
 
@@ -281,6 +283,7 @@ def test_retry_backoff_disabled_keeps_reference_behavior(kube, fake_tpu, tmp_pat
     fake_tpu.fail_next("reset")
 
     def idle():
+        # cclint: test-sleep-ok(simulated idle watch-stream segment)
         time.sleep(0.05)
         return []
 

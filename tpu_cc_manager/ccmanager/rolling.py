@@ -172,6 +172,19 @@ SURGE_TAINT = {
     "key": SURGE_TAINT_KEY, "value": "true", "effect": "NoSchedule",
 }
 
+#: The canonical set of named orchestrator crash points. ``_crash_point``
+#: refuses names outside it, the kill-at-every-crash-point suites assert
+#: they exhausted exactly this set, and the cclint crash-point coverage
+#: checker fails the build when a member has no test naming it — so
+#: adding a point here without extending the suites cannot land.
+CRASH_POINTS = (
+    "planned",
+    "window-start",
+    "mid-window",
+    "awaited",
+    "window-boundary",
+)
+
 #: Terminal await-state for a node whose Node OBJECT vanished mid-window
 #: (cluster-autoscaler scale-down, spot reclaim). The informer delivers
 #: the DELETED event (or the fallback GET answers 404), and the await
@@ -470,6 +483,10 @@ class RollingReconfigurator:
         (FaultPlan.decide_orchestrator_kill) may raise OrchestratorKilled
         here, modeling a SIGKILL that runs no cleanup."""
         if self.crash_hook is not None:
+            assert point in CRASH_POINTS, (
+                f"undeclared crash point {point!r}: add it to "
+                "rolling.CRASH_POINTS and a kill-at test"
+            )
             with self._crash_lock:
                 self.crash_hook(point)
 

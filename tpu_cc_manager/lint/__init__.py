@@ -1,17 +1,26 @@
 """cclint: contract-aware static analysis for this repo's safety invariants.
 
-Eight PRs of robustness work accumulated safety contracts that lived only
-as prose in CHANGES.md and reviewer memory. Each checker here machine-
-checks one of them, over the package's own source (stdlib ``ast`` only):
+Robustness work accumulated safety contracts that lived only as prose in
+CHANGES.md and reviewer memory. Each checker here machine-checks one of
+them over the package's own source (stdlib ``ast`` only). v2 upgraded
+the engine from per-file lexical checks to flow-aware analysis:
+:mod:`tpu_cc_manager.lint.flow` builds a per-function CFG and resolves
+the intra-class/intra-module call graph, so the checkers prove the
+invariants where they actually live — across call chains and threads.
 
 ``locks``
     Shared fields annotated ``# cclint: guarded-by(<lock>)`` at their
     ``__init__`` assignment may only be touched inside a
-    ``with self.<lock>:`` block elsewhere in the class (or in a method
-    annotated ``# cclint: requires(<lock>)``, whose callers hold it).
+    ``with self.<lock>:`` block elsewhere in the class, or in a method
+    annotated ``# cclint: requires(<lock>)`` — and ``requires`` is now
+    VERIFIED at every same-class call site, bare references of
+    ``requires`` methods (thread targets) are findings, and an
+    unannotated private helper is judged by its callers' lock context.
 ``waits``
     ``time.sleep`` outside ``utils/retry.py`` / ``faults/`` is an error —
-    every wait rides the shared retry/backoff layer (the PR 2 invariant).
+    every wait rides the shared retry/backoff layer (the PR 2
+    invariant). Now covers ``tests/`` too (the ad-hoc test sleep is the
+    flake factory), with ``# cclint: test-sleep-ok(<reason>)`` waivers.
 ``crash``
     A handler that can catch ``BaseException`` (bare ``except:`` or
     explicit) must re-raise it; the kill-at-every-crash-point suites
@@ -19,9 +28,21 @@ checks one of them, over the package's own source (stdlib ``ast`` only):
     intentionally captures (worker threads re-raising at join) carries
     ``# cclint: crash-ok(<reason>)``.
 ``journal``
-    Direct calls to ``backend.reset`` / ``backend.restart_runtime``
-    outside the allowlisted journaled call sites are an error — every
-    hardware-effecting operation journals an intent first (PR 5).
+    Journal typestate, proven on the CFG: every ``backend.reset`` /
+    ``backend.restart_runtime`` must be dominated by an intent-begin
+    write on every path (interprocedurally — tokens carry their callers'
+    proof), and a begun intent must be closed on every non-crash exit.
+    The old reviewed allowlist survives only as a waiver of last resort
+    (currently empty).
+``fenced``
+    Fenced-write taint: once a ``RolloutLease`` is acquired, every
+    apiserver write must flow through ``FencedKube`` — a raw-client
+    write reachable inside the lease bracket (including through a
+    callee) is the CAS-bypass bug class, and a finding.
+``crashpoints``
+    Crash-point coverage: every named orchestrator crash point and
+    journal phase mark must be named by at least one kill-at test under
+    ``tests/``, and point names only tests still reference are stale.
 ``surface``
     Contract-surface drift: every ``CC_*`` env read must appear in the
     docs/operations.md env table, every ``CC_*`` env the daemonset sets
@@ -34,11 +55,13 @@ checks one of them, over the package's own source (stdlib ``ast`` only):
 The driver (``python -m tpu_cc_manager.lint``) runs every checker plus
 the Prometheus exposition lint (:mod:`tpu_cc_manager.lint.expo`, the
 former ``hack/check_metrics_lint.py`` — the old entrypoint remains as a
-shim), emits human or ``--json`` output, and compares findings against
-the committed baseline (``.cclint-baseline.json``): grandfathered
-violations are explicit, each with a reason, and any NEW finding fails
-the build. The static passes pair with an opt-in runtime lock-order
-checker (``CC_LOCKCHECK=1``, :mod:`tpu_cc_manager.utils.locks`).
+shim), emits human or ``--json`` output (plus a ``--changed-only
+<git-ref>`` fast review mode), and compares findings against the
+committed baseline (``.cclint-baseline.json``): grandfathered
+violations are explicit, each with a reason; any NEW finding — or any
+STALE baseline entry — fails the build. The static passes pair with an
+opt-in runtime lock-order checker (``CC_LOCKCHECK=1``,
+:mod:`tpu_cc_manager.utils.locks`).
 """
 
 from tpu_cc_manager.lint.base import Finding, LintContext  # noqa: F401

@@ -92,13 +92,16 @@ class SourceFile:
 @dataclass
 class LintContext:
     """Everything a checker may look at. ``root`` is the repo root;
-    ``files`` covers ``tpu_cc_manager/**/*.py``."""
+    ``files`` covers ``tpu_cc_manager/**/*.py`` and ``test_files``
+    covers ``tests/**/*.py`` (the crash-point coverage and test-wait
+    checkers read the suite; the package checkers never do)."""
 
     root: str
     files: list[SourceFile] = field(default_factory=list)
+    test_files: list[SourceFile] = field(default_factory=list)
 
     def file(self, relpath: str) -> SourceFile | None:
-        for f in self.files:
+        for f in self.files + self.test_files:
             if f.relpath == relpath:
                 return f
         return None
@@ -131,6 +134,8 @@ def build_context(root: str) -> LintContext:
     ctx = LintContext(root=root)
     for relpath in package_files(root):
         ctx.files.append(SourceFile(root, relpath))
+    for relpath in package_files(root, package_dir="tests"):
+        ctx.test_files.append(SourceFile(root, relpath))
     return ctx
 
 

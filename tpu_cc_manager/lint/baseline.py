@@ -7,8 +7,10 @@ file; one entry covers every finding sharing its fingerprint (e.g. three
 simulated-latency sleeps in one method).
 
 A finding without an entry fails the build. An entry without a finding
-is *stale* — reported so the file shrinks as violations get fixed, but
-not fatal (a fix should not be blocked on a second file edit race).
+is *stale* — and since v2 that is a HARD error too: the fix and the
+entry deletion belong to the same change (`--write-baseline` regenerates
+the file, preserving hand-written reasons and pruning fixed entries, so
+shedding the grandfathering is one command, not an edit race).
 """
 
 from __future__ import annotations
