@@ -50,7 +50,10 @@ mkdir -p "$(dirname "$OUT")" artifacts
 # handoff, slice fencing of a departed peer) — seeded from the same
 # CC_CHAOS_SEED, summarized via PREEMPTION_SUMMARY lines.
 # test_serve.py carries the serving-under-the-flip soak (rolling CC flip
-# under sustained traffic, zero lost requests) — SERVE_SUMMARY lines.
+# under sustained traffic, zero lost requests) — SERVE_SUMMARY lines —
+# plus the open-loop overload leg (rate-driven arrivals, admission
+# control shedding, flip under overload with zero accepted losses) —
+# SERVE_OVERLOAD_SUMMARY lines.
 # test_flight.py carries the flight-recorder crash leg (kill the
 # orchestrator at every crash point, resume, assert ONE exactly-once
 # timeline with zero torn JSONL lines) — OBS_SUMMARY lines.
@@ -81,8 +84,9 @@ for i in $(seq 0 $((ITERS - 1))); do
   offline=$(grep -ao "OFFLINE_SUMMARY.*" "$log" | tail -1 | sed "s/^OFFLINE_SUMMARY //; s/'/ /g; s/\"/ /g")
   preemption=$(grep -ao "PREEMPTION_SUMMARY.*" "$log" | sed "s/^PREEMPTION_SUMMARY //; s/'/ /g; s/\"/ /g" | paste -sd'; ' -)
   serve=$(grep -ao "SERVE_SUMMARY.*" "$log" | tail -1 | sed "s/^SERVE_SUMMARY //; s/'/ /g; s/\"/ /g")
+  serve_overload=$(grep -ao "SERVE_OVERLOAD_SUMMARY.*" "$log" | tail -1 | sed "s/^SERVE_OVERLOAD_SUMMARY //; s/'/ /g; s/\"/ /g")
   obs=$(grep -ao "OBS_SUMMARY.*" "$log" | tail -1 | sed "s/^OBS_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"obs\": \"${obs}\"}")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"obs\": \"${obs}\"}")
 done
 
 {

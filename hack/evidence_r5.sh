@@ -44,6 +44,7 @@ ARTIFACTS=(
   artifacts/chaos_soak.json
   SCALE_r01.json
   SERVE_r01.json
+  SERVE_r02.json
   artifacts/smoke_cache_r06.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
@@ -191,6 +192,28 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s SERVE_r01.json ] && mv SERVE_r01.json artifacts/SERVE_r01.failed.json
     echo ">>> serve bench FAILED; stopping ladder (summary in artifacts/SERVE_r01.failed.json)"
+    finish
+  }
+fi
+
+# Open-loop overload evidence (ROADMAP item 1, SERVE_r02): the rate
+# sweep finds the knee (goodput tracks offered load below it, bounded
+# queue-delay p99), proves shedding holds goodput past it, then a full
+# rolling flip AT the knee under open-loop traffic with zero accepted
+# losses. CPU-only. Resumable at two grains: completed ok:true sweep
+# rates persist in the partial JSONL and are skipped on re-run, and the
+# whole stage skips once the summary records ok:true; a failed summary
+# is parked like the chaos soak's.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("SERVE_r02.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> SERVE_r02.json already captured (ok:true); skipping"
+else
+  echo "=== stage: serve-bench --sweep (open-loop overload, no tunnel) ==="
+  python3 hack/serve_bench.py --sweep 200,400,800,1600,3200,6400 \
+      --partial artifacts/serve_sweep_partial.jsonl \
+      --out SERVE_r02.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s SERVE_r02.json ] && mv SERVE_r02.json artifacts/SERVE_r02.failed.json
+    echo ">>> open-loop serve bench FAILED; stopping ladder (summary in artifacts/SERVE_r02.failed.json; partial sweep rows kept for resume)"
     finish
   }
 fi

@@ -1,21 +1,33 @@
-"""Serving-under-the-flip bench: one JSON line, ok-gated (SERVE_r01).
+"""Serving bench: one JSON line, ok-gated (SERVE_r01 / SERVE_r02).
 
-Converts the "millions of users" north star into a measurable artifact:
-a TrafficDriver sustains batched synthetic inference across a pool of
-REAL node agents while a REAL rolling CC flip runs mid-traffic
-(tpu_cc_manager/serve/). The line reports p50/p99 latency and error
-rate DURING the rollout vs steady state, and the headline claim:
-``requests_lost_per_node_bounced`` == 0 — every in-flight request
-checkpoints through the drain handshake and completes.
+Converts the "millions of users" north star into measurable artifacts:
+
+**Default (SERVE_r01)**: a closed-loop TrafficDriver sustains batched
+synthetic inference across a pool of REAL node agents while a REAL
+rolling CC flip runs mid-traffic (tpu_cc_manager/serve/). The line
+reports p50/p99 latency and error rate DURING the rollout vs steady
+state, and the headline claim: ``requests_lost_per_node_bounced`` == 0.
+
+**--sweep (SERVE_r02)**: the open-loop overload artifact. A resumable
+rate sweep (seeded Poisson arrivals, per-request deadlines, admission
+control) finds the KNEE — the last rate where goodput tracks offered
+load and queue-delay p99 stays bounded — and proves shedding holds
+goodput near the knee past it instead of collapsing. Then a full
+rolling CC flip runs AT the knee under open-loop traffic, with the
+orchestrator's wave-boundary SLO gate armed from the harness's live
+evaluator: ``ok`` requires the knee found, goodput held past it, the
+flip converged, and ZERO accepted requests lost (sheds are counted,
+never lost).
 
 Usage:
   python3 hack/serve_bench.py [--nodes 3] [--traffic-s 8] [--out FILE]
       [--calibrate-smoke]  # calibrate the executor model from a real
                            # llama smoke run (ms_per_token, hbm_bw_util)
+      [--sweep 150,300,600,1200,2400] [--rate-s 2.5] [--deadline-ms 500]
+      [--partial artifacts/serve_sweep_partial.jsonl]  # resumable rows
 
-``ok`` is true only when the rollout converged, zero requests were
-lost, and both latency buckets have data — the evidence ladder's
-skip-when-ok:true gate (hack/evidence_r5.sh) reads it.
+``ok`` gates the evidence ladder's skip-when-ok:true stage
+(hack/evidence_r5.sh) for both artifact shapes.
 """
 
 from __future__ import annotations
@@ -25,6 +37,145 @@ import json
 import os
 import sys
 import tempfile
+
+
+def _load_partial(path: str | None, config: dict) -> dict[float, dict]:
+    """Completed sweep rows from a previous interrupted run, keyed by
+    rate. Only ok:true rows measured under the SAME configuration
+    (deadline/nodes/seed/point duration — every field in ``config``) are
+    reused: mixing rows from different deadlines or pool sizes would
+    report a knee that corresponds to no single configuration. Failed or
+    mismatched rows are re-bought on resume (same discipline as
+    scale_bench --partial)."""
+    rows: dict[float, dict] = {}
+    if not path or not os.path.exists(path):
+        return rows
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if (
+                row.get("ok") is True
+                and "rate_rps" in row
+                and all(row.get(k) == v for k, v in config.items())
+            ):
+                rows[float(row["rate_rps"])] = row
+    return rows
+
+
+def run_sweep(args, executor_factory, calibration) -> dict:
+    from tpu_cc_manager.serve import ServeHarness
+    from tpu_cc_manager.serve import sweep as sweep_mod
+    from tpu_cc_manager.serve.driver import PoissonSchedule
+
+    rates = sorted(float(r) for r in args.sweep.split(",") if r.strip())
+    deadline_s = args.deadline_ms / 1e3
+    done = _load_partial(args.partial, {
+        "deadline_ms": round(1e3 * deadline_s, 1),
+        "nodes": args.nodes,
+        "seed": args.seed,
+        "traffic_s": args.rate_s,
+        # A calibrated executor has a different capacity, hence a
+        # different knee: rows from the other executor model must not
+        # be mixed in on resume.
+        "calibrated": calibration is not None,
+    })
+    rows: list[dict] = []
+    for rate in rates:
+        if rate in done:
+            print(f">>> rate {rate} already captured; skipping",
+                  file=sys.stderr)
+            rows.append(done[rate])
+            continue
+        row = sweep_mod.run_rate_point(
+            rate,
+            n_nodes=args.nodes,
+            traffic_s=args.rate_s,
+            deadline_s=deadline_s,
+            seed=args.seed,
+            executor_factory=executor_factory,
+        )
+        row["calibrated"] = calibration is not None
+        rows.append(row)
+        if args.partial:
+            os.makedirs(os.path.dirname(args.partial) or ".", exist_ok=True)
+            with open(args.partial, "a", encoding="utf-8") as f:
+                f.write(json.dumps(row) + "\n")
+    knee = sweep_mod.find_knee(rows)
+    holds = (
+        sweep_mod.goodput_holds_past_knee(rows, knee)
+        if knee is not None else False
+    )
+    swept_past = knee is not None and any(
+        r["rate_rps"] > knee["rate_rps"] for r in rows
+    )
+
+    flip = None
+    slo_pauses = None
+    if knee is not None:
+        # The other half of the claim: a rolling CC flip AT the knee,
+        # open-loop traffic still arriving on schedule, SLO gate armed
+        # (lenient burn threshold: the gate must pace, not veto — the
+        # artifact's bar is zero ACCEPTED losses, sheds counted).
+        harness = ServeHarness(
+            n_nodes=args.nodes,
+            tmp_dir=tempfile.mkdtemp(prefix="tpu-cc-serve-r02-"),
+            executor_factory=executor_factory,
+            driver_kwargs={
+                "schedule": PoissonSchedule(
+                    knee["rate_rps"], seed=args.seed + 1
+                ),
+                "deadline_s": deadline_s,
+                "initial_batch": knee["batch"],
+                "min_batch": knee["batch"],
+                "max_batch": knee["batch"],
+            },
+            slo_windows_s=(2.0, 30.0),
+            slo_error_budget=0.05,
+        )
+        harness.build()
+        try:
+            flip = harness.run(
+                traffic_s=args.traffic_s,
+                rollout_mode=args.mode,
+                max_unavailable=args.max_unavailable,
+                slo_max_burn_rate=2.0,
+                slo_window_s=2.0,
+                slo_max_pause_s=30.0,
+            )
+        finally:
+            harness.shutdown()
+        slo_pauses = flip.get("rollout_slo_pauses")
+
+    return {
+        "metric": "open_loop_overload_sweep",
+        "nodes": args.nodes,
+        "rate_s": args.rate_s,
+        "deadline_ms": args.deadline_ms,
+        "seed": args.seed,
+        "rates": rows,
+        "knee": knee,
+        "goodput_holds_past_knee": holds,
+        "flip_at_knee": flip,
+        "rollout_slo_pauses": slo_pauses,
+        "calibration": calibration,
+        "ok": bool(
+            knee is not None
+            and swept_past
+            and holds
+            and all(r["ok"] for r in rows)
+            and flip is not None
+            and flip["rollout_ok"]
+            and flip["requests_lost"] == 0
+            and flip["nodes_bounced"] == args.nodes
+            and flip["conserved"]
+        ),
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -38,6 +189,19 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--calibrate-smoke", action="store_true",
                         help="run one real llama smoke and calibrate the "
                         "executor's latency/bandwidth model from it")
+    parser.add_argument("--sweep", default=None,
+                        help="comma-separated offered rates (rps): run the "
+                        "open-loop overload sweep + flip-at-the-knee "
+                        "(SERVE_r02) instead of the closed-loop flip")
+    parser.add_argument("--rate-s", type=float, default=2.5,
+                        help="traffic seconds per sweep rate point")
+    parser.add_argument("--deadline-ms", type=float, default=500.0,
+                        help="per-request completion deadline (admission "
+                        "control sheds when the budget is provably spent)")
+    parser.add_argument("--seed", type=int, default=20260804)
+    parser.add_argument("--partial", default=None,
+                        help="resumable sweep rows (JSONL): ok:true rates "
+                        "are skipped on re-run")
     parser.add_argument("--out", default=None,
                         help="also write the JSON line to this file")
     args = parser.parse_args(argv)
@@ -66,6 +230,15 @@ def main(argv: list[str] | None = None) -> int:
         executor_factory = (
             lambda: SimulatedExecutor.from_smoke_result(smoke)
         )
+
+    if args.sweep:
+        result = run_sweep(args, executor_factory, calibration)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return 0 if result["ok"] else 1
 
     harness = ServeHarness(
         n_nodes=args.nodes,

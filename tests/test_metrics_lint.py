@@ -43,7 +43,12 @@ def test_live_registry_render_passes_lint():
     registry.set_serve_inflight("serve-node-0", 2)
     registry.record_serve_outcome("serve-node-0", "completed", 3)
     registry.record_serve_outcome("serve-node-0", "bounced")
+    registry.record_serve_outcome("serve-node-0", "shed", 4)
     registry.record_serve_lost(2)
+    registry.record_serve_deadline_miss("serve-node-0", 6)
+    registry.set_serve_offered_rps(640.5)
+    registry.record_slo_pause()
+    registry.record_slo_pause()
     registry.set_serve_goodput(123.4)
     registry.set_serve_slo(30.0, 0.08, 1.5)
     registry.set_serve_slo(300.0, None, 0.0)  # empty window: burn only
@@ -64,6 +69,13 @@ def test_live_registry_render_passes_lint():
         in text
     )
     assert "tpu_cc_serve_lost_total 2" in text
+    assert (
+        'tpu_cc_serve_requests_total{node="serve-node-0",outcome="shed"} 4'
+        in text
+    )
+    assert 'tpu_cc_serve_deadline_miss_total{node="serve-node-0"} 6' in text
+    assert "tpu_cc_serve_offered_rps 640.500" in text
+    assert "tpu_cc_rollout_slo_pauses_total 2" in text
     assert "tpu_cc_serve_goodput_rps 123.400" in text
     assert 'tpu_cc_serve_slo_p99_seconds{window="30"} 0.080000' in text
     assert 'tpu_cc_serve_error_budget_burn{window="30"} 1.500000' in text

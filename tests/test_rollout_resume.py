@@ -39,7 +39,21 @@ ROLLING_CRASH_POINTS = [
     "mid-window",
     "awaited",
     "window-boundary",
+    "slo-paused",
 ]
+
+
+def one_breach_gate():
+    """An SLO gate that reports breached on its FIRST poll and recovered
+    ever after — the cheapest deterministic way to drive the orchestrator
+    through its slo-paused crash point (pause -> recover -> resume)."""
+    polls = {"n": 0}
+
+    def gate() -> bool:
+        polls["n"] += 1
+        return polls["n"] == 1
+
+    return gate
 
 
 class Clock:
@@ -292,7 +306,13 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
 
     lease_a = make_lease(fake, "orch-a", clk, metrics=metrics, duration_s=30)
     lease_a.acquire()
-    roller_a = make_roller(fake, lease=lease_a, crash_hook=killer)
+    # Every run carries a one-breach SLO gate so the kill loop reaches
+    # the slo-paused crash point too (pause at the first boundary,
+    # recover on the next poll) — a kill landing INSIDE the pause is the
+    # "orchestrator dies while latency-paused" scenario.
+    roller_a = make_roller(
+        fake, lease=lease_a, crash_hook=killer, slo_gate=one_breach_gate()
+    )
     killed = False
     try:
         result = roller_a.rollout("on")
@@ -306,8 +326,12 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
         record = lease_b.acquire()
         assert record is not None, "no resumable record after the kill"
         assert record.status == rollout_state.RECORD_IN_PROGRESS
+        # The gate config survived the kill: the record stays
+        # latency-gated and the successor re-arms it.
+        assert record.slo_gate is not None
         roller_b = make_roller(
-            fake, lease=lease_b, resume_record=record, metrics=metrics
+            fake, lease=lease_b, resume_record=record, metrics=metrics,
+            slo_gate=one_breach_gate(),
         )
         result = roller_b.rollout(record.mode)
         assert result.resumed is True
@@ -1279,3 +1303,282 @@ def test_informer_selector_mismatch_is_rejected(fake_kube):
     informer = NodeInformer(fake_kube, "pool=other")
     with pytest.raises(ValueError):
         make_roller(fake_kube, informer=informer)
+
+
+# ---------------------------------------------------------------------------
+# SLO-paced rollouts (ISSUE 14): the wave-boundary gate pauses, resumes,
+# halts like the failure budget, and survives a crash + --resume.
+# ---------------------------------------------------------------------------
+
+
+def _flight(tmp_path, name="slo.jsonl"):
+    from tpu_cc_manager.obs import flight as flight_mod
+
+    return flight_mod.FlightRecorder(str(tmp_path / name))
+
+
+def _flight_events(recorder):
+    from tpu_cc_manager.obs import flight as flight_mod
+
+    events, torn = flight_mod.read_events(recorder.path)
+    assert torn == 0
+    return [e["event"] for e in events]
+
+
+def test_slo_breach_pauses_next_wave_and_recovery_resumes_it(
+    fake_kube, tmp_path
+):
+    """Induced burn pauses the next wave within ONE boundary (slo-paused
+    journaled before any further window opens), recovery resumes it
+    (slo-resumed), and the rollout still converges every node."""
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    add_pool(fake_kube, 3)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    flight = _flight(tmp_path)
+    metrics = MetricsRegistry()
+    windows_opened = []
+
+    breached = {"on": False}
+
+    def gate():
+        was = breached["on"]
+        breached["on"] = False  # recover on the next poll
+        return was
+
+    def hook(point):
+        if point == "window-boundary" and not windows_opened:
+            # Burn starts right after the first window: the SECOND
+            # window must pause before opening.
+            windows_opened.append(point)
+            breached["on"] = True
+
+    roller = make_roller(
+        fake_kube, crash_hook=hook, slo_gate=gate,
+        slo_config=rolling_mod.SloGateConfig(max_burn_rate=2.0,
+                                             max_pause_s=5.0),
+        metrics=metrics, flight=flight,
+    )
+    result = roller.rollout("on")
+    assert result.ok
+    assert all(counts.get(f"node-{i}") == 1 for i in range(3))
+    names = _flight_events(flight)
+    # Pause journaled between the first window's close and the second's
+    # open — within one boundary of the induced burn.
+    assert "slo-paused" in names and "slo-resumed" in names
+    first_close = names.index("window-close")
+    assert names.index("slo-paused") > first_close
+    second_open = [i for i, n in enumerate(names)
+                   if n == "window-open"][1]
+    assert names.index("slo-paused") < second_open
+    assert metrics.rollout_totals()["slo_pauses"] == 1
+
+
+def test_sustained_slo_burn_halts_like_the_failure_budget(
+    fake_kube, tmp_path
+):
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    add_pool(fake_kube, 3)
+    agent_simulator(fake_kube)
+    flight = _flight(tmp_path)
+    roller = make_roller(
+        fake_kube,
+        slo_gate=lambda: True,  # never recovers
+        slo_config=rolling_mod.SloGateConfig(max_pause_s=0.2),
+        flight=flight,
+    )
+    result = roller.rollout("on")
+    assert result.ok is False
+    assert result.halted_reason == "slo-burn-exceeded"
+    # Nothing was bounced: the gate held the FIRST window too.
+    assert result.groups == []
+    names = _flight_events(flight)
+    assert "slo-paused" in names and "slo-halt" in names
+    assert "window-open" not in names
+
+
+def test_sharded_waves_all_stop_on_slo_halt(fake_kube):
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    add_zoned_pool(fake_kube, 6)
+    agent_simulator(fake_kube)
+    polls = {"n": 0}
+
+    def gate():
+        polls["n"] += 1
+        return polls["n"] > 2  # healthy start, then sustained burn
+
+    roller = make_roller(
+        fake_kube, wave_shards=2, slo_gate=gate,
+        slo_config=rolling_mod.SloGateConfig(max_pause_s=0.2),
+    )
+    result = roller.rollout("on")
+    assert result.ok is False
+    assert result.halted_reason == "slo-burn-exceeded"
+
+
+def test_kill_while_slo_paused_resume_rearms_the_gate(fake_kube, tmp_path):
+    """The chaos acceptance bar: SIGKILL the orchestrator AT the
+    slo-paused crash point; the successor's --resume re-arms the gate
+    from the record (config persisted, gate polled again) and converges
+    with no double bounce."""
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    add_pool(fake_kube, 3)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    clk = Clock()
+    metrics = MetricsRegistry()
+    flight = _flight(tmp_path)
+    cfg = rolling_mod.SloGateConfig(
+        max_burn_rate=3.5, p99_target_ms=250.0, max_pause_s=5.0,
+        source="http://serve-pool:9100/metrics",
+    )
+
+    def kill_at_pause(point):
+        if point == "slo-paused":
+            raise OrchestratorKilled(point, 0)
+
+    lease_a = make_lease(fake_kube, "orch-a", clk, metrics=metrics,
+                         duration_s=30)
+    lease_a.acquire()
+    roller_a = make_roller(
+        fake_kube, lease=lease_a, crash_hook=kill_at_pause,
+        slo_gate=one_breach_gate(), slo_config=cfg, flight=flight,
+    )
+    with pytest.raises(OrchestratorKilled):
+        roller_a.rollout("on")
+
+    clk.advance(31)
+    lease_b = make_lease(fake_kube, "orch-b", clk, metrics=metrics,
+                         duration_s=30)
+    record = lease_b.acquire()
+    assert record is not None
+    # The full gate config survived the kill, exactly as configured.
+    assert record.slo_gate == cfg.to_dict()
+    rearmed = rolling_mod.SloGateConfig.from_dict(record.slo_gate)
+    assert rearmed.max_burn_rate == 3.5
+    assert rearmed.p99_target_ms == 250.0
+    assert rearmed.source == "http://serve-pool:9100/metrics"
+    gate_b = one_breach_gate()
+    roller_b = make_roller(
+        fake_kube, lease=lease_b, resume_record=record, metrics=metrics,
+        slo_gate=gate_b, slo_config=rearmed, flight=flight,
+    )
+    result = roller_b.rollout(record.mode)
+    assert result.ok and result.resumed
+    assert all(counts.get(f"node-{i}") == 1 for i in range(3)), counts
+    # The successor checkpointed the gate back into its own record
+    # lineage AND actually paused on it (its one-breach gate fired).
+    assert metrics.rollout_totals()["slo_pauses"] >= 1
+    names = _flight_events(flight)
+    assert names.count("slo-paused") >= 2  # one per orchestrator
+
+
+def test_slo_gate_failure_reads_not_breached(fake_kube):
+    """A gate that RAISES must not wedge the rollout: fail-open, logged,
+    rollout proceeds (the failure budget still guards real damage)."""
+    add_pool(fake_kube, 2)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+
+    def broken_gate():
+        raise RuntimeError("scrape endpoint died")
+
+    result = make_roller(fake_kube, slo_gate=broken_gate).rollout("on")
+    assert result.ok
+    assert all(counts.get(f"node-{i}") == 1 for i in range(2))
+
+
+def test_metrics_gate_judges_scraped_exposition():
+    """ctl's remote gate: breached/not-breached judged from a scraped
+    /metrics payload via obs/slo.py's parser — the same nearest-rank
+    gauges the serving pool exports."""
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    healthy = (
+        'tpu_cc_serve_slo_p99_seconds{window="5"} 0.050000\n'
+        'tpu_cc_serve_error_budget_burn{window="5"} 0.200000\n'
+    )
+    burning = (
+        'tpu_cc_serve_slo_p99_seconds{window="5"} 0.900000\n'
+        'tpu_cc_serve_error_budget_burn{window="5"} 14.000000\n'
+    )
+    payload = {"text": healthy}
+    cfg = rolling_mod.SloGateConfig(
+        max_burn_rate=1.0, p99_target_ms=500.0,
+        source="http://pool:9100/metrics",
+    )
+    gate = rolling_mod.metrics_gate(cfg, fetch=lambda url: payload["text"])
+    assert gate() is False
+    payload["text"] = burning
+    assert gate() is True
+    # p99 target alone trips it too (burn below budget).
+    payload["text"] = (
+        'tpu_cc_serve_slo_p99_seconds{window="5"} 0.900000\n'
+        'tpu_cc_serve_error_budget_burn{window="5"} 0.100000\n'
+    )
+    assert gate() is True
+    # A dead scrape endpoint fails OPEN (not breached, logged).
+    def dead(url):
+        raise OSError("connection refused")
+
+    gate2 = rolling_mod.metrics_gate(cfg, fetch=dead)
+    assert gate2() is False
+    # An empty scrape (pool exports no SLO yet) is not evidence either.
+    payload["text"] = ""
+    assert gate() is False
+
+
+def test_library_resume_of_gated_record_never_proceeds_ungated(fake_kube):
+    """A latency-gated record resumed WITHOUT a gate callable must not
+    bounce the pool at full speed: with a persisted metrics source the
+    gate is rebuilt (fail-open on a dead endpoint, loudly); without one
+    the resume is refused."""
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+
+    add_pool(fake_kube, 2)
+    counts: dict = {}
+    agent_simulator(fake_kube, converge_counts=counts)
+    clk = Clock()
+
+    def run_gated_then_crash(cfg):
+        lease = make_lease(fake_kube, "orch-a", clk, duration_s=30)
+        lease.acquire()
+        roller = make_roller(
+            fake_kube, lease=lease,
+            crash_hook=lambda p: (_ for _ in ()).throw(
+                OrchestratorKilled(p, 0)
+            ) if p == "planned" else None,
+            slo_gate=lambda: False, slo_config=cfg,
+        )
+        with pytest.raises(OrchestratorKilled):
+            roller.rollout("on")
+        clk.advance(31)
+        lease_b = make_lease(fake_kube, "orch-b", clk, duration_s=30)
+        return lease_b, lease_b.acquire()
+
+    # Sourceless persisted gate (in-process evaluator): refuse.
+    lease_b, record = run_gated_then_crash(
+        rolling_mod.SloGateConfig(max_pause_s=7.0)
+    )
+    roller_b = make_roller(fake_kube, lease=lease_b, resume_record=record)
+    with pytest.raises(ValueError, match="latency-gated"):
+        roller_b.rollout(record.mode)
+    lease_b.release(clear_record=True)
+
+    # Persisted source: the remote gate is rebuilt and the rollout
+    # converges (the dead endpoint reads NOT breached, fail-open).
+    lease_c, record_c = run_gated_then_crash(
+        rolling_mod.SloGateConfig(
+            max_pause_s=7.0, source="http://127.0.0.1:1/metrics",
+        )
+    )
+    roller_c = make_roller(fake_kube, lease=lease_c, resume_record=record_c)
+    result = roller_c.rollout(record_c.mode)
+    assert result.ok
+    assert roller_c.slo_gate is not None
+    assert roller_c.slo_config.max_pause_s == 7.0  # rehydrated, not default
+    assert all(counts.get(f"node-{i}") == 1 for i in range(2)), counts

@@ -366,3 +366,253 @@ def test_executor_calibration_from_smoke_result():
     # And the model stays a monotone, capped lower-bound shape.
     assert ex.hbm_bw_util(8) > ex.hbm_bw_util(4)
     assert ex.hbm_bw_util(1000) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Open-loop overload serving (ISSUE 14 / SERVE_r02): rate-driven arrivals,
+# admission control, knee finding — seeded property tests + tier-1 smoke
+# ---------------------------------------------------------------------------
+
+
+def test_poisson_schedule_is_seeded_and_matches_rate():
+    from tpu_cc_manager.serve.driver import PoissonSchedule
+
+    a = PoissonSchedule(200.0, seed=42)
+    b = PoissonSchedule(200.0, seed=42)
+    gaps_a = [a.next_interarrival_s(0.0) for _ in range(3000)]
+    gaps_b = [b.next_interarrival_s(0.0) for _ in range(3000)]
+    assert gaps_a == gaps_b, "same seed must give the same schedule"
+    mean = sum(gaps_a) / len(gaps_a)
+    # 3000 exponential samples: the mean interarrival is 1/rate within a
+    # few percent (sigma/sqrt(n) ~ 1.8%).
+    assert abs(mean - 1 / 200.0) < 0.1 / 200.0 * 10, mean
+    assert PoissonSchedule(200.0, seed=1).next_interarrival_s(0.0) != gaps_a[0]
+
+
+def test_ramp_schedule_rate_ramps_linearly():
+    from tpu_cc_manager.serve.driver import RampSchedule
+
+    s = RampSchedule(100.0, 500.0, duration_s=10.0, seed=3)
+    assert s.rate_at(0.0) == pytest.approx(100.0)
+    assert s.rate_at(5.0) == pytest.approx(300.0)
+    assert s.rate_at(10.0) == pytest.approx(500.0)
+    assert s.rate_at(99.0) == pytest.approx(500.0)  # holds after the ramp
+    assert s.next_interarrival_s(0.0) > 0
+
+
+def test_open_loop_offered_matches_scheduled_rate():
+    """The no-coordinated-omission property: the driver mints arrivals at
+    the SCHEDULE's rate, regardless of what the pool absorbs. Measured
+    against a real (fast) pool over ~1s of traffic."""
+    from tpu_cc_manager.serve import sweep as sweep_mod
+    from tpu_cc_manager.serve.server import SimulatedExecutor
+
+    rate = 400.0
+    row = sweep_mod.run_rate_point(
+        rate, n_nodes=1, traffic_s=1.0, deadline_s=0.5, seed=11,
+        executor_factory=lambda: SimulatedExecutor(
+            base_s=0.0005, per_token_s=0.0005,
+        ),
+    )
+    # Poisson noise at ~400 samples is ~5%; 15% tolerance is safely wide
+    # while still catching a closed-loop regression (which would track
+    # the pool's capacity, not the schedule).
+    assert row["offered_rps"] == pytest.approx(rate, rel=0.15), row
+    assert row["conserved"], row
+
+
+def test_open_loop_conservation_under_overload():
+    """shed + completed + lost == issued at ~4x overload, with lost == 0:
+    every refused request is an explicit, counted shed — nothing leaks."""
+    from tpu_cc_manager.serve import sweep as sweep_mod
+    from tpu_cc_manager.serve.server import SimulatedExecutor
+
+    row = sweep_mod.run_rate_point(
+        2000.0, n_nodes=1, traffic_s=1.0, deadline_s=0.3, seed=5,
+        executor_factory=lambda: SimulatedExecutor(
+            base_s=0.001, per_token_s=0.002,
+        ),
+    )
+    assert row["conserved"], row
+    assert row["lost"] == 0, row
+    assert row["shed"] > 0, "4x overload must shed"
+    assert row["issued"] == row["completed"] + row["shed"] + row["lost"]
+
+
+def test_admission_control_sheds_spent_deadlines_at_intake(fake_kube):
+    """Intake estimates queue delay from queue depth x the executor's
+    calibrated per-token rate and sheds requests whose deadline budget
+    is already spent — BEFORE they burn capacity."""
+    fake_kube.add_node(NODE)
+    shed, lock = [], threading.Lock()
+
+    def on_shed(node, reqs):
+        with lock:
+            shed.extend(reqs)
+
+    done, requeued, on_complete, on_requeue = collecting_callbacks()
+    server = NodeServer(
+        fake_kube, NODE, on_complete, on_requeue, on_shed=on_shed,
+        executor=SimulatedExecutor(base_s=0.0, per_token_s=0.01),
+        poll_interval_s=5.0,
+    )
+    server.start()
+    try:
+        now = time.monotonic()
+        # 100 tokens x 10ms = 1s of work in flight: the queue estimate.
+        busy = [Request(1, 100, now)]
+        assert server.submit(busy)
+        retry_mod.poll_until(lambda: server.queue_delay_estimate_s() > 0.5,
+                             2.0, 0.01)
+        # Deadline budget 50ms < ~1s estimated queue delay: shed.
+        doomed = Request(2, 8, now, deadline_at=now + 0.05)
+        assert server.submit([doomed])
+        assert retry_mod.poll_until(lambda: len(shed) == 1, 2.0, 0.01)
+        assert shed[0].req_id == 2
+        assert doomed.attempts == 0, "a shed request was never admitted"
+        # A generous budget is admitted alongside.
+        fine = Request(3, 8, now, deadline_at=now + 30.0)
+        assert server.submit([fine])
+        assert fine.attempts == 1
+        assert retry_mod.poll_until(
+            lambda: any(r.req_id == 3 for r in done), 10.0, 0.02,
+        )
+    finally:
+        server.stop()
+
+
+def test_deadline_miss_counted_separately_from_shed():
+    """An ACCEPTED request completing past its deadline is a miss (burns
+    the error budget, counted per node) — not a shed, not a loss."""
+    from tpu_cc_manager.obs.slo import SloEvaluator
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    metrics = MetricsRegistry()
+    slo = SloEvaluator(windows_s=(30.0,), error_budget=1e-2)
+    driver = TrafficDriver({}, metrics=metrics, slo=slo)
+    now = 1000.0
+    hit = Request(1, 8, submitted_at=now, deadline_at=now + 1.0,
+                  completed_at=now + 0.5)
+    miss = Request(2, 8, submitted_at=now, deadline_at=now + 1.0,
+                   completed_at=now + 2.0)
+    driver._outstanding["n0"] = 2
+    driver.on_complete("n0", hit, 0.5)
+    driver.on_complete("n0", miss, 0.5)
+    totals = metrics.serve_totals()
+    assert totals["deadline_misses"] == {"n0": 1}
+    assert totals["outcomes"][("n0", "completed")] == 2
+    report = driver.report()
+    assert report["deadline_misses"] == 1
+    assert report["completed_within_deadline"] == 1
+    # The miss burned the budget; the hit did not.
+    assert slo.snapshot()["errors_total"] == 1
+
+
+def test_find_knee_properties():
+    """Pure-function knee properties on synthetic sweep rows: the knee is
+    the LAST tracking+bounded rate; goodput monotone non-increasing past
+    the knee holds; a fully-collapsed sweep has no knee."""
+    from tpu_cc_manager.serve import sweep as sweep_mod
+
+    def row(rate, goodput, qd99):
+        return {"rate_rps": rate, "offered_rps": rate,
+                "goodput_rps": goodput, "queue_delay_p99_ms": qd99,
+                "deadline_ms": 500.0}
+
+    rows = [
+        row(100, 100, 20), row(200, 199, 30), row(400, 396, 60),
+        row(800, 640, 480), row(1600, 560, 490),
+    ]
+    knee = sweep_mod.find_knee(rows)
+    assert knee["rate_rps"] == 400  # 800 tracks only 80% < 0.95
+    assert sweep_mod.goodput_holds_past_knee(rows, knee)  # >= 0.8*396
+    # Goodput past the knee is monotone non-increasing here — the shape
+    # shedding is supposed to produce (collapse would violate hold).
+    past = [r["goodput_rps"] for r in rows if r["rate_rps"] > 400]
+    assert past == sorted(past, reverse=True)
+    # An unbounded queue delay disqualifies an otherwise-tracking rate.
+    rows2 = [row(100, 100, 20), row(200, 199, 9000)]
+    assert sweep_mod.find_knee(rows2)["rate_rps"] == 100
+    # Collapse (goodput to ~zero) past the knee fails the hold bar.
+    rows3 = [row(100, 100, 20), row(200, 30, 40), row(400, 5, 50)]
+    knee3 = sweep_mod.find_knee(rows3)
+    assert knee3["rate_rps"] == 100
+    assert not sweep_mod.goodput_holds_past_knee(rows3, knee3)
+    # Every rate already past the knee: no knee at all.
+    assert sweep_mod.find_knee([row(100, 10, 9000)]) is None
+
+
+def test_tiny_open_loop_sweep_smoke():
+    """The tier-1 seconds-scale smoke: a 3-rate sweep on a fast fake
+    executor finds a knee, conserves every request, and holds goodput
+    past the knee (the SERVE_r02 shape end to end, minus the flip)."""
+    from tpu_cc_manager.serve import sweep as sweep_mod
+    from tpu_cc_manager.serve.server import SimulatedExecutor
+
+    factory = lambda: SimulatedExecutor(base_s=0.0005, per_token_s=0.0005)
+    rows = [
+        sweep_mod.run_rate_point(
+            rate, n_nodes=1, traffic_s=0.8, deadline_s=0.25, seed=9,
+            executor_factory=factory,
+        )
+        for rate in (300.0, 1200.0, 2400.0)
+    ]
+    assert all(r["conserved"] and r["lost"] == 0 for r in rows), rows
+    knee = sweep_mod.find_knee(rows)
+    assert knee is not None, rows
+    assert any(r["rate_rps"] > knee["rate_rps"] for r in rows), (
+        "the sweep must go past the knee to prove anything"
+    )
+    assert sweep_mod.goodput_holds_past_knee(rows, knee), rows
+    # Overload sheds; the knee does not (or barely).
+    assert rows[-1]["shed_rate"] > rows[0]["shed_rate"]
+
+
+def test_open_loop_overload_flip_sheds_but_never_loses(tmp_path):
+    """The SERVE_r02 acceptance shape in tier-1: a rolling CC flip while
+    an OPEN-LOOP overload-adjacent load keeps arriving on schedule.
+    Accepted requests are never lost; refusals are explicit sheds; the
+    during-rollout shed/miss buckets use the same overlap rule as
+    latency."""
+    from tpu_cc_manager.serve.driver import PoissonSchedule
+
+    harness = ServeHarness(
+        n_nodes=3, tmp_dir=str(tmp_path), checkpoint_full_s=0.05,
+        driver_kwargs={
+            "schedule": PoissonSchedule(900.0, seed=13),
+            "deadline_s": 0.5,
+            "initial_batch": 8, "min_batch": 8, "max_batch": 8,
+        },
+        slo_windows_s=(2.0, 30.0),
+        slo_error_budget=0.05,
+    )
+    harness.build()
+    try:
+        report = harness.run(
+            traffic_s=3.0, rollout_mode="on",
+            slo_max_burn_rate=5.0, slo_window_s=2.0, slo_max_pause_s=20.0,
+        )
+    finally:
+        harness.shutdown()
+    print("SERVE_OVERLOAD_SUMMARY " + json.dumps({
+        k: report[k] for k in (
+            "requests_issued", "requests_completed", "requests_lost",
+            "requests_shed", "shed_rate", "deadline_misses",
+            "offered_rps", "goodput_rps", "conserved", "nodes_bounced",
+            "shed_during_rollout", "shed_steady_state",
+            "deadline_miss_during_rollout", "deadline_miss_steady_state",
+            "rollout_slo_pauses", "rollout_wall_s",
+        )
+    }))
+    assert report["rollout_ok"], report["rollout_summary"]
+    assert report["nodes_bounced"] == 3
+    assert report["requests_lost"] == 0, report
+    assert report["conserved"], report
+    assert report["requests_completed"] > 0
+    assert report["offered_rps"] == pytest.approx(900.0, rel=0.2)
+    # Shed/miss bucket splits are conserved against their totals.
+    assert (report["shed_during_rollout"] + report["shed_steady_state"]
+            == report["requests_shed"])
+    assert (report["deadline_miss_during_rollout"]
+            + report["deadline_miss_steady_state"]
+            == report["deadline_misses"])

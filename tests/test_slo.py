@@ -210,3 +210,31 @@ def test_constructor_rejects_degenerate_configs():
         SloEvaluator(windows_s=())
     with pytest.raises(ValueError):
         SloEvaluator(error_budget=0.0)
+
+
+def test_parse_serve_slo_text_roundtrips_the_exported_gauges():
+    """The remote gate's parser reads back exactly what the registry
+    renders — the two ends of the ctl --slo-source loop cannot drift."""
+    from tpu_cc_manager.obs import slo as slo_mod
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    registry.set_serve_slo(5.0, 0.042, 1.25)
+    registry.set_serve_slo(30.0, None, 0.0)  # empty window: burn only
+    parsed = slo_mod.parse_serve_slo_text(registry.render_prometheus())
+    assert parsed[5.0]["p99_s"] == pytest.approx(0.042)
+    assert parsed[5.0]["burn_rate"] == pytest.approx(1.25)
+    assert "p99_s" not in parsed[30.0]  # no invented sample
+    assert parsed[30.0]["burn_rate"] == 0.0
+    # breached judges the FASTEST window by default, like the evaluator.
+    assert slo_mod.breached_from_metrics_text(
+        registry.render_prometheus(), max_burn_rate=1.0,
+    ) is True
+    assert slo_mod.breached_from_metrics_text(
+        registry.render_prometheus(), max_burn_rate=1.0, window_s=30.0,
+    ) is False
+    assert slo_mod.breached_from_metrics_text(
+        registry.render_prometheus(), max_burn_rate=2.0,
+        p99_target_s=0.01,
+    ) is True  # p99 target trips it even under budget
+    assert slo_mod.breached_from_metrics_text("", 1.0) is False

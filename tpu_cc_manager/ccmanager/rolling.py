@@ -183,7 +183,131 @@ CRASH_POINTS = (
     "mid-window",
     "awaited",
     "window-boundary",
+    # Fired the moment the SLO gate pauses a wave: a kill here models the
+    # orchestrator dying while latency-paused, and --resume must re-arm
+    # the gate from the record (tests/test_rollout_resume.py).
+    "slo-paused",
 )
+
+
+@dataclasses.dataclass
+class SloGateConfig:
+    """Parameters of the wave-boundary SLO gate (persisted in the
+    RolloutRecord — rollout_state.py v4 — so crash + ``--resume`` stays
+    latency-gated). The gate CALLABLE itself is injected separately
+    (``slo_gate``): in-process it is ``SloEvaluator.breached`` over the
+    live serve metrics (ServeHarness); ``tpu-cc-ctl rollout`` builds one
+    that polls a serving pool's ``/metrics`` (``source``)."""
+
+    #: Halt signal threshold: error-budget burn above this pauses the
+    #: next wave (1.0 = spending exactly as provisioned).
+    max_burn_rate: float = 1.0
+    #: Optional absolute p99 target (ms); breached when exceeded.
+    p99_target_ms: float | None = None
+    #: SLO window the gate judges (None = the evaluator's fastest).
+    window_s: float | None = None
+    #: Pause budget: burn sustained past this halts the rollout like the
+    #: failure budget does (a pool that cannot recover its SLO should
+    #: stop being reconfigured, not wait forever half-flipped).
+    max_pause_s: float = 300.0
+    #: Metrics URL a remote gate polls (ctl); None for in-process gates.
+    source: str | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "max_burn_rate": self.max_burn_rate,
+            "p99_target_ms": self.p99_target_ms,
+            "window_s": self.window_s,
+            "max_pause_s": self.max_pause_s,
+            "source": self.source,
+        }
+
+    @classmethod
+    def from_dict(cls, obj: dict) -> "SloGateConfig":
+        # `is not None` throughout: 0.0 is a meaningful persisted value
+        # for max_burn_rate (pause on ANY burn) and max_pause_s (halt
+        # immediately on breach) — a falsy fallback would silently
+        # weaken the gate on resume, the exact drop the v4 record
+        # format exists to prevent.
+        return cls(
+            max_burn_rate=(
+                float(obj["max_burn_rate"])
+                if obj.get("max_burn_rate") is not None else 1.0
+            ),
+            p99_target_ms=(
+                float(obj["p99_target_ms"])
+                if obj.get("p99_target_ms") is not None else None
+            ),
+            window_s=(
+                float(obj["window_s"])
+                if obj.get("window_s") is not None else None
+            ),
+            max_pause_s=(
+                float(obj["max_pause_s"])
+                if obj.get("max_pause_s") is not None else 300.0
+            ),
+            source=obj.get("source") or None,
+        )
+
+
+def metrics_gate(config: SloGateConfig, fetch=None):
+    """Build a gate callable that scrapes ``config.source`` (a serving
+    pool's ``/metrics``) and judges it with
+    :func:`~tpu_cc_manager.obs.slo.breached_from_metrics_text` — the
+    remote form ``tpu-cc-ctl rollout --slo-source`` uses. A failed
+    scrape reads NOT breached (fail-open, logged): missing telemetry
+    must pause nobody — the gate protects users from the rollout, not
+    the rollout from a dead scrape endpoint."""
+    from tpu_cc_manager.obs import slo as slo_mod
+
+    if fetch is None:
+        def fetch(url: str) -> str:  # pragma: no cover - trivial I/O
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.read().decode("utf-8", "replace")
+
+    warned_window = [False]
+
+    def gate() -> bool:
+        try:
+            text = fetch(config.source)
+        except Exception as e:  # noqa: BLE001 - fail-open by design
+            log.warning(
+                "SLO gate scrape of %s failed (%s); reading NOT breached",
+                config.source, e,
+            )
+            return False
+        windows = slo_mod.parse_serve_slo_text(text)
+        if not windows:
+            return False  # no SLO gauges exported: no evidence
+        if config.window_s is not None:
+            stats = windows.get(float(config.window_s))
+            if stats is None:
+                if not warned_window[0]:
+                    # A typo'd --slo-window would otherwise disable the
+                    # gate SILENTLY for the whole rollout (no matching
+                    # gauge = no evidence = not breached, forever).
+                    warned_window[0] = True
+                    log.warning(
+                        "SLO gate window %ss is not among the windows "
+                        "%s exports (%s); the gate reads NOT breached "
+                        "until that window appears — check --slo-window",
+                        config.window_s, config.source, sorted(windows),
+                    )
+                return False
+        else:
+            stats = windows[min(windows)]
+        return slo_mod.breach_verdict(
+            stats.get("burn_rate", 0.0), stats.get("p99_s"),
+            config.max_burn_rate,
+            (
+                config.p99_target_ms / 1e3
+                if config.p99_target_ms is not None else None
+            ),
+        )
+
+    return gate
 
 #: Terminal await-state for a node whose Node OBJECT vanished mid-window
 #: (cluster-autoscaler scale-down, spot reclaim). The informer delivers
@@ -273,6 +397,8 @@ class RollingReconfigurator:
         surge: int = 0,
         adopt_new_nodes: bool = True,
         flight: "flight_mod.FlightRecorder | None" = None,
+        slo_gate=None,
+        slo_config: "SloGateConfig | None" = None,
     ) -> None:
         # Crash safety: with a lease, every write goes through the fence
         # (a lost lease refuses further patches) and progress is
@@ -394,6 +520,25 @@ class RollingReconfigurator:
         self.flight = flight
         if flight is not None and self.generation is not None:
             flight.set_generation(self.generation)
+        # SLO-paced rollouts (ROADMAP item 1): ``slo_gate`` is a zero-arg
+        # callable returning True while the serving SLO is breached
+        # (SloEvaluator.breached over the live serve metrics, or the
+        # remote metrics_gate). Polled at EVERY wave boundary in both
+        # the single-shard and sharded window loops: burn above budget
+        # pauses the next wave (bounded, stop-aware), recovery resumes
+        # it, burn sustained past the pause budget halts like the
+        # failure budget. The config (not the callable) is persisted in
+        # the record so crash + --resume stays latency-gated.
+        self.slo_gate = slo_gate
+        # A gate without an explicit config gets defaults — but remember
+        # the config was synthesized: on resume the record's PERSISTED
+        # gate parameters win over synthesized defaults (a library
+        # caller re-arming with just the callable must not clobber the
+        # pause budget / thresholds the record carries).
+        self._slo_config_defaulted = slo_gate is not None and slo_config is None
+        if self._slo_config_defaulted:
+            slo_config = SloGateConfig()
+        self.slo_config = slo_config
 
     def _fl(self, event: str, **fields) -> None:
         """One flight-recorder event (no-op without a recorder)."""
@@ -477,6 +622,83 @@ class RollingReconfigurator:
             len(spend), spend, self.failure_budget,
         )
         return True
+
+    def _slo_breached(self) -> bool:
+        """One gate poll. A gate that RAISES reads as not breached
+        (fail-open, logged): the gate exists to protect users from the
+        rollout, and a broken telemetry path must not wedge the pool
+        half-flipped — the failure budget still guards real damage."""
+        if self.slo_gate is None:
+            return False
+        try:
+            return bool(self.slo_gate())
+        except Exception as e:  # noqa: BLE001 - fail-open by design
+            log.warning("SLO gate poll failed (%s); reading NOT breached", e)
+            return False
+
+    def _slo_gate_wait(
+        self,
+        wave: int | str | None,
+        window: int | str | None,
+        stop: threading.Event | None = None,
+    ) -> bool:
+        """Wave-boundary SLO pacing: when the gate reports the serving
+        SLO breached, pause the next wave — a bounded, stop-aware
+        poll-wait (shared retry-ladder shape) that resumes the moment
+        the window recovers and gives up after the configured pause
+        budget. Returns True to proceed, False when the rollout must
+        halt (sustained burn) or another wave already halted (``stop``
+        set mid-pause — the caller re-checks it and stays silent)."""
+        if not self._slo_breached():
+            return True
+        cfg = self.slo_config or SloGateConfig()
+        self.metrics.record_slo_pause()
+        log.warning(
+            "SLO gate breached at wave %s window %s boundary: pausing "
+            "the next wave (max %.0fs) until the window recovers",
+            wave, window, cfg.max_pause_s,
+        )
+        self._fl(
+            flight_mod.EVENT_SLO_PAUSED, wave=wave, window=window,
+            max_burn_rate=cfg.max_burn_rate,
+            p99_target_ms=cfg.p99_target_ms,
+            max_pause_s=cfg.max_pause_s,
+        )
+        self._crash_point("slo-paused")
+        paused_at = time.monotonic()
+
+        def recovered_or_stopped() -> bool:
+            if stop is not None and stop.is_set():
+                return True
+            return not self._slo_breached()
+
+        recovered = retry_mod.poll_until(
+            recovered_or_stopped, cfg.max_pause_s, self.poll_interval_s
+        )
+        if stop is not None and stop.is_set():
+            return False  # another wave halted; nothing to journal here
+        paused_s = round(time.monotonic() - paused_at, 3)
+        if recovered:
+            log.warning(
+                "SLO window recovered after %.1fs; resuming the wave",
+                paused_s,
+            )
+            self._fl(
+                flight_mod.EVENT_SLO_RESUMED, wave=wave, window=window,
+                paused_s=paused_s,
+            )
+            return True
+        log.error(
+            "SLO burn sustained past the %.0fs pause budget; halting the "
+            "rollout (same contract as the failure budget: a pool that "
+            "cannot hold its SLO stops being reconfigured)",
+            cfg.max_pause_s,
+        )
+        self._fl(
+            flight_mod.EVENT_SLO_HALT, wave=wave, window=window,
+            paused_s=paused_s, reason="slo-burn-exceeded",
+        )
+        return False
 
     def _crash_point(self, point: str) -> None:
         """Named orchestrator crash points for chaos testing: the hook
@@ -594,6 +816,35 @@ class RollingReconfigurator:
             record.failure_budget = self.failure_budget
             record.wave_shards = self.wave_shards
             record.surge = self.surge
+            # Re-persist the gate config when this run carries an
+            # EXPLICIT one; a resume without one — or with only the gate
+            # callable and synthesized default config — keeps (and
+            # rehydrates from) the record's persisted parameters: the
+            # record never silently sheds or weakens its latency gate.
+            if record.slo_gate and (
+                self.slo_config is None or self._slo_config_defaulted
+            ):
+                self.slo_config = SloGateConfig.from_dict(record.slo_gate)
+            elif self.slo_config is not None:
+                record.slo_gate = self.slo_config.to_dict()
+            if record.slo_gate and self.slo_gate is None:
+                # A latency-gated record resumed without a gate callable
+                # must not proceed ungated at full speed: rebuild the
+                # remote gate from the persisted source, or refuse —
+                # the same contract the ctl path and the v4 version
+                # refusal enforce.
+                if self.slo_config is not None and self.slo_config.source:
+                    log.warning(
+                        "resume: re-arming the persisted SLO gate from "
+                        "its metrics source %s", self.slo_config.source,
+                    )
+                    self.slo_gate = metrics_gate(self.slo_config)
+                else:
+                    raise ValueError(
+                        "resuming a latency-gated rollout without a "
+                        "gate: the persisted config has no pollable "
+                        "source, so pass slo_gate= (or abort the record)"
+                    )
         elif self.lease is not None:
             record = rollout_state.RolloutRecord(
                 mode=mode, selector=self.selector,
@@ -602,6 +853,10 @@ class RollingReconfigurator:
                 failure_budget=self.failure_budget,
                 wave_shards=self.wave_shards,
                 surge=self.surge,
+                slo_gate=(
+                    self.slo_config.to_dict()
+                    if self.slo_config is not None else None
+                ),
             )
         if record is not None:
             record.charge_budget(quarantined)
@@ -835,6 +1090,22 @@ class RollingReconfigurator:
                     )
             window = groups[i : i + self.max_unavailable]
             window_id = i // self.max_unavailable
+            # SLO pacing: the gate is polled at every wave boundary —
+            # burn above budget pauses this window until the serving
+            # window recovers; sustained burn halts like the failure
+            # budget (the pool keeps serving; nothing else is bounced).
+            if not self._slo_gate_wait(wave=0, window=window_id):
+                self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+                return RolloutResult(
+                    mode=mode, ok=False, groups=results,
+                    window_seconds=window_seconds,
+                    skipped_quarantined=quarantined,
+                    halted_reason="slo-burn-exceeded",
+                    resumed=resumed, generation=self.generation,
+                    retired_deleted=self._deleted_of(results),
+                    surged=surged,
+                    max_unavailable_observed=self._max_inflight_observed,
+                )
             self._crash_point("window-start")
             started = time.monotonic()
             self._note_window_inflight(len(window))
@@ -1160,6 +1431,13 @@ class RollingReconfigurator:
                         )
                 window = groups[i : i + self.max_unavailable]
                 window_id = i // self.max_unavailable
+                # Adopted windows are real disruption too: the SLO gate
+                # paces them exactly like the main loops.
+                if not self._slo_gate_wait(wave="adopt", window=window_id):
+                    self._checkpoint(
+                        record, status=rollout_state.RECORD_HALTED
+                    )
+                    return sorted(adopted), False, "slo-burn-exceeded"
                 self._crash_point("window-start")
                 started = time.monotonic()
                 self._note_window_inflight(len(window))
@@ -1358,6 +1636,22 @@ class RollingReconfigurator:
                     return
             window = wave[i : i + self.max_unavailable]
             window_id = i // self.max_unavailable
+            # SLO pacing, stop-aware: a pause interrupted by another
+            # wave's halt just stops; a pause that outlasts the budget
+            # halts EVERY wave at its next boundary, like the failure
+            # budget does.
+            if not self._slo_gate_wait(
+                wave=wid, window=window_id, stop=shared["halt"]
+            ):
+                if not shared["halt"].is_set():
+                    with shared["lock"]:
+                        shared["halted_reason"] = "slo-burn-exceeded"
+                        shared["ok"] = False
+                    shared["halt"].set()
+                    self._checkpoint(
+                        record, status=rollout_state.RECORD_HALTED
+                    )
+                return
             self._crash_point("window-start")
             started = time.monotonic()
             self._note_window_inflight(len(window))

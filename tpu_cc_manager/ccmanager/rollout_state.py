@@ -82,13 +82,18 @@ RECORD_HALTED = "halted"
 #: non-surge records stay v2 and older orchestrators keep resuming them;
 #: a surge record resumed by a surge-unaware binary would silently strand
 #: the spares' NoSchedule taints, which is exactly the silent field drop
-#: the version refusal exists to prevent. The parser accepts every
+#: the version refusal exists to prevent.
+#: 4: adds ``slo_gate`` (SLO-paced rollouts) — written ONLY when a gate
+#: is configured, by the same downgrade-compat logic: a latency-gated
+#: record resumed by a gate-unaware binary would silently drop the gate
+#: and bounce a burning pool at full speed. The parser accepts every
 #: version <= the current one — v1 records resume under the sharded
 #: orchestrator unchanged (the wave partition is derived from the plan,
 #: never persisted) — and refuses newer versions loudly rather than
 #: silently dropping fields a successor relied on.
-RECORD_VERSION = 3
-#: What a record WITHOUT the v3 field writes (compatibility floor).
+RECORD_VERSION = 4
+#: What records WITHOUT the newer optional fields write (compat floors).
+RECORD_VERSION_NO_SLO = 3
 RECORD_VERSION_NO_SURGE = 2
 
 
@@ -143,6 +148,12 @@ class RolloutRecord:
     # (rolling.py: re-picking "spares" from serving nodes would exceed
     # max_unavailable behind a taint that evicts nothing).
     surge: int = 0
+    # SLO-paced rollouts (format v4, written only when configured): the
+    # gate's parameters (rolling.SloGateConfig.to_dict() — max burn
+    # rate, p99 target, window, pause budget, metrics source), persisted
+    # so a crash + --resume re-arms the gate instead of silently
+    # resuming a latency-gated rollout ungated.
+    slo_gate: dict | None = None
 
     def charge_budget(self, nodes) -> None:
         self.budget_spend = sorted(set(self.budget_spend) | set(nodes))
@@ -159,11 +170,15 @@ class RolloutRecord:
         }
 
     def to_json(self) -> str:
+        if self.slo_gate:
+            version = RECORD_VERSION
+        elif self.surge:
+            version = RECORD_VERSION_NO_SLO
+        else:
+            version = RECORD_VERSION_NO_SURGE
         return json.dumps(
             {
-                "version": (
-                    RECORD_VERSION if self.surge else RECORD_VERSION_NO_SURGE
-                ),
+                "version": version,
                 "mode": self.mode,
                 "selector": self.selector,
                 "generation": self.generation,
@@ -175,6 +190,7 @@ class RolloutRecord:
                 "status": self.status,
                 "wave_shards": self.wave_shards,
                 "surge": self.surge,
+                "slo_gate": self.slo_gate,
             },
             sort_keys=True, separators=(",", ":"),
         )
@@ -211,6 +227,10 @@ class RolloutRecord:
                 status=str(obj.get("status") or RECORD_IN_PROGRESS),
                 wave_shards=int(obj.get("wave_shards") or 1),
                 surge=int(obj.get("surge") or 0),
+                slo_gate=(
+                    dict(obj["slo_gate"])
+                    if isinstance(obj.get("slo_gate"), dict) else None
+                ),
             )
         except RolloutFenced:
             raise

@@ -162,6 +162,36 @@ def build_parser() -> argparse.ArgumentParser:
         "flight-recorder snapshot) on this port for the rollout's "
         "duration (0 = off)",
     )
+    r.add_argument(
+        "--slo-max-burn-rate", type=float, default=None,
+        help="SLO-paced rollout: pause the next wave while the serving "
+        "pool's error-budget burn rate exceeds this (1.0 = spending "
+        "exactly as provisioned); sustained burn halts like "
+        "--failure-budget. Requires --slo-source. A --resume inherits "
+        "the record's persisted gate when these flags are omitted",
+    )
+    r.add_argument(
+        "--slo-p99-target-ms", type=float, default=None,
+        help="SLO-paced rollout: also pause while the pool's windowed "
+        "p99 latency exceeds this many milliseconds",
+    )
+    r.add_argument(
+        "--slo-window", type=float, default=None,
+        help="which exported SLO window (seconds) the gate judges "
+        "(default: the fastest the pool exports)",
+    )
+    r.add_argument(
+        "--slo-max-pause", type=float, default=None,
+        help="pause budget in seconds: SLO burn sustained past this "
+        "halts the rollout (default 300; on --resume an omitted flag "
+        "keeps the record's persisted value)",
+    )
+    r.add_argument(
+        "--slo-source", default=None,
+        help="URL of the serving pool's /metrics exposition the SLO "
+        "gate polls at wave boundaries (tpu_cc_serve_slo_p99_seconds / "
+        "tpu_cc_serve_error_budget_burn)",
+    )
 
     tl = sub.add_parser(
         "rollout-timeline",
@@ -508,6 +538,75 @@ def cmd_rollout(api, args) -> int:
         wave_shards = 1
     if surge is None:
         surge = 0
+    # SLO-paced rollout: flags build the gate config. A --resume starts
+    # from the gate persisted in the record (a latency-gated rollout
+    # must stay latency-gated across a crash) and overlays any flags
+    # explicitly passed — so `--resume --slo-max-pause 600` extends the
+    # pause budget instead of being silently dropped.
+    from tpu_cc_manager.ccmanager.rolling import SloGateConfig, metrics_gate
+
+    slo_flag_values = {
+        name: getattr(args, name, None)
+        for name in (
+            "slo_max_burn_rate", "slo_p99_target_ms", "slo_window",
+            "slo_max_pause", "slo_source",
+        )
+    }
+    slo_flags_given = any(v is not None for v in slo_flag_values.values())
+    slo_config = None
+    if resume_record is not None and resume_record.slo_gate:
+        slo_config = SloGateConfig.from_dict(resume_record.slo_gate)
+        if slo_flag_values["slo_max_burn_rate"] is not None:
+            slo_config.max_burn_rate = slo_flag_values["slo_max_burn_rate"]
+        if slo_flag_values["slo_p99_target_ms"] is not None:
+            slo_config.p99_target_ms = slo_flag_values["slo_p99_target_ms"]
+        if slo_flag_values["slo_window"] is not None:
+            slo_config.window_s = slo_flag_values["slo_window"]
+        if slo_flag_values["slo_max_pause"] is not None:
+            slo_config.max_pause_s = slo_flag_values["slo_max_pause"]
+        if slo_flag_values["slo_source"] is not None:
+            slo_config.source = slo_flag_values["slo_source"]
+        log.warning(
+            "resume: re-arming the persisted SLO gate (burn<=%s, "
+            "p99<=%sms, source=%s)",
+            slo_config.max_burn_rate, slo_config.p99_target_ms,
+            slo_config.source,
+        )
+    elif slo_flags_given:
+        slo_config = SloGateConfig(
+            max_burn_rate=(
+                slo_flag_values["slo_max_burn_rate"]
+                if slo_flag_values["slo_max_burn_rate"] is not None
+                else 1.0
+            ),
+            p99_target_ms=slo_flag_values["slo_p99_target_ms"],
+            window_s=slo_flag_values["slo_window"],
+            max_pause_s=(
+                slo_flag_values["slo_max_pause"]
+                if slo_flag_values["slo_max_pause"] is not None else 300.0
+            ),
+            source=slo_flag_values["slo_source"],
+        )
+        if not slo_config.source:
+            if lease is not None:
+                lease.release()
+            raise ValueError(
+                "SLO gate flags need --slo-source (the serving pool's "
+                "/metrics URL the gate polls)"
+            )
+    slo_gate = None
+    if slo_config is not None:
+        if not slo_config.source:
+            # A record persisted by an in-process gate (ServeHarness)
+            # has no pollable source; resuming from ctl cannot rebuild
+            # the callable — say so instead of silently ungating.
+            if lease is not None:
+                lease.release()
+            raise ValueError(
+                "persisted SLO gate has no metrics source; re-run with "
+                "--slo-source (or --abort to discard the record)"
+            )
+        slo_gate = metrics_gate(slo_config)
     if mode is None:
         if lease is not None:
             lease.release()
@@ -584,6 +683,8 @@ def cmd_rollout(api, args) -> int:
             surge=surge,
             adopt_new_nodes=not getattr(args, "no_adopt", False),
             flight=flight,
+            slo_gate=slo_gate,
+            slo_config=slo_config,
         )
         result = roller.rollout(mode)
     except rollout_state.RolloutFenced as e:

@@ -284,8 +284,13 @@ def _seeded_registry_text() -> str:
     registry.record_serve_outcome("serve-node-0", "completed", 2)
     registry.record_serve_outcome("serve-node-0", "bounced")
     registry.record_serve_outcome("serve-node-0", "requeued")
+    registry.record_serve_outcome("serve-node-0", "shed", 2)
     registry.record_serve_outcome('odd"node', 'odd"outcome')
     registry.record_serve_lost(1)
+    registry.record_serve_deadline_miss("serve-node-0", 3)
+    registry.record_serve_deadline_miss('odd"node\nname')
+    registry.set_serve_offered_rps(997.25)
+    registry.record_slo_pause()
     registry.set_serve_goodput(812.5)
     registry.set_serve_slo(30.0, 0.059, 0.2)
     registry.set_serve_slo(300.0, None, 0.0)  # empty window: no p99

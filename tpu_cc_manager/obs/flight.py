@@ -64,6 +64,13 @@ EVENT_BUDGET_CHARGE = "budget-charge"
 EVENT_HALT = "halt"
 EVENT_FENCED = "fenced"
 EVENT_COMPLETE = "complete"
+#: SLO pacing (ccmanager/rolling.py slo_gate): the gate paused the next
+#: wave at a boundary / the window recovered and the wave resumed / the
+#: burn outlasted the pause budget and the rollout halted like the
+#: failure budget does. Every pacing decision is journaled.
+EVENT_SLO_PAUSED = "slo-paused"
+EVENT_SLO_RESUMED = "slo-resumed"
+EVENT_SLO_HALT = "slo-halt"
 
 #: Node-terminal events: the exactly-once reconstruction keys on these
 #: (a node converges/fails/retires once per rollout, crash+resume
@@ -252,6 +259,7 @@ def reconstruct(events: list[dict]) -> dict:
     duplicates: list[dict] = []
     windows: dict[tuple, dict] = {}
     halts: list[dict] = []
+    slo_pauses = 0
     resumes: list[dict] = []
     generations: list[int] = []
     plan: dict | None = None
@@ -266,8 +274,10 @@ def reconstruct(events: list[dict]) -> dict:
             plan = e
         elif ev == EVENT_RESUME:
             resumes.append(e)
-        elif ev == EVENT_HALT:
+        elif ev in (EVENT_HALT, EVENT_SLO_HALT):
             halts.append(e)
+        elif ev == EVENT_SLO_PAUSED:
+            slo_pauses += 1
         elif ev == EVENT_SURGE_PICK:
             surged.extend(e.get("nodes") or [])
         elif ev == EVENT_NODE_ADOPTED:
@@ -335,6 +345,7 @@ def reconstruct(events: list[dict]) -> dict:
         "adopted": sorted(n for n in adopted if n),
         "surged": sorted(set(surged)),
         "halts": halts,
+        "slo_pauses": slo_pauses,
         "duplicate_node_events": duplicates,
     }
 

@@ -60,6 +60,27 @@ def percentile(sorted_vals: list[float], q: float) -> float | None:
     return sorted_vals[idx]
 
 
+def breach_verdict(
+    burn_rate: float,
+    p99_s: float | None,
+    max_burn_rate: float,
+    p99_target_s: float | None,
+) -> bool:
+    """THE breach predicate — the single comparison both gate forms
+    share (:meth:`SloEvaluator.breached` in-process,
+    :func:`breached_from_metrics_text` over a remote scrape), so the
+    thresholds can never drift between them: burn above budget, or p99
+    above target when both exist (a None p99 is no evidence, never a
+    breach)."""
+    if burn_rate > max_burn_rate:
+        return True
+    return (
+        p99_target_s is not None
+        and p99_s is not None
+        and p99_s > p99_target_s
+    )
+
+
 class SloEvaluator:
     """Thread-safe rolling-window SLO evaluator.
 
@@ -200,22 +221,79 @@ class SloEvaluator:
         max_burn_rate: float = 1.0,
         window_s: float | None = None,
         now: float | None = None,
+        p99_target_s: float | None = None,
     ) -> bool:
         """True when the SLO is being violated over ``window_s``: the
-        burn rate exceeds ``max_burn_rate``, or (when a p99 target is
-        configured) the window p99 exceeds it. The halt predicate a
+        burn rate exceeds ``max_burn_rate``, or the window p99 exceeds
+        the target (``p99_target_s`` argument, falling back to the
+        evaluator's configured target). The halt predicate a
         latency-gated rollout checks at wave boundaries, same shape as
-        the failure budget's."""
+        the failure budget's — and its ONLY implementation: callers
+        (ServeHarness's in-process gate, the remote
+        ``breached_from_metrics_text``) must not re-derive it."""
+        target = p99_target_s if p99_target_s is not None else self.p99_target_s
         s = self.stats(window_s, now=now)
-        if s["burn_rate"] > max_burn_rate:
-            return True
-        if (
-            self.p99_target_s is not None
-            and s["p99_s"] is not None
-            and s["p99_s"] > self.p99_target_s
-        ):
-            return True
+        return breach_verdict(
+            s["burn_rate"], s["p99_s"], max_burn_rate, target
+        )
+
+
+#: Exposition families the remote gate reads. One sample line looks like
+#: ``tpu_cc_serve_error_budget_burn{window="30"} 1.500000``.
+_GAUGE_RE_TMPL = r'^%s\{window="([^"]+)"\}\s+([0-9.eE+-]+)\s*$'
+
+
+def parse_serve_slo_text(text: str) -> dict[float, dict[str, float]]:
+    """Parse the ``tpu_cc_serve_slo_p99_seconds`` /
+    ``tpu_cc_serve_error_budget_burn`` gauges out of a Prometheus
+    exposition scrape: window seconds -> {"p99_s": ..., "burn_rate":
+    ...}. A window exporting only burn (empty window: no invented p99)
+    yields no ``p99_s`` key — the same no-sample contract the local
+    evaluator keeps."""
+    import re
+
+    out: dict[float, dict[str, float]] = {}
+    for family, key in (
+        ("tpu_cc_serve_slo_p99_seconds", "p99_s"),
+        ("tpu_cc_serve_error_budget_burn", "burn_rate"),
+    ):
+        pat = re.compile(_GAUGE_RE_TMPL % re.escape(family), re.MULTILINE)
+        for window, value in pat.findall(text):
+            try:
+                w = float(window)
+                v = float(value)
+            except ValueError:
+                continue
+            out.setdefault(w, {})[key] = v
+    return out
+
+
+def breached_from_metrics_text(
+    text: str,
+    max_burn_rate: float = 1.0,
+    p99_target_s: float | None = None,
+    window_s: float | None = None,
+) -> bool:
+    """The remote twin of :meth:`SloEvaluator.breached`, judged from a
+    scraped ``/metrics`` payload (a serving pool's live exposition)
+    instead of an in-process evaluator — what ``tpu-cc-ctl rollout
+    --slo-source`` polls at wave boundaries. ``window_s`` selects one
+    exported window (default: the fastest exported). A scrape with no
+    serve SLO gauges at all reads as NOT breached — no evidence is not
+    bad evidence, same as the empty-window rule."""
+    windows = parse_serve_slo_text(text)
+    if not windows:
         return False
+    if window_s is not None:
+        stats = windows.get(float(window_s))
+        if stats is None:
+            return False
+    else:
+        stats = windows[min(windows)]
+    return breach_verdict(
+        stats.get("burn_rate", 0.0), stats.get("p99_s"),
+        max_burn_rate, p99_target_s,
+    )
 
 
 def merge_p99(sorted_a: list[float], sorted_b: list[float]) -> float | None:

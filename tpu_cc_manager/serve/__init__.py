@@ -20,22 +20,37 @@ user-visible disruption. The pieces here close that gap:
   p50/p99 latency + error rate during the rollout vs steady state, plus
   requests lost per node bounced (target: zero).
 
+The driver has two traffic modes: the closed-loop ladder above
+(SERVE_r01) and an **open-loop** rate-driven mode
+(:class:`~tpu_cc_manager.serve.driver.PoissonSchedule` /
+:class:`~tpu_cc_manager.serve.driver.RampSchedule`, SERVE_r02) that
+submits on schedule regardless of pipe depth, attaches per-request
+deadlines, and lets the server's admission control shed at intake —
+the overload-honest half: goodput = completed-within-deadline, and
+``serve/sweep.py`` finds the knee of a rate sweep.
+
 The layer is live-observable, not just report-observable: servers and
 driver export the ``tpu_cc_serve_*`` metric families through one shared
 ``utils/metrics.py`` registry (latency histogram, queue depth,
-in-flight, outcome/loss counters, goodput) and feed an
-``obs/slo.py`` :class:`~tpu_cc_manager.obs.slo.SloEvaluator` whose
+in-flight, outcome/shed/loss counters, offered rate, goodput) and feed
+an ``obs/slo.py`` :class:`~tpu_cc_manager.obs.slo.SloEvaluator` whose
 windowed p99 / error-budget burn readout is both exported as gauges and
-pollable in-process — the contract a latency-gated rollout reads at
-wave boundaries (ROADMAP item 1).
+pollable in-process — the contract the latency-gated rollout
+(``ccmanager/rolling.py`` ``slo_gate``) polls at wave boundaries.
 """
 
-from tpu_cc_manager.serve.driver import TrafficDriver
+from tpu_cc_manager.serve.driver import (
+    PoissonSchedule,
+    RampSchedule,
+    TrafficDriver,
+)
 from tpu_cc_manager.serve.harness import ServeHarness
 from tpu_cc_manager.serve.server import NodeServer, Request, SimulatedExecutor
 
 __all__ = [
     "NodeServer",
+    "PoissonSchedule",
+    "RampSchedule",
     "Request",
     "ServeHarness",
     "SimulatedExecutor",

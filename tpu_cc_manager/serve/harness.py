@@ -167,6 +167,7 @@ class ServeHarness:
                 self.kube, name,
                 on_complete=lambda n, r, u: self.driver.on_complete(n, r, u),
                 on_requeue=lambda n, rs: self.driver.on_requeue(n, rs),
+                on_shed=lambda n, rs: self.driver.on_shed(n, rs),
                 executor=self.executor_factory(),
                 checkpoint_full_s=self.checkpoint_full_s,
                 metrics=self.metrics,
@@ -200,11 +201,15 @@ class ServeHarness:
     def run(
         self,
         traffic_s: float = 6.0,
-        rollout_mode: str = "on",
+        rollout_mode: str | None = "on",
         warmup_frac: float = 0.25,
         max_unavailable: int = 1,
         rollout_timeout_s: float = 60.0,
         rollout_hook=None,
+        slo_max_burn_rate: float | None = None,
+        slo_p99_target_ms: float | None = None,
+        slo_window_s: float | None = None,
+        slo_max_pause_s: float = 60.0,
     ) -> dict:
         """Sustain traffic for ``traffic_s`` (plus however long the flip
         needs), run the rolling CC flip after ``warmup_frac`` of it, and
@@ -212,33 +217,77 @@ class ServeHarness:
         post-flip tail. ``rollout_hook`` is passed to the orchestrator's
         named crash points ("window-start"/"mid-window"/...) — the
         mid-flip scrape tests hang their assertions there, so "scraped
-        during the flip" is true by construction, not by sleep-timing."""
+        during the flip" is true by construction, not by sleep-timing.
+
+        ``rollout_mode=None`` runs traffic with NO flip (the rate
+        sweep's steady measurement). ``slo_max_burn_rate`` /
+        ``slo_p99_target_ms`` arm the orchestrator's wave-boundary SLO
+        gate with THIS harness's live evaluator — the in-process form of
+        the latency-gated rollout (``ctl rollout --slo-source`` is the
+        remote one)."""
         assert self.driver is not None, "call build() first"
         for server in self.servers.values():
             server.start()
         self.driver.start()
+        result = None
+        t_roll_0 = t_roll_1 = None
         try:
-            retry_mod.wait(traffic_s * warmup_frac, None)
-            roller = RollingReconfigurator(
-                self.kube, POOL_SELECTOR,
-                max_unavailable=max_unavailable,
-                node_timeout_s=rollout_timeout_s,
-                poll_interval_s=0.02,
-                crash_hook=rollout_hook,
-                flight=self.flight,
-            )
-            t_roll_0 = time.monotonic()
-            result = roller.rollout(rollout_mode)
-            t_roll_1 = time.monotonic()
-            # Post-flip steady tail: the rest of the traffic budget, at
-            # least a second so the tail bucket has data.
-            tail = max(1.0, traffic_s * (1.0 - warmup_frac))
-            retry_mod.wait(tail, None)
+            if rollout_mode is None:
+                retry_mod.wait(traffic_s, None)
+            else:
+                retry_mod.wait(traffic_s * warmup_frac, None)
+                slo_gate = None
+                slo_config = None
+                if slo_max_burn_rate is not None or slo_p99_target_ms is not None:
+                    from tpu_cc_manager.ccmanager.rolling import SloGateConfig
+
+                    burn = (
+                        slo_max_burn_rate
+                        if slo_max_burn_rate is not None else 1.0
+                    )
+                    target_s = (
+                        slo_p99_target_ms / 1e3
+                        if slo_p99_target_ms is not None else None
+                    )
+                    slo_config = SloGateConfig(
+                        max_burn_rate=burn,
+                        p99_target_ms=slo_p99_target_ms,
+                        window_s=slo_window_s,
+                        max_pause_s=slo_max_pause_s,
+                    )
+
+                    def slo_gate() -> bool:
+                        return self.slo.breached(
+                            max_burn_rate=burn,
+                            window_s=slo_window_s,
+                            p99_target_s=target_s,
+                        )
+
+                roller = RollingReconfigurator(
+                    self.kube, POOL_SELECTOR,
+                    max_unavailable=max_unavailable,
+                    node_timeout_s=rollout_timeout_s,
+                    poll_interval_s=0.02,
+                    crash_hook=rollout_hook,
+                    flight=self.flight,
+                    metrics=self.metrics,
+                    slo_gate=slo_gate,
+                    slo_config=slo_config,
+                )
+                t_roll_0 = time.monotonic()
+                result = roller.rollout(rollout_mode)
+                t_roll_1 = time.monotonic()
+                # Post-flip steady tail: the rest of the traffic budget,
+                # at least a second so the tail bucket has data.
+                tail = max(1.0, traffic_s * (1.0 - warmup_frac))
+                retry_mod.wait(tail, None)
         finally:
             self.driver.stop()
         # Everything still in the system must complete: the zero-loss
         # claim is checked AFTER the grace drain, not before.
         self.driver.drain_outstanding(grace_s=15.0)
+        if rollout_mode is None:
+            return self.driver.report()
         bounced = sum(
             1 for name in self.nodes
             if node_labels(self.kube.get_node(name)).get(
@@ -251,6 +300,9 @@ class ServeHarness:
         report["rollout_ok"] = bool(result.ok)
         report["rollout_wall_s"] = round(t_roll_1 - t_roll_0, 3)
         report["rollout_summary"] = result.summary()
+        report["rollout_slo_pauses"] = self.metrics.rollout_totals()[
+            "slo_pauses"
+        ]
         report["drains"] = {
             name: {
                 "drains": s.drains,

@@ -54,8 +54,15 @@ DEFAULT_CHECKPOINT_BUDGET_FRACTION = 0.5
 class Request:
     """One synthetic inference request: ``decode_tokens`` of work, with
     checkpointable progress. ``submitted_at`` is stamped when the request
-    enters the system (driver clock) — a checkpoint-and-requeue bounce
-    does NOT restamp it, so reported latency is what the user saw."""
+    enters the system (driver clock) — for open-loop traffic that is the
+    SCHEDULED arrival time, so reported latency includes every second of
+    queue wait (no coordinated omission) — and a checkpoint-and-requeue
+    bounce does NOT restamp it, so reported latency is what the user saw.
+    ``deadline_at`` (open-loop mode) is the absolute completion deadline:
+    admission control sheds the request once the deadline budget is
+    provably spent, and a completion past it counts as a deadline miss.
+    ``started_at`` is the FIRST executor dispatch (bounces keep it), so
+    ``started_at - submitted_at`` is the queue delay the sweep reports."""
 
     req_id: int
     decode_tokens: int
@@ -64,6 +71,9 @@ class Request:
     attempts: int = 0
     checkpoints: int = 0
     completed_at: float | None = None
+    deadline_at: float | None = None
+    started_at: float | None = None
+    shed_at: float | None = None
 
     def remaining(self) -> int:
         return max(0, self.decode_tokens - self.tokens_done)
@@ -121,6 +131,13 @@ class SimulatedExecutor:
     def hbm_bw_util(self, batch_size: int) -> float:
         return min(1.0, self.weight_frac + batch_size * self.kv_frac)
 
+    def estimate_s(self, tokens: int) -> float:
+        """Predicted wall time for ``tokens`` of batch-parallel decode —
+        the calibrated per-token rate admission control multiplies queue
+        depth by (serve/server.py intake). The same model ``execute``
+        charges, so the estimate and the charge cannot drift."""
+        return self.base_s + self.per_token_s * max(0, tokens)
+
     def execute(
         self, batch: list[Request], interrupt: threading.Event,
         stop: threading.Event,
@@ -148,6 +165,7 @@ class NodeServer:
         node_name: str,
         on_complete,
         on_requeue,
+        on_shed=None,
         executor: SimulatedExecutor | None = None,
         job_name: str = "serve",
         poll_interval_s: float = 0.05,
@@ -155,6 +173,7 @@ class NodeServer:
         checkpoint_budget_fraction: float = DEFAULT_CHECKPOINT_BUDGET_FRACTION,
         restore_s: float = 0.0,
         metrics: metrics_mod.MetricsRegistry | None = None,
+        clock=time.monotonic,
     ) -> None:
         self.api = api
         self.node_name = node_name
@@ -167,9 +186,23 @@ class NodeServer:
         self.executor = executor if executor is not None else SimulatedExecutor()
         self._on_complete = on_complete  # (node_name, Request, util)
         self._on_requeue = on_requeue    # (node_name, list[Request])
+        # Admission control / load shedding (open-loop overload): when a
+        # submitted request carries a deadline, intake estimates the
+        # queue delay ahead of it (queue depth x the executor's
+        # calibrated per-token rate) and sheds it if it provably cannot
+        # complete in time — admitting it would burn capacity on a
+        # guaranteed deadline miss and drag every request queued behind
+        # it past ITS deadline too. Shed requests go to this callback
+        # (counted outcome=shed by the driver; never lost).
+        self._on_shed = on_shed          # (node_name, list[Request])
         self.checkpoint_full_s = checkpoint_full_s
         self.checkpoint_budget_fraction = checkpoint_budget_fraction
         self.restore_s = restore_s
+        # Must share the driver's time domain: request stamps
+        # (submitted_at/deadline_at from the driver, started_at/
+        # completed_at from here) are compared against each other by the
+        # admission check and the latency report.
+        self.clock = clock
         self._lock = locks_mod.make_lock("serve.server")
         self._state = STATE_ACCEPTING  # cclint: guarded-by(_lock)
         self._queue: list[list[Request]] = []  # cclint: guarded-by(_lock)
@@ -238,19 +271,67 @@ class NodeServer:
         self.metrics.set_serve_queue_depth(self.node_name, depth)
         self.metrics.set_serve_inflight(self.node_name, inflight)
 
+    def _queue_delay_estimate_s(self) -> float:  # cclint: requires(_lock)
+        """Predicted wait before a newly-accepted batch starts executing:
+        every queued batch's modeled wall time (batch-parallel, so each
+        pays its LONGEST remaining sequence) plus whatever the in-flight
+        batch still owes. Uses the executor's calibrated per-token rate
+        (``estimate_s``) — the same model that charges the work — so the
+        admission decision is as honest as the simulation itself."""
+        est = 0.0
+        for b in self._queue:
+            est += self.executor.estimate_s(
+                max((r.remaining() for r in b), default=0)
+            )
+        if self._inflight:
+            # tokens_done advances live at each boundary, so this reads
+            # the true remaining work, not the batch's original size.
+            est += self.executor.per_token_s * max(
+                (r.remaining() for r in self._inflight), default=0
+            )
+        return est
+
+    def queue_delay_estimate_s(self) -> float:
+        with self._lock:
+            return self._queue_delay_estimate_s()
+
     def submit(self, batch: list[Request]) -> bool:
         """Accept one batch for execution; False while draining/drained
-        (the driver keeps the requests and routes them elsewhere)."""
+        (the driver keeps the requests and routes them elsewhere).
+
+        Admission control: requests carrying a deadline are shed at
+        intake when the estimated queue delay plus their own service
+        time already overruns the deadline budget — handed to
+        ``on_shed`` (outcome=shed), never queued to miss. Requests
+        without a deadline are always admitted (closed-loop traffic is
+        unchanged)."""
         if not batch:
             return True
+        now = self.clock()
+        shed: list[Request] = []
         with self._lock:
             if self._state != STATE_ACCEPTING or self._stop.is_set():
                 return False
+            est = self._queue_delay_estimate_s()
+            accepted: list[Request] = []
             for r in batch:
+                # No shed sink = no shedding: a deadline-carrying request
+                # submitted without an on_shed callback must be admitted,
+                # not silently dropped.
+                if self._on_shed is not None and r.deadline_at is not None and (
+                    now + est + self.executor.estimate_s(r.remaining())
+                    > r.deadline_at
+                ):
+                    shed.append(r)
+                    continue
                 r.attempts += 1
-            self._queue.append(list(batch))
-            self._work.set()
+                accepted.append(r)
+            if accepted:
+                self._queue.append(accepted)
+                self._work.set()
         self._export_gauges()
+        if shed and self._on_shed is not None:
+            self._on_shed(self.node_name, shed)
         return True
 
     # -- serving loop ------------------------------------------------------
@@ -270,8 +351,15 @@ class NodeServer:
             if batch is None:
                 continue
             self._export_gauges()
+            dispatch_t = self.clock()
+            for r in batch:
+                if r.started_at is None:
+                    # First dispatch only: a bounced request keeps its
+                    # original start, so queue delay measures the wait
+                    # before ANY service, not the latest hop's.
+                    r.started_at = dispatch_t
             util = self.executor.execute(batch, self._drain_break, self._stop)
-            now = time.monotonic()
+            now = self.clock()
             with self._lock:
                 self._inflight = []
                 done = [r for r in batch if r.remaining() == 0]

@@ -247,6 +247,9 @@ class MetricsRegistry:
         self._serve_inflight: dict[str, int] = {}  # cclint: guarded-by(_lock)
         self._serve_outcome_totals: dict[tuple[str, str], int] = {}  # cclint: guarded-by(_lock)
         self._serve_lost_total = 0  # cclint: guarded-by(_lock)
+        self._serve_deadline_miss_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._serve_offered_rps: float | None = None  # cclint: guarded-by(_lock)
+        self._rollout_slo_pauses_total = 0  # cclint: guarded-by(_lock)
         self._serve_goodput: float | None = None  # cclint: guarded-by(_lock)
         # window_s -> (p99_s or None, burn_rate)
         self._serve_slo: dict[float, tuple[float | None, float]] = {}  # cclint: guarded-by(_lock)
@@ -490,6 +493,27 @@ class MetricsRegistry:
         with self._lock:
             self._serve_lost_total += count
 
+    def record_serve_deadline_miss(self, node: str, count: int = 1) -> None:
+        """Count ACCEPTED requests that completed past their deadline —
+        the broken promise, separate from ``outcome=shed`` (the counted,
+        deliberate refusal at intake)."""
+        with self._lock:
+            self._serve_deadline_miss_totals[node] = (
+                self._serve_deadline_miss_totals.get(node, 0) + count
+            )
+
+    def set_serve_offered_rps(self, rps: float) -> None:
+        """Open-loop offered (scheduled) arrival rate — the load the
+        pool was asked to absorb, independent of what it completed."""
+        with self._lock:
+            self._serve_offered_rps = max(0.0, rps)
+
+    def record_slo_pause(self) -> None:
+        """Count one SLO-gate pause of a rolling rollout's next wave
+        (ccmanager/rolling.py wave boundaries)."""
+        with self._lock:
+            self._rollout_slo_pauses_total += 1
+
     def set_serve_goodput(self, rps: float) -> None:
         """Completed-requests-per-second over the SLO window."""
         with self._lock:
@@ -509,6 +533,8 @@ class MetricsRegistry:
             return {
                 "outcomes": dict(self._serve_outcome_totals),
                 "lost": self._serve_lost_total,
+                "deadline_misses": dict(self._serve_deadline_miss_totals),
+                "offered_rps": self._serve_offered_rps,
                 "queue_depth": dict(self._serve_queue_depth),
                 "inflight": dict(self._serve_inflight),
                 "goodput_rps": self._serve_goodput,
@@ -521,6 +547,7 @@ class MetricsRegistry:
                 "resumes": self._rollout_resumes_total,
                 "lease_transitions": self._rollout_lease_transitions_total,
                 "fenced_writes": self._rollout_fenced_writes_total,
+                "slo_pauses": self._rollout_slo_pauses_total,
             }
 
     def _accumulate(self, m: ReconcileMetrics) -> None:
@@ -612,6 +639,9 @@ class MetricsRegistry:
             serve_inflight = dict(self._serve_inflight)
             serve_outcomes = dict(self._serve_outcome_totals)
             serve_lost = self._serve_lost_total
+            serve_deadline_misses = dict(self._serve_deadline_miss_totals)
+            serve_offered = self._serve_offered_rps
+            rollout_slo_pauses = self._rollout_slo_pauses_total
             serve_goodput = self._serve_goodput
             serve_slo = dict(self._serve_slo)
         for result in ("ok", "failed", "noop"):
@@ -915,6 +945,37 @@ class MetricsRegistry:
             )
             lines.append("# TYPE tpu_cc_serve_lost_total counter")
             lines.append("tpu_cc_serve_lost_total %d" % serve_lost)
+        if serve_deadline_misses:
+            lines.append(
+                "# HELP tpu_cc_serve_deadline_miss_total Accepted "
+                "requests that completed past their deadline, per node "
+                "(separate from outcome=shed — the deliberate refusal at "
+                "intake; a miss is the broken promise)."
+            )
+            lines.append("# TYPE tpu_cc_serve_deadline_miss_total counter")
+            for node in sorted(serve_deadline_misses):
+                lines.append(
+                    "tpu_cc_serve_deadline_miss_total%s %d"
+                    % (_labels(node=node), serve_deadline_misses[node])
+                )
+        if serve_offered is not None:
+            lines.append(
+                "# HELP tpu_cc_serve_offered_rps Open-loop offered "
+                "(scheduled) arrival rate — the load the pool was asked "
+                "to absorb, which goodput is judged against."
+            )
+            lines.append("# TYPE tpu_cc_serve_offered_rps gauge")
+            lines.append("tpu_cc_serve_offered_rps %.3f" % serve_offered)
+        if rollout_slo_pauses:
+            lines.append(
+                "# HELP tpu_cc_rollout_slo_pauses_total Rollout waves "
+                "paused by the SLO gate at a wave boundary (error-budget "
+                "burn or p99 above target; ccmanager/rolling.py)."
+            )
+            lines.append("# TYPE tpu_cc_rollout_slo_pauses_total counter")
+            lines.append(
+                "tpu_cc_rollout_slo_pauses_total %d" % rollout_slo_pauses
+            )
         if serve_goodput is not None:
             lines.append(
                 "# HELP tpu_cc_serve_goodput_rps Completed requests per "
