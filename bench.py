@@ -369,6 +369,183 @@ def run_scenario(
     }
 
 
+def run_spare_prestage_scenario(
+    tpu_usable: bool,
+    reset_latency_s=None,
+    boot_latency_s: float = 20.0,
+    pod_delete_delay_s: float = 3.0,
+) -> dict:
+    """BENCH_r08: the zero-bounce spare. A 2-node pool of REAL agents
+    (realistic device latencies, same 30 s reset / 20 s boot model as
+    the headline scenario) driven by the REAL rolling orchestrator with
+    ``surge=1, prestage=True``: the spare is armed (surge taint +
+    prestage annotation), runs its FULL journaled flip + compile warmup
+    ahead of the wave and HOLDS; its flip window then converges in
+    ~drain+readmit time while the second node pays the full path in the
+    SAME run — the internal control the artifact compares against.
+
+    The claim the JSON gates on: the pre-staged spare's effective flip
+    wall (desired write → converged, orchestrator-measured) is at most
+    the drain + readmit cost of its own prestage transition
+    (journal-measured), and strictly below the full path its pool-mate
+    paid."""
+    import tempfile
+    import threading as _threading
+
+    from tpu_cc_manager.ccmanager.manager import CCManager
+    from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+    from tpu_cc_manager.kubeclient.api import node_labels
+    from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
+    from tpu_cc_manager.obs import flight as flight_mod
+    from tpu_cc_manager.obs.journal import Journal
+    from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+    from tpu_cc_manager.utils import retry as retry_mod
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    if reset_latency_s is None:
+        reset_latency_s = [7.5, 7.5, 7.5, 7.5]
+    from tpu_cc_manager.drain.sim import add_drainable_node
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    kube = FakeKube()
+    names = ["bench-spare-0", "bench-spare-1"]
+    journals: dict[str, Journal] = {}
+    stop = _threading.Event()
+    threads = []
+    for i, name in enumerate(names):
+        add_drainable_node(
+            kube, name, NS, pod_delete_delay_s=pod_delete_delay_s,
+            extra_labels={"pool": "bench-spare"},
+        )
+        journals[name] = Journal(trace_file="")
+        backend = FakeTpuBackend(
+            num_chips=4,
+            accelerator_type="v5p-8",
+            slice_id=f"bench-spare-slice-{i}",
+            reset_latency_s=reset_latency_s,
+            boot_latency_s=boot_latency_s,
+            reset_parallelism_override=4,
+        )
+        mgr = CCManager(
+            api=kube,
+            backend=backend,
+            node_name=name,
+            default_mode="off",
+            operator_namespace=NS,
+            evict_components=True,
+            smoke_workload="matmul",
+            smoke_runner=lambda w: _smoke_subprocess(
+                w, timeout_s=240.0, force_cpu=not tpu_usable
+            ),
+            eviction_poll_interval_s=0.1,
+            metrics=MetricsRegistry(),
+            journal=journals[name],
+            watch_timeout_s=1,
+            reconnect_delay_s=0.0,
+        )
+        t = _threading.Thread(
+            target=mgr.watch_and_apply, args=(stop,), daemon=True,
+            name=f"bench-spare-agent-{name}",
+        )
+        threads.append(t)
+    for t in threads:
+        t.start()
+
+    def settled() -> bool:
+        return all(
+            node_labels(kube.get_node(n)).get(CC_MODE_STATE_LABEL) == "off"
+            for n in names
+        )
+
+    result: dict = {"ok": False}
+    try:
+        if not retry_mod.poll_until(settled, 60.0, 0.1):
+            result["error"] = "agents never settled at mode off"
+            return result
+        flight_path = tempfile.mktemp(
+            prefix="tpu-cc-bench-spare-", suffix=".jsonl"
+        )
+        flight = flight_mod.FlightRecorder(flight_path)
+        roller = RollingReconfigurator(
+            kube, "pool=bench-spare",
+            max_unavailable=1,
+            node_timeout_s=600.0,
+            poll_interval_s=0.05,
+            surge=1,
+            prestage=True,
+            flight=flight,
+            metrics=MetricsRegistry(),
+        )
+        t0 = time.perf_counter()
+        rres = roller.rollout("on")
+        rollout_wall = time.perf_counter() - t0
+        events, _torn = flight_mod.read_events(flight_path)
+        prestaged_events = [
+            e for e in events if e["event"] == flight_mod.EVENT_SPARE_PRESTAGED
+        ]
+        spare = prestaged_events[0]["node"] if prestaged_events else None
+        surge_windows = [
+            e for e in events
+            if e["event"] == flight_mod.EVENT_WINDOW_CLOSE
+            and e.get("wave") == "surge"
+        ]
+        full_windows = [
+            e for e in events
+            if e["event"] == flight_mod.EVENT_WINDOW_CLOSE
+            and e.get("wave") == 0
+        ]
+        effective = surge_windows[0].get("seconds") if surge_windows else None
+        full_path = full_windows[0].get("seconds") if full_windows else None
+        prestage_wall = (
+            prestaged_events[0].get("seconds") if prestaged_events else None
+        )
+        # The bar: what the spare's OWN prestage transition spent on the
+        # two phases a pre-staged flip cannot skip in principle — the
+        # drain bracket and re-admission. Everything else (stage, reset,
+        # boot, verify, smoke) ran ahead of the wave.
+        drain_s = readmit_s = None
+        if spare is not None:
+            durs = journals[spare].phase_durations(("drain", "readmit"))
+            drain_s = round(sum(durs.get("drain", ())), 3)
+            readmit_s = round(sum(durs.get("readmit", ())), 3)
+        bar = (
+            round(drain_s + readmit_s, 3)
+            if drain_s is not None and readmit_s is not None else None
+        )
+        states = {
+            n: node_labels(kube.get_node(n)).get(CC_MODE_STATE_LABEL)
+            for n in names
+        }
+        result = {
+            "rollout_ok": bool(rres.ok),
+            "rollout_wall_s": round(rollout_wall, 2),
+            "spare": spare,
+            "prestage_wall_s": prestage_wall,
+            "effective_flip_wall_s": effective,
+            "full_path_wall_s": full_path,
+            "drain_s": drain_s,
+            "readmit_s": readmit_s,
+            "bar_drain_plus_readmit_s": bar,
+            "states": states,
+            "surged": rres.surged,
+            "ok": bool(
+                rres.ok
+                and spare is not None
+                and effective is not None
+                and bar is not None
+                and effective <= bar
+                and full_path is not None
+                and effective < full_path
+                and all(s == "on" for s in states.values())
+            ),
+        }
+        return result
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+
+
 def run_multihost_scenario() -> dict:
     """Two agents of one 2-host slice transition to mode 'slice' through
     the cross-host commit barrier (ccmanager/slicecoord.py) — the
@@ -699,5 +876,45 @@ def main() -> int:
     return 0 if result["ok"] and result["realistic"]["under_target"] else 1
 
 
+def spare_main(out: str | None) -> int:
+    """BENCH_r08 entry (`python bench.py --spare [--out FILE]`): one
+    JSON line for the zero-bounce spare scenario, ok-gated on the
+    pre-staged spare's effective flip wall landing at or under its own
+    drain+readmit cost AND strictly below BENCH_r07's full-path wall."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)  # stdout carries ONE line
+
+    tpu_usable = _tpu_preflight()
+    spare = run_spare_prestage_scenario(tpu_usable)
+    # BENCH_r07's measured full-path per-node wall: the pre-staged
+    # spare's effective flip must land strictly below it (it lands ~two
+    # orders under — the whole flip ran ahead of the wave).
+    reference = 31.45
+    value = spare.get("effective_flip_wall_s")
+    result = {
+        "metric": "spare_prestage_flip_sec",
+        "value": value,
+        "unit": "s",
+        "full_path_reference_s": reference,
+        **spare,
+    }
+    result["ok"] = bool(
+        result["ok"] and value is not None and value < reference
+    )
+    line = json.dumps(result)
+    print(line)
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return 0 if result["ok"] else 1
+
+
 if __name__ == "__main__":
+    if "--spare" in sys.argv:
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(spare_main(_out))
     sys.exit(main())

@@ -28,9 +28,12 @@
 # scenarios' outcomes (PREEMPTION_SUMMARY: preemption fast-drain +
 # handoff resume, slice fencing of a departed peer), and the
 # serving-under-the-flip soak (SERVE_SUMMARY: rolling flip under
-# sustained traffic, zero lost requests), and the flight-recorder crash
-# leg (OBS_SUMMARY: events written across kill+resume at every crash
-# point, zero torn JSONL lines) so the evidence ladder can cite them.
+# sustained traffic, zero lost requests), the zero-bounce handoff leg
+# (HANDOFF_SUMMARY: flip with the in-flight-handoff sink wired — zero
+# lost, nonzero accepted handoffs, conserved), and the flight-recorder
+# crash leg (OBS_SUMMARY: events written across kill+resume at every
+# crash point, zero torn JSONL lines) so the evidence ladder can cite
+# them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -85,8 +88,9 @@ for i in $(seq 0 $((ITERS - 1))); do
   preemption=$(grep -ao "PREEMPTION_SUMMARY.*" "$log" | sed "s/^PREEMPTION_SUMMARY //; s/'/ /g; s/\"/ /g" | paste -sd'; ' -)
   serve=$(grep -ao "SERVE_SUMMARY.*" "$log" | tail -1 | sed "s/^SERVE_SUMMARY //; s/'/ /g; s/\"/ /g")
   serve_overload=$(grep -ao "SERVE_OVERLOAD_SUMMARY.*" "$log" | tail -1 | sed "s/^SERVE_OVERLOAD_SUMMARY //; s/'/ /g; s/\"/ /g")
+  handoff=$(grep -ao "HANDOFF_SUMMARY.*" "$log" | tail -1 | sed "s/^HANDOFF_SUMMARY //; s/'/ /g; s/\"/ /g")
   obs=$(grep -ao "OBS_SUMMARY.*" "$log" | tail -1 | sed "s/^OBS_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"obs\": \"${obs}\"}")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"handoff\": \"${handoff}\", \"obs\": \"${obs}\"}")
 done
 
 {

@@ -45,6 +45,8 @@ ARTIFACTS=(
   SCALE_r01.json
   SERVE_r01.json
   SERVE_r02.json
+  SERVE_r03.json
+  BENCH_r08.json
   artifacts/smoke_cache_r06.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
@@ -214,6 +216,43 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s SERVE_r02.json ] && mv SERVE_r02.json artifacts/SERVE_r02.failed.json
     echo ">>> open-loop serve bench FAILED; stopping ladder (summary in artifacts/SERVE_r02.failed.json; partial sweep rows kept for resume)"
+    finish
+  }
+fi
+
+# Zero-bounce flip evidence (ROADMAP item 5, SERVE_r03): the same knee
+# setup as SERVE_r02, flipped twice — control (checkpoint+requeue) vs
+# in-flight handoff to accepting peers — gated on the handoff flip's
+# during/steady p99 ratio <= 1.3, zero lost, nonzero accepted handoffs.
+# CPU-only; same two-grain resume discipline as SERVE_r02 (its own
+# partial file — the handoff sweep must not poison SERVE_r02's rows).
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("SERVE_r03.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> SERVE_r03.json already captured (ok:true); skipping"
+else
+  echo "=== stage: serve-bench --handoff (zero-bounce flip, no tunnel) ==="
+  python3 hack/serve_bench.py --handoff \
+      --partial artifacts/serve_handoff_sweep_partial.jsonl \
+      --out SERVE_r03.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s SERVE_r03.json ] && mv SERVE_r03.json artifacts/SERVE_r03.failed.json
+    echo ">>> zero-bounce serve bench FAILED; stopping ladder (summary in artifacts/SERVE_r03.failed.json; partial sweep rows kept for resume)"
+    finish
+  }
+fi
+
+# Pre-staged spare evidence (ROADMAP item 5, BENCH_r08): a surge spare
+# pre-stages its full flip + warmup ahead of the wave; the artifact
+# gates on effective flip wall <= the spare's own drain+readmit cost
+# AND strictly below BENCH_r07's full-path wall. CPU-only, single
+# point, same skip/park discipline.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("BENCH_r08.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> BENCH_r08.json already captured (ok:true); skipping"
+else
+  echo "=== stage: bench --spare (pre-staged spare flip, no tunnel) ==="
+  python3 bench.py --spare --out BENCH_r08.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s BENCH_r08.json ] && mv BENCH_r08.json artifacts/BENCH_r08.failed.json
+    echo ">>> spare-prestage bench FAILED; stopping ladder (summary in artifacts/BENCH_r08.failed.json)"
     finish
   }
 fi

@@ -68,10 +68,111 @@ def _load_partial(path: str | None, config: dict) -> dict[float, dict]:
     return rows
 
 
-def run_sweep(args, executor_factory, calibration) -> dict:
+def _flip_at_knee(args, executor_factory, knee, deadline_s, handoff) -> dict:
+    """One full rolling flip AT the knee under open-loop traffic — the
+    SERVE_r02 flip leg, parameterized by ``handoff`` so SERVE_r03 can
+    measure the zero-bounce path against the same baseline."""
     from tpu_cc_manager.serve import ServeHarness
-    from tpu_cc_manager.serve import sweep as sweep_mod
     from tpu_cc_manager.serve.driver import PoissonSchedule
+
+    harness = ServeHarness(
+        n_nodes=args.nodes,
+        tmp_dir=tempfile.mkdtemp(prefix="tpu-cc-serve-flip-"),
+        executor_factory=executor_factory,
+        handoff=handoff,
+        driver_kwargs={
+            "schedule": PoissonSchedule(
+                knee["rate_rps"], seed=args.seed + 1
+            ),
+            "deadline_s": deadline_s,
+            "initial_batch": knee["batch"],
+            "min_batch": knee["batch"],
+            "max_batch": knee["batch"],
+        },
+        slo_windows_s=(2.0, 30.0),
+        slo_error_budget=0.05,
+    )
+    harness.build()
+    try:
+        return harness.run(
+            traffic_s=args.traffic_s,
+            rollout_mode=args.mode,
+            max_unavailable=args.max_unavailable,
+            slo_max_burn_rate=2.0,
+            slo_window_s=2.0,
+            slo_max_pause_s=30.0,
+        )
+    finally:
+        harness.shutdown()
+
+
+def _p99_ratio(flip: dict) -> float | None:
+    """during-rollout p99 / steady-state p99 — the number a user FEELS
+    during a flip (1.0 = the rollout is invisible)."""
+    during = (flip.get("latency_during_rollout") or {}).get("p99_ms")
+    steady = (flip.get("latency_steady_state") or {}).get("p99_ms")
+    if not during or not steady:
+        return None
+    return round(during / steady, 3)
+
+
+def run_handoff(args, executor_factory, calibration) -> dict:
+    """SERVE_r03: the zero-bounce flip artifact. Re-finds the knee with
+    the SAME sweep machinery as SERVE_r02 (resumable partial rows), then
+    runs the flip-at-the-knee twice — control (checkpoint-and-requeue,
+    today's path) and handoff (parked requests migrate to an accepting
+    peer inside the ack window) — and gates on the handoff flip's
+    during-rollout/steady p99 ratio."""
+    sweep = run_sweep(args, executor_factory, calibration, flip=False)
+    knee = sweep.get("knee")
+    control = handoff_flip = None
+    if knee is not None:
+        control = _flip_at_knee(
+            args, executor_factory, knee, args.deadline_ms / 1e3,
+            handoff=False,
+        )
+        handoff_flip = _flip_at_knee(
+            args, executor_factory, knee, args.deadline_ms / 1e3,
+            handoff=True,
+        )
+    ratio = _p99_ratio(handoff_flip) if handoff_flip else None
+    control_ratio = _p99_ratio(control) if control else None
+    accepted = (
+        (handoff_flip.get("handoffs") or {}).get("accepted", 0)
+        if handoff_flip else 0
+    )
+    return {
+        "metric": "zero_bounce_flip_p99_ratio",
+        "nodes": args.nodes,
+        "deadline_ms": args.deadline_ms,
+        "seed": args.seed,
+        "knee": knee,
+        "ratio_bar": args.ratio_bar,
+        # Control: the SERVE_r02-shaped flip (local checkpoint+requeue).
+        "control_flip": control,
+        "control_p99_ratio": control_ratio,
+        # The zero-bounce flip: in-flight handoff to accepting peers.
+        "handoff_flip": handoff_flip,
+        "handoff_p99_ratio": ratio,
+        "handoffs": (handoff_flip or {}).get("handoffs"),
+        "calibration": calibration,
+        "ok": bool(
+            knee is not None
+            and sweep["ok"]
+            and handoff_flip is not None
+            and handoff_flip["rollout_ok"]
+            and handoff_flip["requests_lost"] == 0
+            and handoff_flip["conserved"]
+            and handoff_flip["nodes_bounced"] == args.nodes
+            and accepted > 0
+            and ratio is not None
+            and ratio <= args.ratio_bar
+        ),
+    }
+
+
+def run_sweep(args, executor_factory, calibration, flip: bool = True) -> dict:
+    from tpu_cc_manager.serve import sweep as sweep_mod
 
     rates = sorted(float(r) for r in args.sweep.split(",") if r.strip())
     deadline_s = args.deadline_ms / 1e3
@@ -115,43 +216,24 @@ def run_sweep(args, executor_factory, calibration) -> dict:
         r["rate_rps"] > knee["rate_rps"] for r in rows
     )
 
-    flip = None
+    flip_report = None
     slo_pauses = None
-    if knee is not None:
+    if knee is not None and flip:
         # The other half of the claim: a rolling CC flip AT the knee,
         # open-loop traffic still arriving on schedule, SLO gate armed
         # (lenient burn threshold: the gate must pace, not veto — the
         # artifact's bar is zero ACCEPTED losses, sheds counted).
-        harness = ServeHarness(
-            n_nodes=args.nodes,
-            tmp_dir=tempfile.mkdtemp(prefix="tpu-cc-serve-r02-"),
-            executor_factory=executor_factory,
-            driver_kwargs={
-                "schedule": PoissonSchedule(
-                    knee["rate_rps"], seed=args.seed + 1
-                ),
-                "deadline_s": deadline_s,
-                "initial_batch": knee["batch"],
-                "min_batch": knee["batch"],
-                "max_batch": knee["batch"],
-            },
-            slo_windows_s=(2.0, 30.0),
-            slo_error_budget=0.05,
+        flip_report = _flip_at_knee(
+            args, executor_factory, knee, deadline_s, handoff=False,
         )
-        harness.build()
-        try:
-            flip = harness.run(
-                traffic_s=args.traffic_s,
-                rollout_mode=args.mode,
-                max_unavailable=args.max_unavailable,
-                slo_max_burn_rate=2.0,
-                slo_window_s=2.0,
-                slo_max_pause_s=30.0,
-            )
-        finally:
-            harness.shutdown()
-        slo_pauses = flip.get("rollout_slo_pauses")
+        slo_pauses = flip_report.get("rollout_slo_pauses")
 
+    sweep_ok = bool(
+        knee is not None
+        and swept_past
+        and holds
+        and all(r["ok"] for r in rows)
+    )
     return {
         "metric": "open_loop_overload_sweep",
         "nodes": args.nodes,
@@ -161,19 +243,21 @@ def run_sweep(args, executor_factory, calibration) -> dict:
         "rates": rows,
         "knee": knee,
         "goodput_holds_past_knee": holds,
-        "flip_at_knee": flip,
+        "flip_at_knee": flip_report,
         "rollout_slo_pauses": slo_pauses,
         "calibration": calibration,
         "ok": bool(
-            knee is not None
-            and swept_past
-            and holds
-            and all(r["ok"] for r in rows)
-            and flip is not None
-            and flip["rollout_ok"]
-            and flip["requests_lost"] == 0
-            and flip["nodes_bounced"] == args.nodes
-            and flip["conserved"]
+            sweep_ok
+            and (
+                not flip
+                or (
+                    flip_report is not None
+                    and flip_report["rollout_ok"]
+                    and flip_report["requests_lost"] == 0
+                    and flip_report["nodes_bounced"] == args.nodes
+                    and flip_report["conserved"]
+                )
+            )
         ),
     }
 
@@ -193,6 +277,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="comma-separated offered rates (rps): run the "
                         "open-loop overload sweep + flip-at-the-knee "
                         "(SERVE_r02) instead of the closed-loop flip")
+    parser.add_argument("--handoff", action="store_true",
+                        help="zero-bounce flip artifact (SERVE_r03): find "
+                        "the knee (same sweep machinery as SERVE_r02), "
+                        "then flip at it twice — control vs in-flight "
+                        "handoff to peers — and gate on the handoff "
+                        "flip's during/steady p99 ratio")
+    parser.add_argument("--ratio-bar", type=float, default=1.3,
+                        help="--handoff ok-gate: during-rollout p99 must "
+                        "stay within this multiple of steady-state p99")
     parser.add_argument("--rate-s", type=float, default=2.5,
                         help="traffic seconds per sweep rate point")
     parser.add_argument("--deadline-ms", type=float, default=500.0,
@@ -230,6 +323,17 @@ def main(argv: list[str] | None = None) -> int:
         executor_factory = (
             lambda: SimulatedExecutor.from_smoke_result(smoke)
         )
+
+    if args.handoff:
+        if not args.sweep:
+            args.sweep = "200,400,800,1600,3200,6400"
+        result = run_handoff(args, executor_factory, calibration)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return 0 if result["ok"] else 1
 
     if args.sweep:
         result = run_sweep(args, executor_factory, calibration)

@@ -52,6 +52,12 @@ def test_live_registry_render_passes_lint():
     registry.set_serve_goodput(123.4)
     registry.set_serve_slo(30.0, 0.08, 1.5)
     registry.set_serve_slo(300.0, None, 0.0)  # empty window: burn only
+    # Zero-bounce flip families (serve/ handoff + prestage), hostile
+    # outcome included.
+    registry.record_serve_handoff("accepted", 7)
+    registry.record_serve_handoff("fallback")
+    registry.record_serve_handoff('odd"outcome\nhere')
+    registry.set_spare_prestage_seconds(31.299)
     problems = check_metrics_lint.lint(registry.render_prometheus())
     assert problems == [], problems
     text = registry.render_prometheus()
@@ -79,6 +85,9 @@ def test_live_registry_render_passes_lint():
     assert "tpu_cc_serve_goodput_rps 123.400" in text
     assert 'tpu_cc_serve_slo_p99_seconds{window="30"} 0.080000' in text
     assert 'tpu_cc_serve_error_budget_burn{window="30"} 1.500000' in text
+    assert 'tpu_cc_serve_handoffs_total{outcome="accepted"} 7' in text
+    assert 'tpu_cc_serve_handoffs_total{outcome="fallback"} 1' in text
+    assert "tpu_cc_spare_prestage_seconds 31.299" in text
     # The empty window exports burn (0) but NO invented p99 sample.
     assert 'tpu_cc_serve_error_budget_burn{window="300"} 0.000000' in text
     assert 'tpu_cc_serve_slo_p99_seconds{window="300"}' not in text

@@ -1,0 +1,426 @@
+"""Zero-bounce flips, pre-staged-spare half (ISSUE 15 / ROADMAP item 5).
+
+Agent side (ccmanager/manager.py): a PRESTAGE annotation makes the agent
+run the FULL journaled transition + warmup to the named mode ahead of
+the rollout wave, report the truthful state label, publish a PRESTAGED
+status record and HOLD there — across watch noise and its own restarts —
+until the wave's desired write lands (instant convergence via the
+idempotent re-attest path), a different desired mode supersedes it, or
+the request annotation is deleted (the abort path).
+
+Orchestrator side (ccmanager/rolling.py): `surge=N, prestage=True` arms
+spares, awaits their records, journals `spare-prestaged` flight events
+and opens a flip window that converges in ~drain+readmit time; spares
+armed AHEAD of the rollout via `prestage_spares()` (`ctl rollout
+--prestage-only`) flip instantly with no in-rollout arming wait.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_cc_manager.ccmanager.manager import (
+    CCManager,
+    PRESTAGE_ANNOTATION,
+    PRESTAGED_ANNOTATION,
+)
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.drain.sim import add_drainable_node
+from tpu_cc_manager.kubeclient.api import node_annotations, node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import CC_MODE_LABEL, CC_MODE_STATE_LABEL
+from tpu_cc_manager.obs import flight as flight_mod
+from tpu_cc_manager.obs.journal import Journal
+from tpu_cc_manager.tpudev.fake import FakeTpuBackend
+from tpu_cc_manager.utils import retry as retry_mod
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NS = "tpu-operator"
+
+
+def make_agent(kube, name, backend, metrics=None):
+    return CCManager(
+        api=kube,
+        backend=backend,
+        node_name=name,
+        default_mode="off",
+        operator_namespace=NS,
+        evict_components=True,
+        smoke_workload="none",
+        metrics=metrics or MetricsRegistry(),
+        journal=Journal(trace_file=""),
+        eviction_poll_interval_s=0.02,
+        watch_timeout_s=1,
+        reconnect_delay_s=0.0,
+    )
+
+
+class AgentPool:
+    """N drainable nodes, each with a real agent watch loop."""
+
+    def __init__(self, n=1, prefix="ps-node", pool_label=None, **backend_kw):
+        self.kube = FakeKube()
+        self.names = [f"{prefix}-{i}" for i in range(n)]
+        self.backends = {}
+        self.metrics = {}
+        self.stop = threading.Event()
+        self.threads = []
+        for i, name in enumerate(self.names):
+            extra = {"pool": pool_label} if pool_label else None
+            add_drainable_node(self.kube, name, NS, extra_labels=extra)
+            backend = FakeTpuBackend(
+                num_chips=2, slice_id=f"{prefix}-slice-{i}", **backend_kw
+            )
+            self.backends[name] = backend
+            self.metrics[name] = MetricsRegistry()
+            mgr = make_agent(self.kube, name, backend, self.metrics[name])
+            self.threads.append(threading.Thread(
+                target=mgr.watch_and_apply, args=(self.stop,), daemon=True,
+            ))
+        for t in self.threads:
+            t.start()
+
+    def settled(self, mode="off", timeout=20.0) -> bool:
+        return retry_mod.poll_until(
+            lambda: all(
+                node_labels(self.kube.get_node(n)).get(CC_MODE_STATE_LABEL)
+                == mode
+                for n in self.names
+            ),
+            timeout, 0.05,
+        )
+
+    def state(self, name):
+        return node_labels(self.kube.get_node(name)).get(CC_MODE_STATE_LABEL)
+
+    def shutdown(self):
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=10)
+
+
+def await_prestaged(kube, name, timeout=20.0) -> dict | None:
+    def ready():
+        return node_annotations(kube.get_node(name)).get(
+            PRESTAGED_ANNOTATION
+        ) is not None
+
+    if not retry_mod.poll_until(ready, timeout, 0.05):
+        return None
+    return json.loads(
+        node_annotations(kube.get_node(name))[PRESTAGED_ANNOTATION]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Agent half
+# ---------------------------------------------------------------------------
+
+
+def test_agent_prestages_on_annotation_holds_and_flips_instantly():
+    pool = AgentPool(1)
+    name = pool.names[0]
+    try:
+        assert pool.settled()
+        pool.kube.patch_node_annotations(name, {PRESTAGE_ANNOTATION: "on"})
+        record = await_prestaged(pool.kube, name)
+        assert record is not None and record["mode"] == "on"
+        assert record["prior"] == "off"
+        assert record["seconds"] >= 0
+        assert pool.state(name) == "on"  # truthful state, desired unchanged
+        assert node_labels(pool.kube.get_node(name)).get(CC_MODE_LABEL) is None
+        # Watch noise (an unrelated annotation write) must not revert
+        # the hold or re-run the pass.
+        pool.kube.patch_node_annotations(name, {"poke": "1"})
+        retry_mod.wait(0.5, None)  # cclint: test-sleep-ok(negative assertion: the hold must still be in place after the event was processed)
+        assert pool.state(name) == "on"
+        # The prestage metric exported.
+        assert "tpu_cc_spare_prestage_seconds" in (
+            pool.metrics[name].render_prometheus()
+        )
+        # The wave arrives: desired=on consumes the request and
+        # converges with NO second transition (the reset count proves
+        # it below), near-instantly.
+        def resets() -> int:
+            return sum(
+                1 for op in pool.backends[name].op_log
+                if str(op[0]).startswith("reset")
+            )
+
+        resets_before = resets()
+        pool.kube.set_node_label(name, CC_MODE_LABEL, "on")
+        assert retry_mod.poll_until(
+            lambda: node_annotations(pool.kube.get_node(name)).get(
+                PRESTAGE_ANNOTATION
+            ) is None and pool.state(name) == "on",
+            10.0, 0.02,
+        )
+        assert resets() == resets_before, (
+            "the pre-staged flip must not reset again at the wave"
+        )
+        # The status record SURVIVES the flip — the operator-visible
+        # explanation of why the wave opened instantly (ctl status).
+        assert node_annotations(pool.kube.get_node(name)).get(
+            PRESTAGED_ANNOTATION
+        ) is not None
+    finally:
+        pool.shutdown()
+
+
+def test_prestage_hold_survives_agent_restart():
+    pool = AgentPool(1)
+    name = pool.names[0]
+    try:
+        assert pool.settled()
+        pool.kube.patch_node_annotations(name, {PRESTAGE_ANNOTATION: "on"})
+        assert await_prestaged(pool.kube, name) is not None
+    finally:
+        pool.shutdown()
+    # Fresh agent process, same node + hardware: the initial apply of
+    # the (unchanged) desired mode must HOLD, not bounce the spare back.
+    stop = threading.Event()
+    mgr = make_agent(pool.kube, name, pool.backends[name])
+    t = threading.Thread(target=mgr.watch_and_apply, args=(stop,), daemon=True)
+    t.start()
+    try:
+        retry_mod.wait(1.0, None)  # cclint: test-sleep-ok(negative assertion: the restarted agent's initial apply must have run and NOT reverted)
+        assert pool.state(name) == "on"
+        assert node_annotations(pool.kube.get_node(name)).get(
+            PRESTAGE_ANNOTATION
+        ) == "on"
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_prestage_abort_reverts_to_desired():
+    pool = AgentPool(1)
+    name = pool.names[0]
+    try:
+        assert pool.settled()
+        pool.kube.patch_node_annotations(name, {PRESTAGE_ANNOTATION: "on"})
+        assert await_prestaged(pool.kube, name) is not None
+        # The operator deletes the request: the hold breaks, the node
+        # reconciles back to the desired mode and the status record is
+        # cleared.
+        pool.kube.patch_node_annotations(name, {PRESTAGE_ANNOTATION: None})
+        assert retry_mod.poll_until(
+            lambda: pool.state(name) == "off"
+            and node_annotations(pool.kube.get_node(name)).get(
+                PRESTAGED_ANNOTATION
+            ) is None,
+            15.0, 0.05,
+        )
+    finally:
+        pool.shutdown()
+
+
+def test_prestage_record_cleared_when_pool_moves_past_it():
+    """A rollout to a DIFFERENT mode than the pre-staged one supersedes
+    the prestage: both annotations clear so the hold cannot re-engage on
+    a stale record."""
+    pool = AgentPool(1)
+    name = pool.names[0]
+    try:
+        assert pool.settled()
+        pool.kube.patch_node_annotations(name, {PRESTAGE_ANNOTATION: "on"})
+        assert await_prestaged(pool.kube, name) is not None
+        pool.kube.set_node_label(name, CC_MODE_LABEL, "on")
+        assert retry_mod.poll_until(lambda: pool.state(name) == "on", 10, 0.02)
+        # The pool moves on: desired=off must both converge and clear
+        # the now-stale prestaged record.
+        pool.kube.set_node_label(name, CC_MODE_LABEL, "off")
+        assert retry_mod.poll_until(
+            lambda: pool.state(name) == "off"
+            and node_annotations(pool.kube.get_node(name)).get(
+                PRESTAGED_ANNOTATION
+            ) is None
+            and node_annotations(pool.kube.get_node(name)).get(
+                PRESTAGE_ANNOTATION
+            ) is None,
+            10.0, 0.05,
+        )
+    finally:
+        pool.shutdown()
+
+
+def test_cc_prestage_env_opt_out(monkeypatch):
+    monkeypatch.setenv("CC_PRESTAGE", "0")
+    pool = AgentPool(1)
+    name = pool.names[0]
+    try:
+        assert pool.settled()
+        pool.kube.patch_node_annotations(name, {PRESTAGE_ANNOTATION: "on"})
+        retry_mod.wait(1.0, None)  # cclint: test-sleep-ok(negative assertion: the request must have been seen and ignored)
+        assert pool.state(name) == "off"
+        assert node_annotations(pool.kube.get_node(name)).get(
+            PRESTAGED_ANNOTATION
+        ) is None
+    finally:
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator half
+# ---------------------------------------------------------------------------
+
+
+def test_surge_prestage_rollout_flips_spare_in_drain_plus_readmit_time(
+    tmp_path,
+):
+    """The BENCH_r08 shape in tier-1: a surge+prestage rollout arms the
+    spare, awaits its pre-staged record, journals spare-prestaged, and
+    the spare's flip window converges far faster than the full-flip
+    windows its pool-mates pay in the SAME rollout."""
+    pool = AgentPool(
+        3, pool_label="tpu-ps", reset_latency_s=0.3, boot_latency_s=0.3,
+    )
+    try:
+        assert pool.settled()
+        fpath = str(tmp_path / "flight.jsonl")
+        flight = flight_mod.FlightRecorder(fpath)
+        roller = RollingReconfigurator(
+            pool.kube, "pool=tpu-ps", max_unavailable=1,
+            node_timeout_s=30, poll_interval_s=0.05,
+            surge=1, prestage=True, flight=flight,
+            metrics=MetricsRegistry(),
+        )
+        result = roller.rollout("on")
+        assert result.ok, result.summary()
+        assert len(result.surged) == 1
+        spare = result.surged[0]
+        events, torn = flight_mod.read_events(fpath)
+        assert torn == 0
+        rec = flight_mod.reconstruct(events)
+        assert rec["prestaged"] == [spare]
+        surge_close = [
+            e for e in events
+            if e["event"] == flight_mod.EVENT_WINDOW_CLOSE
+            and e.get("wave") == "surge"
+        ]
+        full_close = [
+            e for e in events
+            if e["event"] == flight_mod.EVENT_WINDOW_CLOSE
+            and e.get("wave") == 0
+        ]
+        assert surge_close and full_close
+        spare_flip = surge_close[0]["seconds"]
+        full_flip = min(e["seconds"] for e in full_close)
+        assert spare_flip < 0.5 * full_flip, (
+            f"pre-staged spare flip ({spare_flip}s) must be well under "
+            f"the full path ({full_flip}s)"
+        )
+        # Taints reclaimed, everyone on.
+        for name in pool.names:
+            assert not (
+                pool.kube.get_node(name).get("spec") or {}
+            ).get("taints")
+            assert pool.state(name) == "on"
+    finally:
+        pool.shutdown()
+
+
+def test_prestage_only_arm_then_rollout_opens_instantly(tmp_path):
+    """The `ctl rollout --prestage-only` shape: arm ahead of the
+    rollout (overlapping the pre-staging with whatever the pool is
+    doing), then the real surge rollout detects the armed spare and its
+    surge phase — arming wait included — is near-instant."""
+    pool = AgentPool(
+        2, pool_label="tpu-pa", reset_latency_s=0.2, boot_latency_s=0.2,
+    )
+    try:
+        assert pool.settled()
+        armer = RollingReconfigurator(
+            pool.kube, "pool=tpu-pa", node_timeout_s=30,
+            poll_interval_s=0.05, surge=1, prestage=True,
+            metrics=MetricsRegistry(),
+        )
+        summary = armer.prestage_spares("on")
+        assert summary["ok"], summary
+        assert len(summary["prestaged"]) == 1
+        spare = summary["prestaged"][0]
+        # Spare holds, taint kept until the real rollout reclaims it.
+        assert pool.state(spare) == "on"
+        assert any(
+            t.get("key") for t in
+            (pool.kube.get_node(spare).get("spec") or {}).get("taints") or []
+        )
+        fpath = str(tmp_path / "flight.jsonl")
+        roller = RollingReconfigurator(
+            pool.kube, "pool=tpu-pa", max_unavailable=1,
+            node_timeout_s=30, poll_interval_s=0.05,
+            surge=1, prestage=True,
+            flight=flight_mod.FlightRecorder(fpath),
+            metrics=MetricsRegistry(),
+        )
+        t0 = time.monotonic()
+        result = roller.rollout("on")
+        assert result.ok, result.summary()
+        events, _ = flight_mod.read_events(fpath)
+        surge_close = [
+            e for e in events
+            if e["event"] == flight_mod.EVENT_WINDOW_CLOSE
+            and e.get("wave") == "surge"
+        ][0]
+        # The pre-armed spare's whole surge phase (detection + flip) is
+        # a tiny fraction of the full path its pool-mate paid.
+        full_close = [
+            e for e in events
+            if e["event"] == flight_mod.EVENT_WINDOW_CLOSE
+            and e.get("wave") == 0
+        ][0]
+        assert surge_close["seconds"] < 0.5 * full_close["seconds"]
+        assert not (
+            pool.kube.get_node(spare).get("spec") or {}
+        ).get("taints"), "the rollout must reclaim the pre-armed taint"
+        del t0
+    finally:
+        pool.shutdown()
+
+
+def test_prestage_timeout_falls_back_to_full_flip(monkeypatch):
+    """Agents that never pre-stage (CC_PRESTAGE=0, older binaries) must
+    cost the surge phase only the bounded await — the flip itself then
+    takes the normal full path and the rollout still converges."""
+    monkeypatch.setenv("CC_PRESTAGE", "0")
+    pool = AgentPool(2, pool_label="tpu-pf")
+    try:
+        assert pool.settled()
+        roller = RollingReconfigurator(
+            pool.kube, "pool=tpu-pf", max_unavailable=1,
+            node_timeout_s=30, poll_interval_s=0.05,
+            surge=1, prestage=True, prestage_timeout_s=0.3,
+            metrics=MetricsRegistry(),
+        )
+        result = roller.rollout("on")
+        assert result.ok, result.summary()
+        for name in pool.names:
+            assert pool.state(name) == "on"
+    finally:
+        pool.shutdown()
+
+
+def test_ctl_status_shows_prestaged_note(capsys):
+    from tpu_cc_manager import ctl as ctl_mod
+
+    pool = AgentPool(1, pool_label="tpu-st")
+    name = pool.names[0]
+    try:
+        assert pool.settled()
+        pool.kube.patch_node_annotations(name, {PRESTAGE_ANNOTATION: "on"})
+        assert await_prestaged(pool.kube, name) is not None
+
+        class Args:
+            selector = "pool=tpu-st"
+            lease_namespace = None
+
+        ctl_mod.cmd_status(pool.kube, Args())
+        out = capsys.readouterr().out
+        assert "PRESTAGED(on," in out
+        assert "holding" in out, out
+    finally:
+        pool.shutdown()

@@ -93,6 +93,13 @@ DEFAULT_PREEMPTION_POLL_S = 5.0
 #: the apiserver copy is the only record that reaches the successor.
 HANDOFF_ANNOTATION = labels_mod.HANDOFF_ANNOTATION
 
+#: Spare pre-staging (zero-bounce flips): the request annotation names
+#: the mode to pre-stage; the status annotation carries the agent's JSON
+#: record {"mode", "prior", "seconds", "ts"} once the pre-staged flip
+#: completed. See labels.py for the full protocol.
+PRESTAGE_ANNOTATION = labels_mod.PRESTAGE_ANNOTATION
+PRESTAGED_ANNOTATION = labels_mod.PRESTAGED_ANNOTATION
+
 
 class _PipelineTask:
     """One overlapped pipeline step on a worker thread, with the caller's
@@ -206,6 +213,7 @@ class CCManager:
         smoke_warmup: bool | None = None,
         smoke_warmup_factory: Callable[[str], object] | None = None,
         state_dir: str | None = None,
+        prestage: bool | None = None,
     ) -> None:
         self.api = api
         self.backend = backend
@@ -451,6 +459,34 @@ class CCManager:
         # challenge nonce this agent answered, so the MODIFIED event our
         # own answer generates doesn't loop into another answer.
         self._answered_challenge_nonce: str | None = None
+        # Spare pre-staging (zero-bounce flips, CC_PRESTAGE, default on):
+        # a PRESTAGE annotation asks this agent to run the full journaled
+        # transition + warmup to a mode AHEAD of the rollout wave that
+        # will request it, publish the truthful state label, and HOLD
+        # there until the desired label catches up (or the annotation is
+        # deleted — the abort path). Caches are written on the watch-loop
+        # thread only, like _rollout_trace_parent.
+        if prestage is None:
+            prestage = os.environ.get(
+                "CC_PRESTAGE", "1"
+            ).lower() not in ("0", "false", "no")
+        self.prestage = prestage
+        self._prestage_request: str | None = None
+        self._prestaged: dict | None = None
+        # In-process copy of the last COMPLETED prestage record: watch
+        # events queued behind the (long) prestage pass carry stale node
+        # snapshots from mid-transition, and trusting them alone would
+        # re-run the pass once per queued event and let a stale view
+        # drop the hold. This copy is authoritative until consumed,
+        # aborted, or superseded by a different-mode reconcile.
+        self._prestage_done: dict | None = None
+        self._in_prestage = False
+        # True when the most recent reconcile resolved as a prestage
+        # HOLD (no hardware touched, desired deliberately not applied):
+        # the success-path housekeeping must not treat it as a completed
+        # desired-mode flip — consuming the prestage record there would
+        # clear the very annotations the hold runs on.
+        self._prestage_held = False
 
     # ------------------------------------------------------------------
     # Label plumbing
@@ -778,13 +814,22 @@ class CCManager:
                     # A consumed handoff is complete once any reconcile
                     # succeeds: the handed-off flip either committed or
                     # was superseded by a newer desired mode.
-                    self._retire_handoff()
+                    if not self._prestage_held:
+                        # A prestage HOLD is not a completed flip: the
+                        # handoff record and prestage annotations must
+                        # survive it untouched.
+                        self._retire_handoff()
+                        # Prestage housekeeping: a desired write matching
+                        # the pre-staged mode consumes the request; one
+                        # that moved past it clears the stale record.
+                        self._consume_prestage(mode)
                 return ok
         finally:
             self.reconciling = False
 
     def _set_cc_mode(self, mode: str) -> bool:
         mode = canonical_mode(mode)
+        self._prestage_held = False
         if self.remediation is not None and self.remediation.quarantined:
             # Containment: a quarantined node stops hammering known-bad
             # hardware. The reconcile is deferred (slow re-check cadence);
@@ -854,6 +899,14 @@ class CCManager:
             )
             return False
         if chips is None:  # nothing to reconfigure; state already reported
+            return True
+
+        if self._prestage_hold(mode, chips):
+            # Deliberate desired!=state: the node pre-staged a mode for
+            # an upcoming rollout wave and holds it. Not a failure, not
+            # drift — the wave's desired write (or a deleted request
+            # annotation) resolves it.
+            self._prestage_held = True
             return True
 
         if self._mode_is_set(chips, mode):
@@ -1970,6 +2023,10 @@ class CCManager:
             return
         from tpu_cc_manager.kubeclient.api import node_annotations
 
+        # Same GET serves the prestage caches: a restarted agent must
+        # know it is holding a pre-staged mode BEFORE its initial apply,
+        # or that apply would bounce the spare back to the desired mode.
+        self._note_prestage(node)
         raw = node_annotations(node).get(HANDOFF_ANNOTATION)
         if not raw:
             return
@@ -2025,6 +2082,237 @@ class CCManager:
         except KubeApiError as e:
             log.warning("could not clear the handoff annotation: %s", e)
             return False
+
+    # ------------------------------------------------------------------
+    # Spare pre-staging (zero-bounce flips)
+    # ------------------------------------------------------------------
+
+    def _note_prestage(self, node: dict) -> None:
+        """Cache the prestage request/status annotations off a node
+        object (watch event or startup GET). Garbled values parse to
+        None — a pre-staging hint must never fail a reconcile."""
+        from tpu_cc_manager.kubeclient.api import node_annotations
+
+        ann = node_annotations(node)
+        raw = ann.get(PRESTAGE_ANNOTATION)
+        mode = canonical_mode(str(raw)) if raw else ""
+        self._prestage_request = mode if mode in VALID_MODES else None
+        raw = ann.get(PRESTAGED_ANNOTATION)
+        prestaged = None
+        if raw:
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                obj = None
+            if (
+                isinstance(obj, dict)
+                and canonical_mode(str(obj.get("mode") or "")) in VALID_MODES
+            ):
+                prestaged = obj
+        if prestaged is None:
+            # A snapshot without the status record may simply predate
+            # our own publish (events queue behind the prestage pass):
+            # the in-process done record outranks a stale view.
+            prestaged = self._prestage_done
+        self._prestaged = prestaged
+
+    def _maybe_prestage(self, node: dict) -> bool | None:
+        """Run a pre-staging pass when the node's annotations ask for
+        one: the PRESTAGE annotation names a mode != desired, and the
+        node does not already hold it. Returns the pass's outcome for
+        the watch loop's backoff bookkeeping (the abort path — request
+        deleted mid-hold — returns the revert reconcile's outcome), or
+        None when nothing ran."""
+        self._note_prestage(node)
+        if not self.prestage:
+            return None
+        labels = node_labels(node)
+        desired = self.with_default(labels.get(CC_MODE_LABEL))
+        state_label = labels.get(labels_mod.CC_MODE_STATE_LABEL)
+        req = self._prestage_request
+        done_mode = (
+            canonical_mode(str(self._prestaged.get("mode") or ""))
+            if self._prestaged is not None else None
+        )
+        if req is None:
+            if done_mode is not None and done_mode == state_label != desired:
+                # Possible abort: the request annotation is gone while
+                # the node still HOLDS the pre-staged mode. Confirm
+                # against a FRESH read first — watch events queued
+                # behind a long reconcile can show this shape
+                # transiently (e.g. mid-consume after the wave landed).
+                fresh = self.api.get_node(self.node_name)
+                self._note_prestage(fresh)
+                fresh_labels = node_labels(fresh)
+                desired = self.with_default(fresh_labels.get(CC_MODE_LABEL))
+                state_label = fresh_labels.get(labels_mod.CC_MODE_STATE_LABEL)
+                done_mode = (
+                    canonical_mode(str(self._prestaged.get("mode") or ""))
+                    if self._prestaged is not None else None
+                )
+                if not (
+                    self._prestage_request is None
+                    and done_mode is not None
+                    and done_mode == state_label != desired
+                ):
+                    return None
+                # Confirmed: clear the status record and reconcile back
+                # to the desired mode.
+                log.warning(
+                    "prestage of mode %s aborted (request annotation "
+                    "deleted); reverting to desired mode %s",
+                    done_mode, desired,
+                )
+                self._prestage_done = None
+                self._clear_prestaged_annotation()
+                return self.set_cc_mode(desired)
+            return None
+        if req == desired:
+            # Moot: the wave arrived before (or instead of) the
+            # prestage pass — the normal desired-mode reconcile owns
+            # convergence and its success consumes the request.
+            return None
+        if done_mode == req and state_label == req:
+            return None  # already pre-staged and holding
+        if self._prestage_done is not None and canonical_mode(
+            str(self._prestage_done.get("mode") or "")
+        ) == req:
+            # This process already completed the pass; the snapshot is a
+            # stale mid-transition view queued behind it.
+            return None
+        return self._run_prestage(req, desired)
+
+    def _run_prestage(self, mode: str, prior: str) -> bool:
+        """The pre-staging pass itself: the FULL journaled transition
+        (drain/stage/reset/verify/warmup-backed smoke/readmit — crash
+        replay included) run against the annotation's mode while the
+        desired label still says ``prior``. The state label ends
+        truthful (it reports what the hardware holds); the hold guard
+        in _set_cc_mode keeps later reconciles from bouncing the spare
+        back until the wave's desired write lands or the request is
+        deleted."""
+        log.warning(
+            "pre-staging CC mode %s ahead of its rollout wave "
+            "(desired stays %s until the wave opens)", mode, prior,
+        )
+        t0 = time.monotonic()
+        self._in_prestage = True
+        try:
+            ok = self.set_cc_mode(mode)
+        finally:
+            self._in_prestage = False
+        seconds = round(time.monotonic() - t0, 3)
+        self.metrics.set_spare_prestage_seconds(seconds)
+        if not ok:
+            # The spare stays on the normal failed-reconcile path (the
+            # backoff retry re-applies the DESIRED mode, reverting any
+            # partial prestage); the orchestrator's prestage await times
+            # out and the wave falls back to a full flip.
+            log.error(
+                "pre-staging of mode %s FAILED after %.1fs; the wave "
+                "falls back to a full flip", mode, seconds,
+            )
+            self._emit_node_event(
+                "Warning", "CCPrestageFailed",
+                f"pre-staging of CC mode {mode} failed",
+            )
+            return False
+        record = {
+            "mode": mode,
+            "prior": prior,
+            "seconds": seconds,
+            "ts": round(time.time(), 3),
+        }
+        self._prestage_done = record
+        try:
+            self.api.patch_node_annotations(
+                self.node_name,
+                {PRESTAGED_ANNOTATION: json.dumps(record, sort_keys=True)},
+            )
+        except KubeApiError as e:
+            # The orchestrator never sees the record and falls back to a
+            # full-flip await; the hold still engages off the local
+            # cache, and the next successful publish heals it.
+            log.warning("could not publish the prestaged record: %s", e)
+        self._prestaged = record
+        self._emit_node_event(
+            "Normal", "CCNodePrestaged",
+            f"pre-staged CC mode {mode} in {seconds}s; holding for the "
+            "rollout wave",
+        )
+        return True
+
+    def _prestage_hold(self, mode: str, chips: tuple[TpuChip, ...]) -> bool:
+        """True while this node deliberately HOLDS a pre-staged mode
+        that differs from the desired one — the PRESTAGE annotation is
+        the suppression: without it, the first desired!=state reconcile
+        would bounce the spare straight back and waste the pre-staged
+        flip. The hold only binds against the desired mode recorded at
+        prestage time: a desired change to any THIRD mode breaks it and
+        reconciles normally (the pool moved on; the prestage is stale)."""
+        if self._in_prestage or not self.prestage:
+            return False
+        req, done = self._prestage_request, self._prestaged
+        if req is None or done is None or req == mode:
+            return False
+        if canonical_mode(str(done.get("mode") or "")) != req:
+            return False
+        if canonical_mode(str(done.get("prior") or "")) != mode:
+            return False
+        if not self._mode_is_set(chips, req):
+            return False
+        log.info(
+            "holding pre-staged mode %s (desired %s unchanged since the "
+            "prestage); the rollout wave's desired write completes the "
+            "flip instantly", req, mode,
+        )
+        return True
+
+    def _consume_prestage(self, mode: str) -> None:
+        """Housekeeping after a successful DESIRED-mode reconcile: a
+        matching prestage request is consumed (the wave arrived — the
+        PRESTAGED status record stays behind as the operator-visible
+        explanation of why the wave opened instantly); a record for a
+        DIFFERENT mode is stale (the pool moved on past it) and both
+        annotations clear so the hold cannot re-engage."""
+        if self._in_prestage:
+            return
+        cleared_req = False
+        if self._prestage_request is not None and self._prestage_request == mode:
+            cleared_req = self._clear_prestage_request()
+        done = self._prestaged
+        if done is not None and canonical_mode(
+            str(done.get("mode") or "")
+        ) != mode:
+            # The pool moved past the pre-staged mode: the record (and
+            # this process's done copy) is stale.
+            self._prestage_done = None
+            if not cleared_req and self._prestage_request is not None:
+                self._clear_prestage_request()
+            self._clear_prestaged_annotation()
+
+    def _clear_prestage_request(self) -> bool:
+        try:
+            self.api.patch_node_annotations(
+                self.node_name, {PRESTAGE_ANNOTATION: None}
+            )
+        except KubeApiError as e:
+            # Cache keeps the value; the next successful reconcile
+            # retries the clear.
+            log.warning("could not clear the prestage request: %s", e)
+            return False
+        self._prestage_request = None
+        return True
+
+    def _clear_prestaged_annotation(self) -> None:
+        try:
+            self.api.patch_node_annotations(
+                self.node_name, {PRESTAGED_ANNOTATION: None}
+            )
+        except KubeApiError as e:
+            log.warning("could not clear the prestaged record: %s", e)
+            return
+        self._prestaged = None
 
     def _start_preemption_monitor(self) -> None:
         """Poll the backend's preemption-notice source (GCE: metadata
@@ -2247,6 +2535,23 @@ class CCManager:
                 log.info("retrying failed reconcile")
                 apply_noted(last_label_value)
 
+        def prestage_noted(node: dict) -> None:
+            """Prestage pass with the same escaped-apiserver-error
+            discipline as apply_noted: an aborted pass schedules the
+            backoff retry (which re-applies the DESIRED mode, reverting
+            any partial prestage — the safe direction)."""
+            try:
+                pre = self._maybe_prestage(node)
+            except KubeApiError as e:
+                self._note_api_err(e)
+                log.warning(
+                    "pre-staging aborted by apiserver error (%s); "
+                    "scheduling backoff retry", e,
+                )
+                pre = False
+            if pre is not None:
+                note_result(pre)
+
         # The preemption monitor starts FIRST: a spot VM can be reclaimed
         # while the agent is still booting, and the fast-drain + handoff
         # window is too short to wait for the watch loop to settle.
@@ -2269,9 +2574,15 @@ class CCManager:
         try:
             # A challenge issued while the agent was down must not wait
             # for the next label edit to be answered.
-            self._maybe_answer_challenge(self.api.get_node(self.node_name))
+            node0 = self.api.get_node(self.node_name)
+            self._maybe_answer_challenge(node0)
         except KubeApiError as e:
             log.debug("startup challenge check failed (non-fatal): %s", e)
+        else:
+            # Likewise a prestage request that landed while the agent
+            # was down (or survived its restart) runs now, not at the
+            # next annotation edit.
+            prestage_noted(node0)
 
         while not (stop and stop.is_set()):
             timeout = self.watch_timeout_s
@@ -2322,6 +2633,10 @@ class CCManager:
                     # desired mode, so this event carries both.
                     self._note_rollout_trace(event_labels)
                     self._maybe_answer_challenge(event.object)
+                    # Refresh the prestage caches on EVERY event: the
+                    # apply below consults them (hold guard + consume)
+                    # even when this event is a desired-label change.
+                    self._note_prestage(event.object)
                     if value != last_label_value:
                         log.info(
                             "%s changed: %r -> %r",
@@ -2337,6 +2652,10 @@ class CCManager:
                             # lost).
                             break
                     else:
+                        # Prestage requests ride node-annotation events;
+                        # only considered while the desired label is
+                        # quiet — a pending desired change always wins.
+                        prestage_noted(event.object)
                         maybe_retry()
                 else:
                     # Stream ended normally (server-side timeout): the

@@ -30,6 +30,7 @@ for the per-node agents (the DaemonSet) to converge, with:
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import threading
 import time
@@ -187,6 +188,12 @@ CRASH_POINTS = (
     # orchestrator dying while latency-paused, and --resume must re-arm
     # the gate from the record (tests/test_rollout_resume.py).
     "slo-paused",
+    # Fired after the surge spares' pre-staging completed (journaled) but
+    # BEFORE their flip window opens: a kill here models the orchestrator
+    # dying between prestage and flip — the successor never re-surges,
+    # and the spares' held state converges them instantly when their
+    # groups are re-driven as ordinary windows.
+    "spare-prestaged",
 )
 
 
@@ -395,6 +402,8 @@ class RollingReconfigurator:
         informer=None,
         wave_shards: int = 1,
         surge: int = 0,
+        prestage: bool = False,
+        prestage_timeout_s: float | None = None,
         adopt_new_nodes: bool = True,
         flight: "flight_mod.FlightRecorder | None" = None,
         slo_gate=None,
@@ -486,6 +495,24 @@ class RollingReconfigurator:
         # migrate workloads onto already-flipped capacity and measured
         # pool unavailability stays bounded by max_unavailable.
         self.surge = max(0, int(surge))
+        # Zero-bounce spares (ROADMAP item 5): with ``prestage`` on, the
+        # surge phase first ARMS its spares — surge taint + the PRESTAGE
+        # annotation — and awaits the agents' pre-staged records (each
+        # agent runs the full journaled flip + compile warmup and HOLDS,
+        # manager.py) before opening the flip window, which then
+        # converges in ~drain+readmit time. Spares pre-armed AHEAD of
+        # the rollout (prestage_spares() / `ctl rollout --prestage-only`
+        # — overlapping the pre-staging with live serving or a
+        # preceding rollout wave) are detected either way and flip
+        # instantly without any in-rollout arming wait. Agents that
+        # never pre-stage (older binaries, CC_PRESTAGE=0) simply time
+        # the await out and fall back to the full flip — prestaging is
+        # an optimization, never a correctness gate.
+        self.prestage = bool(prestage)
+        self.prestage_timeout_s = (
+            prestage_timeout_s if prestage_timeout_s is not None
+            else node_timeout_s
+        )
         if self.surge > 0 and rollback_on_failure:
             # A surge halt would have to revert tainted spares (and the
             # halt path would silently skip the rollback otherwise) —
@@ -1248,15 +1275,7 @@ class RollingReconfigurator:
         unavailability (:meth:`_note_window_inflight`). Returns
         (every surge group converged, the remaining plan, surged node
         names)."""
-        spares: list[tuple[str, tuple[str, ...]]] = []
-        rest: list[tuple[str, tuple[str, ...]]] = []
-        budget = self.surge
-        for gid, names in groups:
-            if 0 < len(names) <= budget:
-                spares.append((gid, names))
-                budget -= len(names)
-            else:
-                rest.append((gid, names))
+        spares, rest = self._pick_spares(groups)
         if not spares:
             log.warning(
                 "surge=%d requested but no group fits the spare budget "
@@ -1272,6 +1291,24 @@ class RollingReconfigurator:
             flight_mod.EVENT_SURGE_PICK, nodes=surged,
             groups=[gid for gid, _ in spares],
         )
+        if self.prestage:
+            # Zero-bounce spares: arm + await pre-staging (or detect
+            # spares pre-armed ahead of the rollout), journal each
+            # pre-staged spare, then open the flip window — which for a
+            # pre-staged spare converges in ~drain+readmit time via the
+            # agent's idempotent re-attest path.
+            prestaged = self._prestage_phase(mode, spares)
+            if prestaged:
+                for gid, names in spares:
+                    for name in names:
+                        rec = prestaged.get(name)
+                        if rec is not None:
+                            self._fl(
+                                flight_mod.EVENT_SPARE_PRESTAGED,
+                                node=name, group=gid,
+                                seconds=rec.get("seconds"),
+                            )
+                self._crash_point("spare-prestaged")
         self._crash_point("window-start")
         started = time.monotonic()
         self._fl(
@@ -1342,6 +1379,206 @@ class RollingReconfigurator:
                     "(autoscaler scale-down); skipping",
                     name, "write" if add else "removal",
                 )
+
+    def _pick_spares(
+        self, groups: list[tuple[str, tuple[str, ...]]]
+    ) -> tuple[
+        list[tuple[str, tuple[str, ...]]], list[tuple[str, tuple[str, ...]]]
+    ]:
+        """Greedy plan-order spare pick: groups that fit the remaining
+        surge budget become spares (a multi-host slice flips as one unit
+        and is skipped rather than split). Pure function of the plan, so
+        a `--prestage-only` arm and the later surge rollout pick the
+        SAME spares."""
+        spares: list[tuple[str, tuple[str, ...]]] = []
+        rest: list[tuple[str, tuple[str, ...]]] = []
+        budget = self.surge
+        for gid, names in groups:
+            if 0 < len(names) <= budget:
+                spares.append((gid, names))
+                budget -= len(names)
+            else:
+                rest.append((gid, names))
+        return spares, rest
+
+    def _prestaged_record_of(self, node: dict, mode: str) -> dict | None:
+        """The node's pre-staged status record, when it is VALID for this
+        rollout: the PRESTAGED annotation parses, names ``mode``, and the
+        node's state label confirms it still holds it (a record without
+        the held state is stale — the agent reverted or never finished)."""
+        from tpu_cc_manager.kubeclient.api import node_annotations
+
+        raw = node_annotations(node).get(labels_mod.PRESTAGED_ANNOTATION)
+        if not raw:
+            return None
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            return None
+        if not isinstance(obj, dict):
+            return None
+        if canonical_mode(str(obj.get("mode") or "")) != mode:
+            return None
+        if node_labels(node).get(CC_MODE_STATE_LABEL) != mode:
+            return None
+        return obj
+
+    def _prestage_phase(
+        self,
+        mode: str,
+        spares: list[tuple[str, tuple[str, ...]]],
+    ) -> dict[str, dict]:
+        """Arm (surge taint + PRESTAGE annotation) and await the spares'
+        pre-staged records. Spares already holding a valid record (armed
+        ahead of the rollout) are detected without any wait; agents that
+        never pre-stage time the bounded await out and fall back to the
+        full flip. Returns {node: prestaged-record} for every spare
+        holding a valid record at the end of the phase."""
+        names = [n for _, ns in spares for n in ns]
+        by_name: dict[str, dict] = {}
+
+        def scan() -> bool:
+            nodes = {
+                n["metadata"]["name"]: n for n in self._list_pool()
+            }
+            for name in names:
+                node = nodes.get(name)
+                if node is None:
+                    continue
+                rec = self._prestaged_record_of(node, mode)
+                if rec is not None:
+                    by_name[name] = rec
+            return len(by_name) == len(names)
+
+        if scan():
+            log.info(
+                "surge: all %d spare(s) already pre-staged for %s "
+                "(armed ahead of the rollout)", len(names), mode,
+            )
+            return by_name
+        to_arm = [n for n in names if n not in by_name]
+        log.info(
+            "surge: arming pre-staging of %s on spare(s) %s "
+            "(await bounded at %.0fs)", mode, to_arm,
+            self.prestage_timeout_s,
+        )
+        for gid, ns in spares:
+            if any(n in to_arm for n in ns):
+                # The taint FIRST: the spare must be unschedulable for
+                # exactly its (pre-staged) flip window, like a plain
+                # surge flip — arming without it would bounce a node
+                # still receiving workloads.
+                self._taint_surge(ns, add=True)
+        for name in to_arm:
+            try:
+                self.retry_policy.call(
+                    lambda name=name: self.api.patch_node_annotations(
+                        name, {labels_mod.PRESTAGE_ANNOTATION: mode}
+                    ),
+                    op="rollout.prestage_arm",
+                    classify=classify_kube_error,
+                )
+            except KubeApiError as e:
+                if e.status != 404:
+                    raise
+                # Drop the vanished node from the await set too: leaving
+                # it in `names` would stall the whole prestage phase for
+                # the full timeout on a node that provably cannot answer
+                # (the flip window's await retires it as deleted).
+                log.warning(
+                    "node %s vanished before its prestage arm "
+                    "(autoscaler scale-down); skipping it in the "
+                    "prestage await", name,
+                )
+                names.remove(name)
+        retry_mod.poll_until(
+            scan, self.prestage_timeout_s, self.poll_interval_s
+        )
+        if len(by_name) < len(names):
+            log.warning(
+                "surge: %d spare(s) never reported pre-staged within "
+                "%.0fs (%s); their flip window falls back to the full "
+                "flip path",
+                len(names) - len(by_name), self.prestage_timeout_s,
+                sorted(set(names) - set(by_name)),
+            )
+        return by_name
+
+    def prestage_spares(self, mode: str) -> dict:
+        """Arm + await spare pre-staging WITHOUT flipping anything — the
+        ahead-of-the-rollout half of zero-bounce flips (``ctl rollout
+        --prestage-only``): pre-stage while the pool is still serving at
+        full capacity (the pre-staging overlaps the preceding wave of
+        live traffic, or a preceding rollout), then run the real
+        ``--surge --prestage`` rollout, whose spare window opens
+        instantly. Picks the same greedy plan-order spares the surge
+        phase will pick. The surge taint is KEPT on armed spares — they
+        hold a non-desired mode; the real rollout reclaims it when they
+        converge."""
+        mode = canonical_mode(mode)
+        if mode not in VALID_MODES:
+            raise ValueError(
+                f"invalid CC mode {mode!r} (valid: {VALID_MODES})"
+            )
+        if self.surge <= 0:
+            raise ValueError("prestage_spares requires surge > 0")
+        if self.informer is not None and not self.informer.synced:
+            self.informer.start()
+            if not self.informer.wait_for_sync(60.0):
+                raise KubeApiError(
+                    None, "informer cache never synced; refusing to "
+                    "pre-stage over a possibly-empty pool view"
+                )
+        listing = self._list_pool()
+        quarantined = set(self._quarantined_of(listing))
+        listing = [
+            n for n in listing
+            if n["metadata"]["name"] not in quarantined
+        ]
+        labels_by_name = {
+            n["metadata"]["name"]: node_labels(n) for n in listing
+        }
+        groups = [
+            (gid, names)
+            for gid, names in plan_groups(
+                self.api, self.selector, nodes=listing
+            )
+            if not all(
+                labels_by_name.get(n, {}).get(CC_MODE_LABEL) == mode
+                and labels_by_name.get(n, {}).get(CC_MODE_STATE_LABEL) == mode
+                for n in names
+            )
+        ]
+        spares, _rest = self._pick_spares(groups)
+        names = sorted(n for _, ns in spares for n in ns)
+        if not spares:
+            log.warning(
+                "prestage: surge=%d but no group fits the spare budget; "
+                "nothing to arm", self.surge,
+            )
+            return {
+                "mode": mode, "spares": [], "prestaged": [],
+                "seconds": 0.0, "ok": False,
+            }
+        t0 = time.monotonic()
+        prestaged = self._prestage_phase(mode, spares)
+        for gid, ns in spares:
+            for name in ns:
+                rec = prestaged.get(name)
+                if rec is not None:
+                    self._fl(
+                        flight_mod.EVENT_SPARE_PRESTAGED, node=name,
+                        group=gid, seconds=rec.get("seconds"),
+                    )
+        if prestaged:
+            self._crash_point("spare-prestaged")
+        return {
+            "mode": mode,
+            "spares": names,
+            "prestaged": sorted(prestaged),
+            "seconds": round(time.monotonic() - t0, 3),
+            "ok": len(prestaged) == len(names),
+        }
 
     # -- autoscaler scale-up adoption -------------------------------------
 

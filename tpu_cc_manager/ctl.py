@@ -96,6 +96,31 @@ def build_parser() -> argparse.ArgumentParser:
         "inherits the record's value)",
     )
     r.add_argument(
+        "--prestage", action="store_true",
+        help="zero-bounce spares: with --surge, arm the spares' "
+        "pre-staging (surge taint + prestage annotation — each agent "
+        "runs the full journaled flip + compile warmup ahead of the "
+        "wave and holds) and await their records before opening the "
+        "flip window, which then converges in ~drain+readmit time; "
+        "spares already armed by --prestage-only flip instantly. "
+        "Agents that never pre-stage fall back to the full flip after "
+        "--prestage-timeout",
+    )
+    r.add_argument(
+        "--prestage-only", action="store_true",
+        help="arm + await spare pre-staging and EXIT without flipping "
+        "anything (requires --surge N and --mode): run it while the "
+        "pool is still serving at full capacity, then the later "
+        "--surge --prestage rollout's spare window opens instantly. "
+        "The surge taint is kept on armed spares until that rollout "
+        "reclaims them",
+    )
+    r.add_argument(
+        "--prestage-timeout", type=float, default=None,
+        help="seconds to await the spares' pre-staged records before "
+        "falling back to the full flip (default: --node-timeout)",
+    )
+    r.add_argument(
         "--no-adopt", action="store_true",
         help="do NOT adopt nodes created mid-rollout (autoscaler "
         "scale-up) into a trailing wave; by default new selector-matching "
@@ -412,6 +437,27 @@ def cmd_rollout(api, args) -> int:
         # Contradictory: resume reads the record checkpointed in the
         # lease the other flag refuses to touch.
         raise ValueError("--resume cannot be combined with --no-lease")
+    if getattr(args, "prestage_only", False):
+        # Arm + await spare pre-staging and exit — writes only the surge
+        # taint + prestage annotations (no desired-mode labels), is
+        # idempotent, and touches no lease: the later --surge --prestage
+        # rollout owns the fenced flip.
+        if mode is None:
+            raise ValueError("--prestage-only requires --mode")
+        surge_n = getattr(args, "surge", None) or 0
+        if surge_n <= 0:
+            raise ValueError("--prestage-only requires --surge N")
+        roller = RollingReconfigurator(
+            api,
+            args.selector,
+            node_timeout_s=args.node_timeout,
+            surge=surge_n,
+            prestage=True,
+            prestage_timeout_s=getattr(args, "prestage_timeout", None),
+        )
+        summary = roller.prestage_spares(mode)
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
     lease = None
     resume_record = None
     if not getattr(args, "no_lease", False):
@@ -681,6 +727,8 @@ def cmd_rollout(api, args) -> int:
             informer=informer,
             wave_shards=wave_shards,
             surge=surge,
+            prestage=getattr(args, "prestage", False),
+            prestage_timeout_s=getattr(args, "prestage_timeout", None),
             adopt_new_nodes=not getattr(args, "no_adopt", False),
             flight=flight,
             slo_gate=slo_gate,
@@ -976,6 +1024,28 @@ def cmd_status(api, args) -> int:
             notes.append(f"reason={labels[CC_FAILED_REASON_LABEL]}")
         if labels.get(ROLLOUT_GEN_LABEL):
             notes.append(f"rollout-gen={labels[ROLLOUT_GEN_LABEL]}")
+        # Zero-bounce spares: a spare whose warmup completed shows
+        # PRESTAGED — while it HOLDS (desired != state) that explains
+        # the deliberate divergence; after the wave landed it explains
+        # why the wave opened instantly.
+        raw = node_annotations(node).get(labels_mod.PRESTAGED_ANNOTATION)
+        if raw:
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                rec = None
+            if isinstance(rec, dict) and rec.get("mode"):
+                held = labels.get(CC_MODE_LABEL) != rec.get("mode")
+                notes.append(
+                    f"PRESTAGED({rec['mode']},{rec.get('seconds')}s"
+                    + (",holding)" if held else ")")
+                )
+        if node_annotations(node).get(labels_mod.PRESTAGE_ANNOTATION) and not raw:
+            notes.append(
+                "prestaging("
+                + str(node_annotations(node).get(labels_mod.PRESTAGE_ANNOTATION))
+                + ")"
+            )
         token = handshake.request_token(
             labels.get(handshake.DRAIN_REQUESTED_LABEL)
         )

@@ -115,6 +115,20 @@ ROLLOUT_GEN_LABEL = "cloud.google.com/tpu-cc.rollout-gen"
 # NoSchedule taint and are reclaimed on convergence.
 SURGE_TAINT_KEY = "cloud.google.com/tpu-cc.surge"
 
+# Spare pre-staging (zero-bounce flips, ccmanager/manager.py +
+# rolling.py): the orchestrator (or an operator) writes the target mode
+# into the PRESTAGE annotation; the agent runs the full journaled
+# transition + compile warmup ahead of the wave, reports the truthful
+# state label, HOLDS there (the prestage annotation suppresses the
+# revert a desired!=state reconcile would otherwise perform), and
+# publishes a JSON status record — {"mode","prior","seconds","ts"} — in
+# the PRESTAGED annotation. The later desired-mode write then converges
+# in ~drain+readmit time via the idempotent re-attest path. Deleting the
+# PRESTAGE annotation aborts the hold (the agent reverts to the desired
+# mode on its next reconcile).
+PRESTAGE_ANNOTATION = "cloud.google.com/tpu-cc.prestage"
+PRESTAGED_ANNOTATION = "cloud.google.com/tpu-cc.prestaged"
+
 # Multi-slice attestation (ccmanager/multislice.py): summary quote,
 # full quote payload, and the verifier-challenge nonce.
 QUOTE_ANNOTATION = "cloud.google.com/tpu-cc.attestation"

@@ -294,6 +294,11 @@ def _seeded_registry_text() -> str:
     registry.set_serve_goodput(812.5)
     registry.set_serve_slo(30.0, 0.059, 0.2)
     registry.set_serve_slo(300.0, None, 0.0)  # empty window: no p99
+    # Zero-bounce flip families (serve/ handoff + ccmanager prestage).
+    registry.record_serve_handoff("accepted", 3)
+    registry.record_serve_handoff("fallback")
+    registry.record_serve_handoff('odd"outcome')
+    registry.set_spare_prestage_seconds(31.3)
     return registry.render_prometheus()
 
 

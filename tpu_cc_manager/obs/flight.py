@@ -71,6 +71,12 @@ EVENT_COMPLETE = "complete"
 EVENT_SLO_PAUSED = "slo-paused"
 EVENT_SLO_RESUMED = "slo-resumed"
 EVENT_SLO_HALT = "slo-halt"
+#: Zero-bounce spares (ccmanager/rolling.py prestage): one event per
+#: surge spare whose agent reported a completed pre-staged flip (the
+#: annotation record's seconds ride along) BEFORE its flip window
+#: opened — the timeline's explanation of a surge window that converged
+#: in ~drain+readmit time.
+EVENT_SPARE_PRESTAGED = "spare-prestaged"
 
 #: Node-terminal events: the exactly-once reconstruction keys on these
 #: (a node converges/fails/retires once per rollout, crash+resume
@@ -265,6 +271,7 @@ def reconstruct(events: list[dict]) -> dict:
     plan: dict | None = None
     adopted: list[str] = []
     surged: list[str] = []
+    prestaged: list[str] = []
     for e in events:
         ev = e.get("event")
         gen = e.get("gen")
@@ -280,6 +287,8 @@ def reconstruct(events: list[dict]) -> dict:
             slo_pauses += 1
         elif ev == EVENT_SURGE_PICK:
             surged.extend(e.get("nodes") or [])
+        elif ev == EVENT_SPARE_PRESTAGED:
+            prestaged.append(e.get("node"))
         elif ev == EVENT_NODE_ADOPTED:
             adopted.append(e.get("node"))
         elif ev in (EVENT_WINDOW_OPEN, EVENT_WINDOW_CLOSE):
@@ -344,6 +353,7 @@ def reconstruct(events: list[dict]) -> dict:
         "nodes": nodes,
         "adopted": sorted(n for n in adopted if n),
         "surged": sorted(set(surged)),
+        "prestaged": sorted({n for n in prestaged if n}),
         "halts": halts,
         "slo_pauses": slo_pauses,
         "duplicate_node_events": duplicates,

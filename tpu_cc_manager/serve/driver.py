@@ -166,6 +166,8 @@ class TrafficDriver:
         }
         self._next_id = 0  # cclint: guarded-by(_lock)
         self._requeues = 0  # cclint: guarded-by(_lock)
+        self._handoffs_accepted = 0  # cclint: guarded-by(_lock)
+        self._handoffs_fallback = 0  # cclint: guarded-by(_lock)
         self._shed: list[Request] = []  # cclint: guarded-by(_lock)
         self._offered = 0  # cclint: guarded-by(_lock)
         self._offered_at_tick = 0  # cclint: guarded-by(_lock)
@@ -220,6 +222,105 @@ class TrafficDriver:
                 )
         if self.metrics is not None:
             self.metrics.record_serve_outcome(node, "shed", len(reqs))
+
+    def on_handoff(self, node: str, reqs: list[Request]) -> tuple[int, int]:
+        """Serving-state handoff sink (SERVE_r03): a draining server's
+        parked in-flight + queued requests, re-dispatched DIRECTLY to
+        accepting peers instead of requeueing into the driver's queue —
+        called synchronously from the drain bracket, so the migration
+        lands inside the ack window. Requests are chunked by each
+        peer's current batch-ladder rung and offered round-robin;
+        whatever finds no accepting peer (every peer draining, or a
+        submit losing its own drain race) falls back to the plain
+        :meth:`on_requeue` — today's behavior, so conservation
+        (issued = completed + shed + lost) holds by construction.
+        Returns ``(migrated, fallback)`` counts: a request the peer's
+        admission control SHED at intake is neither — it left the
+        system as a counted shed, not a migration (counting it
+        accepted would inflate the zero-bounce evidence).
+
+        A migrated request keeps its original ``submitted_at`` (latency
+        stays stamped at arrival), carries its ``tokens_done`` progress,
+        and pays the state-transfer restore at the receiving executor
+        (``restore_pending`` → ``resume_from_progress``)."""
+        queue = list(reqs)
+        accepted_total = 0
+        fallback: list[Request] = []
+        # Snapshot targets + rungs under the lock; submit OUTSIDE it —
+        # a peer's intake may synchronously shed into on_shed, which
+        # takes this same (non-reentrant) lock.
+        with self._lock:
+            rungs = dict(self._batch)
+        peers = [
+            (name, server) for name, server in self.servers.items()
+            if name != node and server.accepting()
+        ]
+        while queue and peers:
+            still_accepting = []
+            for pname, server in peers:
+                if not queue:
+                    break
+                chunk = queue[: max(1, rungs.get(pname, 1))]
+                for r in chunk:
+                    # Progress-carrying requests owe a restore at the
+                    # new executor; fresh (queued, zero-progress) ones
+                    # have no state to transfer.
+                    r.handoffs += 1
+                    r.restore_pending = r.tokens_done > 0
+                with self._lock:
+                    self._outstanding[node] = max(
+                        0, self._outstanding[node] - len(chunk)
+                    )
+                    self._outstanding[pname] = (
+                        self._outstanding.get(pname, 0) + len(chunk)
+                    )
+                # front=True: migrated requests are the oldest in-flight
+                # work in the system; they resume ahead of the peer's
+                # queued fresh traffic (its executing batch still
+                # finishes first).
+                if server.submit(chunk, front=True):
+                    del queue[: len(chunk)]
+                    # The peer's intake may have SHED part of the chunk
+                    # (on_shed stamps shed_at synchronously inside
+                    # submit): those left the system as counted sheds,
+                    # not migrations — excluded from the accepted count
+                    # and their handoff marks reverted.
+                    for r in chunk:
+                        if r.shed_at is not None:
+                            r.handoffs -= 1
+                            r.restore_pending = False
+                        else:
+                            accepted_total += 1
+                    still_accepting.append((pname, server))
+                else:
+                    # Lost the race with the peer's own drain: undo the
+                    # outstanding transfer and stop offering to it.
+                    with self._lock:
+                        self._outstanding[pname] = max(
+                            0, self._outstanding[pname] - len(chunk)
+                        )
+                        self._outstanding[node] += len(chunk)
+                    for r in chunk:
+                        r.handoffs -= 1
+                        r.restore_pending = False
+            peers = still_accepting
+        fallback = queue
+        with self._lock:
+            self._handoffs_accepted += accepted_total
+            self._handoffs_fallback += len(fallback)
+        if self.metrics is not None:
+            if accepted_total:
+                self.metrics.record_serve_handoff("accepted", accepted_total)
+            if fallback:
+                self.metrics.record_serve_handoff("fallback", len(fallback))
+        if fallback:
+            for r in fallback:
+                # The durable checkpoint the draining node charges
+                # covers exactly these — they survive in the driver's
+                # queue on the written copy alone.
+                r.checkpoints += 1
+            self.on_requeue(node, fallback)
+        return accepted_total, len(fallback)
 
     def on_requeue(self, node: str, reqs: list[Request]) -> None:
         """Checkpointed requests coming back from a draining server:
@@ -479,6 +580,8 @@ class TrafficDriver:
                 self._outstanding.values()
             )
             requeues = self._requeues
+            handoffs_accepted = self._handoffs_accepted
+            handoffs_fallback = self._handoffs_fallback
             issued = self._next_id
             open_loop_t0 = self._open_loop_t0
             traffic_stopped_t = self._traffic_stopped_t
@@ -554,6 +657,14 @@ class TrafficDriver:
             "requests_completed": len(completed),
             "requests_lost": lost,
             "requests_requeued": requeues,
+            # Serving-state handoff: parked requests a draining node
+            # migrated straight to an accepting peer (accepted) vs ones
+            # that found no peer and fell back to the local requeue
+            # (fallback, a subset of requests_requeued).
+            "handoffs": {
+                "accepted": handoffs_accepted,
+                "fallback": handoffs_fallback,
+            },
             "requests_shed": len(shed),
             "shed_rate": round(len(shed) / issued, 6) if issued else 0.0,
             "deadline_misses": misses,

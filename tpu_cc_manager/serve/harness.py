@@ -83,6 +83,7 @@ class ServeHarness:
         metrics_port: int | None = None,
         slo_windows_s: tuple[float, ...] = (5.0, 30.0),
         slo_error_budget: float = 1e-3,
+        handoff: bool = False,
     ) -> None:
         self.n_nodes = n_nodes
         self.nodes = [f"serve-node-{i}" for i in range(n_nodes)]
@@ -110,6 +111,11 @@ class ServeHarness:
         self.reset_latency_s = reset_latency_s
         self.boot_latency_s = boot_latency_s
         self.driver_kwargs = driver_kwargs or {}
+        # Serving-state handoff (SERVE_r03): a draining server's parked
+        # requests migrate straight to an accepting peer inside the ack
+        # window instead of requeueing into the driver queue. Off by
+        # default so SERVE_r01/r02 measurements keep their shape.
+        self.handoff = handoff
         self.kube = FakeKube()
         self.backends: dict[str, FakeTpuBackend] = {}
         self.agents: list[CCManager] = []
@@ -168,6 +174,10 @@ class ServeHarness:
                 on_complete=lambda n, r, u: self.driver.on_complete(n, r, u),
                 on_requeue=lambda n, rs: self.driver.on_requeue(n, rs),
                 on_shed=lambda n, rs: self.driver.on_shed(n, rs),
+                on_handoff=(
+                    (lambda n, rs: self.driver.on_handoff(n, rs))
+                    if self.handoff else None
+                ),
                 executor=self.executor_factory(),
                 checkpoint_full_s=self.checkpoint_full_s,
                 metrics=self.metrics,
@@ -312,7 +322,11 @@ class ServeHarness:
                     if s.last_checkpoint_s is not None else None
                 ),
                 "last_checkpoint_deadline_s": s.last_checkpoint_deadline_s,
+                # Both per-LAST-drain, so the pair stays comparable;
+                # the cumulative migration count rides separately.
                 "requeued": s.last_checkpoint_requeued,
+                "handed_off": s.last_handoff_accepted,
+                "handed_off_total": s.handoffs_accepted,
             }
             for name, s in self.servers.items()
         }

@@ -13,12 +13,22 @@ manager requests a drain the server
    published ``drain.deadline-s`` hint's budget share the park wait left
    over (a preemption fast-drain's hard window must bound the whole
    bracket, not truncate it — normal drains pay the full write), and
-4. requeues every unfinished request to the driver — progress
-   (``tokens_done``) preserved, so the retry only pays the remaining
-   tokens — before the subscriber acks the cycle (a batch that outruns
-   the park budget is the one exception: it requeues the moment it
-   parks, which under deadline pressure may land just after the ack —
-   conserved either way).
+4. hands every unfinished request to the driver's ``on_handoff`` sink
+   (when wired — SERVE_r03's zero-bounce path): the sink re-dispatches
+   them DIRECTLY to an accepting peer, still inside the ack window, and
+   whatever finds no accepting peer falls back to the plain requeue —
+   so conservation (issued = completed + shed + lost) holds by
+   construction whichever path each request takes. Without a sink,
+   requeues everything to the driver — progress (``tokens_done``)
+   preserved, so the retry only pays the remaining tokens — before the
+   subscriber acks the cycle (a batch that outruns the park budget is
+   the one exception: it requeues the moment it parks, which under
+   deadline pressure may land just after the ack — conserved either
+   way). The durable-checkpoint write is charged only for requests that
+   did NOT migrate live: a handed-off request's decode state transfers
+   with it and is paid as a restore at the receiving executor
+   (:meth:`SimulatedExecutor.resume_from_progress`), not as a durable
+   write here.
 
 The executor is a latency/bandwidth model by default
 (:class:`SimulatedExecutor`, calibratable from a real llama smoke
@@ -49,6 +59,15 @@ STATE_DRAINING = "draining"
 #: and (on a preemption) the handoff publish.
 DEFAULT_CHECKPOINT_BUDGET_FRACTION = 0.5
 
+#: Restoring a handed-off request's checkpointed decode state on the
+#: receiving node re-ingests its ``tokens_done`` context at prefill
+#: speed, which is roughly an order of magnitude faster than decode
+#: (weights stream once for the whole re-ingest instead of once per
+#: token) — so the restore charge is this fraction of the decode-side
+#: per-token rate. Deliberately not a calibration parameter:
+#: ``from_smoke_result`` stays untouched.
+RESTORE_PREFILL_FRAC = 0.1
+
 
 @dataclasses.dataclass
 class Request:
@@ -74,6 +93,12 @@ class Request:
     deadline_at: float | None = None
     started_at: float | None = None
     shed_at: float | None = None
+    # Serving-state handoff (SERVE_r03): how many times this request
+    # migrated from a draining node to an accepting peer, and whether
+    # its checkpointed decode state still awaits the restore charge at
+    # the next executor dispatch (cleared by resume_from_progress).
+    handoffs: int = 0
+    restore_pending: bool = False
 
     def remaining(self) -> int:
         return max(0, self.decode_tokens - self.tokens_done)
@@ -138,6 +163,29 @@ class SimulatedExecutor:
         charges, so the estimate and the charge cannot drift."""
         return self.base_s + self.per_token_s * max(0, tokens)
 
+    def resume_from_progress(
+        self, batch: list[Request], stop: threading.Event,
+    ) -> float:
+        """Charge the one-time restore of checkpointed decode state for
+        requests handed off from a draining peer: one dispatch overhead
+        plus a prefill-speed re-ingest of the LONGEST checkpointed
+        context in the batch (restores run batch-parallel like decode).
+        Requests without ``restore_pending`` cost nothing — the method
+        is a no-op outside the handoff path, so closed-loop/requeue
+        behavior is byte-identical to before. Returns the seconds
+        charged and clears the flags."""
+        tokens = max(
+            (r.tokens_done for r in batch if r.restore_pending), default=0
+        )
+        restored = [r for r in batch if r.restore_pending]
+        if not restored:
+            return 0.0
+        cost = self.base_s + RESTORE_PREFILL_FRAC * self.per_token_s * tokens
+        retry_mod.wait(cost, stop)
+        for r in restored:
+            r.restore_pending = False
+        return cost
+
     def execute(
         self, batch: list[Request], interrupt: threading.Event,
         stop: threading.Event,
@@ -166,6 +214,7 @@ class NodeServer:
         on_complete,
         on_requeue,
         on_shed=None,
+        on_handoff=None,
         executor: SimulatedExecutor | None = None,
         job_name: str = "serve",
         poll_interval_s: float = 0.05,
@@ -195,6 +244,15 @@ class NodeServer:
         # it past ITS deadline too. Shed requests go to this callback
         # (counted outcome=shed by the driver; never lost).
         self._on_shed = on_shed          # (node_name, list[Request])
+        # Serving-state handoff (SERVE_r03): the drain bracket hands its
+        # parked in-flight + queued requests to this driver-side sink
+        # instead of requeueing them locally; the sink re-dispatches
+        # them to an accepting peer INSIDE the ack window and returns
+        # how many a peer accepted (it requeues the rest itself — the
+        # no-accepting-peer fallback IS today's local requeue, so
+        # conservation holds whichever path each request takes). None =
+        # the pre-handoff behavior, unchanged.
+        self._on_handoff = on_handoff    # (node_name, list[Request]) -> int
         self.checkpoint_full_s = checkpoint_full_s
         self.checkpoint_budget_fraction = checkpoint_budget_fraction
         self.restore_s = restore_s
@@ -231,6 +289,8 @@ class NodeServer:
         self.last_checkpoint_s: float | None = None
         self.last_checkpoint_deadline_s: float | None = None
         self.last_checkpoint_requeued = 0
+        self.last_handoff_accepted = 0
+        self.handoffs_accepted = 0
         self.last_hbm_bw_util: float | None = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -295,9 +355,13 @@ class NodeServer:
         with self._lock:
             return self._queue_delay_estimate_s()
 
-    def submit(self, batch: list[Request]) -> bool:
+    def submit(self, batch: list[Request], front: bool = False) -> bool:
         """Accept one batch for execution; False while draining/drained
         (the driver keeps the requests and routes them elsewhere).
+        ``front`` queues the batch AHEAD of waiting work — the handoff
+        sink uses it because migrated requests are the oldest in-flight
+        work in the system and re-queueing them behind the peer's fresh
+        pipe would compound the bounce delay they already paid.
 
         Admission control: requests carrying a deadline are shed at
         intake when the estimated queue delay plus their own service
@@ -327,7 +391,10 @@ class NodeServer:
                 r.attempts += 1
                 accepted.append(r)
             if accepted:
-                self._queue.append(accepted)
+                if front:
+                    self._queue.insert(0, accepted)
+                else:
+                    self._queue.append(accepted)
                 self._work.set()
         self._export_gauges()
         if shed and self._on_shed is not None:
@@ -358,6 +425,9 @@ class NodeServer:
                     # original start, so queue delay measures the wait
                     # before ANY service, not the latest hop's.
                     r.started_at = dispatch_t
+            # Handed-off requests pay their state-transfer restore here,
+            # at the receiving executor (no-op for everything else).
+            self.executor.resume_from_progress(batch, self._stop)
             util = self.executor.execute(batch, self._drain_break, self._stop)
             now = self.clock()
             with self._lock:
@@ -392,8 +462,9 @@ class NodeServer:
     def _on_drain(self) -> None:
         """Checkpoint-and-drain, run on the subscriber thread BEFORE the
         ack is published — the manager's bounded ack wait covers exactly
-        this bracket: park the in-flight batch (bounded), checkpoint, and
-        requeue everything unfinished, then let the ack go out. The park
+        this bracket: park the in-flight batch (bounded), hand everything
+        unfinished to the peer-migration sink (or checkpoint + requeue it
+        locally without one), then let the ack go out. The park
         wait and the checkpoint write share ONE budget (the hint's
         fraction): each bounded separately could consume 2× the share of
         a hard window that also has to fit the manager's eviction and
@@ -425,30 +496,63 @@ class NodeServer:
             # nothing can strand in the parked list between drains.
             self._drain_collecting = False
         to_requeue = pending + parked
+        # Serving-state handoff: migrate the parked batch + queued
+        # requests to an accepting peer FIRST, still inside the ack
+        # window — a live migration carries the decode state with the
+        # request (the restore is charged at the receiving executor),
+        # so migrated requests skip the durable write entirely. The
+        # sink requeues whatever found no accepting peer itself; the
+        # durable-checkpoint charge below then covers exactly that
+        # remainder (its progress survives only in the written copy),
+        # and the ack still waits out the write as before.
+        accepted = 0
+        fallback = 0
+        if self._on_handoff is not None and to_requeue:
+            # The sink owns every request from here (migrated ones may
+            # already be EXECUTING on a peer — this thread must not
+            # touch them again); it requeues the fallback remainder
+            # itself and stamps those requests' checkpoint counts.
+            accepted, fallback = self._on_handoff(self.node_name, to_requeue)
+            to_requeue = []
+        self.last_handoff_accepted = accepted
+        self.handoffs_accepted += accepted
         # Simulated durable checkpoint write: the full write when no
         # deadline pressure; under a hint, whatever of the budget the
         # park wait left over — the hint exists so jobs can fit the
         # window instead of starting a write the kill would truncate
-        # (drain/handshake.py).
-        if budget is not None:
+        # (drain/handshake.py). Skipped when nothing took the local
+        # requeue path (migrated requests carry their state with them;
+        # peer-shed ones left the system) — only fallback requests
+        # depend on the durable copy.
+        if self._on_handoff is not None and fallback == 0:
+            ckpt_s = 0.0
+        elif budget is not None:
             remaining = max(0.0, budget - (time.monotonic() - t0))
             ckpt_s = min(self.checkpoint_full_s, remaining)
         else:
             ckpt_s = self.checkpoint_full_s
         retry_mod.wait(ckpt_s, self._stop)
-        for r in pending:
-            r.checkpoints += 1
+        if self._on_handoff is None:
+            for r in pending:
+                r.checkpoints += 1
+        # else: the sink stamped the fallback requests' checkpoint
+        # counts itself; migrated requests may already be executing on a
+        # peer and must not be touched from this thread.
         self.last_checkpoint_s = time.monotonic() - t0
         self.last_checkpoint_deadline_s = deadline
-        self.last_checkpoint_requeued = len(to_requeue)
+        # Requests that took the LOCAL requeue path this drain (the
+        # durable write covers exactly these; migrated/shed ones do not
+        # count — see last_handoff_accepted for the migrations).
+        self.last_checkpoint_requeued = len(to_requeue) + fallback
         self.drains += 1
         self._export_gauges()
         if to_requeue:
             self._on_requeue(self.node_name, to_requeue)
         log.info(
-            "server %s drained: %d requeued, checkpoint %.3fs (hint=%s)",
-            self.node_name, len(to_requeue), self.last_checkpoint_s,
-            deadline,
+            "server %s drained: %d requeued (%d handed off), checkpoint "
+            "%.3fs (hint=%s)",
+            self.node_name, self.last_checkpoint_requeued, accepted,
+            self.last_checkpoint_s, deadline,
         )
 
     def _on_resume(self) -> None:

@@ -253,6 +253,14 @@ class MetricsRegistry:
         self._serve_goodput: float | None = None  # cclint: guarded-by(_lock)
         # window_s -> (p99_s or None, burn_rate)
         self._serve_slo: dict[float, tuple[float | None, float]] = {}  # cclint: guarded-by(_lock)
+        # Zero-bounce flips (serve/ handoff + ccmanager prestage): parked
+        # requests migrated to a peer at drain time by outcome (accepted
+        # = a peer took them inside the ack window; fallback = no
+        # accepting peer, local requeue), and how long the most recent
+        # spare pre-staging (annotation-driven full flip + warmup ahead
+        # of the rollout wave) took.
+        self._serve_handoff_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._spare_prestage_seconds: float | None = None  # cclint: guarded-by(_lock)
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -508,6 +516,29 @@ class MetricsRegistry:
         with self._lock:
             self._serve_offered_rps = max(0.0, rps)
 
+    def record_serve_handoff(self, outcome: str, count: int = 1) -> None:
+        """Count parked requests a draining node's drain bracket handed
+        to the driver's migration sink, by outcome: ``accepted`` (an
+        accepting peer took them inside the ack window — the zero-bounce
+        path) or ``fallback`` (no accepting peer; requeued locally,
+        today's behavior)."""
+        with self._lock:
+            self._serve_handoff_totals[outcome] = (
+                self._serve_handoff_totals.get(outcome, 0) + count
+            )
+
+    def serve_handoff_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._serve_handoff_totals)
+
+    def set_spare_prestage_seconds(self, seconds: float) -> None:
+        """Record how long the most recent spare pre-staging took — the
+        annotation-driven full flip + compile warmup a surge spare runs
+        BEFORE the rollout wave that needs it opens (ccmanager/manager.py
+        prestage; the wave then converges in ~drain+readmit time)."""
+        with self._lock:
+            self._spare_prestage_seconds = max(0.0, seconds)
+
     def record_slo_pause(self) -> None:
         """Count one SLO-gate pause of a rolling rollout's next wave
         (ccmanager/rolling.py wave boundaries)."""
@@ -539,6 +570,7 @@ class MetricsRegistry:
                 "inflight": dict(self._serve_inflight),
                 "goodput_rps": self._serve_goodput,
                 "slo": dict(self._serve_slo),
+                "handoffs": dict(self._serve_handoff_totals),
             }
 
     def rollout_totals(self) -> dict[str, int]:
@@ -644,6 +676,8 @@ class MetricsRegistry:
             rollout_slo_pauses = self._rollout_slo_pauses_total
             serve_goodput = self._serve_goodput
             serve_slo = dict(self._serve_slo)
+            serve_handoffs = dict(self._serve_handoff_totals)
+            spare_prestage_seconds = self._spare_prestage_seconds
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -966,6 +1000,31 @@ class MetricsRegistry:
             )
             lines.append("# TYPE tpu_cc_serve_offered_rps gauge")
             lines.append("tpu_cc_serve_offered_rps %.3f" % serve_offered)
+        if serve_handoffs:
+            lines.append(
+                "# HELP tpu_cc_serve_handoffs_total Parked in-flight "
+                "requests a draining node handed to the driver's "
+                "migration sink, by outcome (accepted = re-dispatched "
+                "to an accepting peer inside the ack window; fallback = "
+                "no accepting peer, local requeue)."
+            )
+            lines.append("# TYPE tpu_cc_serve_handoffs_total counter")
+            for outcome in sorted(serve_handoffs):
+                lines.append(
+                    "tpu_cc_serve_handoffs_total%s %d"
+                    % (_labels(outcome=outcome), serve_handoffs[outcome])
+                )
+        if spare_prestage_seconds is not None:
+            lines.append(
+                "# HELP tpu_cc_spare_prestage_seconds Duration of the "
+                "most recent spare pre-staging (annotation-driven full "
+                "flip + compile warmup run ahead of the rollout wave "
+                "that needs the spare)."
+            )
+            lines.append("# TYPE tpu_cc_spare_prestage_seconds gauge")
+            lines.append(
+                "tpu_cc_spare_prestage_seconds %.3f" % spare_prestage_seconds
+            )
         if rollout_slo_pauses:
             lines.append(
                 "# HELP tpu_cc_rollout_slo_pauses_total Rollout waves "
