@@ -8,6 +8,7 @@ command; this entrypoint keeps the historical invocation working:
   python3 hack/check_metrics_lint.py                # lint a seeded live registry
   python3 hack/check_metrics_lint.py --url URL      # lint a live /metrics scrape
   python3 hack/check_metrics_lint.py --file PATH    # lint a saved exposition
+  python3 hack/check_metrics_lint.py --fleet        # lint the gateway's MERGED exposition
 
 tests/test_metrics_lint.py imports this module's names; they re-export
 from the package unchanged.
@@ -25,6 +26,7 @@ sys.path.insert(
 from tpu_cc_manager.lint.expo import (  # noqa: E402,F401 - re-exports
     lint,
     main,
+    _seeded_fleet_text,
     _seeded_registry_text,
 )
 

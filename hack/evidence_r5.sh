@@ -43,6 +43,7 @@ mkdir -p artifacts
 ARTIFACTS=(
   artifacts/chaos_soak.json
   SCALE_r01.json
+  FLEET_r01.json
   SERVE_r01.json
   SERVE_r02.json
   SERVE_r03.json
@@ -162,6 +163,24 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s SCALE_r01.json ] && mv SCALE_r01.json artifacts/SCALE_r01.failed.json
     echo ">>> scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r01.failed.json; partial rows kept for resume)"
+    finish
+  }
+fi
+
+# Fleet observability evidence (FLEET_r01): the federation gateway over
+# a 100-agent in-process fleet — one-interval scrape+merge convergence,
+# merged exposition through the exposition lint, killed agents stale
+# within 2 sweeps, and a sharded kill+resume rollout whose stitched
+# cross-shard timeline reconstructs exactly-once outcomes. CPU-only,
+# single point, same skip/park discipline as the other stages.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("FLEET_r01.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> FLEET_r01.json already captured (ok:true); skipping"
+else
+  echo "=== stage: scale-bench --gateway (fleet gateway, no tunnel) ==="
+  python3 hack/scale_bench.py --gateway --out FLEET_r01.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s FLEET_r01.json ] && mv FLEET_r01.json artifacts/FLEET_r01.failed.json
+    echo ">>> fleet gateway bench FAILED; stopping ladder (summary in artifacts/FLEET_r01.failed.json)"
     finish
   }
 fi

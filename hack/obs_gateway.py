@@ -1,0 +1,193 @@
+"""Fleet observability gateway server (obs/fleet.py, standalone).
+
+Runs a :class:`~tpu_cc_manager.obs.fleet.FleetGateway` as a process:
+discovers agent endpoints (informer over the node pool, or an explicit
+``--targets`` list), sweeps them on an interval, and serves the merged
+fleet truth:
+
+- ``/metrics``  — the federated ``tpu_cc_*`` rollups plus the
+  ``tpu_cc_fleet_*`` families (capacity ledger included);
+- ``/fleetz``   — JSON per-node freshness/headroom/SLO-burn ledger;
+  ``/fleetz?rollout=`` adds the stitched cross-shard rollout timeline;
+- ``/healthz``  — liveness.
+
+Usage:
+    python hack/obs_gateway.py --selector pool=tpu             # informer discovery
+    python hack/obs_gateway.py --targets a=http://h1:9100 b=http://h2:9100
+    python hack/obs_gateway.py --smoke                         # CI self-test, no cluster
+
+``--smoke`` needs no cluster and no sockets beyond an ephemeral
+loopback port: it builds an in-process 3-agent fleet (seeded
+registries), runs two sweeps, asserts the merged exposition passes the
+exposition lint, kills an agent and asserts it goes stale — the fast
+gateway check the cclint CI job runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_cc_manager.obs import fleet as fleet_mod  # noqa: E402
+
+log = logging.getLogger("obs_gateway")
+
+DEFAULT_AGENT_PORT = 9100
+
+
+def parse_targets(pairs: list[str]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for pair in pairs:
+        name, sep, url = pair.partition("=")
+        if not sep or not name or not url:
+            raise SystemExit(f"--targets entries are name=url, got {pair!r}")
+        out[name] = url
+    return out
+
+
+def discover_loop(gateway, selector: str, agent_port: int, stop) -> None:
+    """Keep the gateway's target set synced to the informer's node list
+    (nodes joining the pool start being scraped next sweep; nodes
+    leaving drop out of the ledger)."""
+    from tpu_cc_manager.ccmanager.informer import NodeInformer
+    from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+    from tpu_cc_manager.utils import retry as retry_mod
+
+    api = RestKube(ClusterConfig.load(None))
+    informer = NodeInformer(api, selector)
+    informer.start(sync_timeout_s=30.0)
+    try:
+        while not stop.is_set():
+            gateway.set_targets(
+                fleet_mod.targets_from_nodes(informer.list(), agent_port)
+            )
+            if retry_mod.wait(gateway.interval_s, stop):
+                return
+    finally:
+        informer.stop()
+
+
+def smoke() -> int:
+    """CI self-test: merged exposition lints clean over a live loopback
+    server, and a killed agent is marked stale within 2 sweeps."""
+    from tpu_cc_manager.lint import expo
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    registries = {}
+    for i in range(3):
+        reg = MetricsRegistry()
+        reg.observe_serve_request(f"smoke-node-{i}", 0.02 * (i + 1))
+        reg.observe_serve_request(f"smoke-node-{i}", 0.3)
+        reg.set_serve_queue_depth(f"smoke-node-{i}", i)
+        reg.set_serve_hbm_bw_util(f"smoke-node-{i}", 0.5 + 0.1 * i)
+        registries[f"smoke-node-{i}"] = reg
+
+    alive = {name: True for name in registries}
+
+    def target(name, reg):
+        inner = fleet_mod.local_target(reg)
+
+        def fetch(path: str) -> str:
+            if not alive[name]:
+                raise ConnectionError("agent killed")
+            return inner(path)
+
+        return fetch
+
+    gateway = fleet_mod.FleetGateway(
+        targets={n: target(n, r) for n, r in registries.items()},
+        scrape_deadline_s=1.0,
+        stale_after_sweeps=2,
+    )
+    gateway.scrape_once()
+    server = gateway.serve(port=0)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            merged = resp.read().decode()
+        problems = expo.lint(merged)
+        assert not problems, f"merged exposition lint: {problems}"
+        assert "tpu_cc_fleet_headroom_nodes 3" in merged, merged
+        assert 'tpu_cc_hbm_bw_util{node="smoke-node-1"}' in merged
+
+        alive["smoke-node-2"] = False
+        gateway.scrape_once()
+        gateway.scrape_once()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleetz", timeout=5
+        ) as resp:
+            fleetz = json.load(resp)
+        assert fleetz["fleet"]["stale_nodes"] == ["smoke-node-2"], fleetz
+        assert fleetz["fleet"]["headroom_nodes"] == 2, fleetz
+        also_lint = expo.lint(gateway.metrics_text())
+        assert not also_lint, also_lint
+    finally:
+        server.shutdown()
+    print("obs_gateway smoke: OK (merged exposition lints clean; "
+          "killed agent stale within 2 sweeps)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--selector", default=None,
+                        help="node selector for informer target discovery")
+    parser.add_argument("--targets", nargs="+", default=None,
+                        metavar="NAME=URL",
+                        help="explicit agent endpoints (skips the informer)")
+    parser.add_argument("--agent-port", type=int, default=DEFAULT_AGENT_PORT,
+                        help="agent /metrics port for discovered nodes")
+    parser.add_argument("--port", type=int, default=9200)
+    parser.add_argument("--bind", default="127.0.0.1")
+    parser.add_argument("--interval", type=float, default=5.0)
+    parser.add_argument("--scrape-deadline", type=float, default=2.0)
+    parser.add_argument("--stale-after", type=int, default=2)
+    parser.add_argument("--workers", type=int, default=8)
+    parser.add_argument("--smoke", action="store_true",
+                        help="in-process CI self-test; no cluster needed")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s"
+    )
+
+    if args.smoke:
+        return smoke()
+    if not args.selector and not args.targets:
+        parser.error("one of --selector, --targets or --smoke is required")
+
+    gateway = fleet_mod.FleetGateway(
+        targets=parse_targets(args.targets) if args.targets else None,
+        interval_s=args.interval,
+        scrape_deadline_s=args.scrape_deadline,
+        stale_after_sweeps=args.stale_after,
+        workers=args.workers,
+    )
+    stop = threading.Event()
+    if args.selector:
+        threading.Thread(
+            target=discover_loop,
+            args=(gateway, args.selector, args.agent_port, stop),
+            name="fleet-discover", daemon=True,
+        ).start()
+    server = gateway.serve(port=args.port, bind=args.bind)
+    try:
+        gateway.run(stop)  # blocks; Ctrl-C winds down
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

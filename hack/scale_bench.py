@@ -49,19 +49,21 @@ import json
 import os
 import random
 import sys
+import tempfile
 import threading
 import time
 import zlib
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tpu_cc_manager.ccmanager import rollout_state  # noqa: E402
 from tpu_cc_manager.ccmanager.informer import NodeInformer  # noqa: E402
 from tpu_cc_manager.ccmanager.rolling import (  # noqa: E402
     RollingReconfigurator,
     ZONE_LABEL,
 )
 from tpu_cc_manager.faults.kube import FaultyKubeClient  # noqa: E402
-from tpu_cc_manager.faults.plan import FaultPlan  # noqa: E402
+from tpu_cc_manager.faults.plan import FaultPlan, OrchestratorKilled  # noqa: E402
 from tpu_cc_manager.kubeclient.api import (  # noqa: E402
     KubeApiError,
     classify_kube_error,
@@ -73,7 +75,11 @@ from tpu_cc_manager.labels import (  # noqa: E402
     CC_MODE_STATE_LABEL,
     SLICE_ID_LABEL,
 )
+from tpu_cc_manager.lint import expo as expo_lint  # noqa: E402
+from tpu_cc_manager.obs import fleet as fleet_mod  # noqa: E402
+from tpu_cc_manager.obs import flight as flight_mod  # noqa: E402
 from tpu_cc_manager.utils import retry as retry_mod  # noqa: E402
+from tpu_cc_manager.utils.metrics import MetricsRegistry  # noqa: E402
 
 SELECTOR = "pool=tpu"
 DEFAULT_SEED = 20260803
@@ -520,6 +526,241 @@ def run_pool(
     }
 
 
+# ---------------------------------------------------------------------------
+# --gateway mode: the fleet observability plane (obs/fleet.py) over a
+# simulated 100-node fleet — the ISSUE 16 acceptance bench. Three legs:
+# a full-fleet scrape+merge must converge inside one gateway interval
+# with a lint-clean merged exposition and a correct capacity ledger;
+# killed agents must be marked stale within 2 intervals; and a sharded
+# rollout killed mid-flight and resumed by a successor — each run
+# writing its OWN flight file, like per-region orchestrators — must
+# stitch back into one federated timeline that reconstructs every
+# node's outcome exactly once.
+# ---------------------------------------------------------------------------
+
+
+class _BenchClock:
+    """Injected lease clock for the stitch leg (advance past the lease
+    TTL without waiting it out)."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def build_fleet_registries(
+    n: int, seed: int
+) -> tuple[dict[str, MetricsRegistry], set[str], set[str]]:
+    """n per-node agent registries with seeded serve telemetry, plus the
+    (disjoint) sets of quarantined and prestaging nodes — so the
+    capacity ledger's expected headroom count is computable exactly."""
+    registries: dict[str, MetricsRegistry] = {}
+    quarantined: set[str] = set()
+    prestaging: set[str] = set()
+    for i in range(n):
+        name = f"fleet-n{i:05d}"
+        rng = random.Random(zlib.crc32(f"{seed}:obs:{name}".encode()))
+        reg = MetricsRegistry()
+        for _ in range(rng.randint(3, 8)):
+            reg.observe_serve_request(name, rng.uniform(0.01, 0.5))
+        reg.set_serve_queue_depth(name, rng.randint(0, 6))
+        reg.set_serve_inflight(name, rng.randint(0, 4))
+        reg.record_serve_outcome(name, "completed", rng.randint(5, 40))
+        reg.set_serve_hbm_bw_util(name, rng.uniform(0.30, 0.85))
+        if i % 29 == 7:
+            reg.set_quarantined(True)
+            quarantined.add(name)
+        elif i % 31 == 11:
+            reg.set_prestage_in_progress(True)
+            prestaging.add(name)
+        registries[name] = reg
+    return registries, quarantined, prestaging
+
+
+def run_gateway_scrape(
+    n: int,
+    seed: int = DEFAULT_SEED,
+    interval_s: float = 5.0,
+    kill: int = 10,
+    workers: int = 16,
+) -> dict:
+    """Legs 1+2: full-fleet scrape+merge convergence and staleness."""
+    registries, quarantined, prestaging = build_fleet_registries(n, seed)
+    alive = {name: True for name in registries}
+
+    def target(name: str, reg: MetricsRegistry):
+        inner = fleet_mod.local_target(reg)
+
+        def fetch(path: str) -> str:
+            if not alive[name]:
+                raise ConnectionError("agent killed by bench chaos")
+            return inner(path)
+
+        return fetch
+
+    gateway = fleet_mod.FleetGateway(
+        targets={name: target(name, reg) for name, reg in registries.items()},
+        interval_s=interval_s,
+        scrape_deadline_s=1.0,
+        stale_after_sweeps=2,
+        workers=workers,
+    )
+    t0 = time.monotonic()
+    fleetz = gateway.scrape_once()
+    sweep_seconds = time.monotonic() - t0
+    merged = gateway.metrics_text()
+    lint_problems = expo_lint.lint(merged)
+    expected_headroom = n - len(quarantined) - len(prestaging)
+    headroom_ok = fleetz["fleet"]["headroom_nodes"] == expected_headroom
+
+    killed = sorted(alive)[:kill]
+    for name in killed:
+        alive[name] = False
+    gateway.scrape_once()
+    after_one = set(gateway.fleetz()["fleet"]["stale_nodes"])
+    gateway.scrape_once()
+    after_two = set(gateway.fleetz()["fleet"]["stale_nodes"])
+    stale_ok = after_one.issubset(set(killed)) and after_two == set(killed)
+
+    return {
+        "nodes": n,
+        "sweep_seconds": round(sweep_seconds, 3),
+        "interval_s": interval_s,
+        "converged_one_interval": bool(sweep_seconds <= interval_s),
+        "merged_lines": len(merged.splitlines()),
+        "merged_lint_problems": lint_problems,
+        "headroom_nodes": fleetz["fleet"]["headroom_nodes"],
+        "expected_headroom_nodes": expected_headroom,
+        "quarantined": len(quarantined),
+        "prestaging": len(prestaging),
+        "fleet_p99_present": "tpu_cc_fleet_serve_p99_seconds" in merged,
+        "killed_agents": len(killed),
+        "stale_after_two_sweeps": sorted(after_two),
+        "ok": bool(
+            sweep_seconds <= interval_s
+            and not lint_problems
+            and headroom_ok
+            and stale_ok
+        ),
+    }
+
+
+def run_gateway_stitch(
+    n: int = 16,
+    seed: int = DEFAULT_SEED,
+    shards: int = 4,
+    kill_at: int = 6,
+) -> dict:
+    """Leg 3: a sharded rollout (wave_shards > 1) killed mid-flight and
+    resumed by a successor orchestrator, each writing its OWN flight
+    file; stitch_files must reconstruct exactly-once node outcomes
+    across the kill."""
+    fake = FakeKube()
+    build_fleet(fake, n)
+    sim = AgentSim(fake, seed=seed, fault_rate=0.0)
+    clk = _BenchClock()
+    metrics = MetricsRegistry()
+    hook_calls = {"n": 0}
+
+    def killer(point):
+        if hook_calls["n"] == kill_at:
+            raise OrchestratorKilled(point, hook_calls["n"])
+        hook_calls["n"] += 1
+
+    stitch_dir = tempfile.mkdtemp(prefix="scale-gateway-stitch-")
+    path_a = os.path.join(stitch_dir, "orch-a.jsonl")
+    path_b = os.path.join(stitch_dir, "orch-b.jsonl")
+
+    def lease_for(holder: str) -> rollout_state.RolloutLease:
+        return rollout_state.RolloutLease(
+            fake, holder=holder, namespace="tpu-operator",
+            duration_s=30.0, metrics=metrics, wall=clk, clock=clk,
+        )
+
+    killed = False
+    try:
+        lease_a = lease_for("orch-a")
+        lease_a.acquire()
+        roller_a = RollingReconfigurator(
+            fake, SELECTOR, max_unavailable=4, node_timeout_s=10,
+            poll_interval_s=0.02, wave_shards=shards, lease=lease_a,
+            crash_hook=killer, metrics=metrics,
+            flight=flight_mod.FlightRecorder(
+                path_a, generation=lease_a.generation
+            ),
+        )
+        try:
+            result = roller_a.rollout("on")
+        except OrchestratorKilled:
+            killed = True
+            clk.advance(31.0)  # the dead holder's lease TTL lapses
+            lease_b = lease_for("orch-b")
+            record = lease_b.acquire()
+            roller_b = RollingReconfigurator(
+                fake, SELECTOR, max_unavailable=4, node_timeout_s=10,
+                poll_interval_s=0.02, wave_shards=shards, lease=lease_b,
+                resume_record=record, metrics=metrics,
+                flight=flight_mod.FlightRecorder(
+                    path_b, generation=lease_b.generation
+                ),
+            )
+            result = roller_b.rollout(record.mode if record else "on")
+    finally:
+        sim.stop()
+    stitched, torn = flight_mod.stitch_files([path_a, path_b])
+    rec = flight_mod.reconstruct(stitched)
+    all_nodes = {f"scale-n{i:05d}" for i in range(n)}
+    exactly_once = (
+        set(rec["nodes"]) == all_nodes
+        and not rec["duplicate_node_events"]
+        and all(
+            e["outcome"] == "node-converged" for e in rec["nodes"].values()
+        )
+    )
+    streams = sorted({e.get("stream") for e in stitched})
+    return {
+        "nodes": n,
+        "wave_shards": shards,
+        "kill_at": kill_at,
+        "killed": killed,
+        "rollout_ok": bool(result.ok),
+        "flight_files": 2,
+        "streams_in_stitch": streams,
+        "stitched_events": len(stitched),
+        "torn_lines": torn,
+        "resumes": rec["resumes"],
+        "generations": rec["generations"],
+        "exactly_once": exactly_once,
+        "ok": bool(
+            killed
+            and result.ok
+            and torn == 0
+            and exactly_once
+            and rec["resumes"] == 1
+            and len(rec["generations"]) == 2
+        ),
+    }
+
+
+def run_gateway_bench(
+    n: int = 100, seed: int = DEFAULT_SEED, shards: int = 4
+) -> dict:
+    scrape = run_gateway_scrape(n, seed=seed)
+    stitch = run_gateway_stitch(seed=seed, shards=max(2, shards))
+    return {
+        "bench": "fleet_gateway",
+        "unit": "one gateway sweep / stitched rollout",
+        "fleet_rollup": scrape,
+        "stitch": stitch,
+        "ok": bool(scrape["ok"] and stitch["ok"]),
+    }
+
+
 def summarize(rows: list[dict]) -> dict:
     by_key = {(r["mode"], r["nodes"]): r for r in rows}
     out: dict = {
@@ -561,6 +802,14 @@ def main(argv: list[str] | None = None) -> int:
         "wire); defaults to the 1k-node fleet and SCALE_r02.json",
     )
     parser.add_argument(
+        "--gateway", action="store_true",
+        help="run the fleet observability gateway bench instead of the "
+        "rollout benches: 100-node scrape+merge convergence inside one "
+        "interval, stale marking of killed agents within 2 intervals, "
+        "and a sharded kill+resume rollout stitched back from two "
+        "flight files (obs/fleet.py); defaults to FLEET_r01.json",
+    )
+    parser.add_argument(
         "--partial", default=None,
         help="JSONL of completed (mode,size) rows; existing rows are "
         "skipped on re-run (resume after an interruption)",
@@ -571,6 +820,18 @@ def main(argv: list[str] | None = None) -> int:
         "listings by construction; skipped by default)",
     )
     args = parser.parse_args(argv)
+    if args.gateway:
+        out = args.out or "FLEET_r01.json"
+        sizes = [int(s) for s in (args.sizes or "100").split(",") if s]
+        summary = run_gateway_bench(
+            n=sizes[0], seed=args.seed, shards=args.shards
+        )
+        summary["seed"] = args.seed
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
     if args.sizes is None:
         args.sizes = "1000" if args.apiserver else "100,1000,10000"
     if args.out is None:
@@ -633,6 +894,18 @@ def main(argv: list[str] | None = None) -> int:
                     f.write(json.dumps(row) + "\n")
     summary = summarize(rows)
     summary["seed"] = args.seed
+    # Every SCALE artifact carries a fleet-rollup section: one gateway
+    # sweep over a seeded 100-agent fleet (obs/fleet.py) — cheap, and it
+    # keeps the federation path exercised wherever the rollout bench
+    # runs. The rollup is informational here; the full acceptance gate
+    # is the --gateway bench (FLEET_r01.json).
+    rollup = run_gateway_scrape(100, seed=args.seed)
+    summary["fleet_rollup"] = {
+        k: rollup[k] for k in (
+            "nodes", "sweep_seconds", "converged_one_interval",
+            "headroom_nodes", "stale_after_two_sweeps", "ok",
+        )
+    }
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(summary, f, indent=1, sort_keys=True)
         f.write("\n")

@@ -68,6 +68,32 @@ def _load_partial(path: str | None, config: dict) -> dict[float, dict]:
     return rows
 
 
+def _fleet_rollup(metrics) -> dict:
+    """One in-process fleet-gateway sweep over the harness's registry
+    (obs/fleet.py): SERVE artifacts carry the same federated view —
+    merged families, capacity-ledger headroom, pooled p99 — an operator
+    would read off the real gateway's /metrics during the flip."""
+    from tpu_cc_manager.lint import expo as expo_lint
+    from tpu_cc_manager.obs import fleet as fleet_mod
+
+    gateway = fleet_mod.FleetGateway(
+        targets={"serve-harness": fleet_mod.local_target(metrics)},
+    )
+    fleetz = gateway.scrape_once()
+    merged = gateway.metrics_text()
+    p99 = None
+    for line in merged.splitlines():
+        if line.startswith("tpu_cc_fleet_serve_p99_seconds "):
+            p99 = float(line.split()[1])
+    return {
+        "merged_lines": len(merged.splitlines()),
+        "merged_lint_ok": not expo_lint.lint(merged),
+        "headroom_nodes": fleetz["fleet"]["headroom_nodes"],
+        "max_slo_burn": fleetz["fleet"]["max_slo_burn"],
+        "fleet_serve_p99_s": p99,
+    }
+
+
 def _flip_at_knee(args, executor_factory, knee, deadline_s, handoff) -> dict:
     """One full rolling flip AT the knee under open-loop traffic — the
     SERVE_r02 flip leg, parameterized by ``handoff`` so SERVE_r03 can
@@ -94,7 +120,7 @@ def _flip_at_knee(args, executor_factory, knee, deadline_s, handoff) -> dict:
     )
     harness.build()
     try:
-        return harness.run(
+        report = harness.run(
             traffic_s=args.traffic_s,
             rollout_mode=args.mode,
             max_unavailable=args.max_unavailable,
@@ -102,6 +128,8 @@ def _flip_at_knee(args, executor_factory, knee, deadline_s, handoff) -> dict:
             slo_window_s=2.0,
             slo_max_pause_s=30.0,
         )
+        report["fleet_rollup"] = _fleet_rollup(harness.metrics)
+        return report
     finally:
         harness.shutdown()
 
@@ -356,6 +384,7 @@ def main(argv: list[str] | None = None) -> int:
             rollout_mode=args.mode,
             max_unavailable=args.max_unavailable,
         )
+        report["fleet_rollup"] = _fleet_rollup(harness.metrics)
     finally:
         harness.shutdown()
 

@@ -58,6 +58,11 @@ def test_live_registry_render_passes_lint():
     registry.record_serve_handoff("fallback")
     registry.record_serve_handoff('odd"outcome\nhere')
     registry.set_spare_prestage_seconds(31.299)
+    # Capacity-ledger families (fleet gateway inputs), hostile node
+    # included; hbm util is clamped into [0, 1].
+    registry.set_serve_hbm_bw_util("serve-node-0", 0.73)
+    registry.set_serve_hbm_bw_util('odd"node', 1.7)
+    registry.set_prestage_in_progress(True)
     problems = check_metrics_lint.lint(registry.render_prometheus())
     assert problems == [], problems
     text = registry.render_prometheus()
@@ -91,6 +96,34 @@ def test_live_registry_render_passes_lint():
     # The empty window exports burn (0) but NO invented p99 sample.
     assert 'tpu_cc_serve_error_budget_burn{window="300"} 0.000000' in text
     assert 'tpu_cc_serve_slo_p99_seconds{window="300"}' not in text
+    assert 'tpu_cc_hbm_bw_util{node="serve-node-0"} 0.730000' in text
+    assert 'tpu_cc_hbm_bw_util{node="odd\\"node"} 1' in text  # clamped
+    assert "tpu_cc_prestage_in_progress 1" in text
+
+
+def test_fleet_merged_exposition_passes_lint():
+    """The gateway's MERGED exposition (two full seeded agents plus a
+    partial one, federated in-process) must pass the same lint the
+    per-agent render does — HELP/TYPE once per family, buckets still
+    cumulative after summation, fleet families declared."""
+    text = check_metrics_lint._seeded_fleet_text()
+    problems = check_metrics_lint.lint(text)
+    assert problems == [], problems
+    assert "tpu_cc_fleet_nodes 3" in text
+    assert "tpu_cc_fleet_nodes_stale 0" in text
+    assert "tpu_cc_fleet_headroom_nodes" in text
+    assert "tpu_cc_fleet_scrape_errors_total 0" in text
+    assert "tpu_cc_fleet_serve_p99_seconds" in text
+    # Per-node series survive federation label-preserving...
+    assert 'tpu_cc_serve_queue_depth{node="fleet-node-2"}' in text
+    # ...and identical series from the two identical seeded agents sum:
+    # each agent observed serve-node-0 twice, so the fleet count is 4.
+    assert 'tpu_cc_serve_request_seconds_count{node="serve-node-0"} 4' in text
+
+
+def test_lint_main_fleet_mode():
+    """`check_metrics_lint.py --fleet` lints the merged exposition."""
+    assert check_metrics_lint.main(["--fleet"]) == 0
 
 
 def test_empty_registry_render_passes_lint():

@@ -1,0 +1,549 @@
+"""Fleet observability plane (tpu_cc_manager/obs/fleet.py).
+
+The acceptance bars (ISSUE 16):
+
+- merged histograms preserve bucket monotonicity and EXACT
+  ``_sum``/``_count`` conservation; counters/gauges sum label-preserving;
+  HELP/TYPE pairing survives federation (the merged exposition passes
+  the same lint the per-agent render does);
+- ``merge_p99`` (obs/slo.py) agrees with the pooled-sample percentile
+  on seeded random shards;
+- the gateway marks killed agents stale within 2 sweeps — listed in
+  ``/fleetz``, excluded from the rollups — and catches a frozen
+  ``snapshot_ts`` (a dead agent behind a replaying proxy);
+- the capacity ledger excludes quarantined/offline/prestaging/saturated
+  nodes from ``tpu_cc_fleet_headroom_nodes``;
+- ``stitch_timelines`` merges N shard flight streams into one
+  seq-consistent federated timeline (generation-then-timestamp order,
+  cross-stream duplicates collapsed, torn tails tolerated) from which
+  ``reconstruct`` reads exactly-once node outcomes across a kill.
+
+The chaos-marked soak prints the FLEET_SUMMARY line
+hack/chaos_soak.sh scrapes (the gateway keeps serving merged truth
+while seeded chaos kills scraped agents).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.request
+
+import pytest
+
+from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.faults.plan import OrchestratorKilled
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import CC_MODE_LABEL, CC_MODE_STATE_LABEL
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.lint import expo as expo_lint
+from tpu_cc_manager.obs import fleet as fleet_mod
+from tpu_cc_manager.obs import flight as flight_mod
+from tpu_cc_manager.obs import slo as slo_mod
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+SEED = 20260807
+
+
+def seeded_registry(name: str, rng: random.Random) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    for _ in range(rng.randint(2, 12)):
+        reg.observe_serve_request(name, rng.uniform(0.005, 2.0))
+    reg.set_serve_queue_depth(name, rng.randint(0, 5))
+    reg.record_serve_outcome(name, "completed", rng.randint(1, 30))
+    reg.set_serve_hbm_bw_util(name, rng.uniform(0.2, 0.8))
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Merge correctness (the property-test satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_merged_histograms_conserve_sum_count_and_stay_monotonic():
+    rng = random.Random(SEED)
+    observations: dict[str, list[float]] = {}
+    scrapes: dict[str, str] = {}
+    for a in range(4):
+        reg = MetricsRegistry()
+        # Two agents share node names (a restarted agent re-reporting)
+        # so same-key summation is exercised, not just disjoint unions.
+        for node in (f"n{a % 2}", f"n{a}-own"):
+            vals = [rng.uniform(0.001, 40.0) for _ in range(rng.randint(1, 20))]
+            observations.setdefault(node, []).extend(vals)
+            for v in vals:
+                reg.observe_serve_request(node, v)
+        scrapes[f"agent-{a}"] = reg.render_prometheus()
+
+    merged = fleet_mod.merge_expositions(scrapes)
+    assert expo_lint.lint(merged) == []  # monotonic, +Inf, _count==+Inf
+
+    parsed = fleet_mod.parse_exposition(merged)
+    sums = {
+        labels["node"]: value
+        for labels, value in parsed.series_values(
+            "tpu_cc_serve_request_seconds_sum"
+        )
+    }
+    counts = {
+        labels["node"]: value
+        for labels, value in parsed.series_values(
+            "tpu_cc_serve_request_seconds_count"
+        )
+    }
+    assert set(sums) == set(observations)
+    for node, vals in observations.items():
+        assert counts[node] == len(vals)
+        # Exact conservation bounded only by the render's own %.6f.
+        assert sums[node] == pytest.approx(sum(vals), abs=1e-5 * len(vals))
+
+
+def test_counters_and_gauges_sum_label_preserving():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.record_serve_outcome("shared", "completed", 7)
+    b.record_serve_outcome("shared", "completed", 5)
+    a.record_serve_outcome("only-a", "bounced", 2)
+    b.set_serve_queue_depth("only-b", 3)
+    merged = fleet_mod.merge_expositions({
+        "a": a.render_prometheus(), "b": b.render_prometheus(),
+    })
+    assert (
+        'tpu_cc_serve_requests_total{node="shared",outcome="completed"} 12'
+        in merged
+    )
+    assert (
+        'tpu_cc_serve_requests_total{node="only-a",outcome="bounced"} 2'
+        in merged
+    )
+    assert 'tpu_cc_serve_queue_depth{node="only-b"} 3' in merged
+    assert expo_lint.lint(merged) == []
+
+
+def test_help_type_pairing_survives_federation_with_hostile_labels():
+    # The lint driver's own seeded registry carries the hostile label
+    # values (quotes, newlines, backslashes); federated twice over plus
+    # a partial agent, the pairing and escaping must survive intact.
+    merged = expo_lint._seeded_fleet_text()
+    assert expo_lint.lint(merged) == []
+    assert merged.count("# TYPE tpu_cc_serve_request_seconds ") == 1
+    assert "tpu_cc_fleet_nodes 3" in merged
+    assert "tpu_cc_fleet_headroom_nodes" in merged
+
+
+def test_merge_p99_agrees_with_pooled_percentile_on_seeded_shards():
+    rng = random.Random(SEED + 1)
+    for trial in range(20):
+        shards = [
+            sorted(rng.uniform(0.0, 10.0) for _ in range(rng.randint(0, 50)))
+            for _ in range(rng.randint(1, 8))
+        ]
+        pooled = sorted(v for s in shards for v in s)
+        want = slo_mod.percentile(pooled, 0.99)
+        got = fleet_mod.fleet_p99(shards)
+        assert got == want, f"trial {trial}: {got} != {want}"
+    assert fleet_mod.fleet_p99([]) is None
+    assert fleet_mod.fleet_p99([[], []]) is None
+
+
+def test_histogram_shard_reconstruction_matches_bucket_counts():
+    reg = MetricsRegistry()
+    vals = [0.003, 0.04, 0.04, 1.7, 250.0]  # last lands in +Inf overflow
+    for v in vals:
+        reg.observe_serve_request("n0", v)
+    shard = fleet_mod.histogram_shard(
+        fleet_mod.parse_exposition(reg.render_prometheus())
+    )
+    assert len(shard) == len(vals)
+    assert shard == sorted(shard)
+    # Every reconstructed sample is a bucket upper bound >= its original
+    # (the +Inf overflow is clamped to the top finite bound).
+    finite_top = max(s for s in shard)
+    assert all(s <= finite_top for s in shard)
+
+
+# ---------------------------------------------------------------------------
+# Gateway: scrape, staleness, capacity ledger
+# ---------------------------------------------------------------------------
+
+
+def build_targets(n: int, alive: dict):
+    rng = random.Random(SEED + 2)
+    targets = {}
+    for i in range(n):
+        name = f"fleet-{i}"
+        alive[name] = True
+        inner = fleet_mod.local_target(seeded_registry(name, rng))
+
+        def fetch(path, name=name, inner=inner):
+            if not alive[name]:
+                raise ConnectionError("killed")
+            return inner(path)
+
+        targets[name] = fetch
+    return targets
+
+
+def test_killed_agent_goes_stale_within_two_sweeps_and_stays_listed():
+    alive: dict[str, bool] = {}
+    gateway = fleet_mod.FleetGateway(
+        targets=build_targets(5, alive), stale_after_sweeps=2,
+    )
+    gateway.scrape_once()
+    assert gateway.fleetz()["fleet"]["stale"] == 0
+    alive["fleet-3"] = False
+    one = gateway.scrape_once()
+    assert one["nodes"]["fleet-3"]["error"]  # failure surfaced at once
+    assert not one["nodes"]["fleet-3"]["stale"]  # but one miss != dead
+    two = gateway.scrape_once()
+    assert two["fleet"]["stale_nodes"] == ["fleet-3"]
+    assert two["nodes"]["fleet-3"]["stale"] is True
+    # Excluded from the rollups, listed in the ledger.
+    merged = gateway.metrics_text()
+    assert 'tpu_cc_serve_queue_depth{node="fleet-3"}' not in merged
+    assert "tpu_cc_fleet_nodes_stale 1" in merged
+    assert expo_lint.lint(merged) == []
+    # Resurrection: a fresh scrape clears the staleness immediately.
+    alive["fleet-3"] = True
+    back = gateway.scrape_once()
+    assert back["fleet"]["stale"] == 0
+    assert 'tpu_cc_serve_queue_depth{node="fleet-3"}' in gateway.metrics_text()
+
+
+def test_frozen_snapshot_ts_marks_a_replayed_exposition_stale():
+    reg = seeded_registry("frozen", random.Random(SEED + 3))
+    body = {
+        "/metrics": reg.render_prometheus(),
+        "/statusz": json.dumps({"agent_version": "0.0.0", "snapshot_ts": 17.0}),
+        "/rolloutz": json.dumps({"enabled": False}),
+    }
+    gateway = fleet_mod.FleetGateway(
+        targets={"frozen": lambda path: body[path]}, stale_after_sweeps=2,
+    )
+    gateway.scrape_once()  # first scrape: nothing to compare against
+    gateway.scrape_once()  # same snapshot_ts: replayed body detected
+    fleetz = gateway.scrape_once()
+    assert fleetz["nodes"]["frozen"]["stale"] is True
+    assert fleetz["nodes"]["frozen"]["error"] == "snapshot-ts-not-advancing"
+
+
+def test_capacity_ledger_headroom_rules():
+    def agent(**kw):
+        reg = MetricsRegistry()
+        reg.observe_serve_request("x", 0.05)
+        reg.set_serve_hbm_bw_util("x", kw.get("hbm", 0.5))
+        reg.set_serve_queue_depth("x", kw.get("queue", 1))
+        if kw.get("quarantined"):
+            reg.set_quarantined(True)
+        if kw.get("prestaging"):
+            reg.set_prestage_in_progress(True)
+        if kw.get("offline"):
+            reg.set_apiserver_connected(False)
+        return fleet_mod.local_target(reg)
+
+    gateway = fleet_mod.FleetGateway(targets={
+        "fine": agent(),
+        "hot": agent(hbm=0.97),
+        "deep": agent(queue=40),
+        "quar": agent(quarantined=True),
+        "prestage": agent(prestaging=True),
+        "offline": agent(offline=True),
+    })
+    fleetz = gateway.scrape_once()
+    headroom = {
+        name: entry["has_headroom"]
+        for name, entry in fleetz["nodes"].items()
+    }
+    assert headroom == {
+        "fine": True, "hot": False, "deep": False,
+        "quar": False, "prestage": False, "offline": False,
+    }
+    assert "tpu_cc_fleet_headroom_nodes 1" in gateway.metrics_text()
+    assert fleetz["nodes"]["quar"]["quarantined"] is True
+    assert fleetz["nodes"]["prestage"]["prestage_in_progress"] is True
+    assert fleetz["nodes"]["offline"]["offline"] is True
+
+
+def test_gateway_http_endpoints_serve_merged_truth():
+    alive: dict[str, bool] = {}
+    gateway = fleet_mod.FleetGateway(targets=build_targets(3, alive))
+    gateway.scrape_once()
+    server = gateway.serve(port=0)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            merged = resp.read().decode()
+        assert "tpu_cc_fleet_nodes 3" in merged
+        assert expo_lint.lint(merged) == []
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleetz?rollout=", timeout=5
+        ) as resp:
+            fleetz = json.load(resp)
+        assert fleetz["fleet"]["nodes"] == 3
+        assert fleetz["rollout"]["streams"] == 0  # no flight recorders
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Timeline stitching
+# ---------------------------------------------------------------------------
+
+
+def test_stitch_orders_by_generation_then_ts_and_tags_streams():
+    stream_a = [
+        {"event": "plan", "gen": 1, "ts": 10.0, "seq": 1},
+        {"event": "window-open", "gen": 1, "ts": 12.0, "seq": 2},
+    ]
+    stream_b = [
+        {"event": "resume", "gen": 2, "ts": 11.0, "seq": 1},
+        {"event": "complete", "gen": 2, "ts": 13.0, "seq": 2},
+    ]
+    # Handed over in the wrong order on purpose.
+    stitched = flight_mod.stitch_timelines(
+        [stream_b, stream_a], labels=["b", "a"]
+    )
+    assert [e["event"] for e in stitched] == [
+        "plan", "window-open", "resume", "complete",
+    ]  # gen 1 entirely before gen 2, despite b's earlier wall-clock
+    assert [e["stream"] for e in stitched] == ["a", "a", "b", "b"]
+
+
+def test_stitch_collapses_cross_stream_duplicates_and_orders_none_gen_last():
+    shared = {"event": "node-converged", "gen": 1, "ts": 5.0, "seq": 3,
+              "node": "n1"}
+    pre_lease = {"event": "plan", "gen": None, "ts": 1.0, "seq": 1}
+    stitched = flight_mod.stitch_timelines(
+        [[shared, pre_lease], [dict(shared)]]
+    )
+    assert len(stitched) == 2  # the duplicate collapsed
+    # None generation ranks after numbered ones (type-stable ordering).
+    assert [e["event"] for e in stitched] == ["node-converged", "plan"]
+
+
+def test_stitch_files_tolerates_torn_tails_per_stream(tmp_path):
+    paths = []
+    for i in range(2):
+        path = str(tmp_path / f"shard-{i}.jsonl")
+        fr = flight_mod.FlightRecorder(path, generation=i + 1)
+        fr.record("plan", mode="on", shard=i)
+        fr.record("complete", ok=True, shard=i)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"event": "window-open", "torn mid-wri')
+        paths.append(path)
+    stitched, torn = flight_mod.stitch_files(paths)
+    assert torn == 2
+    assert len(stitched) == 4
+    assert [e["gen"] for e in stitched] == [1, 1, 2, 2]
+
+
+def add_pool(fake, n):
+    for i in range(n):
+        fake.add_node(f"node-{i}", {"pool": "tpu"})
+
+
+def agent_simulator(fake):
+    in_flight = set()
+
+    def reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired and name not in in_flight:
+            in_flight.add(name)
+
+            def fire():
+                in_flight.discard(name)
+                fake.set_node_label(name, CC_MODE_STATE_LABEL, desired)
+
+            t = threading.Timer(0.03, fire)
+            t.daemon = True
+            t.start()
+
+    fake.add_patch_reactor(reactor)
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _sharded_kill_resume(tmp_path, kill_at: int):
+    """A sharded (wave_shards=2) rollout killed mid-flight; successor
+    resumes writing its OWN flight file — per-region orchestrators."""
+    fake = FakeKube()
+    add_pool(fake, 4)
+    agent_simulator(fake)
+    clk = Clock()
+    metrics = MetricsRegistry()
+    calls = {"n": 0}
+
+    def killer(point):
+        if calls["n"] == kill_at:
+            raise OrchestratorKilled(point, calls["n"])
+        calls["n"] += 1
+
+    path_a = str(tmp_path / "orch-a.jsonl")
+    path_b = str(tmp_path / "orch-b.jsonl")
+
+    def lease_for(holder):
+        return rollout_state.RolloutLease(
+            fake, holder=holder, namespace="tpu-operator", duration_s=30.0,
+            metrics=metrics, wall=clk, clock=clk,
+        )
+
+    lease_a = lease_for("orch-a")
+    lease_a.acquire()
+    roller_a = RollingReconfigurator(
+        fake, "pool=tpu", max_unavailable=1, node_timeout_s=5,
+        poll_interval_s=0.02, wave_shards=2, lease=lease_a,
+        crash_hook=killer, metrics=metrics,
+        flight=flight_mod.FlightRecorder(path_a, generation=lease_a.generation),
+    )
+    killed = False
+    try:
+        result = roller_a.rollout("on")
+    except OrchestratorKilled:
+        killed = True
+        clk.advance(31)
+        lease_b = lease_for("orch-b")
+        record = lease_b.acquire()
+        assert record is not None
+        roller_b = RollingReconfigurator(
+            fake, "pool=tpu", max_unavailable=1, node_timeout_s=5,
+            poll_interval_s=0.02, wave_shards=2, lease=lease_b,
+            resume_record=record, metrics=metrics,
+            flight=flight_mod.FlightRecorder(
+                path_b, generation=lease_b.generation
+            ),
+        )
+        result = roller_b.rollout(record.mode)
+    return killed, result, path_a, path_b
+
+
+def test_stitched_sharded_rollout_reconstructs_exactly_once(tmp_path):
+    killed, result, path_a, path_b = _sharded_kill_resume(tmp_path, kill_at=5)
+    assert killed and result.ok
+    stitched, torn = flight_mod.stitch_files([path_a, path_b])
+    assert torn == 0
+    rec = flight_mod.reconstruct(stitched)
+    assert set(rec["nodes"]) == {f"node-{i}" for i in range(4)}
+    assert rec["duplicate_node_events"] == []
+    assert all(
+        n["outcome"] == "node-converged" for n in rec["nodes"].values()
+    )
+    assert rec["resumes"] == 1
+    assert len(rec["generations"]) == 2
+    # The federated timeline never interleaves generations.
+    gens = [e["gen"] for e in stitched if e.get("gen") is not None]
+    assert gens == sorted(gens)
+
+
+def test_ctl_rollout_timeline_stitch_renders_federated_view(
+    tmp_path, capsys
+):
+    from tpu_cc_manager import ctl
+
+    killed, result, path_a, path_b = _sharded_kill_resume(tmp_path, kill_at=3)
+    assert killed and result.ok
+    args = ctl.build_parser().parse_args(
+        ["rollout-timeline", "--stitch", path_a, path_b]
+    )
+    assert ctl.cmd_rollout_timeline(None, args) == 0
+    out = capsys.readouterr().out
+    assert "reconstruction:" in out
+    assert "resumes=1" in out
+    for i in range(4):
+        assert f"node node-{i}: node-converged" in out
+    # --json over the same stitch returns machine-readable streams.
+    args = ctl.build_parser().parse_args(
+        ["rollout-timeline", "--stitch", path_a, path_b, "--json"]
+    )
+    assert ctl.cmd_rollout_timeline(None, args) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["reconstruction"]["resumes"] == 1
+    assert {e["stream"] for e in payload["events"]} == {
+        "orch-a.jsonl", "orch-b.jsonl",
+    }
+
+
+def test_gateway_fleetz_rollout_stitches_scraped_rolloutz_streams(tmp_path):
+    recorders = {}
+    targets = {}
+    for i in range(2):
+        fr = flight_mod.FlightRecorder(
+            str(tmp_path / f"agent-{i}.jsonl"), generation=1
+        )
+        fr.record("window-open", wave=i, window=0)
+        fr.record("node-converged", node=f"node-{i}", wave=i, state="on")
+        recorders[f"agent-{i}"] = fr
+        targets[f"agent-{i}"] = fleet_mod.local_target(
+            seeded_registry(f"agent-{i}", random.Random(SEED + 10 + i)),
+            flight=fr,
+        )
+    gateway = fleet_mod.FleetGateway(targets=targets)
+    gateway.scrape_once()
+    rollout = gateway.stitched_rollout()
+    assert rollout["streams"] == 2
+    assert rollout["events"] == 4
+    assert set(rollout["reconstruction"]["nodes"]) == {"node-0", "node-1"}
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: merged truth survives agents dying mid-sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_gateway_serves_merged_truth_while_chaos_kills_agents():
+    """Seeded chaos kills (and resurrects) scraped agents between
+    sweeps; every sweep's merged exposition must stay lint-clean, stale
+    marking must track the kill schedule within stale_after_sweeps, and
+    the fleet families must never disappear. Prints the FLEET_SUMMARY
+    line hack/chaos_soak.sh scrapes."""
+    rng = random.Random(SEED + 4)
+    alive: dict[str, bool] = {}
+    n = 12
+    gateway = fleet_mod.FleetGateway(
+        targets=build_targets(n, alive), stale_after_sweeps=2,
+    )
+    sweeps = 0
+    kills = 0
+    resurrections = 0
+    max_stale = 0
+    for round_no in range(10):
+        for name in list(alive):
+            if alive[name] and rng.random() < 0.25:
+                alive[name] = False
+                kills += 1
+            elif not alive[name] and rng.random() < 0.5:
+                alive[name] = True
+                resurrections += 1
+        fleetz = gateway.scrape_once()
+        sweeps += 1
+        merged = gateway.metrics_text()
+        problems = expo_lint.lint(merged)
+        assert problems == [], f"round {round_no}: {problems}"
+        assert f"tpu_cc_fleet_nodes {n}" in merged
+        assert "tpu_cc_fleet_headroom_nodes" in merged
+        # Every node is LISTED every sweep, dead or alive.
+        assert len(fleetz["nodes"]) == n
+        # Anything stale genuinely missed >= 2 consecutive sweeps.
+        for name in fleetz["fleet"]["stale_nodes"]:
+            assert not alive[name] or fleetz["nodes"][name]["error"]
+        max_stale = max(max_stale, fleetz["fleet"]["stale"])
+    assert kills > 0 and max_stale > 0  # the chaos actually bit
+    print("FLEET_SUMMARY " + json.dumps({
+        "sweeps": sweeps, "agents": n, "kills": kills,
+        "resurrections": resurrections, "max_stale": max_stale,
+        "scrape_errors": fleetz["fleet"]["scrape_errors_total"],
+        "merged_lint_problems": 0,
+    }))

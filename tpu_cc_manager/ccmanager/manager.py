@@ -2197,10 +2197,12 @@ class CCManager:
         )
         t0 = time.monotonic()
         self._in_prestage = True
+        self.metrics.set_prestage_in_progress(True)
         try:
             ok = self.set_cc_mode(mode)
         finally:
             self._in_prestage = False
+            self.metrics.set_prestage_in_progress(False)
         seconds = round(time.monotonic() - t0, 3)
         self.metrics.set_spare_prestage_seconds(seconds)
         if not ok:

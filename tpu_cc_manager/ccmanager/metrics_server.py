@@ -28,10 +28,12 @@ import json
 import logging
 import os
 import threading
+import time
 from urllib.parse import parse_qs, urlparse
 
 from tpu_cc_manager.obs import journal as journal_mod
 from tpu_cc_manager.utils.metrics import MetricsRegistry
+from tpu_cc_manager.version import __version__
 
 log = logging.getLogger(__name__)
 
@@ -58,6 +60,13 @@ def _statusz_payload(
     finished = journal.spans()
     totals = registry.result_totals()
     return {
+        # For the fleet gateway (obs/fleet.py): agent_version identifies
+        # mixed-version fleets mid-rollout; snapshot_ts is MONOTONIC and
+        # stamped per response, so a scrape whose snapshot_ts fails to
+        # advance between sweeps is a cached/replayed body from a dead
+        # agent — stale, not live.
+        "agent_version": __version__,
+        "snapshot_ts": round(time.monotonic(), 6),
         "mode": last.mode if last is not None else None,
         "reconciling": bool(
             last is not None and last.result == "pending"

@@ -237,6 +237,14 @@ def build_parser() -> argparse.ArgumentParser:
         "selector-derived default",
     )
     tl.add_argument(
+        "--stitch", nargs="+", default=None, metavar="FILE",
+        help="stitch N shard/region flight files into one federated "
+        "timeline (lease-generation then timestamp ordering, exact "
+        "cross-stream duplicates collapsed, torn tails tolerated per "
+        "stream) — the offline twin of the fleet gateway's "
+        "/fleetz?rollout=",
+    )
+    tl.add_argument(
         "--json", action="store_true", dest="as_json",
         help="print raw events + reconstruction as JSON",
     )
@@ -775,15 +783,20 @@ def cmd_rollout_timeline(api, args) -> int:
     read from a CC_TRACE_FILE-format span JSONL."""
     from tpu_cc_manager.obs import flight as flight_mod
 
-    path = getattr(args, "flight_file", None)
-    if not path:
-        if not getattr(args, "selector", None):
-            raise ValueError(
-                "rollout-timeline: --selector (to derive the default "
-                "flight-file path) or --file is required"
-            )
-        path = flight_mod.flight_path_for(args.selector)
-    events, torn = flight_mod.read_events(path)
+    stitch = getattr(args, "stitch", None)
+    if stitch:
+        events, torn = flight_mod.stitch_files(list(stitch))
+        path = "+".join(stitch)
+    else:
+        path = getattr(args, "flight_file", None)
+        if not path:
+            if not getattr(args, "selector", None):
+                raise ValueError(
+                    "rollout-timeline: --selector (to derive the default "
+                    "flight-file path) or --file is required"
+                )
+            path = flight_mod.flight_path_for(args.selector)
+        events, torn = flight_mod.read_events(path)
     if not events:
         log.error("no flight-recorder events in %s", path)
         return 1

@@ -299,7 +299,47 @@ def _seeded_registry_text() -> str:
     registry.record_serve_handoff("fallback")
     registry.record_serve_handoff('odd"outcome')
     registry.set_spare_prestage_seconds(31.3)
+    # Capacity-ledger inputs (obs/fleet.py headroom judgment).
+    registry.set_serve_hbm_bw_util("serve-node-0", 0.73)
+    registry.set_serve_hbm_bw_util('odd"node\nname', 0.99)
+    registry.set_prestage_in_progress(True)
     return registry.render_prometheus()
+
+
+def _seeded_fleet_text() -> str:
+    """The fleet gateway's MERGED exposition over seeded per-node
+    registries — what ``obs/fleet.py`` actually serves at fleet
+    ``/metrics``. Two full seeded agents plus one partial-overlap agent
+    (different node names, a subset of families) exercise the merge's
+    HELP/TYPE dedup, label-preserving summation and histogram
+    conservation, then the fleet's own ``tpu_cc_fleet_*`` families are
+    appended by the gateway's rebuild — so federation regressions fail
+    the same lint the per-agent render does."""
+    from tpu_cc_manager.obs import fleet as fleet_mod
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    partial = MetricsRegistry()
+    partial.observe_serve_request("fleet-node-2", 0.021)
+    partial.observe_serve_request("fleet-node-2", 2.75)
+    partial.set_serve_queue_depth("fleet-node-2", 1)
+    partial.set_serve_hbm_bw_util("fleet-node-2", 0.42)
+    partial.record_serve_outcome("fleet-node-2", "completed", 5)
+    gateway = fleet_mod.FleetGateway(targets={
+        "agent-a": fleet_mod.local_target(_SeededRegistry()),
+        "agent-b": fleet_mod.local_target(_SeededRegistry()),
+        "agent-c": fleet_mod.local_target(partial),
+    })
+    gateway.scrape_once()
+    return gateway.metrics_text()
+
+
+class _SeededRegistry:
+    """Duck-typed registry whose render IS the seeded exposition — so
+    the fleet seed reuses _seeded_registry_text verbatim (hostile label
+    values included) without re-driving the setters."""
+
+    def render_prometheus(self) -> str:
+        return _seeded_registry_text()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -307,6 +347,11 @@ def main(argv: list[str] | None = None) -> int:
     source = parser.add_mutually_exclusive_group()
     source.add_argument("--url", help="scrape this /metrics URL and lint it")
     source.add_argument("--file", help="lint a saved exposition file")
+    source.add_argument(
+        "--fleet", action="store_true",
+        help="lint the fleet gateway's MERGED exposition over seeded "
+        "per-node registries (obs/fleet.py federation)",
+    )
     args = parser.parse_args(argv)
 
     if args.url:
@@ -317,6 +362,8 @@ def main(argv: list[str] | None = None) -> int:
     elif args.file:
         with open(args.file, encoding="utf-8") as f:
             text = f.read()
+    elif args.fleet:
+        text = _seeded_fleet_text()
     else:
         text = _seeded_registry_text()
 

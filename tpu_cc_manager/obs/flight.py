@@ -360,6 +360,78 @@ def reconstruct(events: list[dict]) -> dict:
     }
 
 
+def _stitch_identity(event: dict) -> str:
+    """Content identity of an event, independent of which stream carried
+    it (the ``stream`` tag a stitch adds is excluded). Two streams can
+    legitimately carry the SAME event — e.g. a gateway scraping a node's
+    /rolloutz and a shard file on disk — and a stitch must not double it."""
+    return json.dumps(
+        {k: v for k, v in event.items() if k != "stream"},
+        sort_keys=True, default=str,
+    )
+
+
+def stitch_timelines(
+    streams: list[list[dict]], labels: list[str] | None = None
+) -> list[dict]:
+    """Merge N shard/region flight-recorder streams into ONE federated
+    timeline, seq-consistent across the fleet.
+
+    Within a stream events are already totally ordered by ``seq``
+    (continued across crash+resume by the recorder). Across streams
+    there is no shared sequence, so the stitch orders by what IS shared:
+    the lease generation first (a gen-N event globally precedes gen-N+1
+    — the fence guarantees no overlap), then the wall-clock ``ts``
+    within a generation, with (stream, seq) as the deterministic
+    tiebreak. ``gen`` uses the type-stable :func:`_order_key` so a
+    pre-lease ``None`` generation sorts after numbered ones it trails
+    in no stream.
+
+    Each stitched event carries a ``stream`` tag (the label or index of
+    its source) so the federated timeline stays attributable; exact
+    duplicates appearing in multiple streams collapse to one event.
+    Torn tails were already dropped per stream by :func:`read_events` —
+    this function only ever sees parseable events, however ragged the
+    shard files' endings.
+    """
+    tagged: list[tuple[tuple, dict]] = []
+    seen: set[str] = set()
+    for idx, stream in enumerate(streams):
+        label = labels[idx] if labels and idx < len(labels) else str(idx)
+        for event in stream:
+            identity = _stitch_identity(event)
+            if identity in seen:
+                continue
+            seen.add(identity)
+            merged = dict(event)
+            merged["stream"] = label
+            tagged.append((
+                (
+                    _order_key(event.get("gen")),
+                    event.get("ts") or 0,
+                    idx,
+                    event.get("seq") or 0,
+                ),
+                merged,
+            ))
+    tagged.sort(key=lambda pair: pair[0])
+    return [event for _, event in tagged]
+
+
+def stitch_files(paths: list[str]) -> tuple[list[dict], int]:
+    """Stitch N flight files (``ctl rollout-timeline --stitch``): the
+    federated timeline plus the total torn-line count across shards."""
+    streams: list[list[dict]] = []
+    labels: list[str] = []
+    torn_total = 0
+    for path in paths:
+        events, torn = read_events(path)
+        streams.append(events)
+        labels.append(os.path.basename(path))
+        torn_total += torn
+    return stitch_timelines(streams, labels=labels), torn_total
+
+
 def render_timeline(events: list[dict], torn: int = 0) -> str:
     """Human timeline for ``tpu-cc-ctl rollout-timeline``: one line per
     event in file order, then the reconstruction summary."""

@@ -520,6 +520,10 @@ class TrafficDriver:
             util = server.last_hbm_bw_util
             if util is None:
                 continue
+            if self.metrics is not None:
+                # Export the ladder's own signal: the fleet capacity
+                # ledger (obs/fleet.py) judges per-node headroom off it.
+                self.metrics.set_serve_hbm_bw_util(name, util)
             with self._lock:
                 if util < self.util_ceiling and self._batch[name] < self.max_batch:
                     self._batch[name] += 1

@@ -245,6 +245,12 @@ class MetricsRegistry:
         self._serve_hist_sum: dict[str, float] = {}  # cclint: guarded-by(_lock)
         self._serve_queue_depth: dict[str, int] = {}  # cclint: guarded-by(_lock)
         self._serve_inflight: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        # Capacity-ledger inputs (obs/fleet.py headroom): per-node HBM
+        # bandwidth utilization (the serve driver's ladder signal) and
+        # whether a spare pre-stage is in flight on this agent — both
+        # read by the fleet gateway to judge per-node serving headroom.
+        self._serve_hbm_bw_util: dict[str, float] = {}  # cclint: guarded-by(_lock)
+        self._prestage_in_progress: bool | None = None  # cclint: guarded-by(_lock)
         self._serve_outcome_totals: dict[tuple[str, str], int] = {}  # cclint: guarded-by(_lock)
         self._serve_lost_total = 0  # cclint: guarded-by(_lock)
         self._serve_deadline_miss_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
@@ -481,6 +487,20 @@ class MetricsRegistry:
         with self._lock:
             self._serve_inflight[node] = max(0, int(inflight))
 
+    def set_serve_hbm_bw_util(self, node: str, util: float) -> None:
+        """Last observed HBM bandwidth utilization (0..1) on a node —
+        the serve driver's batch-ladder signal, exported so the fleet
+        capacity ledger can judge headroom against its ceiling."""
+        with self._lock:
+            self._serve_hbm_bw_util[node] = min(1.0, max(0.0, float(util)))
+
+    def set_prestage_in_progress(self, in_progress: bool) -> None:
+        """Whether a spare pre-stage (annotation-driven full flip +
+        warmup ahead of a rollout wave) is currently running on this
+        agent. A prestaging node is warming, not serving headroom."""
+        with self._lock:
+            self._prestage_in_progress = bool(in_progress)
+
     def record_serve_outcome(
         self, node: str, outcome: str, count: int = 1
     ) -> None:
@@ -669,6 +689,8 @@ class MetricsRegistry:
             serve_hist_sum = dict(self._serve_hist_sum)
             serve_queue_depth = dict(self._serve_queue_depth)
             serve_inflight = dict(self._serve_inflight)
+            serve_hbm_bw_util = dict(self._serve_hbm_bw_util)
+            prestage_in_progress = self._prestage_in_progress
             serve_outcomes = dict(self._serve_outcome_totals)
             serve_lost = self._serve_lost_total
             serve_deadline_misses = dict(self._serve_deadline_miss_totals)
@@ -957,6 +979,31 @@ class MetricsRegistry:
                     "tpu_cc_serve_inflight%s %d"
                     % (_labels(node=node), serve_inflight[node])
                 )
+        if serve_hbm_bw_util:
+            lines.append(
+                "# HELP tpu_cc_hbm_bw_util Last observed HBM bandwidth "
+                "utilization (0..1) per node — the serve driver's batch-"
+                "ladder signal; the fleet capacity ledger judges headroom "
+                "against its ceiling."
+            )
+            lines.append("# TYPE tpu_cc_hbm_bw_util gauge")
+            for node in sorted(serve_hbm_bw_util):
+                lines.append(
+                    "tpu_cc_hbm_bw_util%s %.6f"
+                    % (_labels(node=node), serve_hbm_bw_util[node])
+                )
+        if prestage_in_progress is not None:
+            lines.append(
+                "# HELP tpu_cc_prestage_in_progress Whether a spare pre-"
+                "stage (annotation-driven flip + warmup ahead of a rollout "
+                "wave) is running on this agent (1) or not (0) — a "
+                "prestaging node is warming, not serving headroom."
+            )
+            lines.append("# TYPE tpu_cc_prestage_in_progress gauge")
+            lines.append(
+                "tpu_cc_prestage_in_progress %d"
+                % (1 if prestage_in_progress else 0)
+            )
         if serve_outcomes:
             lines.append(
                 "# HELP tpu_cc_serve_requests_total Serving request "
