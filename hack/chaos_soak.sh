@@ -32,11 +32,15 @@
 # (HANDOFF_SUMMARY: flip with the in-flight-handoff sink wired — zero
 # lost, nonzero accepted handoffs, conserved), the flight-recorder
 # crash leg (OBS_SUMMARY: events written across kill+resume at every
-# crash point, zero torn JSONL lines), and the fleet-gateway leg
+# crash point, zero torn JSONL lines), the fleet-gateway leg
 # (FLEET_SUMMARY: the federation gateway keeps serving a lint-clean
 # merged exposition while seeded chaos kills and resurrects scraped
-# agents, staleness tracking the kill schedule) so the evidence ladder
-# can cite them.
+# agents, staleness tracking the kill schedule), and the federated
+# regional-rollout leg (FEDERATION_SUMMARY: seeded mid-rollout regional
+# orchestrator kill + successor resume, then a regional apiserver
+# blackout that stalls only its own region — parent record completes
+# with exactly-once budget accounting) so the evidence ladder can cite
+# them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -66,7 +70,10 @@ mkdir -p "$(dirname "$OUT")" artifacts
 # test_obs_fleet.py carries the fleet-gateway leg (merged exposition
 # stays lint-clean while seeded chaos kills scraped agents) —
 # FLEET_SUMMARY lines.
-PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py tests/test_flight.py tests/test_obs_fleet.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
+# test_federation.py carries the federated regional-rollout leg (seeded
+# regional kill + resume, regional apiserver blackout, exactly-once
+# shared budget) — FEDERATION_SUMMARY lines.
+PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py tests/test_flight.py tests/test_obs_fleet.py tests/test_federation.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
 if [ "$TERMINAL" = "0" ]; then
   PYTEST_ARGS+=(--deselect \
     "tests/test_chaos.py::test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts")
@@ -97,7 +104,8 @@ for i in $(seq 0 $((ITERS - 1))); do
   handoff=$(grep -ao "HANDOFF_SUMMARY.*" "$log" | tail -1 | sed "s/^HANDOFF_SUMMARY //; s/'/ /g; s/\"/ /g")
   obs=$(grep -ao "OBS_SUMMARY.*" "$log" | tail -1 | sed "s/^OBS_SUMMARY //; s/'/ /g; s/\"/ /g")
   fleet=$(grep -ao "FLEET_SUMMARY.*" "$log" | tail -1 | sed "s/^FLEET_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"handoff\": \"${handoff}\", \"obs\": \"${obs}\", \"fleet\": \"${fleet}\"}")
+  federation=$(grep -ao "FEDERATION_SUMMARY.*" "$log" | tail -1 | sed "s/^FEDERATION_SUMMARY //; s/'/ /g; s/\"/ /g")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"handoff\": \"${handoff}\", \"obs\": \"${obs}\", \"fleet\": \"${fleet}\", \"federation\": \"${federation}\"}")
 done
 
 {

@@ -43,6 +43,7 @@ mkdir -p artifacts
 ARTIFACTS=(
   artifacts/chaos_soak.json
   SCALE_r01.json
+  SCALE_r03.json
   FLEET_r01.json
   SERVE_r01.json
   SERVE_r02.json
@@ -196,6 +197,28 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s SCALE_r02.json ] && mv SCALE_r02.json artifacts/SCALE_r02.failed.json
     echo ">>> HTTP scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r02.failed.json)"
+    finish
+  }
+fi
+
+# Federated fleet-scale evidence (SCALE_r03): a 100k-node rollout
+# region-sharded across 10 per-region mock apiservers — shared failure
+# budget through one CAS-fenced parent record, a mid-rollout regional
+# orchestrator kill + successor resume, per-apiserver load no worse than
+# SCALE_r02's per-node baseline, and the per-region flight recorders
+# stitched into one exactly-once cross-region timeline. CPU-only;
+# resumable at two grains like SCALE_r01 (completed federation rows
+# persist in the partial JSONL; the stage skips once the summary records
+# ok:true; a failed summary is parked).
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("SCALE_r03.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> SCALE_r03.json already captured (ok:true); skipping"
+else
+  echo "=== stage: scale-bench --federation (region-sharded, no tunnel) ==="
+  python3 hack/scale_bench.py --federation --out SCALE_r03.json \
+      --partial artifacts/scale_federation_partial.jsonl \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s SCALE_r03.json ] && mv SCALE_r03.json artifacts/SCALE_r03.failed.json
+    echo ">>> federation scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r03.failed.json; partial rows kept for resume)"
     finish
   }
 fi

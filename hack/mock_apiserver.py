@@ -161,66 +161,9 @@ def _load_cluster_role_grants() -> set[tuple[str, str]]:
 
 GRANTS = _load_cluster_role_grants()
 
-lock = threading.Lock()
-rv = [1]
-# Per-verb request counters (get/list/watch/patch/create/update/delete):
-# served at POST /_ctl/requests so the demos and the scale harness can
-# read the apiserver-side QPS the orchestrator generated.
-request_counts: dict = {}
-
-
-def count_request(verb: str) -> None:
-    with lock:
-        request_counts[verb] = request_counts.get(verb, 0) + 1
-
-# Watch resumes below this resourceVersion answer 410 Gone, like a real
-# apiserver after etcd compaction. Raised via POST /_ctl/compact.
-compacted_below = [0]
-nodes: dict[str, dict] = {}
-pods: dict[str, dict] = {}  # pod name -> pod dict
-# coordination.k8s.io/v1 Leases ((namespace, name) -> Lease dict): the
-# rolling orchestrator's single-writer lock + checkpoint record
-# (ccmanager/rollout_state.py). Updates enforce resourceVersion CAS.
-leases: dict[tuple[str, str], dict] = {}
-# In-flight chunked listings: a continue token serves from the snapshot
-# taken at the FIRST page (real apiservers pin continues to the first
-# page's etcd revision) so a label flip between pages can't shift the
-# name sort and drop a node from the listing. token -> (items, rv).
-page_snapshots: dict[str, tuple[list, str]] = {}
-page_snapshot_seq = [0]
-
 _LEASE_PATH_RE = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases(?:/([^/]+))?$"
 )
-
-
-def add_node(name: str) -> None:
-    nodes[name] = {
-        "kind": "Node",
-        "apiVersion": "v1",
-        "metadata": {
-            "name": name,
-            "resourceVersion": "1",
-            "labels": {k: "true" for k in COMPONENTS},
-        },
-    }
-    for key, app in COMPONENTS.items():
-        pods[f"{app}-{name}"] = {
-            "metadata": {
-                "name": f"{app}-{name}", "namespace": NS,
-                "labels": {"app": app},
-            },
-            "spec": {"nodeName": name},
-            "status": {"phase": "Running"},
-        }
-
-
-# watchers: list of (chunk_writer, node_name_filter or None,
-# label_selector or None, in_view name set, wants_bookmarks). in_view
-# tracks which nodes a selector-scoped watcher currently "sees", so a
-# node whose labels stop matching is delivered as DELETED — the rule a
-# real apiserver applies and an informer cache depends on.
-watchers = []
 
 # Real apiservers send periodic BOOKMARK events (metadata-only, fresh
 # resourceVersion) to watchers that asked via allowWatchBookmarks=true —
@@ -233,81 +176,231 @@ BOOKMARK_INTERVAL_S = float(os.environ.get("MOCK_BOOKMARK_INTERVAL_S", "5"))
 _BOOKMARK = object()  # queue sentinel: broadcast a bookmark frame
 
 
-def _bookmark_ticker():
-    while True:
-        time.sleep(BOOKMARK_INTERVAL_S)
-        _event_queue.put((_BOOKMARK, b""))
+class MockState:
+    """One mock apiserver's complete state: nodes, pods, leases, watch
+    plumbing, request counters. Instance-scoped so a federation bench
+    (hack/scale_bench.py --federation) can run ten independent
+    per-region apiservers in one process — each region gets its own
+    ``MockState`` + ``make_handler(state)``. The original module-global
+    surface (``nodes``, ``lock``, ``add_node`` ...) stays intact as
+    aliases of the module-level DEFAULT_STATE below, so the demos and
+    the validation tests keep working unchanged."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.rv = [1]
+        # Per-verb request counters (get/list/watch/patch/create/update/
+        # delete): served at POST /_ctl/requests so the demos and the
+        # scale harness can read the apiserver-side QPS the orchestrator
+        # generated.
+        self.request_counts: dict = {}
+        # Watch resumes below this resourceVersion answer 410 Gone, like
+        # a real apiserver after etcd compaction (POST /_ctl/compact).
+        self.compacted_below = [0]
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[str, dict] = {}  # pod name -> pod dict
+        # coordination.k8s.io/v1 Leases ((namespace, name) -> Lease):
+        # the rolling orchestrator's single-writer lock + checkpoint
+        # record (ccmanager/rollout_state.py). Updates enforce
+        # resourceVersion CAS.
+        self.leases: dict[tuple[str, str], dict] = {}
+        # In-flight chunked listings: a continue token serves from the
+        # snapshot taken at the FIRST page (real apiservers pin continues
+        # to the first page's etcd revision) so a label flip between
+        # pages can't shift the name sort and drop a node from the
+        # listing. token -> (items, rv).
+        self.page_snapshots: dict[str, tuple[list, str]] = {}
+        self.page_snapshot_seq = [0]
+        # watchers: list of (chunk_writer, node_name_filter or None,
+        # label_selector or None, in_view name set, wants_bookmarks).
+        # in_view tracks which nodes a selector-scoped watcher currently
+        # "sees", so a node whose labels stop matching is delivered as
+        # DELETED — the rule a real apiserver applies and an informer
+        # cache depends on.
+        self.watchers: list = []
+        self.sticky_pods: set = set()  # pods the operator refuses to delete
+        self.events: list[dict] = []  # core/v1 Events POSTed by the agent
+        # name is a node name (str) or the _BOOKMARK sentinel object.
+        self._event_queue: "queue.Queue[tuple[object, bytes]]" = queue.Queue()
+        self._threads_started = False
+
+    def count_request(self, verb: str) -> None:
+        with self.lock:
+            self.request_counts[verb] = self.request_counts.get(verb, 0) + 1
+
+    def add_node(self, name: str) -> None:
+        self.nodes[name] = {
+            "kind": "Node",
+            "apiVersion": "v1",
+            "metadata": {
+                "name": name,
+                "resourceVersion": "1",
+                "labels": {k: "true" for k in COMPONENTS},
+            },
+        }
+        for key, app in COMPONENTS.items():
+            self.pods[f"{app}-{name}"] = {
+                "metadata": {
+                    "name": f"{app}-{name}", "namespace": NS,
+                    "labels": {"app": app},
+                },
+                "spec": {"nodeName": name},
+                "status": {"phase": "Running"},
+            }
+
+    def bump_rv(self, node: dict) -> None:
+        self.rv[0] += 1
+        node["metadata"]["resourceVersion"] = str(self.rv[0])
+
+    def emit_watch_event(self, node: dict) -> None:
+        """Snapshot under the caller's lock, enqueue for the single
+        writer thread: writes happen OUTSIDE the lock (a stalled watch
+        client must not wedge the other endpoints by blocking sendall
+        while holding it), and one writer preserves both frame integrity
+        and event ordering. The writer serializes per watcher, because
+        selector-scoped watchers each need their own event type
+        (MODIFIED vs ADDED vs synthesized DELETED, depending on what
+        that watcher saw before)."""
+        name = node["metadata"]["name"]
+        snapshot = json.loads(json.dumps(node))  # frozen at emit time
+        self._event_queue.put((name, snapshot))
+
+    def _bookmark_ticker(self) -> None:
+        while True:
+            time.sleep(BOOKMARK_INTERVAL_S)
+            self._event_queue.put((_BOOKMARK, b""))
+
+    def _watch_writer(self) -> None:
+        while True:
+            name, node = self._event_queue.get()
+            # (writer, frame) pairs resolved under the lock, written
+            # outside.
+            deliveries = []
+            if name is _BOOKMARK:
+                with self.lock:
+                    frame = (json.dumps({
+                        "type": "BOOKMARK",
+                        "object": {
+                            "metadata": {"resourceVersion": str(self.rv[0])}
+                        },
+                    }) + "\n").encode()
+                    deliveries = [
+                        (wf, frame)
+                        for wf, _, _, _, bm in self.watchers if bm
+                    ]
+            else:
+                with self.lock:
+                    for wf, flt, lsel, in_view, _ in self.watchers:
+                        if flt is not None and flt != name:
+                            continue
+                        matches = _match_label_selector(
+                            node["metadata"].get("labels") or {}, lsel
+                        )
+                        if matches:
+                            etype = "MODIFIED" if name in in_view else "ADDED"
+                            in_view.add(name)
+                        elif name in in_view:
+                            # Left the watcher's selector: a real
+                            # apiserver sends DELETED so caches drop the
+                            # node.
+                            in_view.discard(name)
+                            etype = "DELETED"
+                        else:
+                            continue
+                        deliveries.append((wf, (json.dumps(
+                            {"type": etype, "object": node}
+                        ) + "\n").encode()))
+            dead = []
+            for wf, frame in deliveries:
+                try:
+                    wf.write(frame)
+                    wf.flush()
+                except Exception:
+                    dead.append(wf)
+            if dead:
+                with self.lock:
+                    self.watchers[:] = [
+                        w for w in self.watchers if w[0] not in dead
+                    ]
+
+    def operator_reactor(self) -> None:
+        """Delete component pods shortly after their node's deploy label
+        pauses; restore them when unpaused. Pods marked sticky
+        (POST /_ctl/stick-pod) are never deleted — simulates a wedged
+        drain for strict-eviction testing."""
+        while True:
+            time.sleep(0.5)
+            with self.lock:
+                for node_name, node in self.nodes.items():
+                    labels = node["metadata"]["labels"]
+                    for key, app in COMPONENTS.items():
+                        name = f"{app}-{node_name}"
+                        if is_paused(labels.get(key)):
+                            if name not in self.sticky_pods:
+                                self.pods.pop(name, None)
+                        elif labels.get(key) == "true" and name not in self.pods:
+                            self.pods[name] = {
+                                "metadata": {"name": name, "namespace": NS,
+                                             "labels": {"app": app}},
+                                "spec": {"nodeName": node_name},
+                                "status": {"phase": "Running"},
+                            }
+
+    def start_threads(self, reactor: bool = False) -> None:
+        """Start this instance's watch writer + bookmark ticker (and,
+        for the demos, the operator reactor). Idempotent."""
+        if self._threads_started:
+            return
+        self._threads_started = True
+        threading.Thread(target=self._watch_writer, daemon=True).start()
+        threading.Thread(target=self._bookmark_ticker, daemon=True).start()
+        if reactor:
+            threading.Thread(target=self.operator_reactor, daemon=True).start()
+
+
+#: The module-level default instance: every original module-global name
+#: below is an alias INTO this instance (same objects, mutated in
+#: place), so existing consumers — demo scripts, the validation tests,
+#: scale_bench's _reset_mock — see the exact pre-refactor surface.
+DEFAULT_STATE = MockState()
+
+lock = DEFAULT_STATE.lock
+rv = DEFAULT_STATE.rv
+request_counts = DEFAULT_STATE.request_counts
+compacted_below = DEFAULT_STATE.compacted_below
+nodes = DEFAULT_STATE.nodes
+pods = DEFAULT_STATE.pods
+leases = DEFAULT_STATE.leases
+page_snapshots = DEFAULT_STATE.page_snapshots
+page_snapshot_seq = DEFAULT_STATE.page_snapshot_seq
+watchers = DEFAULT_STATE.watchers
+sticky_pods = DEFAULT_STATE.sticky_pods
+events = DEFAULT_STATE.events
+_event_queue = DEFAULT_STATE._event_queue
+
+
+def count_request(verb: str) -> None:
+    DEFAULT_STATE.count_request(verb)
+
+
+def add_node(name: str) -> None:
+    DEFAULT_STATE.add_node(name)
 
 
 def bump_rv(node: dict) -> None:
-    rv[0] += 1
-    node["metadata"]["resourceVersion"] = str(rv[0])
-
-
-# name is a node name (str) or the _BOOKMARK sentinel object.
-_event_queue: "queue.Queue[tuple[object, bytes]]" = queue.Queue()
+    DEFAULT_STATE.bump_rv(node)
 
 
 def emit_watch_event(node: dict) -> None:
-    """Snapshot under the caller's lock, enqueue for the single writer
-    thread: writes happen OUTSIDE the lock (a stalled watch client must
-    not wedge the other endpoints by blocking sendall while holding it),
-    and one writer preserves both frame integrity and event ordering.
-    The writer serializes per watcher, because selector-scoped watchers
-    each need their own event type (MODIFIED vs ADDED vs synthesized
-    DELETED, depending on what that watcher saw before)."""
-    name = node["metadata"]["name"]
-    snapshot = json.loads(json.dumps(node))  # frozen at emit time
-    _event_queue.put((name, snapshot))
+    DEFAULT_STATE.emit_watch_event(node)
+
+
+def _bookmark_ticker():
+    DEFAULT_STATE._bookmark_ticker()
 
 
 def _watch_writer():
-    while True:
-        name, node = _event_queue.get()
-        # (writer, frame) pairs resolved under the lock, written outside.
-        deliveries = []
-        if name is _BOOKMARK:
-            with lock:
-                frame = (json.dumps({
-                    "type": "BOOKMARK",
-                    "object": {"metadata": {"resourceVersion": str(rv[0])}},
-                }) + "\n").encode()
-                deliveries = [
-                    (wf, frame) for wf, _, _, _, bm in watchers if bm
-                ]
-        else:
-            with lock:
-                for wf, flt, lsel, in_view, _ in watchers:
-                    if flt is not None and flt != name:
-                        continue
-                    matches = _match_label_selector(
-                        node["metadata"].get("labels") or {}, lsel
-                    )
-                    if matches:
-                        etype = "MODIFIED" if name in in_view else "ADDED"
-                        in_view.add(name)
-                    elif name in in_view:
-                        # Left the watcher's selector: a real apiserver
-                        # sends DELETED so caches drop the node.
-                        in_view.discard(name)
-                        etype = "DELETED"
-                    else:
-                        continue
-                    deliveries.append((wf, (json.dumps(
-                        {"type": etype, "object": node}
-                    ) + "\n").encode()))
-        dead = []
-        for wf, frame in deliveries:
-            try:
-                wf.write(frame)
-                wf.flush()
-            except Exception:
-                dead.append(wf)
-        if dead:
-            with lock:
-                watchers[:] = [
-                    w for w in watchers if w[0] not in dead
-                ]
+    DEFAULT_STATE._watch_writer()
 
 
 def is_paused(v):
@@ -330,35 +423,15 @@ def _match_label_selector(labels: dict, selector: str | None) -> bool:
     return True
 
 
-sticky_pods = set()  # pods the emulated operator refuses to delete
-events: list[dict] = []  # core/v1 Events POSTed by the agent
-
-
 def operator_reactor():
-    """Delete component pods shortly after their node's deploy label pauses;
-    restore them when unpaused. Pods marked sticky (POST /_ctl/stick-pod)
-    are never deleted — simulates a wedged drain for strict-eviction
-    testing."""
-    while True:
-        time.sleep(0.5)
-        with lock:
-            for node_name, node in nodes.items():
-                labels = node["metadata"]["labels"]
-                for key, app in COMPONENTS.items():
-                    name = f"{app}-{node_name}"
-                    if is_paused(labels.get(key)):
-                        if name not in sticky_pods:
-                            pods.pop(name, None)
-                    elif labels.get(key) == "true" and name not in pods:
-                        pods[name] = {
-                            "metadata": {"name": name, "namespace": NS,
-                                         "labels": {"app": app}},
-                            "spec": {"nodeName": node_name},
-                            "status": {"phase": "Running"},
-                        }
+    DEFAULT_STATE.operator_reactor()
 
 
 class Handler(BaseHTTPRequestHandler):
+    #: The MockState this handler serves. The module-level Handler binds
+    #: the default instance; make_handler() subclasses with another.
+    state: MockState = DEFAULT_STATE
+
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):
@@ -404,26 +477,27 @@ class Handler(BaseHTTPRequestHandler):
         return (verb, resource) in GRANTS
 
     def do_GET(self):
+        st = self.state
         u = urlparse(self.path)
         q = parse_qs(u.query)
         m = re.match(r"^/api/v1/nodes/([^/]+)$", u.path)
         if m and not self._authorized("get", "nodes"):
             return self._forbid("get", "nodes")
         if m:
-            count_request("get")
-            with lock:
-                node = nodes.get(m.group(1))
+            st.count_request("get")
+            with st.lock:
+                node = st.nodes.get(m.group(1))
             if node is None:
                 return self._json(
                     {"kind": "Status", "code": 404, "message": "no such node"},
                     404,
                 )
-            with lock:
+            with st.lock:
                 return self._json(node)
         if u.path == "/api/v1/nodes" and q.get("watch") == ["true"]:
             if not self._authorized("watch", "nodes"):
                 return self._forbid("watch", "nodes")
-            count_request("watch")
+            st.count_request("watch")
             # Real apiservers 410-Gone a watch resuming from a
             # resourceVersion older than the compaction floor; the
             # manager's resync path (re-GET + conditional re-apply,
@@ -434,7 +508,7 @@ class Handler(BaseHTTPRequestHandler):
             rv_param = q.get("resourceVersion", [None])[0]
             if rv_param is not None and rv_param != "0":
                 try:
-                    too_old = int(rv_param) < compacted_below[0]
+                    too_old = int(rv_param) < st.compacted_below[0]
                 except ValueError:
                     too_old = False
                 if too_old:
@@ -474,9 +548,9 @@ class Handler(BaseHTTPRequestHandler):
             # never block delivery to the healthy watchers.
             self.connection.settimeout(10.0)
             cw = ChunkWriter(self.wfile)
-            with lock:
+            with st.lock:
                 in_view = set()
-                for name, node in nodes.items():
+                for name, node in st.nodes.items():
                     if (flt is None or flt == name) and _match_label_selector(
                         node["metadata"].get("labels") or {}, lsel
                     ):
@@ -485,7 +559,7 @@ class Handler(BaseHTTPRequestHandler):
                         cw.write(ev.encode())
                 cw.flush()
                 wants_bookmarks = q.get("allowWatchBookmarks") == ["true"]
-                watchers.append((cw, flt, lsel, in_view, wants_bookmarks))
+                st.watchers.append((cw, flt, lsel, in_view, wants_bookmarks))
             # Hold the connection open; events pushed by emit_watch_event.
             timeout = float(q.get("timeoutSeconds", ["300"])[0])
             time.sleep(timeout)
@@ -493,13 +567,13 @@ class Handler(BaseHTTPRequestHandler):
                 self.wfile.write(b"0\r\n\r\n")
             except Exception:
                 pass
-            with lock:
-                watchers[:] = [w for w in watchers if w[0] is not cw]
+            with st.lock:
+                st.watchers[:] = [w for w in st.watchers if w[0] is not cw]
             return
         if u.path == "/api/v1/nodes":
             if not self._authorized("list", "nodes"):
                 return self._forbid("list", "nodes")
-            count_request("list")
+            st.count_request("list")
             sel = q.get("labelSelector", [None])[0]
             # limit/continue chunking, as the real apiserver pages big
             # listings: the first page snapshots the name-sorted matching
@@ -509,9 +583,9 @@ class Handler(BaseHTTPRequestHandler):
             # clients treat as "restart the listing".
             limit = q.get("limit", [None])[0]
             token = q.get("continue", [None])[0]
-            with lock:
+            with st.lock:
                 if token is not None:
-                    snap = page_snapshots.pop(token, None)
+                    snap = st.page_snapshots.pop(token, None)
                     if snap is None:
                         return self._json(
                             {"kind": "Status", "code": 410,
@@ -523,21 +597,21 @@ class Handler(BaseHTTPRequestHandler):
                     offset = int(token.split(":")[-1])
                 else:
                     items = [
-                        copy.deepcopy(n) for _, n in sorted(nodes.items())
+                        copy.deepcopy(n) for _, n in sorted(st.nodes.items())
                         if _match_label_selector(n["metadata"]["labels"], sel)
                     ]
-                    list_rv = str(rv[0])
+                    list_rv = str(st.rv[0])
                     offset = 0
                 meta = {"resourceVersion": list_rv}
                 end = offset + max(1, int(limit)) if limit else len(items)
                 if end < len(items):
-                    page_snapshot_seq[0] += 1
-                    new_token = f"{page_snapshot_seq[0]}:{end}"
-                    page_snapshots[new_token] = (items, list_rv)
+                    st.page_snapshot_seq[0] += 1
+                    new_token = f"{st.page_snapshot_seq[0]}:{end}"
+                    st.page_snapshots[new_token] = (items, list_rv)
                     meta["continue"] = new_token
                     # Abandoned paginations must not pin snapshots forever.
-                    while len(page_snapshots) > 8:
-                        del page_snapshots[next(iter(page_snapshots))]
+                    while len(st.page_snapshots) > 8:
+                        del st.page_snapshots[next(iter(st.page_snapshots))]
                 return self._json({"kind": "NodeList",
                                    "items": items[offset:end],
                                    "metadata": meta})
@@ -545,9 +619,9 @@ class Handler(BaseHTTPRequestHandler):
         if lm and lm.group(2):
             if not self._authorized("get", "leases"):
                 return self._forbid("get", "leases")
-            count_request("get")
-            with lock:
-                lease = leases.get((lm.group(1), lm.group(2)))
+            st.count_request("get")
+            with st.lock:
+                lease = st.leases.get((lm.group(1), lm.group(2)))
                 if lease is None:
                     return self._json(
                         {"kind": "Status", "code": 404,
@@ -557,11 +631,11 @@ class Handler(BaseHTTPRequestHandler):
         if u.path == f"/api/v1/namespaces/{NS}/pods":
             if not self._authorized("list", "pods"):
                 return self._forbid("list", "pods")
-            count_request("list")
+            st.count_request("list")
             sel = q.get("labelSelector", [None])[0]
             fsel = q.get("fieldSelector", [None])[0]
-            with lock:
-                items = list(pods.values())
+            with st.lock:
+                items = list(st.pods.values())
             if sel:
                 m = re.match(r"^([^=]+)=(.+)$", sel)
                 k, v = m.group(1), m.group(2)
@@ -574,6 +648,7 @@ class Handler(BaseHTTPRequestHandler):
         self._json({"kind": "Status", "code": 404, "message": "not found"}, 404)
 
     def do_PATCH(self):
+        st = self.state
         u = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(length) or b"{}")
@@ -581,9 +656,9 @@ class Handler(BaseHTTPRequestHandler):
         if m:
             if not self._authorized("patch", "nodes"):
                 return self._forbid("patch", "nodes")
-            count_request("patch")
-            with lock:
-                node = nodes.get(m.group(1))
+            st.count_request("patch")
+            with st.lock:
+                node = st.nodes.get(m.group(1))
                 if node is None:
                     return self._json({"kind": "Status", "code": 404}, 404)
                 meta = body.get("metadata") or {}
@@ -635,8 +710,8 @@ class Handler(BaseHTTPRequestHandler):
                     ):
                         return self._invalid("spec.taints entries need a key")
                     node.setdefault("spec", {})["taints"] = taints
-                bump_rv(node)
-                emit_watch_event(node)
+                st.bump_rv(node)
+                st.emit_watch_event(node)
                 return self._json(node)
         self._json({"kind": "Status", "code": 404}, 404)
 
@@ -649,14 +724,15 @@ class Handler(BaseHTTPRequestHandler):
         u = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(length) or b"{}")
+        st = self.state
         lm = _LEASE_PATH_RE.match(u.path)
         if lm and lm.group(2):
             if not self._authorized("update", "leases"):
                 return self._forbid("update", "leases")
-            count_request("update")
+            st.count_request("update")
             key = (lm.group(1), lm.group(2))
-            with lock:
-                stored = leases.get(key)
+            with st.lock:
+                stored = st.leases.get(key)
                 if stored is None:
                     return self._json(
                         {"kind": "Status", "code": 404,
@@ -670,30 +746,31 @@ class Handler(BaseHTTPRequestHandler):
                         f"(sent resourceVersion {sent_rv}, current "
                         f"{stored['metadata']['resourceVersion']})"
                     )
-                rv[0] += 1
+                st.rv[0] += 1
                 updated = {
                     "apiVersion": "coordination.k8s.io/v1",
                     "kind": "Lease",
                     "metadata": {
                         **(body.get("metadata") or {}),
                         "name": lm.group(2), "namespace": lm.group(1),
-                        "resourceVersion": str(rv[0]),
+                        "resourceVersion": str(st.rv[0]),
                     },
                     "spec": body.get("spec") or {},
                 }
-                leases[key] = updated
+                st.leases[key] = updated
                 return self._json(updated)
         self._json({"kind": "Status", "code": 404}, 404)
 
     def do_DELETE(self):
+        st = self.state
         u = urlparse(self.path)
         lm = _LEASE_PATH_RE.match(u.path)
         if lm and lm.group(2):
             if not self._authorized("delete", "leases"):
                 return self._forbid("delete", "leases")
-            count_request("delete")
-            with lock:
-                if leases.pop((lm.group(1), lm.group(2)), None) is None:
+            st.count_request("delete")
+            with st.lock:
+                if st.leases.pop((lm.group(1), lm.group(2)), None) is None:
                     return self._json(
                         {"kind": "Status", "code": 404,
                          "message": "no such lease"}, 404,
@@ -705,6 +782,7 @@ class Handler(BaseHTTPRequestHandler):
         self._json({"kind": "Status", "code": 404}, 404)
 
     def do_POST(self):
+        st = self.state
         u = urlparse(self.path)
         length = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(length) or b"{}")
@@ -724,86 +802,96 @@ class Handler(BaseHTTPRequestHandler):
         if m:
             if not self._authorized("create", "events"):
                 return self._forbid("create", "events")
-            count_request("create")
-            with lock:
-                events.append(body)
+            st.count_request("create")
+            with st.lock:
+                st.events.append(body)
             return self._json(body, 201)
         lm = _LEASE_PATH_RE.match(u.path)
         if lm and not lm.group(2):
             if not self._authorized("create", "leases"):
                 return self._forbid("create", "leases")
-            count_request("create")
+            st.count_request("create")
             name = ((body.get("metadata") or {}).get("name")) or ""
             if not name:
                 return self._invalid("lease create: metadata.name required")
-            with lock:
+            with st.lock:
                 key = (lm.group(1), name)
-                if key in leases:
+                if key in st.leases:
                     return self._conflict(
                         f'leases.coordination.k8s.io "{name}" already exists'
                     )
-                rv[0] += 1
+                st.rv[0] += 1
                 lease = {
                     "apiVersion": "coordination.k8s.io/v1",
                     "kind": "Lease",
                     "metadata": {
                         "name": name, "namespace": lm.group(1),
-                        "resourceVersion": str(rv[0]),
+                        "resourceVersion": str(st.rv[0]),
                     },
                     "spec": body.get("spec") or {},
                 }
-                leases[key] = lease
+                st.leases[key] = lease
                 return self._json(lease, 201)
         if u.path == "/_ctl/set-label":
-            with lock:
-                node = nodes.get(body.get("node", DEFAULT_NODE))
+            with st.lock:
+                node = st.nodes.get(body.get("node", DEFAULT_NODE))
                 if node is None:
                     return self._json({"ok": False, "error": "no such node"}, 404)
                 if body.get("value") is None:
                     node["metadata"]["labels"].pop(body["key"], None)
                 else:
                     node["metadata"]["labels"][body["key"]] = body["value"]
-                bump_rv(node)
-                emit_watch_event(node)
+                st.bump_rv(node)
+                st.emit_watch_event(node)
                 return self._json({"ok": True, "labels": node["metadata"]["labels"]})
         if u.path == "/_ctl/compact":
             # Emulate etcd compaction: watches resuming below the floor
             # (default: the current rv) get 410 Gone.
-            with lock:
-                compacted_below[0] = int(body.get("below_rv", rv[0]))
+            with st.lock:
+                st.compacted_below[0] = int(body.get("below_rv", st.rv[0]))
                 return self._json(
-                    {"ok": True, "compacted_below": compacted_below[0]}
+                    {"ok": True, "compacted_below": st.compacted_below[0]}
                 )
         if u.path == "/_ctl/stick-pod":
-            with lock:
+            with st.lock:
                 if body.get("stuck", True):
-                    sticky_pods.add(body["name"])
+                    st.sticky_pods.add(body["name"])
                 else:
-                    sticky_pods.discard(body["name"])
-                return self._json({"ok": True, "sticky": sorted(sticky_pods)})
+                    st.sticky_pods.discard(body["name"])
+                return self._json(
+                    {"ok": True, "sticky": sorted(st.sticky_pods)}
+                )
         if u.path == "/_ctl/requests":
-            with lock:
-                return self._json({"requests": dict(request_counts)})
+            with st.lock:
+                return self._json({"requests": dict(st.request_counts)})
         if u.path == "/_ctl/state":
-            with lock:
+            with st.lock:
                 evs = [
                     f"{e.get('type', '?')}/{e.get('reason', '?')}"
-                    for e in events
+                    for e in st.events
                 ]
-                if len(nodes) == 1:
+                if len(st.nodes) == 1:
                     # Single-node shape kept for demo_local.sh compat.
-                    (node,) = nodes.values()
+                    (node,) = st.nodes.values()
                     return self._json({"labels": node["metadata"]["labels"],
-                                       "pods": sorted(pods),
+                                       "pods": sorted(st.pods),
                                        "events": evs})
                 return self._json({
                     "nodes": {
-                        name: n["metadata"]["labels"] for name, n in nodes.items()
+                        name: n["metadata"]["labels"]
+                        for name, n in st.nodes.items()
                     },
-                    "pods": sorted(pods),
+                    "pods": sorted(st.pods),
                     "events": evs,
                 })
         self._json({"kind": "Status", "code": 404}, 404)
+
+
+def make_handler(state: MockState) -> type:
+    """A Handler subclass bound to ``state`` — hand it to an
+    http.server so one process can serve many independent apiservers
+    (one per federation region in hack/scale_bench.py)."""
+    return type("BoundHandler", (Handler,), {"state": state})
 
 
 if __name__ == "__main__":

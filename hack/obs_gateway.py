@@ -87,6 +87,12 @@ def smoke() -> int:
         reg.observe_serve_request(f"smoke-node-{i}", 0.3)
         reg.set_serve_queue_depth(f"smoke-node-{i}", i)
         reg.set_serve_hbm_bw_util(f"smoke-node-{i}", 0.5 + 0.1 * i)
+        # Each scraped agent doubles as a regional rollout shard: the
+        # merged exposition must carry the federation families too.
+        reg.record_federation_sync("ok")
+        if i == 1:
+            reg.record_federation_fence("parent-generation")
+        reg.set_federation_budget_spent(i)
         registries[f"smoke-node-{i}"] = reg
 
     alive = {name: True for name in registries}
@@ -118,6 +124,13 @@ def smoke() -> int:
         assert not problems, f"merged exposition lint: {problems}"
         assert "tpu_cc_fleet_headroom_nodes 3" in merged, merged
         assert 'tpu_cc_hbm_bw_util{node="smoke-node-1"}' in merged
+        # Federation leg: regional-shard families survive the merge —
+        # labelled counters aggregate by label, the unlabeled spend
+        # gauge sums across shards (0+1+2).
+        assert 'tpu_cc_federation_syncs_total{outcome="ok"} 3' in merged
+        assert 'tpu_cc_federation_fences_total' \
+            '{reason="parent-generation"} 1' in merged, merged
+        assert "tpu_cc_federation_budget_spent 3" in merged, merged
 
         alive["smoke-node-2"] = False
         gateway.scrape_once()

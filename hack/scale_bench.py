@@ -462,6 +462,362 @@ def run_pool_apiserver(
     }
 
 
+# ---------------------------------------------------------------------------
+# --federation mode: the ISSUE 17 acceptance bench (SCALE_r03). One
+# federated rollout over >=100k nodes sharded across >=10 REGIONS, each
+# region served by its OWN mock apiserver instance (mock_apiserver
+# .MockState + make_handler) with its own server-side agent sim — plus a
+# dedicated control-plane apiserver hosting only the parent record's CAS
+# lease (ccmanager/federation.py). Every regional orchestrator holds a
+# regional lease, checkpoints a regional record, and settles the single
+# global failure budget through the parent at wave boundaries.
+#
+# Three things are measured and gated:
+#  - per-apiserver load: each region's HTTP request count, normalized
+#    per node, must stay within the SCALE_r02 1k-node informer baseline
+#    plus a small allowance for what r02 did not carry (regional lease
+#    checkpoints + acquire traffic);
+#  - regional failure: one region's orchestrator is SIGKILL-simulated
+#    mid-rollout (OrchestratorKilled at a crash point) and a successor
+#    resumes from the regional record, re-attaching to the live parent;
+#  - cross-region observability: every region writes its own flight
+#    file; stitch_files + reconstruct must rebuild ONE timeline with
+#    every node's outcome exactly once across all regions and the kill.
+# ---------------------------------------------------------------------------
+
+#: SCALE_r02's measured per-node apiserver cost for the 1k informer run
+#: ({list: 2, patch: 1000, watch: 1} ≈ 1.003 req/node), re-read from the
+#: committed artifact when present so the gate tracks the actual
+#: baseline, not a stale constant.
+R02_FALLBACK_PER_NODE = 1.003
+#: The r03 run adds traffic r02 did not have: regional lease
+#: create/acquire + one CAS checkpoint per window + the resume leg's
+#: re-list. All are O(windows) or O(1), not O(nodes); 0.25 req/node
+#: bounds them with room at 10k nodes/region.
+FEDERATION_PER_NODE_ALLOWANCE = 0.25
+
+
+def _r02_baseline_per_node() -> float:
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "SCALE_r02.json",
+    )
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        for row in doc.get("pools", []):
+            if row.get("mode") == "informer" and row.get("apiserver_requests"):
+                return sum(row["apiserver_requests"].values()) / row["nodes"]
+    except (OSError, ValueError, KeyError, ZeroDivisionError):
+        pass
+    return R02_FALLBACK_PER_NODE
+
+
+def _federation_region_fleet(state, region: str, n: int,
+                             hosts_per_slice: int = 4) -> None:
+    from tpu_cc_manager.ccmanager import federation as federation_mod
+
+    for i in range(n):
+        name = f"{region}-n{i:05d}"
+        labels = fleet_labels(i, n, hosts_per_slice, zones=8)
+        labels[federation_mod.REGION_LABEL] = region
+        state.nodes[name] = {
+            "kind": "Node",
+            "apiVersion": "v1",
+            "metadata": {
+                "name": name,
+                "resourceVersion": "1",
+                "labels": labels,
+            },
+        }
+
+
+def run_federation(
+    total_nodes: int = 100_000,
+    regions_count: int = 10,
+    seed: int = DEFAULT_SEED,
+    shards: int = 8,
+    per_shard_unavailable: int = 25,
+    poll_interval_s: float = 0.05,
+    node_timeout_s: float = 600.0,
+    kill_region_index: int = 3,
+    kill_at: int | None = None,
+) -> dict:
+    """One federated rollout across ``regions_count`` regional mock
+    apiservers, one region killed mid-flight and resumed; returns the
+    SCALE_r03 row."""
+    from http.server import ThreadingHTTPServer
+
+    from tpu_cc_manager.ccmanager import federation as federation_mod
+    from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+    mock = _load_mock()
+    ns = "tpu-operator"
+    nodes_per_region = total_nodes // regions_count
+    regions = [f"r{i:02d}" for i in range(regions_count)]
+    kill_region = regions[kill_region_index % len(regions)]
+    if kill_at is None:
+        # Deep enough to be mid-rollout, shallow enough that small smoke
+        # fleets (tests) still reach it before the region completes.
+        kill_at = 40 if nodes_per_region >= 1000 else 8
+    flight_dir = tempfile.mkdtemp(prefix="scale-federation-")
+
+    servers: list = []
+    region_urls: dict[str, str] = {}
+    region_states: dict[str, object] = {}
+    sims: dict[str, ServerAgentSim] = {}
+
+    def start_server(state) -> str:
+        state.start_threads()
+        srv = ThreadingHTTPServer(
+            ("127.0.0.1", 0), mock.make_handler(state)
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return f"http://127.0.0.1:{srv.server_address[1]}"
+
+    # The control plane: ONLY the parent record's CAS lease lives here,
+    # so the per-region load gate measures regional traffic alone.
+    control_state = mock.MockState()
+    control_url = start_server(control_state)
+    for region in regions:
+        state = mock.MockState()
+        _federation_region_fleet(state, region, nodes_per_region)
+        region_urls[region] = start_server(state)
+        region_states[region] = state
+        sims[region] = ServerAgentSim(
+            state, seed=seed, min_delay_s=0.01, max_delay_s=0.04,
+            scan_interval_s=0.1,
+        )
+
+    def control_client():
+        return RestKube(ClusterConfig(server=control_url, token="scale-bench"))
+
+    parent = federation_mod.ParentStore(
+        control_client(), namespace=ns
+    ).initialize(
+        federation_mod.ParentRecord.fresh(
+            "on", SELECTOR, regions,
+            max_unavailable=shards * per_shard_unavailable,
+        ),
+        resume=False,
+    )
+
+    results: dict[str, dict] = {}
+    errors: dict[str, BaseException] = {}
+    flight_files: dict[str, list[str]] = {region: [] for region in regions}
+    results_lock = threading.Lock()
+
+    def run_leg(region, client, lease, resume_record, gate, flight_path):
+        informer = NodeInformer(
+            client, federation_mod.regional_selector(SELECTOR, region),
+            page_limit=500,
+        ).start(sync_timeout_s=120.0)
+        crash_hook = None
+        if region == kill_region and resume_record is None:
+            calls = {"n": 0}
+
+            def killer(point):
+                if calls["n"] == kill_at:
+                    raise OrchestratorKilled(point, calls["n"])
+                calls["n"] += 1
+
+            crash_hook = killer
+        try:
+            roller = RollingReconfigurator(
+                client,
+                federation_mod.regional_selector(SELECTOR, region),
+                max_unavailable=per_shard_unavailable,
+                poll_interval_s=poll_interval_s,
+                node_timeout_s=node_timeout_s,
+                informer=informer,
+                wave_shards=shards,
+                lease=lease,
+                resume_record=resume_record,
+                crash_hook=crash_hook,
+                flight=flight_mod.FlightRecorder(
+                    flight_path, generation=lease.generation
+                ),
+                federation=gate,
+            )
+            mode = resume_record.mode if resume_record is not None else "on"
+            return roller.rollout(mode)
+        finally:
+            informer.stop()
+
+    def run_region(region: str) -> None:
+        client = CountingKube(
+            RestKube(
+                ClusterConfig(server=region_urls[region], token="scale-bench")
+            )
+        )
+        store = federation_mod.ParentStore(control_client(), namespace=ns)
+        # Injected lease clock (gateway-stitch idiom): time stands still
+        # during a leg, so leases never lapse mid-run without a renewer,
+        # and the kill leg advances past the dead holder's TTL exactly.
+        clk = _BenchClock()
+        killed = resumed = False
+        t0 = time.monotonic()
+        result = None
+        try:
+            lease = rollout_state.RolloutLease(
+                client, holder=f"bench-{region}-a", namespace=ns,
+                name=federation_mod.regional_lease_name(region),
+                duration_s=30.0, wall=clk, clock=clk,
+            )
+            lease.acquire()
+            gate = federation_mod.FederationGate(store, region)
+            gate.attach(parent)
+            path_a = os.path.join(flight_dir, f"orch-{region}-a.jsonl")
+            flight_files[region].append(path_a)
+            try:
+                result = run_leg(region, client, lease, None, gate, path_a)
+            except OrchestratorKilled:
+                killed = True
+                clk.advance(31.0)  # dead holder's lease TTL lapses
+                lease_b = rollout_state.RolloutLease(
+                    client, holder=f"bench-{region}-b", namespace=ns,
+                    name=federation_mod.regional_lease_name(region),
+                    duration_s=30.0, wall=clk, clock=clk,
+                )
+                record = lease_b.acquire()
+                if record is None or not record.federation:
+                    raise RuntimeError(
+                        f"{region}: resumed record lost its federation "
+                        "attachment"
+                    )
+                gate_b = federation_mod.FederationGate.from_record_dict(
+                    control_client(), record.federation
+                )
+                resumed = True
+                path_b = os.path.join(flight_dir, f"orch-{region}-b.jsonl")
+                flight_files[region].append(path_b)
+                lease = lease_b
+                result = run_leg(
+                    region, client, lease_b, record, gate_b, path_b
+                )
+            lease.release(clear_record=bool(result.ok))
+        except BaseException as e:  # noqa: BLE001 - surfaced to the caller
+            with results_lock:
+                errors[region] = e
+            return
+        with results_lock:
+            results[region] = {
+                "ok": bool(result.ok),
+                "groups": len(result.groups),
+                "seconds": round(time.monotonic() - t0, 2),
+                "killed": killed,
+                "resumed": resumed,
+            }
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=run_region, args=(region,), daemon=True)
+        for region in regions
+    ]
+    final = None
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        seconds = time.monotonic() - t0
+        if not errors:
+            final = federation_mod.ParentStore(
+                control_client(), namespace=ns
+            ).load()
+    finally:
+        for sim in sims.values():
+            sim.stop()
+        for srv in servers:
+            srv.shutdown()
+    if errors:
+        region, err = sorted(errors.items())[0]
+        raise RuntimeError(f"region {region} failed: {err!r}") from err
+
+    baseline = _r02_baseline_per_node()
+    per_node_budget = round(baseline + FEDERATION_PER_NODE_ALLOWANCE, 3)
+    per_apiserver: dict[str, dict] = {}
+    load_ok = True
+    for region in regions:
+        state = region_states[region]
+        with state.lock:
+            counts = dict(sorted(state.request_counts.items()))
+            converged = all(
+                node["metadata"]["labels"].get(CC_MODE_STATE_LABEL) == "on"
+                for node in state.nodes.values()
+            )
+        total = sum(counts.values())
+        per_node = round(total / max(1, nodes_per_region), 3)
+        ok_region = per_node <= per_node_budget and converged
+        load_ok = load_ok and ok_region
+        per_apiserver[region] = {
+            "requests": counts,
+            "total": total,
+            "per_node": per_node,
+            "converged": converged,
+        }
+    with control_state.lock:
+        control_requests = dict(sorted(control_state.request_counts.items()))
+
+    all_paths = [p for region in regions for p in flight_files[region]]
+    stitched, torn = flight_mod.stitch_files(all_paths)
+    rec = flight_mod.reconstruct(stitched)
+    all_nodes = {
+        f"{region}-n{i:05d}"
+        for region in regions
+        for i in range(nodes_per_region)
+    }
+    exactly_once = (
+        set(rec["nodes"]) == all_nodes
+        and not rec["duplicate_node_events"]
+        and all(
+            e["outcome"] == "node-converged" for e in rec["nodes"].values()
+        )
+    )
+    killed_row = results.get(kill_region, {})
+    ok = bool(
+        results
+        and all(r["ok"] for r in results.values())
+        and final is not None
+        and final.status == federation_mod.PARENT_COMPLETE
+        and load_ok
+        and killed_row.get("killed")
+        and killed_row.get("resumed")
+        and torn == 0
+        and exactly_once
+    )
+    return {
+        "mode": "federation",
+        "nodes": total_nodes,
+        "transport": "http",
+        "ok": ok,
+        "seconds": round(seconds, 2),
+        "regions": regions_count,
+        "nodes_per_region": nodes_per_region,
+        "wave_shards": shards,
+        "max_unavailable_per_region": per_shard_unavailable * shards,
+        "killed_region": kill_region,
+        "kill_at": kill_at,
+        "parent_status": final.status if final is not None else "missing",
+        "budget_spend": len(final.budget_spend) if final is not None else -1,
+        "region_results": {r: results[r] for r in sorted(results)},
+        "per_apiserver": per_apiserver,
+        "baseline_per_node_r02": round(baseline, 3),
+        "per_node_budget": per_node_budget,
+        "apiserver_load_ok": load_ok,
+        "control_plane_requests": control_requests,
+        "stitch": {
+            "files": len(all_paths),
+            "events": len(stitched),
+            "torn_lines": torn,
+            "resumes": rec["resumes"],
+            "generations": sorted(rec["generations"]),
+            "exactly_once": exactly_once,
+        },
+    }
+
+
 def run_pool(
     n: int,
     mode: str,
@@ -810,6 +1166,20 @@ def main(argv: list[str] | None = None) -> int:
         "flight files (obs/fleet.py); defaults to FLEET_r01.json",
     )
     parser.add_argument(
+        "--federation", action="store_true",
+        help="run the federated region-sharded bench instead: one "
+        "rollout over --sizes total nodes split across --regions "
+        "per-region mock apiservers, a single global failure budget "
+        "CAS-settled through a control-plane parent record, one region "
+        "killed mid-rollout and resumed, and all regional flight files "
+        "stitched into one exactly-once timeline; defaults to 100000 "
+        "nodes, 10 regions, SCALE_r03.json",
+    )
+    parser.add_argument(
+        "--regions", type=int, default=10,
+        help="region (= per-region apiserver) count for --federation",
+    )
+    parser.add_argument(
         "--partial", default=None,
         help="JSONL of completed (mode,size) rows; existing rows are "
         "skipped on re-run (resume after an interruption)",
@@ -826,6 +1196,50 @@ def main(argv: list[str] | None = None) -> int:
         summary = run_gateway_bench(
             n=sizes[0], seed=args.seed, shards=args.shards
         )
+        summary["seed"] = args.seed
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
+    if args.federation:
+        out = args.out or "SCALE_r03.json"
+        total = int((args.sizes or "100000").split(",")[0])
+        summary = None
+        if args.partial and os.path.exists(args.partial):
+            with open(args.partial, encoding="utf-8") as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    row = json.loads(line)
+                    if (
+                        row.get("mode") == "federation"
+                        and row.get("nodes") == total
+                        and row.get("ok")
+                    ):
+                        summary = row
+            if summary is not None:
+                print(
+                    f">>> resuming: federation@{total} already completed "
+                    f"in {args.partial}", file=sys.stderr,
+                )
+        if summary is None:
+            print(
+                f">>> federated rollout: {total} node(s) across "
+                f"{args.regions} regional apiserver(s)", file=sys.stderr,
+            )
+            summary = run_federation(
+                total_nodes=total, regions_count=args.regions,
+                seed=args.seed, shards=args.shards,
+            )
+            if args.partial:
+                os.makedirs(
+                    os.path.dirname(args.partial) or ".", exist_ok=True
+                )
+                with open(args.partial, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(summary) + "\n")
+        summary["bench"] = "federated_scale_rollout"
+        summary["unit"] = "per-apiserver requests / federated rollout"
         summary["seed"] = args.seed
         with open(out, "w", encoding="utf-8") as f:
             json.dump(summary, f, indent=1, sort_keys=True)

@@ -1,0 +1,584 @@
+"""Federated region-sharded rollouts (ccmanager/federation.py).
+
+The acceptance bars (ISSUE 17), all in tier-1:
+
+- a 2-region federated rollout over a 100-node pool converges both
+  regional shards and completes the parent record exactly once;
+- a parent-record CAS race between two shards charges the single global
+  failure budget exactly once (set-union merge under honest 409s);
+- ONE global budget halts EVERY region: a region that blows the budget
+  pushes HALTED to the parent, and every other shard stops at its next
+  wave-boundary sync without bouncing another node;
+- a regional apiserver blackout stalls ONLY that region — the siblings
+  keep settling the global budget through the parent and finish — and a
+  successor resumes the blacked-out region from its regional record;
+- a force-aborted federation fences a wedged shard on its next write
+  (parent generation bump, the federated analogue of release_lease);
+- downgrade compat: a federation-unaware (record v4) orchestrator
+  refuses a federated record loudly, and a single-region federated
+  record serializes <= v4 and round-trips through the legacy resume
+  path.
+
+The chaos-marked soak (hack/chaos_soak.sh) re-runs the kill + blackout
+legs under any CC_CHAOS_SEED and prints the FEDERATION_SUMMARY line.
+"""
+
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from tpu_cc_manager.ccmanager import federation as federation_mod
+from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+from tpu_cc_manager.faults.kube import FaultyKubeClient
+from tpu_cc_manager.faults.plan import FaultPlan, OrchestratorKilled
+from tpu_cc_manager.kubeclient.api import KubeApiError, node_labels
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    QUARANTINED_LABEL,
+)
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+POOL = "pool=tpu"
+NS = "tpu-operator"
+
+
+class Clock:
+    """Injectable wall/monotonic clock for deterministic lease expiry."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def converge_reactor(kube):
+    """Agents in miniature: desired-mode label edits converge instantly."""
+
+    def reactor(name, node):
+        labels = node_labels(node)
+        desired = labels.get(CC_MODE_LABEL)
+        if desired and labels.get(CC_MODE_STATE_LABEL) != desired:
+            kube.set_node_label(name, CC_MODE_STATE_LABEL, desired)
+
+    kube.add_patch_reactor(reactor)
+
+
+def add_region_pool(fake, region, n, quarantined=0):
+    for i in range(n):
+        labels = {"pool": "tpu", federation_mod.REGION_LABEL: region}
+        if i < quarantined:
+            labels[QUARANTINED_LABEL] = "true"
+        fake.add_node(f"{region}-node-{i}", labels)
+
+
+def make_parent(fake, regions=("r1", "r2"), mode="on", **kw):
+    store = federation_mod.ParentStore(fake, namespace=NS)
+    parent = store.initialize(
+        federation_mod.ParentRecord.fresh(mode, POOL, list(regions), **kw),
+        resume=False,
+    )
+    return store, parent
+
+
+def regional_lease(api, region, holder, clk, metrics=None):
+    return rollout_state.RolloutLease(
+        api, holder=holder, namespace=NS,
+        name=federation_mod.regional_lease_name(region),
+        duration_s=30.0, metrics=metrics or MetricsRegistry(),
+        wall=clk, clock=clk,
+    )
+
+
+def regional_roller(api, region, gate, **kw):
+    kw.setdefault("node_timeout_s", 5)
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("metrics", MetricsRegistry())
+    return RollingReconfigurator(
+        api, federation_mod.regional_selector(POOL, region),
+        federation=gate, **kw
+    )
+
+
+def region_converged(fake, region, mode="on"):
+    nodes = fake.list_nodes(federation_mod.regional_selector(POOL, region))
+    return nodes and all(
+        node_labels(n).get(CC_MODE_STATE_LABEL) == mode
+        for n in nodes
+        if QUARANTINED_LABEL not in node_labels(n)
+    )
+
+
+# ---------------------------------------------------------------------------
+# The 100-node two-region smoke: the tier-1 federation acceptance path
+# ---------------------------------------------------------------------------
+
+
+def test_two_region_federated_rollout_converges_100_nodes(fake_kube):
+    converge_reactor(fake_kube)
+    add_region_pool(fake_kube, "r1", 50)
+    add_region_pool(fake_kube, "r2", 50)
+    store, parent = make_parent(fake_kube)
+    clk = Clock()
+    results = {}
+
+    def run_region(region):
+        lease = regional_lease(fake_kube, region, f"orch-{region}", clk)
+        lease.acquire()
+        gate = federation_mod.FederationGate(store, region)
+        gate.attach(parent)
+        roller = regional_roller(
+            fake_kube, region, gate, lease=lease, max_unavailable=10,
+        )
+        results[region] = roller.rollout("on")
+        lease.release(clear_record=True)
+
+    threads = [
+        threading.Thread(target=run_region, args=(r,), daemon=True)
+        for r in ("r1", "r2")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results["r1"].ok and results["r2"].ok
+    assert region_converged(fake_kube, "r1")
+    assert region_converged(fake_kube, "r2")
+    final = store.load()
+    assert final is not None
+    assert final.status == federation_mod.PARENT_COMPLETE
+    assert final.budget_spend == []
+    assert set(final.regions) == {"r1", "r2"}
+    assert all(
+        r["status"] == federation_mod.PARENT_COMPLETE
+        for r in final.regions.values()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once budget accounting under a parent-record CAS race
+# ---------------------------------------------------------------------------
+
+
+def test_parent_cas_race_charges_budget_exactly_once(fake_kube):
+    store, parent = make_parent(fake_kube, regions=("r1", "r2"))
+    gates = {}
+    for region in ("r1", "r2"):
+        gates[region] = federation_mod.FederationGate(store, region)
+        gates[region].attach(parent)
+
+    # Both shards charge an overlapping spend set concurrently: the CAS
+    # loser re-runs its merge against the winner's write, and the
+    # set-union makes the retried charge idempotent.
+    barrier = threading.Barrier(2)
+    views = {}
+
+    def charge(region, spend):
+        barrier.wait()
+        views[region] = gates[region].sync(spend)
+
+    t1 = threading.Thread(
+        target=charge, args=("r1", ["shared-node", "r1-only"]), daemon=True
+    )
+    t2 = threading.Thread(
+        target=charge, args=("r2", ["shared-node", "r2-only"]), daemon=True
+    )
+    for t in (t1, t2):
+        t.start()
+    for t in (t1, t2):
+        t.join(timeout=10)
+    final = store.load()
+    assert set(final.budget_spend) == {"shared-node", "r1-only", "r2-only"}
+    # Whichever shard synced LAST saw the full union folded back down.
+    assert set(views["r1"]["spend"]) | set(views["r2"]["spend"]) == {
+        "shared-node", "r1-only", "r2-only",
+    }
+    # Re-syncing the same spend stays exactly-once.
+    gates["r1"].sync(["shared-node", "r1-only"])
+    assert set(store.load().budget_spend) == {
+        "shared-node", "r1-only", "r2-only",
+    }
+
+
+def test_parent_cas_race_many_shards_each_charge_lands_once(fake_kube):
+    regions = [f"z{i}" for i in range(8)]
+    store, parent = make_parent(fake_kube, regions=regions)
+    barrier = threading.Barrier(len(regions))
+
+    def charge(region):
+        gate = federation_mod.FederationGate(store, region)
+        gate.attach(parent)
+        barrier.wait()
+        gate.sync([f"{region}-failed"])
+
+    threads = [
+        threading.Thread(target=charge, args=(r,), daemon=True)
+        for r in regions
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert set(store.load().budget_spend) == {f"{r}-failed" for r in regions}
+
+
+# ---------------------------------------------------------------------------
+# One global budget halts every region
+# ---------------------------------------------------------------------------
+
+
+def test_global_budget_blown_in_one_region_halts_the_others(fake_kube):
+    converge_reactor(fake_kube)
+    add_region_pool(fake_kube, "r1", 4, quarantined=2)
+    add_region_pool(fake_kube, "r2", 4)
+    store, parent = make_parent(fake_kube, failure_budget=1)
+    clk = Clock()
+
+    # r1 blows the single global budget (2 quarantined > budget 1) and
+    # pushes HALTED to the parent.
+    lease_1 = regional_lease(fake_kube, "r1", "orch-r1", clk)
+    lease_1.acquire()
+    gate_1 = federation_mod.FederationGate(store, "r1")
+    gate_1.attach(parent)
+    roller_1 = regional_roller(
+        fake_kube, "r1", gate_1, lease=lease_1, failure_budget=1,
+    )
+    result_1 = roller_1.rollout("on")
+    assert not result_1.ok
+    assert result_1.halted_reason == "failure-budget-exceeded"
+    mid = store.load()
+    assert mid.status == federation_mod.PARENT_HALTED
+
+    # r2 is perfectly healthy, but the GLOBAL budget is spent: its very
+    # first wave-boundary sync sees the halted parent and stops before
+    # bouncing a single node.
+    lease_2 = regional_lease(fake_kube, "r2", "orch-r2", clk)
+    lease_2.acquire()
+    gate_2 = federation_mod.FederationGate(store, "r2")
+    gate_2.attach(parent)
+    roller_2 = regional_roller(
+        fake_kube, "r2", gate_2, lease=lease_2, failure_budget=1,
+    )
+    result_2 = roller_2.rollout("on")
+    assert not result_2.ok
+    assert result_2.halted_reason
+    assert result_2.groups == []
+    for n in fake_kube.list_nodes(
+        federation_mod.regional_selector(POOL, "r2")
+    ):
+        assert CC_MODE_LABEL not in node_labels(n)
+
+
+def test_sibling_spend_folds_into_regional_budget_math(fake_kube):
+    """A region that never failed anything still halts when SIBLING
+    spend pushed through the parent exhausts the shared budget — the
+    whole point of one global ledger."""
+    converge_reactor(fake_kube)
+    add_region_pool(fake_kube, "r2", 4)
+    store, parent = make_parent(fake_kube, failure_budget=1)
+    gate_1 = federation_mod.FederationGate(store, "r1")
+    gate_1.attach(parent)
+    # r1 (not under test) reports two dead nodes, still in-progress.
+    gate_1.sync(["r1-node-0", "r1-node-1"])
+
+    clk = Clock()
+    lease_2 = regional_lease(fake_kube, "r2", "orch-r2", clk)
+    lease_2.acquire()
+    gate_2 = federation_mod.FederationGate(store, "r2")
+    gate_2.attach(parent)
+    roller_2 = regional_roller(
+        fake_kube, "r2", gate_2, lease=lease_2, failure_budget=1,
+    )
+    result_2 = roller_2.rollout("on")
+    assert not result_2.ok
+    assert result_2.halted_reason == "failure-budget-exceeded"
+    # The halt came from folded-down sibling spend, not local failures.
+    assert result_2.groups == []
+
+
+# ---------------------------------------------------------------------------
+# Regional apiserver blackout: stalls one region, not the federation
+# ---------------------------------------------------------------------------
+
+
+def run_blackout_leg(fake_kube, seed=0):
+    """One full blackout scenario; shared by the tier-1 test and the
+    chaos soak. Returns a summary dict."""
+    converge_reactor(fake_kube)
+    add_region_pool(fake_kube, "r1", 4, quarantined=1)
+    add_region_pool(fake_kube, "r2", 4)
+    store, parent = make_parent(fake_kube, failure_budget=2)
+    clk = Clock()
+
+    # r1's REGIONAL apiserver traffic rides a faulty client; the parent
+    # store stays on the (separate, healthy) control plane.
+    plan = FaultPlan(seed=seed, rate=0.0)
+    faulty = FaultyKubeClient(fake_kube, plan)
+    lease_1 = regional_lease(faulty, "r1", "orch-r1-a", clk)
+    lease_1.acquire()
+    gate_1 = federation_mod.FederationGate(store, "r1")
+    gate_1.attach(parent)
+    boundaries = {"n": 0}
+
+    def blackout_mid_rollout(point):
+        if point == "window-boundary":
+            boundaries["n"] += 1
+            if boundaries["n"] == 1:
+                plan.begin_blackout()
+
+    roller_1 = regional_roller(
+        faulty, "r1", gate_1, lease=lease_1, failure_budget=2,
+        crash_hook=blackout_mid_rollout,
+    )
+    with pytest.raises(KubeApiError):
+        roller_1.rollout("on")
+    assert plan.in_blackout
+
+    # The blackout stalls ONLY r1: r2 runs to completion against the
+    # healthy apiserver, and the global ledger it folds down already
+    # carries r1's quarantined node.
+    lease_2 = regional_lease(fake_kube, "r2", "orch-r2", clk)
+    lease_2.acquire()
+    gate_2 = federation_mod.FederationGate(store, "r2")
+    gate_2.attach(parent)
+    roller_2 = regional_roller(
+        fake_kube, "r2", gate_2, lease=lease_2, failure_budget=2,
+    )
+    result_2 = roller_2.rollout("on")
+    assert result_2.ok
+    assert region_converged(fake_kube, "r2")
+    mid = store.load()
+    assert mid.status == federation_mod.PARENT_IN_PROGRESS
+    assert "r1-node-0" in mid.budget_spend
+
+    # Apiserver back: a successor takes the lapsed regional lease,
+    # re-attaches to the live parent from the persisted record, and
+    # finishes r1. The federation completes exactly once.
+    plan.end_blackout()
+    clk.advance(31.0)
+    lease_1b = regional_lease(fake_kube, "r1", "orch-r1-b", clk)
+    record = lease_1b.acquire()
+    assert record is not None and record.federation
+    gate_1b = federation_mod.FederationGate.from_record_dict(
+        fake_kube, record.federation
+    )
+    roller_1b = regional_roller(
+        fake_kube, "r1", gate_1b, lease=lease_1b,
+        resume_record=record, failure_budget=2,
+    )
+    result_1b = roller_1b.rollout(record.mode)
+    assert result_1b.ok
+    assert region_converged(fake_kube, "r1")
+    final = store.load()
+    assert final.status == federation_mod.PARENT_COMPLETE
+    return {
+        "blackout_refusals": plan.blackout_refusals,
+        "budget_spend": sorted(final.budget_spend),
+        "r1_groups": len(result_1b.groups),
+    }
+
+
+def test_regional_blackout_stalls_only_that_region(fake_kube):
+    summary = run_blackout_leg(fake_kube)
+    assert summary["blackout_refusals"] > 0
+    assert summary["budget_spend"] == ["r1-node-0"]
+
+
+# ---------------------------------------------------------------------------
+# Force-abort: the wedged shard self-fences on its next write
+# ---------------------------------------------------------------------------
+
+
+def test_force_abort_fences_live_shard_on_next_sync(fake_kube):
+    metrics = MetricsRegistry()
+    store, parent = make_parent(fake_kube)
+    gate = federation_mod.FederationGate(store, "r1", metrics=metrics)
+    gate.attach(parent)
+    assert gate.sync([])["parent_status"] == federation_mod.PARENT_IN_PROGRESS
+
+    aborted = store.abort("operator gave up on this plan")
+    assert aborted.status == federation_mod.PARENT_ABORTED
+    assert aborted.generation == parent.generation + 1
+    with pytest.raises(rollout_state.RolloutFenced):
+        gate.sync([])
+    text = metrics.render_prometheus()
+    assert 'tpu_cc_federation_fences_total{reason="parent-generation"}' in text
+
+
+def test_force_abort_stops_a_running_regional_rollout(fake_kube):
+    converge_reactor(fake_kube)
+    add_region_pool(fake_kube, "r1", 6)
+    store, parent = make_parent(fake_kube, regions=("r1", "r2"))
+    clk = Clock()
+    lease = regional_lease(fake_kube, "r1", "orch-r1", clk)
+    lease.acquire()
+    gate = federation_mod.FederationGate(store, "r1")
+    gate.attach(parent)
+    fired = {"n": 0}
+
+    def abort_mid_rollout(point):
+        if point == "window-boundary":
+            fired["n"] += 1
+            if fired["n"] == 1:
+                store.abort("chaos: operator force-abort")
+
+    roller = regional_roller(
+        fake_kube, "r1", gate, lease=lease, max_unavailable=1,
+        crash_hook=abort_mid_rollout,
+    )
+    with pytest.raises(rollout_state.RolloutFenced):
+        roller.rollout("on")
+    # The wedged shard stopped before converging its whole region.
+    assert not region_converged(fake_kube, "r1")
+
+
+# ---------------------------------------------------------------------------
+# Downgrade compatibility
+# ---------------------------------------------------------------------------
+
+
+def _federated_record(regions_total=2):
+    return rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=3,
+        groups=[("g0", ("r1-node-0",))], done=[],
+        federation={
+            "region": "r1", "regions": regions_total,
+            "parent_namespace": NS,
+            "parent_name": federation_mod.PARENT_LEASE_NAME,
+            "generation": 1, "digest": "abc123",
+        },
+    )
+
+
+def test_federation_unaware_orchestrator_refuses_v5_record(monkeypatch):
+    """A v4-era orchestrator (no federation support) must refuse the
+    record loudly, never resume a regional slice as a plain rollout."""
+    data = _federated_record().to_json()
+    assert json.loads(data)["version"] == rollout_state.RECORD_VERSION
+    monkeypatch.setattr(
+        rollout_state, "RECORD_VERSION",
+        rollout_state.RECORD_VERSION_NO_FEDERATION,
+    )
+    with pytest.raises(rollout_state.RolloutFenced, match="newer than"):
+        rollout_state.RolloutRecord.from_json(data)
+
+
+def test_resume_of_federated_record_without_gate_is_refused(fake_kube):
+    record = _federated_record()
+    roller = RollingReconfigurator(
+        fake_kube, POOL, resume_record=record, node_timeout_s=1,
+    )
+    with pytest.raises(ValueError, match="federation gate"):
+        roller.rollout("on")
+
+
+def test_single_region_federated_record_roundtrips_legacy_resume(fake_kube):
+    """regions=1 is not a federation: the record serializes <= v4 with
+    no federation field, so a legacy orchestrator resumes it."""
+    record = _federated_record(regions_total=1)
+    data = record.to_json()
+    obj = json.loads(data)
+    assert obj["version"] <= rollout_state.RECORD_VERSION_NO_FEDERATION
+    assert "federation" not in obj
+    back = rollout_state.RolloutRecord.from_json(data)
+    assert back.federation is None
+
+    converge_reactor(fake_kube)
+    add_region_pool(fake_kube, "r1", 1)
+    result = RollingReconfigurator(
+        fake_kube, POOL, resume_record=back,
+        node_timeout_s=5, poll_interval_s=0.02,
+    ).rollout("on")
+    assert result.ok
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: seeded regional kill + blackout (FEDERATION_SUMMARY)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_federation_soak_seeded_regional_kill_and_blackout(fake_kube):
+    """One seeded federation weather pass: a regional orchestrator is
+    killed at a seeded crash point and resumed from its record, then the
+    blackout leg runs on a fresh pool. Prints the FEDERATION_SUMMARY
+    line hack/chaos_soak.sh scrapes."""
+    seed = int(os.environ.get("CC_CHAOS_SEED", "20260807"))
+    rng = random.Random(seed)
+
+    converge_reactor(fake_kube)
+    add_region_pool(fake_kube, "k1", 8)
+    add_region_pool(fake_kube, "k2", 8)
+    store, parent = make_parent(fake_kube, regions=("k1", "k2"))
+    clk = Clock()
+
+    # Leg 1: seeded kill in k1, clean run in k2.
+    kill_at = rng.randrange(2, 12)
+    calls = {"n": 0}
+
+    def killer(point):
+        if calls["n"] == kill_at:
+            raise OrchestratorKilled(point, calls["n"])
+        calls["n"] += 1
+
+    lease_a = regional_lease(fake_kube, "k1", "orch-k1-a", clk)
+    lease_a.acquire()
+    gate_a = federation_mod.FederationGate(store, "k1")
+    gate_a.attach(parent)
+    killed = False
+    try:
+        result_1 = regional_roller(
+            fake_kube, "k1", gate_a, lease=lease_a, max_unavailable=1,
+            crash_hook=killer,
+        ).rollout("on")
+    except OrchestratorKilled:
+        killed = True
+        clk.advance(31.0)
+        lease_b = regional_lease(fake_kube, "k1", "orch-k1-b", clk)
+        record = lease_b.acquire()
+        assert record is not None and record.federation
+        gate_b = federation_mod.FederationGate.from_record_dict(
+            fake_kube, record.federation
+        )
+        result_1 = regional_roller(
+            fake_kube, "k1", gate_b, lease=lease_b, resume_record=record,
+            max_unavailable=1,
+        ).rollout(record.mode)
+    assert result_1.ok
+    assert region_converged(fake_kube, "k1")
+
+    lease_2 = regional_lease(fake_kube, "k2", "orch-k2", clk)
+    lease_2.acquire()
+    gate_2 = federation_mod.FederationGate(store, "k2")
+    gate_2.attach(parent)
+    result_2 = regional_roller(
+        fake_kube, "k2", gate_2, lease=lease_2, max_unavailable=2,
+    ).rollout("on")
+    assert result_2.ok
+    assert store.load().status == federation_mod.PARENT_COMPLETE
+
+    # Leg 2: the blackout scenario on a fresh pool + fresh parent.
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    blackout = run_blackout_leg(FakeKube(), seed=seed)
+
+    print(
+        "FEDERATION_SUMMARY "
+        + json.dumps({
+            "seed": seed,
+            "kill_at": kill_at,
+            "killed": killed,
+            "regions": 2,
+            "parent_complete": True,
+            "blackout_refusals": blackout["blackout_refusals"],
+            "budget_spend": blackout["budget_spend"],
+        })
+    )

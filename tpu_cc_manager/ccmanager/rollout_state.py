@@ -91,8 +91,17 @@ RECORD_HALTED = "halted"
 #: orchestrator unchanged (the wave partition is derived from the plan,
 #: never persisted) — and refuses newer versions loudly rather than
 #: silently dropping fields a successor relied on.
-RECORD_VERSION = 4
+#: 5: adds ``federation`` (region-sharded rollouts) — written ONLY when
+#: this record is one regional slice of a MULTI-region federated rollout
+#: (ccmanager/federation.py). A federated slice resumed by a
+#: federation-unaware binary would re-drive one region unfenced against
+#: the GLOBAL failure budget (spending nobody else can see), so v5 is
+#: refused loudly by older parsers. A single-region federation is just a
+#: plain rollout and serializes <= v4, so it round-trips through the
+#: legacy resume path.
+RECORD_VERSION = 5
 #: What records WITHOUT the newer optional fields write (compat floors).
+RECORD_VERSION_NO_FEDERATION = 4
 RECORD_VERSION_NO_SLO = 3
 RECORD_VERSION_NO_SURGE = 2
 
@@ -154,6 +163,13 @@ class RolloutRecord:
     # so a crash + --resume re-arms the gate instead of silently
     # resuming a latency-gated rollout ungated.
     slo_gate: dict | None = None
+    # Federated region-sharded rollouts (format v5, written only for a
+    # regional slice of a MULTI-region federation): this shard's region
+    # name plus the parent-record coordinates
+    # (ccmanager/federation.py FederationGate.to_record_dict()) so a
+    # crash + --resume reconnects the successor to the parent's global
+    # budget instead of silently resuming one region unfenced.
+    federation: dict | None = None
 
     def charge_budget(self, nodes) -> None:
         self.budget_spend = sorted(set(self.budget_spend) | set(nodes))
@@ -170,30 +186,38 @@ class RolloutRecord:
         }
 
     def to_json(self) -> str:
-        if self.slo_gate:
+        # A single-region "federation" is a plain rollout: drop the field
+        # so the record stays <= v4 and the legacy resume path round-trips
+        # it (the downgrade-compat contract, tests/test_federation.py).
+        federation = self.federation if (
+            self.federation and int(self.federation.get("regions") or 0) > 1
+        ) else None
+        if federation:
             version = RECORD_VERSION
+        elif self.slo_gate:
+            version = RECORD_VERSION_NO_FEDERATION
         elif self.surge:
             version = RECORD_VERSION_NO_SLO
         else:
             version = RECORD_VERSION_NO_SURGE
-        return json.dumps(
-            {
-                "version": version,
-                "mode": self.mode,
-                "selector": self.selector,
-                "generation": self.generation,
-                "groups": [[gid, list(nodes)] for gid, nodes in self.groups],
-                "done": self.done,
-                "budget_spend": list(self.budget_spend),
-                "max_unavailable": self.max_unavailable,
-                "failure_budget": self.failure_budget,
-                "status": self.status,
-                "wave_shards": self.wave_shards,
-                "surge": self.surge,
-                "slo_gate": self.slo_gate,
-            },
-            sort_keys=True, separators=(",", ":"),
-        )
+        body = {
+            "version": version,
+            "mode": self.mode,
+            "selector": self.selector,
+            "generation": self.generation,
+            "groups": [[gid, list(nodes)] for gid, nodes in self.groups],
+            "done": self.done,
+            "budget_spend": list(self.budget_spend),
+            "max_unavailable": self.max_unavailable,
+            "failure_budget": self.failure_budget,
+            "status": self.status,
+            "wave_shards": self.wave_shards,
+            "surge": self.surge,
+            "slo_gate": self.slo_gate,
+        }
+        if federation:
+            body["federation"] = federation
+        return json.dumps(body, sort_keys=True, separators=(",", ":"))
 
     @classmethod
     def from_json(cls, data: str) -> "RolloutRecord":
@@ -230,6 +254,10 @@ class RolloutRecord:
                 slo_gate=(
                     dict(obj["slo_gate"])
                     if isinstance(obj.get("slo_gate"), dict) else None
+                ),
+                federation=(
+                    dict(obj["federation"])
+                    if isinstance(obj.get("federation"), dict) else None
                 ),
             )
         except RolloutFenced:

@@ -217,6 +217,18 @@ def build_parser() -> argparse.ArgumentParser:
         "gate polls at wave boundaries (tpu_cc_serve_slo_p99_seconds / "
         "tpu_cc_serve_error_budget_burn)",
     )
+    r.add_argument(
+        "--regions", default=None,
+        help="federated rollout: comma-separated region names "
+        "(topology.kubernetes.io/region label values). One regional "
+        "orchestrator shard per region, each with its own rollout "
+        "lease and its own regional slice of ONE federated record; "
+        "--failure-budget and --max-unavailable are GLOBAL (spent "
+        "across all regions via the CAS-fenced parent record). "
+        "--resume resumes every region's slice; --abort force-aborts "
+        "the whole federation (live shards self-fence on their next "
+        "parent sync)",
+    )
 
     tl = sub.add_parser(
         "rollout-timeline",
@@ -430,6 +442,8 @@ def cmd_rollout(api, args) -> int:
     from tpu_cc_manager.labels import canonical_mode
 
     lease_namespace = getattr(args, "lease_namespace", None)
+    if getattr(args, "regions", None):
+        return _rollout_federated(api, args)
     if getattr(args, "abort_rollout", False):
         return _abort_rollout(
             api, lease_namespace, force=getattr(args, "force", False)
@@ -565,6 +579,32 @@ def cmd_rollout(api, args) -> int:
             log.error("--resume: no persisted rollout record found")
             lease.release()
             return 2
+    federation_gate = None
+    if resume_record is not None and resume_record.federation:
+        # A regional slice of a MULTI-region federation: the successor
+        # must re-attach to the parent record (global budget, fencing
+        # generation) before touching a node — resuming it unfenced
+        # would spend budget the siblings never see. Single-region
+        # federated records persist as <=v4 and never reach here.
+        from tpu_cc_manager.ccmanager import federation as federation_mod
+
+        try:
+            federation_gate = federation_mod.FederationGate.from_record_dict(
+                api, resume_record.federation
+            )
+        except rollout_state.RolloutFenced as e:
+            log.error(
+                "resume: this record is a regional slice of a federated "
+                "rollout and its parent refused the attachment (%s); "
+                "`rollout --abort` discards the regional record", e,
+            )
+            lease.release()
+            return 1
+        log.warning(
+            "resume: regional slice of a federated rollout (region %s of "
+            "%d); re-attached to the parent record",
+            federation_gate.region, federation_gate.regions_total,
+        )
     failure_budget = getattr(args, "failure_budget", None)
     # None = flag omitted (the parser's default), distinguishable from an
     # explicit `--max-unavailable 1`.
@@ -741,6 +781,7 @@ def cmd_rollout(api, args) -> int:
             flight=flight,
             slo_gate=slo_gate,
             slo_config=slo_config,
+            federation=federation_gate,
         )
         result = roller.rollout(mode)
     except rollout_state.RolloutFenced as e:
@@ -774,6 +815,217 @@ def cmd_rollout(api, args) -> int:
         lease.release(clear_record=result.ok)
     print(json.dumps(result.summary()))
     return 0 if result.ok else 1
+
+
+def _rollout_federated(api, args) -> int:
+    """``rollout --regions r1,r2,...``: one regional orchestrator shard
+    per region (own lease, own flight file, own regional slice of the
+    pool via the topology region label), federated under ONE parent
+    record carrying the global plan digest and the single global
+    failure budget / max-unavailable. Shards run as threads here; at
+    fleet scale each shard is its own process against its own regional
+    apiserver (hack/scale_bench.py --federation) — the parent-record
+    protocol is identical."""
+    import os as _os
+    import socket as _socket
+    import threading as _threading
+
+    from tpu_cc_manager.ccmanager import federation as federation_mod
+    from tpu_cc_manager.ccmanager import rollout_state
+    from tpu_cc_manager.labels import canonical_mode
+    from tpu_cc_manager.obs import flight as flight_mod
+
+    regions = [r.strip() for r in args.regions.split(",") if r.strip()]
+    if len(regions) != len(set(regions)):
+        raise ValueError("--regions: duplicate region names")
+    if getattr(args, "no_lease", False):
+        raise ValueError(
+            "--regions cannot run --no-lease: the federation IS the "
+            "fencing hierarchy"
+        )
+    lease_namespace = getattr(args, "lease_namespace", None)
+    store = federation_mod.ParentStore(api, namespace=lease_namespace)
+    if getattr(args, "abort_rollout", False):
+        parent = store.load()
+        if parent is None:
+            log.error("--abort --regions: no federated parent record")
+            return 1
+        aborted = store.abort()
+        # Live shards self-fence on their next parent sync (the abort
+        # bumped the generation); their regional leases/records are
+        # force-released so a fresh federation can start immediately.
+        for region in sorted(set(regions) | set(parent.regions)):
+            rollout_state.release_lease(
+                api,
+                lease_namespace or rollout_state.lease_namespace(),
+                name=federation_mod.regional_lease_name(region),
+            )
+        log.warning(
+            "federated rollout aborted (generation now %d); every live "
+            "shard is fenced at its next parent sync", aborted.generation,
+        )
+        return 0
+    mode = canonical_mode(args.mode) if getattr(args, "mode", None) else None
+    if mode is not None and mode not in VALID_MODES:
+        raise ValueError(f"invalid CC mode {mode!r} (valid: {VALID_MODES})")
+    resume_requested = getattr(args, "resume", False)
+    failure_budget = getattr(args, "failure_budget", None)
+    max_unavailable = getattr(args, "max_unavailable", None)
+    if resume_requested:
+        existing = store.load()
+        if existing is None:
+            log.error("--resume --regions: no federated parent record")
+            return 2
+        # The parent carries the dead federation's settings; explicit
+        # flags still win (same inheritance rule as a regional resume).
+        mode = mode or existing.mode
+        if failure_budget is None:
+            failure_budget = existing.failure_budget
+        if max_unavailable is None:
+            max_unavailable = existing.max_unavailable
+    if mode is None:
+        raise ValueError("--mode is required (unless --resume)")
+    if max_unavailable is None:
+        max_unavailable = 1
+    parent = store.initialize(
+        federation_mod.ParentRecord.fresh(
+            mode, args.selector, regions,
+            max_unavailable=max_unavailable,
+            failure_budget=failure_budget,
+        ),
+        resume=resume_requested,
+    )
+    results: dict[str, object] = {}
+    errors: dict[str, BaseException] = {}
+    flight_files: dict[str, str] = {}
+
+    def run_region(region: str) -> None:
+        regional_selector = federation_mod.regional_selector(
+            args.selector, region
+        )
+        lease = rollout_state.RolloutLease(
+            api,
+            holder=f"{_socket.gethostname()}-{_os.getpid()}-{region}",
+            namespace=lease_namespace,
+            name=federation_mod.regional_lease_name(region),
+            duration_s=(
+                getattr(args, "lease_duration", None)
+                or rollout_state.DEFAULT_LEASE_DURATION_S
+            ),
+        )
+        try:
+            record = lease.acquire()
+        except (rollout_state.LeaseHeld, rollout_state.RolloutFenced) as e:
+            log.error("region %s: cannot acquire regional lease: %s",
+                      region, e)
+            results[region] = None
+            return
+        resume_record = None
+        if record is not None and (
+            record.status == rollout_state.RECORD_IN_PROGRESS
+            or (resume_requested
+                and record.status == rollout_state.RECORD_HALTED)
+        ):
+            fed = record.federation or {}
+            if fed.get("digest") and fed["digest"] != parent.digest:
+                log.error(
+                    "region %s: regional record belongs to a different "
+                    "federation (digest %s != %s); abort it first",
+                    region, fed["digest"], parent.digest,
+                )
+                lease.release()
+                results[region] = None
+                return
+            resume_record = record
+        gate = federation_mod.FederationGate(store, region)
+        gate.attach(parent)
+        flight = None
+        if not getattr(args, "no_flight", False):
+            flight = flight_mod.FlightRecorder(
+                getattr(args, "flight_file", None)
+                and f"{args.flight_file}.{region}"
+                or flight_mod.flight_path_for(regional_selector),
+                generation=lease.generation,
+            )
+            flight_files[region] = flight.path
+            flight.record(
+                flight_mod.EVENT_LEASE_ACQUIRED, holder=lease.holder,
+                region=region, resumed=resume_record is not None or None,
+            )
+        lease.start_renewer()
+        result = None
+        try:
+            roller = RollingReconfigurator(
+                api,
+                regional_selector,
+                max_unavailable=max_unavailable,
+                node_timeout_s=args.node_timeout,
+                continue_on_failure=args.continue_on_failure,
+                rollback_on_failure=args.rollback_on_failure,
+                failure_budget=failure_budget,
+                lease=lease,
+                resume_record=resume_record,
+                flight=flight,
+                federation=gate,
+            )
+            result = roller.rollout(mode)
+            results[region] = result
+        except rollout_state.RolloutFenced as e:
+            log.error(
+                "region %s: shard fenced out mid-flight (%s); it wrote "
+                "nothing after losing its fence", region, e,
+            )
+            if flight is not None:
+                flight.record(
+                    flight_mod.EVENT_FENCED, error=str(e), region=region
+                )
+            results[region] = None
+        except BaseException as e:  # noqa: BLE001  # cclint: crash-ok(shard thread trampoline: the exception is stashed in `errors` and re-raised verbatim in the caller after join — a modeled SIGKILL still escapes through that re-raise)
+            errors[region] = e
+            results[region] = None
+        finally:
+            lease.stop_renewer()
+            lease.release(
+                clear_record=result is not None and result.ok
+            )
+
+    threads = [
+        _threading.Thread(
+            target=run_region, args=(region,),
+            name=f"federation-{region}", daemon=True,
+        )
+        for region in regions
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        region, error = sorted(errors.items())[0]
+        log.error("region %s shard died: %s", region, error)
+        raise error
+    final = store.load()
+    ok = (
+        final is not None
+        and final.status == federation_mod.PARENT_COMPLETE
+        and all(
+            getattr(r, "ok", False) for r in results.values()
+        )
+    )
+    if final is not None:
+        print(federation_mod.describe_parent(final), file=sys.stderr)
+    print(json.dumps({
+        "ok": ok,
+        "mode": mode,
+        "regions": {
+            region: (r.summary() if r is not None else None)
+            for region, r in sorted(results.items())
+        },
+        "parent_status": final.status if final is not None else None,
+        "budget_spend": len(final.budget_spend) if final is not None else 0,
+        "flight_files": dict(sorted(flight_files.items())),
+    }))
+    return 0 if ok else 1
 
 
 def cmd_rollout_timeline(api, args) -> int:
@@ -1007,6 +1259,19 @@ def cmd_status(api, args) -> int:
     )
     if rollout_line:
         print(rollout_line)
+    # Federated rollouts: when a parent record exists, show the global
+    # view (per-region status, global budget spend) above the node
+    # table — the first thing to read when one region looks stuck.
+    try:
+        from tpu_cc_manager.ccmanager import federation as federation_mod
+
+        parent = federation_mod.ParentStore(
+            api, namespace=getattr(args, "lease_namespace", None)
+        ).load()
+        if parent is not None:
+            print(federation_mod.describe_parent(parent))
+    except Exception as e:  # noqa: BLE001 - status stays best-effort
+        log.debug("federated parent record unreadable: %s", e)
     rows = [
         f"{'NODE':<24} {'SLICE':<20} {'DESIRED':<10} {'STATE':<10} "
         f"{'READY':<6} {'TRACE':<17} NOTE"

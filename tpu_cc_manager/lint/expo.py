@@ -252,6 +252,11 @@ def _seeded_registry_text() -> str:
     registry.record_lease_transition()
     registry.record_lease_transition()
     registry.record_fenced_write()
+    # Federated rollout families (ccmanager/federation.py).
+    registry.record_federation_sync("ok")
+    registry.record_federation_sync('odd"outcome\nhere')
+    registry.record_federation_fence("parent-generation")
+    registry.set_federation_budget_spent(7)
     # Apiserver-outage autonomy families (ccmanager/intent_journal.py).
     registry.set_apiserver_connected(False)
     registry.set_offline_seconds(93.5)

@@ -77,6 +77,12 @@ EVENT_SLO_HALT = "slo-halt"
 #: opened — the timeline's explanation of a surge window that converged
 #: in ~drain+readmit time.
 EVENT_SPARE_PRESTAGED = "spare-prestaged"
+#: Federated rollouts (ccmanager/federation.py): one event per
+#: wave-boundary exchange with the parent record — region, the global
+#: spend size folded back, and the parent status at that instant. The
+#: stitched cross-region timeline uses these to show WHEN each region
+#: learned of a sibling's budget charges or a global halt.
+EVENT_FEDERATION_SYNC = "federation-sync"
 
 #: Node-terminal events: the exactly-once reconstruction keys on these
 #: (a node converges/fails/retires once per rollout, crash+resume

@@ -206,6 +206,13 @@ class MetricsRegistry:
         self._rollout_resumes_total = 0  # cclint: guarded-by(_lock)
         self._rollout_lease_transitions_total = 0  # cclint: guarded-by(_lock)
         self._rollout_fenced_writes_total = 0  # cclint: guarded-by(_lock)
+        # Federated rollouts (ccmanager/federation.py): parent-record
+        # syncs by outcome (ok / fenced), hierarchical fences by reason
+        # (parent-generation / parent-aborted), and the global budget
+        # spend size this shard last observed on the parent.
+        self._federation_sync_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._federation_fence_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        self._federation_budget_spent: int | None = None  # cclint: guarded-by(_lock)
         # Apiserver-outage autonomy (ccmanager/intent_journal.py): live
         # connectivity, how long the current outage has lasted, intent-
         # journal replays by outcome, and deferred label patches.
@@ -365,6 +372,30 @@ class MetricsRegistry:
         (a stale orchestrator's patch stopped by the fence)."""
         with self._lock:
             self._rollout_fenced_writes_total += 1
+
+    def record_federation_sync(self, outcome: str) -> None:
+        """Count one regional shard's wave-boundary exchange with the
+        federated parent record by outcome (``ok`` / ``fenced``)."""
+        with self._lock:
+            self._federation_sync_totals[outcome] = (
+                self._federation_sync_totals.get(outcome, 0) + 1
+            )
+
+    def record_federation_fence(self, reason: str) -> None:
+        """Count one hierarchical fence refusal by reason
+        (``parent-generation`` after a force-abort bumped the parent,
+        ``parent-aborted`` when the whole federation was discarded)."""
+        with self._lock:
+            self._federation_fence_totals[reason] = (
+                self._federation_fence_totals.get(reason, 0) + 1
+            )
+
+    def set_federation_budget_spent(self, count: int) -> None:
+        """Record the GLOBAL failure-budget spend size (distinct node
+        names charged across every region) this shard last read off the
+        parent record."""
+        with self._lock:
+            self._federation_budget_spent = max(0, int(count))
 
     def set_apiserver_connected(self, connected: bool) -> None:
         """Record whether the last apiserver interaction succeeded (the
@@ -675,6 +706,9 @@ class MetricsRegistry:
             rollout_resumes = self._rollout_resumes_total
             rollout_transitions = self._rollout_lease_transitions_total
             rollout_fenced = self._rollout_fenced_writes_total
+            federation_syncs = dict(self._federation_sync_totals)
+            federation_fences = dict(self._federation_fence_totals)
+            federation_budget_spent = self._federation_budget_spent
             apiserver_connected = self._apiserver_connected
             offline_seconds = self._offline_seconds
             journal_replays = dict(self._journal_replay_totals)
@@ -817,6 +851,40 @@ class MetricsRegistry:
             lines.append("# TYPE tpu_cc_rollout_fenced_writes_total counter")
             lines.append(
                 "tpu_cc_rollout_fenced_writes_total %d" % rollout_fenced
+            )
+        if federation_syncs:
+            lines.append(
+                "# HELP tpu_cc_federation_syncs_total Regional shard "
+                "exchanges with the federated parent record by outcome "
+                "(ok / fenced; ccmanager/federation.py)."
+            )
+            lines.append("# TYPE tpu_cc_federation_syncs_total counter")
+            for outcome in sorted(federation_syncs):
+                lines.append(
+                    "tpu_cc_federation_syncs_total%s %d"
+                    % (_labels(outcome=outcome), federation_syncs[outcome])
+                )
+        if federation_fences:
+            lines.append(
+                "# HELP tpu_cc_federation_fences_total Hierarchical fence "
+                "refusals by reason (parent-generation after a force-"
+                "abort, parent-aborted when the federation was discarded)."
+            )
+            lines.append("# TYPE tpu_cc_federation_fences_total counter")
+            for reason in sorted(federation_fences):
+                lines.append(
+                    "tpu_cc_federation_fences_total%s %d"
+                    % (_labels(reason=reason), federation_fences[reason])
+                )
+        if federation_budget_spent is not None:
+            lines.append(
+                "# HELP tpu_cc_federation_budget_spent Global failure-"
+                "budget spend (distinct node names charged across every "
+                "region) this shard last read off the parent record."
+            )
+            lines.append("# TYPE tpu_cc_federation_budget_spent gauge")
+            lines.append(
+                "tpu_cc_federation_budget_spent %d" % federation_budget_spent
             )
         if apiserver_connected is not None:
             lines.append(
