@@ -44,6 +44,7 @@ ARTIFACTS=(
   artifacts/chaos_soak.json
   SCALE_r01.json
   SCALE_r03.json
+  SCALE_r04.json
   FLEET_r01.json
   SERVE_r01.json
   SERVE_r02.json
@@ -219,6 +220,27 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s SCALE_r03.json ] && mv SCALE_r03.json artifacts/SCALE_r03.failed.json
     echo ">>> federation scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r03.failed.json; partial rows kept for resume)"
+    finish
+  }
+fi
+
+# Parent-plane partition evidence (SCALE_r04): the SCALE_r03 federation
+# driven through a TOTAL parent-apiserver blackout mid-rollout — healthy
+# regions keep flipping against escrowed budget slices, one region is
+# SIGKILLed mid-blackout and a successor resumes DARK from the
+# checkpointed escrow ledger under a ±135 s clock skew, another spends
+# its escrow dry and halts (then resumes after reconnect), and the
+# stitched cross-region timeline stays exactly-once with zero torn
+# writes. Same skip/park/resume discipline as SCALE_r03.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("SCALE_r04.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> SCALE_r04.json already captured (ok:true); skipping"
+else
+  echo "=== stage: scale-bench --federation-blackout (parent partition, no tunnel) ==="
+  python3 hack/scale_bench.py --federation-blackout --out SCALE_r04.json \
+      --partial artifacts/scale_blackout_partial.jsonl \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s SCALE_r04.json ] && mv SCALE_r04.json artifacts/SCALE_r04.failed.json
+    echo ">>> parent-blackout scale bench FAILED; stopping ladder (summary in artifacts/SCALE_r04.failed.json; partial rows kept for resume)"
     finish
   }
 fi

@@ -26,6 +26,8 @@ STATE_LABEL="cloud.google.com/tpu-cc.mode.state"
 cleanup() {
   [ -n "${AGENT_PID:-}" ] && kill "$AGENT_PID" 2>/dev/null || true
   [ -n "${PROXY_PID:-}" ] && kill "$PROXY_PID" 2>/dev/null || true
+  [ -n "${FAKE_AGENTS_PID:-}" ] && kill "$FAKE_AGENTS_PID" 2>/dev/null || true
+  [ -n "${FED_PID:-}" ] && kill "$FED_PID" 2>/dev/null || true
   kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true
 }
 trap cleanup EXIT
@@ -338,4 +340,107 @@ echo "$JOURNALZ" | grep -q "deferred label patches: 0" || {
   echo "FAIL: deferred label patches were not flushed after reconnect"
   exit 1; }
 
-echo ">>> kind integration OK (RBAC incl. taints + leases + real watch + merge-patch + rollout + SIGKILL/resume + quarantine + apiserver-outage + mid-rollout /rolloutz+/metrics drill verified)"
+echo ">>> federated parent-plane partition drill: escrowed budget + degraded mode"
+# The federated rollout keeps the PARENT record on the kubeconfig's
+# current context while each --regions shard drives its own named
+# context. Pointing the current context at the TCP proxy and the region
+# context straight at the real apiserver makes killing the proxy a
+# PARENT-ONLY blackout: the shard keeps flipping nodes against a live
+# regional apiserver while the coordination plane is unreachable — the
+# SCALE_r04 scenario on a real apiserver with real Lease/CAS RBAC.
+FED_KUBECONFIG=$(mktemp)
+cat > "$FED_KUBECONFIG" <<EOF
+apiVersion: v1
+kind: Config
+clusters:
+- name: parent-proxied
+  cluster: {server: "https://127.0.0.1:$PROXY_PORT", certificate-authority: "$CA_FILE"}
+- name: kind-direct
+  cluster: {server: "$SERVER", certificate-authority: "$CA_FILE"}
+users:
+- name: sa
+  user: {token: "$TOKEN"}
+contexts:
+- name: parent
+  context: {cluster: parent-proxied, user: sa}
+- name: direct
+  context: {cluster: kind-direct, user: sa}
+current-context: parent
+EOF
+
+# The real agent would race the drill's stand-in agents on $NODE (and it
+# dials the proxy, which is about to die again); stop it for the drill.
+kill "$AGENT_PID" 2>/dev/null || true
+wait "$AGENT_PID" 2>/dev/null || true
+AGENT_PID=
+
+# Phantom pool members stretch the rollout across enough windows that
+# several federation boundaries land inside the blackout (grace = 2 s).
+FED_PHANTOMS="fed-ph-1 fed-ph-2 fed-ph-3 fed-ph-4 fed-ph-5"
+for ph in $FED_PHANTOMS; do
+  kubectl apply -f - <<EOF
+apiVersion: v1
+kind: Node
+metadata:
+  name: $ph
+  labels: {pool: tpu-it}
+EOF
+done
+
+# Stand-in region agents: converge each node's state label ~3 s after
+# the orchestrator stamps its desired label — kubectl uses the admin
+# kubeconfig, so the "agents" stay up through the parent blackout just
+# like real per-region agents would.
+fake_region_agents() {
+  while true; do
+    for n in $NODE $FED_PHANTOMS; do
+      want=$(kubectl get node "$n" -o jsonpath="{.metadata.labels.cloud\.google\.com/tpu-cc\.mode}" 2>/dev/null || true)
+      got=$(kubectl get node "$n" -o jsonpath="{.metadata.labels.cloud\.google\.com/tpu-cc\.mode\.state}" 2>/dev/null || true)
+      if [ -n "$want" ] && [ "$want" != "$got" ]; then
+        sleep 3
+        kubectl label node "$n" "$STATE_LABEL=$want" --overwrite >/dev/null
+      fi
+    done
+    sleep 1
+  done
+}
+fake_region_agents &
+FAKE_AGENTS_PID=$!
+
+FED_LOG=$(mktemp)
+CC_FEDERATION_OFFLINE_GRACE_S=2 PYTHONPATH="$REPO" KUBECONFIG="$FED_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl rollout \
+    --selector pool=tpu-it --mode on --regions ka=direct \
+    --failure-budget 1 --max-unavailable 1 --node-timeout 120 \
+    > "$FED_LOG" 2>&1 &
+FED_PID=$!
+
+sleep 6   # attach + escrow CAS + first window boundary land on a live parent
+echo ">>> parent blackout: killing the proxy mid-rollout (region traffic unaffected)"
+kill "$PROXY_PID" 2>/dev/null || true
+wait "$PROXY_PID" 2>/dev/null || true
+sleep 12  # several window boundaries sync dark, past the 2 s offline grace
+echo ">>> restoring the parent plane"
+start_proxy
+
+wait "$FED_PID" || {
+  echo "FAIL: federated rollout did not survive the parent-plane blackout"
+  tail -60 "$FED_LOG"; kill "$FAKE_AGENTS_PID" 2>/dev/null || true; exit 1; }
+kill "$FAKE_AGENTS_PID" 2>/dev/null || true
+grep -q "parent plane offline past grace" "$FED_LOG" || {
+  echo "FAIL: shard never declared degraded mode during the blackout"
+  tail -60 "$FED_LOG"; exit 1; }
+grep -q "parent plane reconnected" "$FED_LOG" || {
+  echo "FAIL: shard never reconciled its dark spend after the blackout"
+  tail -60 "$FED_LOG"; exit 1; }
+FED_STATUS=$(PYTHONPATH="$REPO" KUBECONFIG="$SA_KUBECONFIG" \
+  python3 -m tpu_cc_manager.ctl status --selector pool=tpu-it)
+echo "$FED_STATUS" | grep -q "federation: mode=on status=complete" || {
+  echo "FAIL: parent record not complete after reconnect reconciliation"
+  echo "$FED_STATUS"; exit 1; }
+
+for ph in $FED_PHANTOMS; do
+  kubectl delete node "$ph" --ignore-not-found >/dev/null
+done
+
+echo ">>> kind integration OK (RBAC incl. taints + leases + real watch + merge-patch + rollout + SIGKILL/resume + quarantine + apiserver-outage + mid-rollout /rolloutz+/metrics + federated parent-blackout drill verified)"

@@ -316,12 +316,17 @@ class ServerAgentSim:
         min_delay_s: float = 0.02,
         max_delay_s: float = 0.08,
         scan_interval_s: float = 0.01,
+        dead_nodes: set[str] | None = None,
     ) -> None:
         self.mock = mock
         self.seed = seed
         self.min_delay_s = min_delay_s
         self.max_delay_s = max_delay_s
         self.scan_interval_s = scan_interval_s
+        #: Names whose agents never flip (simulated hardware failure).
+        #: The set is live: clearing it mid-run models the hardware
+        #: recovering — the next scan schedules the flip normally.
+        self.dead_nodes = dead_nodes if dead_nodes is not None else set()
         self.transitions = 0
         self._due: list[tuple[float, str, str]] = []
         self._scheduled: set[str] = set()
@@ -346,6 +351,7 @@ class ServerAgentSim:
                         desired
                         and desired != state
                         and name not in self._scheduled
+                        and name not in self.dead_nodes
                     ):
                         self._scheduled.add(name)
                         heapq.heappush(
@@ -818,6 +824,510 @@ def run_federation(
     }
 
 
+# ---------------------------------------------------------------------------
+# --federation-blackout mode: the ISSUE 18 acceptance bench (SCALE_r04).
+# Same federated topology as --federation, but the PARENT PLANE goes
+# dark mid-rollout — per-region FaultyKubeClient wrappers around the
+# control-plane client refuse every parent CAS while a blackout window
+# is open. What the bench must prove:
+#  - every region either completes or escrow-halts WITHOUT the parent:
+#    healthy regions ride seeded blackout windows, charge nothing, and
+#    reconcile on reconnect; the escrow region times out a dead slice
+#    while dark, charges its escrowed budget slice, and halts
+#    `escrow-exhausted` the moment dark spend would exceed it;
+#  - a SIGKILL at the `parent-offline` crash point (mid-blackout) is
+#    survivable: the successor takes the regional lease over through the
+#    skew-proof observation window (its wall clock disagrees with the
+#    dead holder's by ~135 s) and dark-resumes from the checkpointed
+#    escrow ledger;
+#  - reconciliation is exactly-once: after every region reconnects, the
+#    parent's budget_spend is EXACTLY the dead slice — no dark charge
+#    lost, none double-counted, every unused escrow slice returned;
+#  - the stitched cross-region timeline has zero torn lines and every
+#    node's final outcome is converged exactly once.
+# ---------------------------------------------------------------------------
+
+
+def run_federation_blackout(
+    total_nodes: int = 100_000,
+    regions_count: int = 10,
+    seed: int = DEFAULT_SEED,
+    shards: int = 8,
+    per_shard_unavailable: int = 25,
+    poll_interval_s: float = 0.05,
+    # Healthy nodes converge in up to ~60 s under full 100k-node thread
+    # contention; only the escrow region's dead slice may time out, so
+    # the bar sits at 2x the observed worst case.
+    node_timeout_s: float = 120.0,
+    kill_region_index: int = 3,
+    escrow_region_index: int = 5,
+    hetero_region_index: int = 2,
+    max_clock_skew_s: float = 150.0,
+) -> dict:
+    """One federated rollout through a parent-plane blackout; returns
+    the SCALE_r04 row."""
+    from http.server import ThreadingHTTPServer
+
+    from tpu_cc_manager.ccmanager import federation as federation_mod
+    from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+    if regions_count < 4:
+        raise ValueError("--federation-blackout needs >= 4 regions")
+    mock = _load_mock()
+    ns = "tpu-operator"
+    nodes_per_region = total_nodes // regions_count
+    windows = -(-nodes_per_region // max(1, shards * per_shard_unavailable))
+    regions = [f"r{i:02d}" for i in range(regions_count)]
+    kill_region = regions[kill_region_index % regions_count]
+    escrow_region = regions[escrow_region_index % regions_count]
+    hetero_region = regions[hetero_region_index % regions_count]
+    if len({kill_region, escrow_region, hetero_region}) != 3:
+        raise ValueError(
+            "kill/escrow/hetero region indices must map to distinct regions"
+        )
+    # The dead slice: ALL hosts of ONE slice (hosts are striped across
+    # the region: slice s = {s + j*slice_count}). A fully-dead slice
+    # keeps the stitched timeline exactly-once — its resume re-drives
+    # only FAILED nodes (the designed re-drive path), never re-bouncing
+    # a converged one — and its whole charge lands at one boundary, so
+    # the escrow halt is deterministic.
+    hosts_per_slice = 4
+    slice_count = max(1, nodes_per_region // hosts_per_slice)
+    dead_slice = int(slice_count * 0.3)
+    dead_live = {
+        f"{escrow_region}-n{dead_slice + j * slice_count:05d}"
+        for j in range(hosts_per_slice)
+    }
+    dead_nodes = set(dead_live)
+    offline_grace_s = 0.05
+    skew_rng = random.Random(seed ^ 0x51E11)
+    region_skews = {r: skew_rng.uniform(-120.0, 120.0) for r in regions}
+    flight_dir = tempfile.mkdtemp(prefix="scale-blackout-")
+
+    servers: list = []
+    region_urls: dict[str, str] = {}
+    region_states: dict[str, object] = {}
+    sims: dict[str, ServerAgentSim] = {}
+
+    def start_server(state) -> str:
+        state.start_threads()
+        srv = ThreadingHTTPServer(
+            ("127.0.0.1", 0), mock.make_handler(state)
+        )
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return f"http://127.0.0.1:{srv.server_address[1]}"
+
+    control_state = mock.MockState()
+    control_url = start_server(control_state)
+    for region in regions:
+        state = mock.MockState()
+        _federation_region_fleet(state, region, nodes_per_region)
+        region_urls[region] = start_server(state)
+        region_states[region] = state
+        sims[region] = ServerAgentSim(
+            state, seed=seed, min_delay_s=0.01, max_delay_s=0.04,
+            scan_interval_s=0.1,
+            dead_nodes=dead_live if region == escrow_region else None,
+        )
+
+    def control_client():
+        return RestKube(ClusterConfig(server=control_url, token="scale-bench"))
+
+    # Per-region chaos plans over the PARENT client only: the regional
+    # apiservers stay healthy — this is a parent-plane partition, not a
+    # regional outage. Spans are sized in parent CALLS (one per dark
+    # boundary sync) to end well before the terminal status push.
+    parent_plans = {
+        region: FaultPlan(
+            seed=seed * 1009 + idx, rate=0.0, watch_rate=0.0,
+            blackout_min_calls=max(2, windows // 3),
+            blackout_max_calls=max(max(2, windows // 3) + 1, windows // 2),
+        )
+        for idx, region in enumerate(regions)
+    }
+    faulty_controls = {
+        region: FaultyKubeClient(
+            control_client(), parent_plans[region], sleep=lambda s: None
+        )
+        for region in regions
+    }
+
+    parent = federation_mod.ParentStore(
+        control_client(), namespace=ns
+    ).initialize(
+        federation_mod.ParentRecord.fresh(
+            "on", SELECTOR, regions,
+            max_unavailable=shards * per_shard_unavailable,
+            # Global budget == region count: fair-share escrow resolves
+            # to exactly 1 per region, so the escrow region (2 dead
+            # hosts) MUST halt while dark, and the total spend stays
+            # within budget. One region carries an explicit per-region
+            # cap so the heterogeneous-budget parent format (v2) is what
+            # this artifact actually serializes.
+            failure_budget=regions_count,
+            region_budgets={hetero_region: 2},
+        ),
+        resume=False,
+    )
+
+    results: dict[str, dict] = {}
+    errors: dict[str, BaseException] = {}
+    flight_files: dict[str, list[str]] = {region: [] for region in regions}
+    results_lock = threading.Lock()
+
+    def run_leg(region, client, lease, resume_record, gate, flight_path,
+                crash_hook):
+        informer = NodeInformer(
+            client, federation_mod.regional_selector(SELECTOR, region),
+            page_limit=500,
+        ).start(sync_timeout_s=120.0)
+        try:
+            roller = RollingReconfigurator(
+                client,
+                federation_mod.regional_selector(SELECTOR, region),
+                max_unavailable=per_shard_unavailable,
+                poll_interval_s=poll_interval_s,
+                node_timeout_s=node_timeout_s,
+                informer=informer,
+                wave_shards=shards,
+                lease=lease,
+                resume_record=resume_record,
+                crash_hook=crash_hook,
+                # A timed-out slice must CHARGE the budget and press on
+                # (degraded-mode semantics), not halt the whole region.
+                continue_on_failure=True,
+                flight=flight_mod.FlightRecorder(
+                    flight_path, generation=lease.generation
+                ),
+                federation=gate,
+            )
+            mode = resume_record.mode if resume_record is not None else "on"
+            return roller.rollout(mode)
+        finally:
+            informer.stop()
+
+    def regional_lease(client, region, holder, clk, skew):
+        return rollout_state.RolloutLease(
+            client, holder=holder, namespace=ns,
+            name=federation_mod.regional_lease_name(region),
+            duration_s=30.0, wall=lambda: clk() + skew, clock=clk,
+            max_clock_skew_s=max_clock_skew_s,
+        )
+
+    def run_region(region: str) -> None:
+        client = CountingKube(
+            RestKube(
+                ClusterConfig(server=region_urls[region], token="scale-bench")
+            )
+        )
+        plan = parent_plans[region]
+        parent_api = faulty_controls[region]
+        store = federation_mod.ParentStore(parent_api, namespace=ns)
+        clk = _BenchClock()
+        skew_a = region_skews[region]
+        killed = resumed = resumed_dark = False
+        escrow_halted_dark = escrow_resumed = False
+        t0 = time.monotonic()
+        result = None
+        try:
+            lease = regional_lease(
+                client, region, f"bench-{region}-a", clk, skew_a
+            )
+            lease.acquire()
+            gate = federation_mod.FederationGate(
+                store, region, offline_grace_s=offline_grace_s
+            )
+            gate.attach(parent)  # attach is LIGHT: escrow reserved via CAS
+
+            boundaries = {"n": 0}
+
+            def hook(point):
+                if point == "federation-boundary":
+                    boundaries["n"] += 1
+                    if region in (kill_region, escrow_region):
+                        # Forced open-ended blackout from the FIRST
+                        # boundary: every charge these regions make is
+                        # guaranteed dark; the bench closes the window.
+                        if boundaries["n"] == 1:
+                            plan.begin_blackout()
+                    elif boundaries["n"] == 2:
+                        # Healthy regions ride a finite SEEDED window —
+                        # the production chaos path — and reconnect
+                        # before their terminal push.
+                        plan.seed_blackout_window()
+                if region == kill_region and point == "parent-offline":
+                    raise OrchestratorKilled(point, boundaries["n"])
+
+            path_a = os.path.join(flight_dir, f"orch-{region}-a.jsonl")
+            flight_files[region].append(path_a)
+            try:
+                result = run_leg(region, client, lease, None, gate, path_a,
+                                 hook)
+            except OrchestratorKilled:
+                killed = True
+                clk.advance(31.0)  # dead holder's lease TTL lapses
+                # The successor's wall clock disagrees with the dead
+                # holder's by ~135 s, forcing acquire() through the
+                # skew-proof observation window (expired OR
+                # future-stamped, depending on sign). The observation
+                # deadline runs on LOCAL monotonic time — a ticker
+                # drives the injected bench clock through it.
+                skew_b = skew_a + (135.0 if skew_a < 0 else -135.0)
+                lease_b = regional_lease(
+                    client, region, f"bench-{region}-b", clk, skew_b
+                )
+                stop_tick = threading.Event()
+
+                def _tick():
+                    while not stop_tick.wait(0.1):
+                        clk.advance(4.0)
+
+                ticker = threading.Thread(target=_tick, daemon=True)
+                ticker.start()
+                try:
+                    record = lease_b.acquire()
+                finally:
+                    stop_tick.set()
+                    ticker.join(timeout=2.0)
+                if record is None or not record.federation:
+                    raise RuntimeError(
+                        f"{region}: resumed record lost its federation "
+                        "attachment"
+                    )
+                # The successor comes up with the parent STILL dark (a
+                # bounded re-armed window): the dark-resume path must
+                # adopt the checkpointed escrow ledger, then reconcile
+                # when the window expires. Two calls — the dark attach
+                # plus one boundary — so even a successor with almost
+                # nothing left to do still pushes its terminal status
+                # through a LIVE parent.
+                plan.end_blackout()
+                plan.begin_blackout(calls=2)
+                refusals_before = plan.blackout_refusals
+                gate_b = federation_mod.FederationGate.from_record_dict(
+                    parent_api, record.federation,
+                    offline_grace_s=offline_grace_s,
+                )
+                resumed = True
+                resumed_dark = plan.blackout_refusals > refusals_before
+                path_b = os.path.join(flight_dir, f"orch-{region}-b.jsonl")
+                flight_files[region].append(path_b)
+                lease = lease_b
+                result = run_leg(
+                    region, client, lease_b, record, gate_b, path_b, None
+                )
+            if (
+                region == escrow_region
+                and result is not None
+                and not result.ok
+                and result.halted_reason
+                == federation_mod.ESCROW_EXHAUSTED_REASON
+            ):
+                # The region halted autonomously, in the dark, with its
+                # escrow slice spent on the dead hosts. Hardware
+                # recovers, the parent plane comes back, and an operator
+                # re-drives: the resume must reconcile the dark charges
+                # exactly once and finish the remaining windows.
+                escrow_halted_dark = plan.in_blackout
+                plan.end_blackout()
+                lease.release(clear_record=False)
+                lease_c = regional_lease(
+                    client, region, f"bench-{region}-c", clk, skew_a
+                )
+                record = lease_c.acquire()
+                if record is None or not record.federation:
+                    raise RuntimeError(
+                        f"{region}: halted record lost its federation "
+                        "attachment"
+                    )
+                gate_c = federation_mod.FederationGate.from_record_dict(
+                    parent_api, record.federation,
+                    offline_grace_s=offline_grace_s,
+                )
+                escrow_resumed = True
+                path_c = os.path.join(flight_dir, f"orch-{region}-c.jsonl")
+                flight_files[region].append(path_c)
+                lease = lease_c
+
+                # The dead hardware recovers only once the successor has
+                # taken its pre-recovery listing and committed to
+                # RE-DRIVING the failed group — window-start fires
+                # strictly after the resume plan, so the timeline always
+                # shows the designed `redriven` supersede instead of a
+                # timing-dependent already-at-target re-observation (the
+                # agent sim's scan loop would otherwise race the resume
+                # listing and self-heal the slice, leaving node-failed
+                # as the reconstructed outcome).
+                def recovery_hook(point):
+                    if point == "window-start" and dead_live:
+                        dead_live.clear()
+
+                result = run_leg(
+                    region, client, lease_c, record, gate_c, path_c,
+                    recovery_hook,
+                )
+            lease.release(clear_record=bool(result.ok))
+        except BaseException as e:  # noqa: BLE001 - surfaced to the caller
+            with results_lock:
+                errors[region] = e
+            return
+        with results_lock:
+            results[region] = {
+                "ok": bool(result.ok),
+                "groups": len(result.groups),
+                "seconds": round(time.monotonic() - t0, 2),
+                "killed": killed,
+                "resumed": resumed,
+                "resumed_dark": resumed_dark,
+                "escrow_halted_dark": escrow_halted_dark,
+                "escrow_resumed": escrow_resumed,
+                "parent_blackout_windows": plan.blackout_windows,
+                "parent_refusals": plan.blackout_refusals,
+            }
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=run_region, args=(region,), daemon=True)
+        for region in regions
+    ]
+    final = None
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        seconds = time.monotonic() - t0
+        if not errors:
+            final = federation_mod.ParentStore(
+                control_client(), namespace=ns
+            ).load()
+    finally:
+        for sim in sims.values():
+            sim.stop()
+        for srv in servers:
+            srv.shutdown()
+    if errors:
+        region, err = sorted(errors.items())[0]
+        raise RuntimeError(f"region {region} failed: {err!r}") from err
+
+    baseline = _r02_baseline_per_node()
+    per_node_budget = round(baseline + FEDERATION_PER_NODE_ALLOWANCE, 3)
+    per_apiserver: dict[str, dict] = {}
+    load_ok = True
+    for region in regions:
+        state = region_states[region]
+        with state.lock:
+            counts = dict(sorted(state.request_counts.items()))
+            converged = all(
+                node["metadata"]["labels"].get(CC_MODE_STATE_LABEL) == "on"
+                for node in state.nodes.values()
+            )
+        total = sum(counts.values())
+        per_node = round(total / max(1, nodes_per_region), 3)
+        load_ok = load_ok and per_node <= per_node_budget and converged
+        per_apiserver[region] = {
+            "requests": counts,
+            "total": total,
+            "per_node": per_node,
+            "converged": converged,
+        }
+    with control_state.lock:
+        control_requests = dict(sorted(control_state.request_counts.items()))
+
+    all_paths = [p for region in regions for p in flight_files[region]]
+    stitched, torn = flight_mod.stitch_files(all_paths)
+    rec = flight_mod.reconstruct(stitched)
+    all_nodes = {
+        f"{region}-n{i:05d}"
+        for region in regions
+        for i in range(nodes_per_region)
+    }
+    exactly_once = (
+        set(rec["nodes"]) == all_nodes
+        and not rec["duplicate_node_events"]
+        and all(
+            e["outcome"] == "node-converged" for e in rec["nodes"].values()
+        )
+    )
+    offline_events = sum(
+        1 for e in stitched
+        if e.get("event") == flight_mod.EVENT_PARENT_OFFLINE
+    )
+    reconnect_events = sum(
+        1 for e in stitched
+        if e.get("event") == flight_mod.EVENT_PARENT_RECONNECT
+    )
+    spend = sorted(final.budget_spend) if final is not None else []
+    # Exactly-once reconciliation, ledger-level: the parent's spend is
+    # PRECISELY the dead slice (no dark charge lost or double-counted)
+    # and every escrow slice went back to zero on terminal sync.
+    spend_exact = spend == sorted(dead_nodes)
+    escrow_zeroed = final is not None and all(
+        v == 0 for v in final.escrow.values()
+    )
+    killed_row = results.get(kill_region, {})
+    escrow_row = results.get(escrow_region, {})
+    ok = bool(
+        results
+        and all(r["ok"] for r in results.values())
+        and final is not None
+        and final.status == federation_mod.PARENT_COMPLETE
+        and final.region_budgets.get(hetero_region) == 2
+        and killed_row.get("killed")
+        and killed_row.get("resumed")
+        and killed_row.get("resumed_dark")
+        and escrow_row.get("escrow_halted_dark")
+        and escrow_row.get("escrow_resumed")
+        and spend_exact
+        and escrow_zeroed
+        and offline_events >= regions_count
+        and reconnect_events >= regions_count - 2
+        and torn == 0
+        and exactly_once
+    )
+    return {
+        "mode": "federation-blackout",
+        "nodes": total_nodes,
+        "transport": "http",
+        "ok": ok,
+        "seconds": round(seconds, 2),
+        "regions": regions_count,
+        "nodes_per_region": nodes_per_region,
+        "wave_shards": shards,
+        "max_unavailable_per_region": per_shard_unavailable * shards,
+        "failure_budget": regions_count,
+        "region_budgets": {hetero_region: 2},
+        "killed_region": kill_region,
+        "escrow_region": escrow_region,
+        "dead_nodes": sorted(dead_nodes),
+        "max_clock_skew_s": max_clock_skew_s,
+        "parent_status": final.status if final is not None else "missing",
+        "budget_spend": spend,
+        "budget_spend_exactly_dead_slice": spend_exact,
+        "escrow_zeroed": escrow_zeroed,
+        "parent_offline_events": offline_events,
+        "parent_reconnect_events": reconnect_events,
+        "region_results": {r: results[r] for r in sorted(results)},
+        "per_apiserver": per_apiserver,
+        "baseline_per_node_r02": round(baseline, 3),
+        "per_node_budget": per_node_budget,
+        # Informational here (the load acceptance gate is SCALE_r03):
+        # this bench gates partition-tolerance invariants, but a load
+        # regression would still show up in these rows.
+        "apiserver_load_ok": load_ok,
+        "control_plane_requests": control_requests,
+        "stitch": {
+            "files": len(all_paths),
+            "events": len(stitched),
+            "torn_lines": torn,
+            "resumes": rec["resumes"],
+            "generations": sorted(rec["generations"]),
+            "exactly_once": exactly_once,
+        },
+    }
+
+
 def run_pool(
     n: int,
     mode: str,
@@ -1176,8 +1686,20 @@ def main(argv: list[str] | None = None) -> int:
         "nodes, 10 regions, SCALE_r03.json",
     )
     parser.add_argument(
+        "--federation-blackout", action="store_true",
+        help="run the parent-plane partition bench instead: the "
+        "--federation topology with every region's parent client riding "
+        "a chaos blackout mid-rollout — healthy regions reconnect and "
+        "reconcile, one region SIGKILLed at the parent-offline crash "
+        "point dark-resumes through the skew-proof lease observation "
+        "window, and one region escrow-halts on a dead slice while dark "
+        "then resumes to completion; defaults to 100000 nodes, 10 "
+        "regions, SCALE_r04.json",
+    )
+    parser.add_argument(
         "--regions", type=int, default=10,
-        help="region (= per-region apiserver) count for --federation",
+        help="region (= per-region apiserver) count for --federation "
+        "and --federation-blackout",
     )
     parser.add_argument(
         "--partial", default=None,
@@ -1202,8 +1724,10 @@ def main(argv: list[str] | None = None) -> int:
             f.write("\n")
         print(json.dumps(summary))
         return 0 if summary["ok"] else 1
-    if args.federation:
-        out = args.out or "SCALE_r03.json"
+    if args.federation or args.federation_blackout:
+        blackout = args.federation_blackout
+        bench_mode = "federation-blackout" if blackout else "federation"
+        out = args.out or ("SCALE_r04.json" if blackout else "SCALE_r03.json")
         total = int((args.sizes or "100000").split(",")[0])
         summary = None
         if args.partial and os.path.exists(args.partial):
@@ -1213,22 +1737,24 @@ def main(argv: list[str] | None = None) -> int:
                         continue
                     row = json.loads(line)
                     if (
-                        row.get("mode") == "federation"
+                        row.get("mode") == bench_mode
                         and row.get("nodes") == total
                         and row.get("ok")
                     ):
                         summary = row
             if summary is not None:
                 print(
-                    f">>> resuming: federation@{total} already completed "
+                    f">>> resuming: {bench_mode}@{total} already completed "
                     f"in {args.partial}", file=sys.stderr,
                 )
         if summary is None:
             print(
-                f">>> federated rollout: {total} node(s) across "
+                f">>> federated rollout{' (parent blackout)' if blackout else ''}: "
+                f"{total} node(s) across "
                 f"{args.regions} regional apiserver(s)", file=sys.stderr,
             )
-            summary = run_federation(
+            runner = run_federation_blackout if blackout else run_federation
+            summary = runner(
                 total_nodes=total, regions_count=args.regions,
                 seed=args.seed, shards=args.shards,
             )
@@ -1238,8 +1764,15 @@ def main(argv: list[str] | None = None) -> int:
                 )
                 with open(args.partial, "a", encoding="utf-8") as f:
                     f.write(json.dumps(summary) + "\n")
-        summary["bench"] = "federated_scale_rollout"
-        summary["unit"] = "per-apiserver requests / federated rollout"
+        summary["bench"] = (
+            "federated_blackout_rollout" if blackout
+            else "federated_scale_rollout"
+        )
+        summary["unit"] = (
+            "partition-tolerance invariants / federated rollout"
+            if blackout
+            else "per-apiserver requests / federated rollout"
+        )
         summary["seed"] = args.seed
         with open(out, "w", encoding="utf-8") as f:
             json.dump(summary, f, indent=1, sort_keys=True)

@@ -832,3 +832,37 @@ def test_blackout_soak_serves_last_known_mode_and_flushes(
         f"replays={registry.journal_replay_totals()} "
         f"pending_left={len(journal.pending_patches())}"
     )
+
+
+def test_seed_blackout_window_arms_one_seeded_span():
+    """seed_blackout_window opens exactly one outage window whose length
+    is a pure function of the seed (the SCALE_r04 parent-blackout drill
+    needs the scenario, not the odds) — same seed, same span, and the
+    wrapped client refuses exactly that many calls before recovering."""
+    def run(seed):
+        kube = FakeKube()
+        kube.add_node(NODE)
+        plan = FaultPlan(
+            seed=seed, rate=0.0, watch_rate=0.0,
+            blackout_min_calls=3, blackout_max_calls=7,
+        )
+        span = plan.seed_blackout_window()
+        assert 3 <= span <= 7
+        api = FaultyKubeClient(kube, plan, sleep=lambda s: None)
+        refused = 0
+        for _ in range(span + 5):
+            try:
+                api.get_node(NODE)
+            except KubeApiError:
+                refused += 1
+        return span, refused, plan
+
+    span1, refused1, plan1 = run(42)
+    span2, refused2, _ = run(42)
+    assert (span1, refused1) == (span2, refused2)
+    assert refused1 == span1
+    assert not plan1.in_blackout
+    assert any(
+        f.kind == "blackout" and f.op == "seeded-window"
+        for f in plan1.injected
+    )

@@ -1,0 +1,214 @@
+"""Skew-proof fencing (ccmanager/rollout_state.py, ISSUE 18).
+
+Federated regions run on different wall clocks. The regional lease's
+expiry stamp is written by the HOLDER's clock and judged by the
+CONTENDER's, so a ±N s skew can fabricate expiry on a healthy holder or
+keep a dead one "live". With ``max_clock_skew_s > 0`` the lease treats
+renewTime + leaseTransitions as an opaque change-token and confirms
+holder death by observing the token frozen for one lease duration of
+LOCAL monotonic time — no cross-clock comparison decides a takeover.
+
+The bars here:
+
+- a seeded property test: the acquire verdict (takeover vs held) is a
+  function of the holder's ACTUAL liveness only — identical under every
+  sampled skew in ±120 s;
+- the frozen-clock regression: a stale holder self-fences from its own
+  monotonic clock alone, before any apiserver round trip;
+- a future-stamped dead holder (skewed-ahead remote clock) is observed
+  and taken over instead of being trusted as live forever;
+- a third-party takeover mid-observation surfaces as LeaseHeld naming
+  the live writer.
+"""
+
+import random
+
+import pytest
+
+from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.ccmanager.rollout_state import (
+    LeaseHeld,
+    RolloutLease,
+    RolloutFenced,
+)
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.utils import retry as retry_mod
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+NS = "tpu-operator"
+LEASE = "tpu-cc-rollout"
+BASE = 1_700_000_000.0
+DURATION = 30.0
+MAX_SKEW = 150.0
+
+
+class Clock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def stamp_holder(fake, holder_wall, duration=DURATION):
+    """A previous holder's lease, stamped by THAT holder's wall clock."""
+    lease = RolloutLease(
+        fake, holder="holder-a", namespace=NS, name=LEASE,
+        duration_s=duration, metrics=MetricsRegistry(),
+        wall=holder_wall, clock=Clock(),
+    )
+    lease.acquire()
+    return lease
+
+
+def contender(fake, wall, clock, max_skew=MAX_SKEW):
+    return RolloutLease(
+        fake, holder="holder-b", namespace=NS, name=LEASE,
+        duration_s=DURATION, metrics=MetricsRegistry(),
+        wall=wall, clock=clock, max_clock_skew_s=max_skew,
+    )
+
+
+def acquire_verdict(monkeypatch, skew_holder, skew_contender, alive):
+    """Run one takeover attempt and classify its outcome. The holder's
+    stamp and the contender's wall disagree by the two skews; the
+    holder's ACTUAL liveness is simulated by (not) advancing the opaque
+    token while the contender observes."""
+    fake = FakeKube()
+    stamp_holder(fake, lambda: BASE + skew_holder)
+
+    # Enough LOCAL elapsed time that the wall verdict reads "suspect"
+    # (expired or future-stamped) under every skew in the sampled band —
+    # the regime where only the observation window decides.
+    elapsed = DURATION + 2 * 120.0 + 60.0
+    clk = Clock()
+    renew_seq = {"n": 0}
+
+    def observing_wait(delay_s, stop=None):
+        clk.advance(delay_s)
+        if alive:
+            lease = fake.get_lease(NS, LEASE)
+            renew_seq["n"] += 1
+            lease["spec"]["renewTime"] = f"1970-01-01T00:00:{renew_seq['n']:02d}Z"
+            fake.update_lease(NS, LEASE, lease)
+        return False
+
+    monkeypatch.setattr(retry_mod, "wait", observing_wait)
+    b = contender(fake, lambda: BASE + elapsed + skew_contender, clk)
+    try:
+        b.acquire()
+    except LeaseHeld:
+        return "held"
+    return "takeover"
+
+
+def test_fencing_verdict_is_skew_invariant(monkeypatch):
+    """Property: under ±120 s of injected skew on either side, the
+    verdict matches the zero-skew verdict for both a dead and a live
+    holder — fencing never depends on whose wall clock is right."""
+    for seed in range(5):
+        rng = random.Random(20260807 + seed)
+        for alive in (False, True):
+            baseline = acquire_verdict(monkeypatch, 0.0, 0.0, alive)
+            assert baseline == ("held" if alive else "takeover")
+            for _ in range(6):
+                sh = rng.uniform(-120.0, 120.0)
+                sc = rng.uniform(-120.0, 120.0)
+                verdict = acquire_verdict(monkeypatch, sh, sc, alive)
+                assert verdict == baseline, (
+                    f"seed={seed} skew_holder={sh:.1f} "
+                    f"skew_contender={sc:.1f} alive={alive}: "
+                    f"{verdict} != {baseline}"
+                )
+
+
+class CountingKube:
+    """Pass-through wrapper that counts every API round trip."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls = 0
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def counted(*a, **kw):
+            self.calls += 1
+            return attr(*a, **kw)
+
+        return counted
+
+
+def test_stale_holder_self_fences_with_zero_api_calls():
+    """The frozen-clock regression: an orchestrator that slept past its
+    own lease duration must fence itself from LOCAL monotonic time
+    alone — before any apiserver round trip could confirm a successor."""
+    counting = CountingKube(FakeKube())
+    clk = Clock()
+    lease = RolloutLease(
+        counting, holder="orch", namespace=NS, name=LEASE,
+        duration_s=DURATION, metrics=MetricsRegistry(),
+        wall=lambda: BASE, clock=clk,
+    )
+    lease.acquire()
+    assert lease.valid
+    calls_after_acquire = counting.calls
+
+    clk.advance(DURATION + 1.0)
+    with pytest.raises(RolloutFenced):
+        lease.check()
+    assert lease.lost
+    assert counting.calls == calls_after_acquire
+
+
+def test_future_stamped_dead_holder_is_observed_and_taken_over(monkeypatch):
+    """A dead holder whose last stamp came from a clock 100 s AHEAD of
+    ours looks perpetually live to wall math. The legacy (skew-unaware)
+    lease waits for our clock to catch up; the skew-aware one observes
+    the frozen token for one duration and takes over."""
+    fake = FakeKube()
+    stamp_holder(fake, lambda: BASE + 100.0)
+
+    legacy = contender(fake, lambda: BASE, Clock(), max_skew=0.0)
+    with pytest.raises(LeaseHeld):
+        legacy.acquire()
+
+    clk = Clock()
+    monkeypatch.setattr(
+        retry_mod, "wait", lambda s, stop=None: clk.advance(s)
+    )
+    aware = contender(fake, lambda: BASE, clk)
+    aware.acquire()  # frozen token for a full duration: holder dead
+    assert fake.get_lease(NS, LEASE)["spec"]["holderIdentity"] == "holder-b"
+
+
+def test_third_party_takeover_mid_observation_raises_held(monkeypatch):
+    """Any token change during the observation window proves a live
+    writer — including a THIRD contender's takeover, which must surface
+    as LeaseHeld naming the new holder, not as our own takeover."""
+    fake = FakeKube()
+    stamp_holder(fake, lambda: BASE - 500.0)  # long-expired stamp
+
+    clk = Clock()
+    fired = {"done": False}
+
+    def interloping_wait(delay_s, stop=None):
+        clk.advance(delay_s)
+        if not fired["done"]:
+            fired["done"] = True
+            lease = fake.get_lease(NS, LEASE)
+            lease["spec"]["holderIdentity"] = "holder-c"
+            lease["spec"]["renewTime"] = "1970-01-01T00:00:59Z"
+            lease["spec"]["leaseTransitions"] = 9
+            fake.update_lease(NS, LEASE, lease)
+        return False
+
+    monkeypatch.setattr(retry_mod, "wait", interloping_wait)
+    b = contender(fake, lambda: BASE, clk)
+    with pytest.raises(LeaseHeld, match="holder-c"):
+        b.acquire()

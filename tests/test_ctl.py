@@ -233,3 +233,59 @@ def test_attest_challenge_rejects_no_verify_signatures(fake_kube):
                allow_fake=True, challenge=True, challenge_timeout=1.0,
                no_verify_signatures=True),
         )
+
+
+# -- per-region flag syntax (ISSUE 18) --------------------------------------
+
+
+def test_parse_regions_plain_and_with_contexts():
+    regions, contexts = ctl._parse_regions("r1,r2,r3")
+    assert regions == ["r1", "r2", "r3"] and contexts == {}
+    regions, contexts = ctl._parse_regions("r1=ctx-a, r2=ctx-b")
+    assert regions == ["r1", "r2"]
+    assert contexts == {"r1": "ctx-a", "r2": "ctx-b"}
+
+
+def test_parse_regions_refuses_duplicates_and_partial_contexts():
+    import pytest
+
+    with pytest.raises(ValueError, match="duplicate"):
+        ctl._parse_regions("r1,r1")
+    # All-or-nothing on contexts: half a federation silently sharing the
+    # local cluster is the mixup the explicit form prevents.
+    with pytest.raises(ValueError, match="EVERY"):
+        ctl._parse_regions("r1=ctx-a,r2")
+    with pytest.raises(ValueError, match="empty kubeconfig context"):
+        ctl._parse_regions("r1=")
+
+
+def test_parse_per_region_int_defaults_and_overrides():
+    regions = ["r1", "r2"]
+    assert ctl._parse_per_region_int(None, "--x", regions) == (None, {})
+    assert ctl._parse_per_region_int("3", "--x", regions) == (3, {})
+    default, per = ctl._parse_per_region_int("2,r2=5", "--x", regions)
+    assert default == 2 and per == {"r2": 5}
+
+
+def test_parse_per_region_int_refusals():
+    import pytest
+
+    regions = ["r1", "r2"]
+    with pytest.raises(ValueError, match="unknown region"):
+        ctl._parse_per_region_int("zz=3", "--x", regions)
+    with pytest.raises(ValueError, match="duplicate region"):
+        ctl._parse_per_region_int("r1=1,r1=2", "--x", regions)
+    with pytest.raises(ValueError, match="more than one bare"):
+        ctl._parse_per_region_int("1,2", "--x", regions)
+
+
+def test_plain_int_flag_refuses_per_region_syntax_without_regions():
+    import pytest
+
+    assert ctl._plain_int_flag(None, "--x") is None
+    assert ctl._plain_int_flag(4, "--x") == 4
+    assert ctl._plain_int_flag("7", "--x") == 7
+    with pytest.raises(ValueError, match="requires --regions"):
+        ctl._plain_int_flag("r1=2", "--x")
+    with pytest.raises(ValueError, match="requires --regions"):
+        ctl._plain_int_flag("2,3", "--x")

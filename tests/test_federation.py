@@ -41,6 +41,7 @@ from tpu_cc_manager.labels import (
     CC_MODE_STATE_LABEL,
     QUARANTINED_LABEL,
 )
+from tpu_cc_manager.utils import retry as retry_mod
 from tpu_cc_manager.utils.metrics import MetricsRegistry
 
 POOL = "pool=tpu"
@@ -462,10 +463,29 @@ def test_federation_unaware_orchestrator_refuses_v5_record(monkeypatch):
     """A v4-era orchestrator (no federation support) must refuse the
     record loudly, never resume a regional slice as a plain rollout."""
     data = _federated_record().to_json()
-    assert json.loads(data)["version"] == rollout_state.RECORD_VERSION
+    assert json.loads(data)["version"] == rollout_state.RECORD_VERSION_NO_ESCROW
     monkeypatch.setattr(
         rollout_state, "RECORD_VERSION",
         rollout_state.RECORD_VERSION_NO_FEDERATION,
+    )
+    with pytest.raises(rollout_state.RolloutFenced, match="newer than"):
+        rollout_state.RolloutRecord.from_json(data)
+
+
+def test_escrow_unaware_orchestrator_refuses_v6_record(monkeypatch):
+    """An escrow ledger in the federation dict forces v6: a v5 binary
+    resuming it would drop the escrow balance and keep charging
+    unbounded while the parent plane is dark — refuse loudly instead."""
+    record = _federated_record()
+    record.federation = dict(
+        record.federation,
+        escrow=2, acked_spend=[], charged=["r1-node-9"],
+    )
+    data = record.to_json()
+    assert json.loads(data)["version"] == rollout_state.RECORD_VERSION
+    monkeypatch.setattr(
+        rollout_state, "RECORD_VERSION",
+        rollout_state.RECORD_VERSION_NO_ESCROW,
     )
     with pytest.raises(rollout_state.RolloutFenced, match="newer than"):
         rollout_state.RolloutRecord.from_json(data)
@@ -570,6 +590,11 @@ def test_federation_soak_seeded_regional_kill_and_blackout(fake_kube):
 
     blackout = run_blackout_leg(FakeKube(), seed=seed)
 
+    # Leg 3: the PARENT-plane partition (escrow weather) — degraded
+    # mode, dark escrow spend, escrow-exhausted halt, exactly-once
+    # reconciliation on reconnect.
+    parent_blackout = run_parent_blackout_leg(seed=seed)
+
     print(
         "FEDERATION_SUMMARY "
         + json.dumps({
@@ -580,5 +605,333 @@ def test_federation_soak_seeded_regional_kill_and_blackout(fake_kube):
             "parent_complete": True,
             "blackout_refusals": blackout["blackout_refusals"],
             "budget_spend": blackout["budget_spend"],
+            "parent_blackout": parent_blackout,
         })
     )
+
+
+# ---------------------------------------------------------------------------
+# Budget escrow & parent-plane partition tolerance (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class DarkSwitchKube:
+    """Client wrapper that refuses the parent-lease transport (status
+    None — a genuine outage, not a served error) while ``.dark``. Node
+    and regional-lease verbs pass through untouched, so only the parent
+    PLANE goes dark, exactly the federated failure domain under test."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.dark = False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _refuse(self):
+        if self.dark:
+            raise KubeApiError(None, "parent plane dark: connection refused")
+
+    def get_lease(self, *a, **kw):
+        self._refuse()
+        return self._inner.get_lease(*a, **kw)
+
+    def update_lease(self, *a, **kw):
+        self._refuse()
+        return self._inner.update_lease(*a, **kw)
+
+    def create_lease(self, *a, **kw):
+        self._refuse()
+        return self._inner.create_lease(*a, **kw)
+
+
+def fast_store(api):
+    """A ParentStore whose retry ladder gives up instantly — dark-path
+    tests should not pay real backoff sleeps."""
+    return federation_mod.ParentStore(
+        api, namespace=NS,
+        retry_policy=retry_mod.RetryPolicy(
+            max_attempts=1, base_delay_s=0.0, max_delay_s=0.0,
+        ),
+    )
+
+
+def dark_gate(fake, clk, **parent_kw):
+    """A gate attached while the parent plane is LIGHT, plus the switch
+    to cut it. Returns (plain_store, switch, gate)."""
+    store, parent = make_parent(fake, **parent_kw)
+    switch = DarkSwitchKube(fake)
+    gate = federation_mod.FederationGate(
+        fast_store(switch), "r1", offline_grace_s=1.0, clock=clk,
+    )
+    gate.attach(parent)
+    return store, switch, gate
+
+
+def run_parent_blackout_leg(seed=0):
+    """One seeded parent-plane partition pass, shared with the chaos
+    soak: the shard rides a total parent blackout past grace, charges
+    its dark spend against the escrowed budget slice, halts
+    ``escrow-exhausted`` when the slice runs dry, and reconciles the
+    ledger exactly once on reconnect. Returns the escrow counters the
+    FEDERATION_SUMMARY line carries."""
+    from tpu_cc_manager.kubeclient.fake import FakeKube
+
+    rng = random.Random(seed)
+    clk = Clock()
+    store, switch, gate = dark_gate(
+        FakeKube(), clk, failure_budget=2, regions=("r1", "r2")
+    )
+    escrow = gate.escrow_balance  # fair share: ceil(2 / 2 regions) = 1
+    switch.dark = True
+    gate.sync([])  # the outage clock starts at the first refusal
+    clk.advance(rng.uniform(1.5, 30.0))  # past the 1.0 s grace
+    first = [f"r1-node-{rng.randrange(100)}"]
+    view = gate.sync(first)
+    degraded = bool(view["degraded"])
+    dark_spend = sorted(
+        set(first) | {f"r1-node-{100 + rng.randrange(100)}"}
+    )
+    view = gate.sync(dark_spend)
+    halted_dark = (
+        bool(view["halted"])
+        and view["reason"] == federation_mod.ESCROW_EXHAUSTED_REASON
+    )
+    parent_untouched = not store.load().budget_spend
+    switch.dark = False
+    view = gate.sync(dark_spend)
+    reconnected = bool(view["reconnected"])
+    reconciled = sorted(store.load().budget_spend) == dark_spend
+    gate.sync(dark_spend)  # replay must not double-charge
+    exactly_once = sorted(store.load().budget_spend) == dark_spend
+    return {
+        "escrow": escrow,
+        "degraded": degraded,
+        "escrow_halted_dark": halted_dark,
+        "parent_untouched_while_dark": parent_untouched,
+        "reconnected": reconnected,
+        "reconciled": reconciled,
+        "reconciled_exactly_once": exactly_once,
+        "dark_spend": dark_spend,
+    }
+
+
+def test_parent_blackout_leg_counters_hold_for_any_seed():
+    for seed in (0, 7, 20260807):
+        leg = run_parent_blackout_leg(seed=seed)
+        assert leg["degraded"]
+        assert leg["escrow_halted_dark"]
+        assert leg["parent_untouched_while_dark"]
+        assert leg["reconnected"]
+        assert leg["reconciled_exactly_once"]
+
+
+def test_attach_reserves_escrow_and_sum_never_exceeds_budget(fake_kube):
+    store, parent = make_parent(fake_kube, failure_budget=3)
+    g1 = federation_mod.FederationGate(store, "r1")
+    g1.attach(parent)
+    assert g1.escrow_balance == 2  # ceil(3 / 2 regions)
+    assert store.load().escrow == {"r1": 2}
+    g2 = federation_mod.FederationGate(store, "r2")
+    g2.attach(parent)
+    # r2's fair share is also 2, but only 3 - 2 = 1 is free: the
+    # invariant len(spend) + sum(escrow) <= failure_budget holds.
+    assert g2.escrow_balance == 1
+    live = store.load()
+    assert sum(live.escrow.values()) <= live.failure_budget
+
+
+def test_terminal_sync_returns_unused_escrow(fake_kube):
+    store, parent = make_parent(fake_kube, failure_budget=4)
+    gate = federation_mod.FederationGate(store, "r1")
+    gate.attach(parent)
+    assert store.load().escrow["r1"] == 2
+    gate.sync([], status=federation_mod.PARENT_COMPLETE, done=5, total=5)
+    assert store.load().escrow["r1"] == 0
+
+
+def test_budgetless_federation_carries_no_escrow_and_serializes_v5():
+    rec = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[("g0", ("r1-node-0",))], done=[],
+        federation={"region": "r1", "regions": 2, "generation": 1,
+                    "digest": "abc"},
+    )
+    obj = json.loads(rec.to_json())
+    assert obj["version"] == rollout_state.RECORD_VERSION_NO_ESCROW
+
+
+def test_dark_shard_charges_escrow_then_halts_exhausted(fake_kube):
+    clk = Clock()
+    store, switch, gate = dark_gate(fake_kube, clk, failure_budget=4)
+    assert gate.escrow_balance == 2
+    switch.dark = True
+
+    # First dark sync: inside both the grace window and the escrow.
+    view = gate.sync(["r1-node-0"])
+    assert view["offline"] and not view["halted"]
+    assert not view["degraded"] and not view["offline_edge"]
+    assert view["escrow_pending"] == 1
+
+    # Past the grace the shard declares degraded mode exactly once.
+    clk.advance(5.0)
+    view = gate.sync(["r1-node-0", "r1-node-1"])
+    assert view["degraded"] and view["offline_edge"]
+    assert not view["halted"]  # pending 2 == escrow 2: still covered
+    view = gate.sync(["r1-node-0", "r1-node-1"])
+    assert not view["offline_edge"]  # edge fires once per outage
+
+    # A third dark bounce would exceed the slice: halt, don't overspend.
+    view = gate.sync(["r1-node-0", "r1-node-1", "r1-node-2"])
+    assert view["halted"]
+    assert view["reason"] == federation_mod.ESCROW_EXHAUSTED_REASON
+
+    # Nothing leaked to the (unreachable) parent ledger.
+    assert store.load().budget_spend == []
+
+
+def test_reconnect_reconciles_dark_spend_exactly_once(fake_kube):
+    clk = Clock()
+    store, switch, gate = dark_gate(fake_kube, clk, failure_budget=4)
+    switch.dark = True
+    gate.sync(["r1-node-0"])  # starts the outage clock
+    clk.advance(5.0)
+    gate.sync(["r1-node-0", "r1-node-1"])
+    assert gate.degraded
+
+    switch.dark = False
+    view = gate.sync(["r1-node-0", "r1-node-1"])
+    assert view["reconnected"] and not view["offline"]
+    assert not gate.degraded
+    live = store.load()
+    assert live.budget_spend == ["r1-node-0", "r1-node-1"]
+    assert live.region_charged("r1") == {"r1-node-0", "r1-node-1"}
+    # Escrow re-targeted to the remaining fair share, not the original.
+    assert gate.escrow_balance == 1  # ceil((4-2)/2)
+
+    # Replaying the same spend (crash-resume double-sync) charges nothing.
+    gate.sync(["r1-node-0", "r1-node-1"])
+    assert store.load().budget_spend == ["r1-node-0", "r1-node-1"]
+
+
+def test_regional_cap_halts_only_that_region(fake_kube):
+    store, parent = make_parent(
+        fake_kube, failure_budget=4, region_budgets={"r1": 1, "r2": 3},
+    )
+    g1 = federation_mod.FederationGate(store, "r1")
+    g1.attach(parent)
+    assert g1.escrow_balance == 1  # heterogeneous cap bounds the slice
+
+    view = g1.sync(["r1-node-0", "r1-node-1"])
+    assert view["halted"]
+    assert federation_mod.REGION_BUDGET_REASON in view["reason"]
+
+    # The halted shard pushes its terminal status: the PARENT stays
+    # in-progress (regional-only halt), so the sibling keeps rolling.
+    g1.sync(
+        ["r1-node-0", "r1-node-1"],
+        status=federation_mod.PARENT_HALTED, halted_reason=view["reason"],
+    )
+    assert store.load().status == federation_mod.PARENT_IN_PROGRESS
+
+    g2 = federation_mod.FederationGate(store, "r2")
+    g2.attach(parent)
+    view2 = g2.sync(["r2-node-0"])
+    assert not view2["halted"]
+
+
+def test_dark_resume_adopts_persisted_escrow_ledger(fake_kube):
+    clk = Clock()
+    store, switch, gate = dark_gate(fake_kube, clk, failure_budget=4)
+    switch.dark = True
+    clk.advance(5.0)
+    gate.sync(["r1-node-0"])
+    fed = gate.to_record_dict()
+    assert fed["escrow"] == 2 and fed["charged"] == ["r1-node-0"]
+
+    # SIGKILL mid-blackout: the successor rebuilds its gate from the
+    # regional record with the parent STILL dark — and keeps rolling on
+    # the persisted ledger instead of wedging.
+    successor = federation_mod.FederationGate.from_record_dict(
+        switch, fed, offline_grace_s=1.0, clock=clk,
+    )
+    assert successor.escrow_balance == 2
+    assert successor.charged == {"r1-node-0"}
+    assert successor.generation == gate.generation
+    view = successor.sync(["r1-node-0", "r1-node-1"])
+    assert view["offline"] and not view["halted"]
+    clk.advance(5.0)
+    view = successor.sync(["r1-node-0", "r1-node-1"])
+    assert view["degraded"]
+
+    # Reconnect: the dark spend of BOTH incarnations lands exactly once.
+    switch.dark = False
+    view = successor.sync(["r1-node-0", "r1-node-1"])
+    assert view["reconnected"]
+    assert store.load().budget_spend == ["r1-node-0", "r1-node-1"]
+
+
+def test_generation_bump_during_blackout_fences_reconnecting_shard(
+    fake_kube,
+):
+    clk = Clock()
+    store, switch, gate = dark_gate(fake_kube, clk, failure_budget=4)
+    switch.dark = True
+    clk.advance(5.0)
+    gate.sync(["r1-node-0"])
+
+    # Operator force-aborts through the (healthy-elsewhere) parent plane
+    # while this shard is partitioned from it.
+    store.abort("operator-abort")
+
+    switch.dark = False
+    with pytest.raises(rollout_state.RolloutFenced):
+        gate.sync(["r1-node-0"])
+
+
+def test_corrupt_parent_abort_entombs_a_tombstone(fake_kube):
+    store, _parent = make_parent(fake_kube)
+    lease = fake_kube.get_lease(NS, federation_mod.PARENT_LEASE_NAME)
+    lease["metadata"]["annotations"][
+        rollout_state.RECORD_ANNOTATION
+    ] = "{definitely not json"
+    fake_kube.update_lease(NS, federation_mod.PARENT_LEASE_NAME, lease)
+
+    with pytest.raises(federation_mod.ParentUnreadable):
+        store.load()
+    tomb = store.abort("operator-abort")
+    assert tomb.status == federation_mod.PARENT_ABORTED
+    assert tomb.digest == "discarded-corrupt"
+    # The tombstone is a readable record again: the documented recovery.
+    assert store.load().status == federation_mod.PARENT_ABORTED
+
+
+def test_escrow_unaware_parser_refuses_v2_parent(monkeypatch):
+    rec = federation_mod.ParentRecord.fresh(
+        "on", POOL, ["r1", "r2"], failure_budget=4,
+    )
+    rec.escrow["r1"] = 2
+    data = rec.to_json()
+    assert json.loads(data)["parentVersion"] == federation_mod.PARENT_VERSION
+
+    monkeypatch.setattr(
+        federation_mod, "PARENT_VERSION",
+        federation_mod.PARENT_VERSION_NO_ESCROW,
+    )
+    with pytest.raises(rollout_state.RolloutFenced, match="newer than"):
+        federation_mod.ParentRecord.from_json(data)
+
+
+def test_describe_parent_shows_escrow_and_staleness(fake_kube):
+    clk = Clock()
+    store, parent = make_parent(fake_kube, failure_budget=4)
+    gate = federation_mod.FederationGate(
+        store, "r1", offline_grace_s=1.0, clock=clk, wall=clk,
+    )
+    gate.attach(parent)
+    gate.sync(["r1-node-0"], done=1, total=5)
+    text = federation_mod.describe_parent(
+        store.load(), wall=lambda: clk.t + 600.0, offline_grace_s=60.0,
+    )
+    assert "escrowed=" in text
+    assert "STALE" in text  # last sync 600 s ago >> 60 s grace

@@ -100,3 +100,75 @@ def test_load_prefers_in_cluster(tmp_path, monkeypatch):
     monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
     cfg = ClusterConfig.load(kubeconfig="/nonexistent/kubeconfig")
     assert cfg.server == "https://10.9.9.9:443"
+
+
+def write_two_context_kubeconfig(tmp_path):
+    cfg = {
+        "current-context": "local",
+        "contexts": [
+            {"name": "local", "context": {"cluster": "c1", "user": "u1"}},
+            {"name": "region-2", "context": {"cluster": "c2", "user": "u2"}},
+        ],
+        "clusters": [
+            {
+                "name": "c1",
+                "cluster": {
+                    "server": "https://local:6443",
+                    "insecure-skip-tls-verify": True,
+                },
+            },
+            {
+                "name": "c2",
+                "cluster": {
+                    "server": "https://region-2:6443",
+                    "insecure-skip-tls-verify": True,
+                },
+            },
+        ],
+        "users": [
+            {"name": "u1", "user": {"token": "t1"}},
+            {"name": "u2", "user": {"token": "t2"}},
+        ],
+    }
+    import yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_named_context_selects_that_cluster(tmp_path):
+    """Per-region federation (--regions r=ctx) picks one context out of
+    a shared kubeconfig instead of the file's current-context."""
+    path = write_two_context_kubeconfig(tmp_path)
+    default = ClusterConfig.from_kubeconfig(path)
+    assert default.server == "https://local:6443"
+    assert default.token == "t1"
+    regional = ClusterConfig.from_kubeconfig(path, context="region-2")
+    assert regional.server == "https://region-2:6443"
+    assert regional.token == "t2"
+
+
+def test_named_context_missing_raises(tmp_path):
+    path = write_two_context_kubeconfig(tmp_path)
+    with pytest.raises(KubeApiError, match="not found"):
+        ClusterConfig.from_kubeconfig(path, context="nope")
+
+
+def test_load_with_named_context_skips_in_cluster(tmp_path, monkeypatch):
+    """A named context must NEVER fall back to the local in-cluster
+    config — silently getting the local cluster is the cross-region
+    mixup the explicit form exists to prevent."""
+    # Make the in-cluster probe LOOK available; the named context must
+    # not even consult it.
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("in-cluster-token")
+    (sa / "ca.crt").write_text("ca")
+    monkeypatch.setattr(
+        "tpu_cc_manager.kubeclient.rest._SA_MOUNT", str(sa), raising=False
+    )
+    path = write_two_context_kubeconfig(tmp_path)
+    cfg = ClusterConfig.load(path, context="region-2")
+    assert cfg.server == "https://region-2:6443"
+    assert cfg.token == "t2"

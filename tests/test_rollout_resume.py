@@ -43,7 +43,43 @@ ROLLING_CRASH_POINTS = [
     "slo-paused",
     "spare-prestaged",
     "federation-boundary",
+    "parent-offline",
 ]
+
+
+class ParentBlackoutKube:
+    """A kube client wrapper for the PARENT STORE only: refuses its
+    verbs while a seeded FaultPlan blackout window is open (advancing
+    the injected clock per refusal so the offline grace elapses
+    deterministically), then delegates. Only the parent plane goes
+    dark — the regional pool keeps answering, which is exactly the
+    partition the parent-offline crash point models."""
+
+    def __init__(self, inner, plan, clk) -> None:
+        self._inner = inner
+        self._plan = plan
+        self._clk = clk
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _gate(self, op: str) -> None:
+        fault = self._plan.decide(op)
+        if fault is not None:
+            self._clk.advance(2.0)
+            raise KubeApiError(None, f"parent blackout: {fault.describe()}")
+
+    def get_lease(self, namespace, name):
+        self._gate("get_lease")
+        return self._inner.get_lease(namespace, name)
+
+    def update_lease(self, namespace, name, lease):
+        self._gate("update_lease")
+        return self._inner.update_lease(namespace, name, lease)
+
+    def create_lease(self, namespace, name, spec):
+        self._gate("create_lease")
+        return self._inner.create_lease(namespace, name, spec)
 
 
 def one_breach_gate():
@@ -340,7 +376,21 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
         federation_mod.ParentRecord.fresh("on", POOL, ["r1", "r2"]),
         resume=False,
     )
-    fed_a = federation_mod.FederationGate(store, "r1", metrics=metrics)
+    # The shard's OWN parent-store client rides through a seeded
+    # blackout window (the regional pool stays up): the attach and the
+    # first boundary sync go dark — 3 retried refusals each, the
+    # injected clock advancing 2 s per refusal past the 1 s grace — so
+    # the SECOND exchange deterministically fires the offline edge and
+    # the parent-offline crash point, and the one after that reconnects.
+    pclk = Clock()
+    blackout_plan = FaultPlan(seed=7, rate=0.0, watch_rate=0.0)
+    blackout_plan.begin_blackout(calls=6)
+    dark_store = federation_mod.ParentStore(
+        ParentBlackoutKube(fake, blackout_plan, pclk), namespace=NS
+    )
+    fed_a = federation_mod.FederationGate(
+        dark_store, "r1", metrics=metrics, offline_grace_s=1.0, clock=pclk,
+    )
     fed_a.attach(parent)
     # Every run carries a one-breach SLO gate so the kill loop reaches
     # the slo-paused crash point too (pause at the first boundary,

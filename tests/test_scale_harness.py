@@ -77,3 +77,24 @@ def test_10k_node_fleet_full_rollout_informer():
     # One chunked listing (10000/500 = 20 pages) plus chaos-triggered
     # relists at most; nothing O(pool).
     assert row["orchestrator_requests"].get("list", 0) <= 60
+
+
+@pytest.mark.slow
+def test_federation_blackout_smoke():
+    """--federation-blackout smoke at 4 regions x 400 nodes: healthy
+    regions ride a seeded parent blackout and reconcile on reconnect,
+    the kill region SIGKILLs at the parent-offline crash point and
+    dark-resumes through the skew-proof lease observation window, and
+    the escrow region halts escrow-exhausted in the dark on its dead
+    slice, then resumes to completion once the parent returns. The
+    committed SCALE_r04.json carries the 100k-node numbers."""
+    row = scale_bench.run_federation_blackout(
+        total_nodes=1600, regions_count=4, shards=4,
+        per_shard_unavailable=13, node_timeout_s=3.0,
+    )
+    assert row["ok"], row
+    assert row["budget_spend_exactly_dead_slice"], row["budget_spend"]
+    assert row["region_results"][row["killed_region"]]["resumed_dark"]
+    assert row["region_results"][row["escrow_region"]]["escrow_halted_dark"]
+    assert row["stitch"]["torn_lines"] == 0
+    assert row["stitch"]["exactly_once"]

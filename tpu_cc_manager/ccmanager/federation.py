@@ -48,11 +48,23 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
+import os
+import time
 from dataclasses import dataclass, field
 
 from tpu_cc_manager.ccmanager import rollout_state
-from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError
+from tpu_cc_manager.ccmanager.intent_journal import (
+    OfflineTracker,
+    is_outage_error,
+)
+from tpu_cc_manager.kubeclient.api import (
+    KubeApi,
+    KubeApiError,
+    caller_retry_attempts,
+    classify_kube_error,
+)
 from tpu_cc_manager.labels import label_safe
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -67,13 +79,70 @@ REGION_LABEL = "topology.kubernetes.io/region"
 
 #: Parent-document format version (independent of the regional
 #: RolloutRecord's ``RECORD_VERSION`` — the parent is a new document,
-#: not an evolution of the regional record).
-PARENT_VERSION = 1
+#: not an evolution of the regional record). History:
+#: 1 (PR 16): plan digest, per-region status map, one global budget,
+#: ``budget_spend`` union, fencing ``generation``.
+#: 2: adds ``escrow`` (per-region slices of the global budget reserved
+#: for autonomous degraded-mode spending during a parent-plane
+#: blackout), ``region_budgets`` and ``region_max_unavailable``
+#: (heterogeneous per-region limits). Written ONLY when one of those
+#: maps is populated, so budgetless/homogeneous federations keep
+#: round-tripping through v1 binaries; an escrow-bearing parent resumed
+#: by an escrow-unaware binary would silently drop the ledger and let
+#: dark regions overspend, so v2 is refused loudly by older parsers.
+PARENT_VERSION = 2
+#: What parents WITHOUT the escrow/heterogeneous fields write.
+PARENT_VERSION_NO_ESCROW = 1
 
 PARENT_IN_PROGRESS = rollout_state.RECORD_IN_PROGRESS
 PARENT_COMPLETE = rollout_state.RECORD_COMPLETE
 PARENT_HALTED = rollout_state.RECORD_HALTED
 PARENT_ABORTED = "aborted"
+#: What :meth:`FederationGate.sync` reports as ``parent_status`` while
+#: the parent apiserver is unreachable (transport-level failures only —
+#: an apiserver that ANSWERS an error is not an outage).
+PARENT_OFFLINE = "offline"
+
+#: Degraded-mode halt reasons. ``escrow-exhausted`` is regional-only:
+#: a dark shard that spent its escrowed slice stops itself without
+#: halting the (unreachable) parent; siblings keep rolling. So is
+#: ``region-failure-budget-exceeded`` (a heterogeneous per-region cap) —
+#: only a GLOBAL budget breach halts the whole federation.
+ESCROW_EXHAUSTED_REASON = "escrow-exhausted"
+REGION_BUDGET_REASON = "region-failure-budget-exceeded"
+_REGIONAL_ONLY_HALTS = (ESCROW_EXHAUSTED_REASON, REGION_BUDGET_REASON)
+
+#: How long the parent plane must be dark (transport errors on every
+#: sync) before a shard declares DEGRADED mode and journals the
+#: parent-offline flight event. The escrow safety math applies from the
+#: very first failed sync regardless — the grace only debounces the
+#: operator-facing state flip, mirroring CC_OFFLINE_GRACE_S one level
+#: down the hierarchy.
+FEDERATION_OFFLINE_GRACE_ENV = "CC_FEDERATION_OFFLINE_GRACE_S"
+DEFAULT_FEDERATION_OFFLINE_GRACE_S = 60.0
+
+
+def federation_offline_grace_s() -> float:
+    raw = os.environ.get(FEDERATION_OFFLINE_GRACE_ENV)
+    if raw is None:
+        return DEFAULT_FEDERATION_OFFLINE_GRACE_S
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning(
+            "%s=%r is not a number; using %.0f",
+            FEDERATION_OFFLINE_GRACE_ENV, raw,
+            DEFAULT_FEDERATION_OFFLINE_GRACE_S,
+        )
+        return DEFAULT_FEDERATION_OFFLINE_GRACE_S
+
+
+class ParentUnreadable(rollout_state.RolloutFenced):
+    """The parent record exists but cannot be parsed. Distinct from the
+    version refusal so ``abort`` (the documented recovery) can discard a
+    corrupt parent instead of tracebacking on it."""
+
+
 #: A region registered at federation creation that has not synced yet.
 #: Pre-seeding every region keeps ``all_complete`` honest (a parent is
 #: complete only when EVERY declared region reports complete, not just
@@ -146,6 +215,16 @@ class ParentRecord:
     regions: dict[str, dict] = field(default_factory=dict)
     status: str = PARENT_IN_PROGRESS
     halted_reason: str | None = None
+    # Budget escrow (format v2): per-region slices of the global budget
+    # reserved for degraded-mode spending while the parent plane is
+    # dark. Invariant: len(budget_spend) + sum(escrow.values()) <=
+    # failure_budget — a dark region charging only against its slice can
+    # never push the federation over the global budget.
+    escrow: dict[str, int] = field(default_factory=dict)
+    # Heterogeneous per-region limits (format v2): a region absent from
+    # either map falls back to the global value.
+    region_budgets: dict[str, int] = field(default_factory=dict)
+    region_max_unavailable: dict[str, int] = field(default_factory=dict)
 
     @classmethod
     def fresh(
@@ -155,6 +234,8 @@ class ParentRecord:
         regions: list[str],
         max_unavailable: int = 1,
         failure_budget: int | None = None,
+        region_budgets: dict[str, int] | None = None,
+        region_max_unavailable: dict[str, int] | None = None,
     ) -> "ParentRecord":
         """A new federation's parent document with every region
         pre-registered as pending — the digest and the region count are
@@ -163,6 +244,8 @@ class ParentRecord:
             mode=mode, selector=selector,
             digest=plan_digest(mode, selector, list(regions)),
             max_unavailable=max_unavailable, failure_budget=failure_budget,
+            region_budgets=dict(region_budgets or {}),
+            region_max_unavailable=dict(region_max_unavailable or {}),
         )
         for region in regions:
             rec.regions[str(region)] = {
@@ -177,13 +260,28 @@ class ParentRecord:
     def note_region(
         self, region: str, status: str, done: int, total: int,
         generation: int | None = None,
+        charged: list[str] | None = None,
+        synced_at: float | None = None,
     ) -> None:
-        self.regions[region] = {
+        entry = {
             "status": status,
             "done": int(done),
             "total": int(total),
             "generation": generation,
         }
+        if charged is not None:
+            # Per-region spend attribution: the subset of budget_spend
+            # this region itself charged (set-union, exactly-once under
+            # CAS races like the global ledger). Only maintained when a
+            # budget exists — it is what heterogeneous caps and escrow
+            # re-reservation are computed from.
+            entry["charged"] = sorted(set(charged))
+        if synced_at is not None:
+            # Display-only wall stamp for `ctl status` last-sync age;
+            # NEVER consulted by fencing (fencing is wall-clock-free:
+            # generation tokens and monotonic local clocks only).
+            entry["synced_at"] = round(float(synced_at), 3)
+        self.regions[region] = entry
 
     @property
     def all_complete(self) -> bool:
@@ -191,23 +289,46 @@ class ParentRecord:
             r.get("status") == PARENT_COMPLETE for r in self.regions.values()
         )
 
+    def region_charged(self, region: str) -> set[str]:
+        """The spend this region itself charged (its slice of the global
+        union), per the persisted per-region attribution."""
+        return set((self.regions.get(region) or {}).get("charged") or [])
+
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "parentVersion": PARENT_VERSION,
-                "mode": self.mode,
-                "selector": self.selector,
-                "digest": self.digest,
-                "max_unavailable": self.max_unavailable,
-                "failure_budget": self.failure_budget,
-                "generation": self.generation,
-                "budget_spend": list(self.budget_spend),
-                "regions": self.regions,
-                "status": self.status,
-                "halted_reason": self.halted_reason,
-            },
-            sort_keys=True, separators=(",", ":"),
+        # Serialize at the LOWEST version that expresses the populated
+        # fields (the regional record's downgrade-compat discipline): a
+        # budgetless/homogeneous federation stays v1 so older binaries
+        # keep adopting it; any escrow or per-region limit forces v2 and
+        # a loud refusal from escrow-unaware parsers.
+        versioned = bool(
+            self.escrow or self.region_budgets or self.region_max_unavailable
         )
+        body = {
+            "parentVersion": (
+                PARENT_VERSION if versioned else PARENT_VERSION_NO_ESCROW
+            ),
+            "mode": self.mode,
+            "selector": self.selector,
+            "digest": self.digest,
+            "max_unavailable": self.max_unavailable,
+            "failure_budget": self.failure_budget,
+            "generation": self.generation,
+            "budget_spend": list(self.budget_spend),
+            "regions": self.regions,
+            "status": self.status,
+            "halted_reason": self.halted_reason,
+        }
+        if versioned:
+            body["escrow"] = {k: int(v) for k, v in self.escrow.items()}
+            if self.region_budgets:
+                body["region_budgets"] = {
+                    k: int(v) for k, v in self.region_budgets.items()
+                }
+            if self.region_max_unavailable:
+                body["region_max_unavailable"] = {
+                    k: int(v) for k, v in self.region_max_unavailable.items()
+                }
+        return json.dumps(body, sort_keys=True, separators=(",", ":"))
 
     @classmethod
     def from_json(cls, data: str) -> "ParentRecord":
@@ -240,11 +361,23 @@ class ParentRecord:
                     str(obj["halted_reason"])
                     if obj.get("halted_reason") else None
                 ),
+                escrow={
+                    str(k): int(v)
+                    for k, v in (obj.get("escrow") or {}).items()
+                },
+                region_budgets={
+                    str(k): int(v)
+                    for k, v in (obj.get("region_budgets") or {}).items()
+                },
+                region_max_unavailable={
+                    str(k): int(v)
+                    for k, v in (obj.get("region_max_unavailable") or {}).items()
+                },
             )
         except rollout_state.RolloutFenced:
             raise
         except (ValueError, KeyError, TypeError) as e:
-            raise rollout_state.RolloutFenced(
+            raise ParentUnreadable(
                 f"unreadable federated parent record: {e}"
             ) from e
 
@@ -266,16 +399,40 @@ class ParentStore:
         api: KubeApi,
         namespace: str | None = None,
         name: str = PARENT_LEASE_NAME,
+        retry_policy: retry_mod.RetryPolicy | None = None,
     ) -> None:
         self.api = api
         self.namespace = namespace or rollout_state.lease_namespace()
         self.name = name
+        # Every parent read/write rides the shared retry ladder like any
+        # other client path: Retry-After honored, transients re-tried,
+        # and attempts collapsed to 1 when the client retries internally
+        # (RestKube) so ladders never nest. A 409 is classified
+        # non-transient, so CAS conflicts still surface to the
+        # read-mutate-write loops below instead of being blindly
+        # replayed against a stale resourceVersion.
+        self.retry = retry_policy or retry_mod.RetryPolicy(
+            max_attempts=caller_retry_attempts(self.api),
+            base_delay_s=0.2, max_delay_s=2.0,
+        )
+
+    def _get_lease(self) -> dict:
+        return self.retry.call(
+            lambda: self.api.get_lease(self.namespace, self.name),
+            op="federation.parent-get", classify=classify_kube_error,
+        )
+
+    def _put_lease(self, lease: dict) -> dict:
+        return self.retry.call(
+            lambda: self.api.update_lease(self.namespace, self.name, lease),
+            op="federation.parent-cas", classify=classify_kube_error,
+        )
 
     def load(self) -> ParentRecord | None:
         """The current parent record, or None when no federation is in
         flight (no lease, or a lease with no record annotation)."""
         try:
-            lease = self.api.get_lease(self.namespace, self.name)
+            lease = self._get_lease()
         except KubeApiError as e:
             if e.status == 404:
                 return None
@@ -328,13 +485,17 @@ class ParentStore:
     def _create(self, parent: ParentRecord) -> ParentRecord:
         for _ in range(_CAS_ATTEMPTS):
             try:
-                lease = self.api.get_lease(self.namespace, self.name)
+                lease = self._get_lease()
             except KubeApiError as e:
                 if e.status != 404:
                     raise
                 try:
-                    self.api.create_lease(
-                        self.namespace, self.name, {"holderIdentity": ""}
+                    self.retry.call(
+                        lambda: self.api.create_lease(
+                            self.namespace, self.name, {"holderIdentity": ""}
+                        ),
+                        op="federation.parent-create",
+                        classify=classify_kube_error,
                     )
                 except KubeApiError as ce:
                     if ce.status != 409:
@@ -349,7 +510,7 @@ class ParentStore:
                 return self.initialize(parent, resume=False)
             annotations[rollout_state.RECORD_ANNOTATION] = parent.to_json()
             try:
-                self.api.update_lease(self.namespace, self.name, lease)
+                self._put_lease(lease)
                 return parent
             except KubeApiError as e:
                 if e.status != 409:
@@ -370,7 +531,7 @@ class ParentStore:
         ``RolloutFenced`` to refuse (stale shard); that propagates."""
         last: KubeApiError | None = None
         for _ in range(_CAS_ATTEMPTS):
-            lease = self.api.get_lease(self.namespace, self.name)
+            lease = self._get_lease()
             raw = ((lease.get("metadata") or {}).get("annotations") or {}).get(
                 rollout_state.RECORD_ANNOTATION
             )
@@ -384,7 +545,7 @@ class ParentStore:
                 rollout_state.RECORD_ANNOTATION
             ] = rec.to_json()
             try:
-                self.api.update_lease(self.namespace, self.name, lease)
+                self._put_lease(lease)
                 return rec
             except KubeApiError as e:
                 if e.status != 409:
@@ -401,7 +562,11 @@ class ParentStore:
         its generation. Every live shard's next sync sees a generation
         newer than the one it attached at and fences itself — the
         federated analogue of ``release_lease``'s self-fencing force
-        release."""
+        release. A CORRUPT parent (unparseable annotation) is replaced
+        by a synthetic aborted tombstone instead of tracebacking:
+        ``abort`` is the documented recovery for exactly that state, and
+        any shard still attached fences on the tombstone's aborted
+        status at its next sync."""
 
         def _abort(rec: ParentRecord) -> ParentRecord:
             rec.status = PARENT_ABORTED
@@ -409,7 +574,42 @@ class ParentStore:
             rec.generation += 1
             return rec
 
-        return self.update(_abort)
+        try:
+            return self.update(_abort)
+        except ParentUnreadable as e:
+            log.warning(
+                "parent record %s/%s is unreadable (%s); replacing with "
+                "an aborted tombstone", self.namespace, self.name, e,
+            )
+            return self._entomb(reason)
+
+    def _entomb(self, reason: str) -> ParentRecord:
+        """CAS-overwrite an unparseable parent annotation with a minimal
+        aborted record. The aborted STATUS (checked before anything else
+        a shard could trust from a corrupt document) is the operative
+        fence here, not the generation."""
+        tomb = ParentRecord(
+            mode="?", selector="?", digest="discarded-corrupt",
+            max_unavailable=1, failure_budget=None,
+            status=PARENT_ABORTED,
+            halted_reason=f"{reason} (previous record unreadable)",
+        )
+        for _ in range(_CAS_ATTEMPTS):
+            lease = self._get_lease()
+            lease.setdefault("metadata", {}).setdefault("annotations", {})[
+                rollout_state.RECORD_ANNOTATION
+            ] = tomb.to_json()
+            try:
+                self._put_lease(lease)
+                return tomb
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+        raise KubeApiError(
+            None,
+            f"parent lease {self.namespace}/{self.name}: tombstone write "
+            "kept conflicting",
+        )
 
 
 class FederationGate:
@@ -428,6 +628,9 @@ class FederationGate:
         store: ParentStore,
         region: str,
         metrics=None,
+        offline_grace_s: float | None = None,
+        clock=time.monotonic,
+        wall=time.time,
     ) -> None:
         self.store = store
         self.region = region
@@ -435,17 +638,142 @@ class FederationGate:
         self.generation: int | None = None
         self.digest: str | None = None
         self.regions_total: int = 0
+        #: Heterogeneous per-region cap (None = global budget only).
+        self.region_budget: int | None = None
+        #: This shard's escrowed slice of the global budget — what it may
+        #: charge autonomously while the parent plane is dark. None when
+        #: the federation has no budget at all (nothing to escrow).
+        self.escrow_balance: int | None = None
+        #: The global spend union at the last SUCCESSFUL sync: anything
+        #: in the local record beyond this is dark spend still pending
+        #: reconciliation, charged against the escrow balance.
+        self.acked_spend: set[str] = set()
+        #: Cumulative spend this region itself charged (mirrors the
+        #: parent's per-region attribution).
+        self.charged: set[str] = set()
+        self.wall = wall
+        self.offline = OfflineTracker(
+            grace_s=(
+                offline_grace_s if offline_grace_s is not None
+                else federation_offline_grace_s()
+            ),
+            clock=clock,
+        )
+        self._was_engaged = False
 
     def attach(self, parent: ParentRecord) -> None:
-        """Adopt the parent's coordinates as this shard's fence token."""
+        """Adopt the parent's coordinates as this shard's fence token,
+        and CAS-reserve this region's attach-time escrow slice. A parent
+        plane already dark at attach leaves a provisional slice computed
+        from the last-seen snapshot (still bounded by the invariant —
+        the reservation lands on the first successful sync)."""
         self.generation = parent.generation
         self.digest = parent.digest
         self.regions_total = max(len(parent.regions), 1)
+        self.region_budget = parent.region_budgets.get(self.region)
+        self.acked_spend = set(parent.budget_spend)
+        self.charged = parent.region_charged(self.region)
+        if parent.failure_budget is None:
+            self.escrow_balance = None
+            return
+        try:
+            live = self.store.update(self._reserve_only)
+        except KubeApiError as e:
+            if not is_outage_error(e):
+                raise
+            self.offline.note_failure()
+            self.escrow_balance = self._escrow_target(
+                parent, self.charged, terminal=False
+            )
+            log.warning(
+                "region %s: parent plane dark at attach; provisional "
+                "escrow slice %s", self.region, self.escrow_balance,
+            )
+            return
+        self.offline.note_success()
+        self.escrow_balance = live.escrow.get(self.region, 0)
+        self.acked_spend = set(live.budget_spend)
+        self.charged = live.region_charged(self.region)
+
+    def _reserve_only(self, rec: ParentRecord) -> ParentRecord:
+        """Mutator for the attach-time reservation: fence checks plus
+        the escrow slice, no status/progress merge."""
+        self._guard(rec)
+        target = self._escrow_target(
+            rec, rec.region_charged(self.region) | self.charged,
+            terminal=False,
+        )
+        if target is not None:
+            rec.escrow[self.region] = target
+        return rec
+
+    def _escrow_target(
+        self, rec: ParentRecord, charged: set[str], terminal: bool
+    ) -> int | None:
+        """How much of the global budget this region should hold in
+        escrow right now. None when there is no budget (nothing to
+        bound); 0 for terminal regions (unused escrow returned). The
+        slice is the region's remaining heterogeneous allowance when one
+        is set, else a fair ceil-share of the remaining global budget —
+        always capped so len(budget_spend) + sum(escrow) never exceeds
+        failure_budget."""
+        if rec.failure_budget is None:
+            return None
+        if terminal:
+            return 0
+        others = sum(
+            v for r, v in rec.escrow.items() if r != self.region
+        )
+        spend = len(rec.budget_spend)
+        free = max(0, rec.failure_budget - spend - others)
+        rb = rec.region_budgets.get(self.region)
+        if rb is not None:
+            want = max(0, rb - len(charged))
+        else:
+            remaining = max(0, rec.failure_budget - spend)
+            want = -(-remaining // max(len(rec.regions) or 1, 1))
+        return min(want, free)
+
+    def _guard(self, rec: ParentRecord) -> None:
+        """The hierarchical fence checks every parent write runs behind:
+        generation advance (force-abort), aborted status, and plan
+        digest (an abort-and-recreate during a blackout must fence the
+        stale shard even if the new plan reset the generation)."""
+        if rec.generation > self.generation:
+            self._count("fenced")
+            if self.metrics is not None:
+                self.metrics.record_federation_fence("parent-generation")
+            raise rollout_state.RolloutFenced(
+                f"region {self.region}: parent generation "
+                f"{rec.generation} > attached {self.generation} "
+                "(force-aborted; this shard is fenced)"
+            )
+        if rec.status == PARENT_ABORTED:
+            self._count("fenced")
+            if self.metrics is not None:
+                self.metrics.record_federation_fence("parent-aborted")
+            raise rollout_state.RolloutFenced(
+                f"region {self.region}: federated rollout aborted "
+                f"({rec.halted_reason or 'no reason recorded'})"
+            )
+        if self.digest and rec.digest != self.digest:
+            self._count("fenced")
+            if self.metrics is not None:
+                self.metrics.record_federation_fence("parent-digest")
+            raise rollout_state.RolloutFenced(
+                f"region {self.region}: parent record belongs to a "
+                f"different rollout (digest {rec.digest} != attached "
+                f"{self.digest})"
+            )
 
     def to_record_dict(self) -> dict:
-        """What the regional RolloutRecord persists (format v5) so a
-        crash + ``--resume`` successor can reconnect to the parent."""
-        return {
+        """What the regional RolloutRecord persists so a crash +
+        ``--resume`` successor can reconnect to the parent. With a
+        budget in play this carries the escrow ledger (balance, acked
+        spend, attribution — format v6): a successor resuming WHILE the
+        parent is still dark must know exactly how much it may keep
+        charging."""
+        d = {
             "region": self.region,
             "regions": self.regions_total,
             "parent_namespace": self.store.namespace,
@@ -453,24 +781,65 @@ class FederationGate:
             "generation": self.generation,
             "digest": self.digest,
         }
+        if self.escrow_balance is not None:
+            d["escrow"] = int(self.escrow_balance)
+            d["acked_spend"] = sorted(self.acked_spend)
+            d["charged"] = sorted(self.charged)
+            if self.region_budget is not None:
+                d["region_budget"] = int(self.region_budget)
+        return d
 
     @classmethod
     def from_record_dict(
-        cls, api: KubeApi, fed: dict, metrics=None
+        cls, api: KubeApi, fed: dict, metrics=None,
+        offline_grace_s: float | None = None, clock=time.monotonic,
     ) -> "FederationGate":
         """Rebuild a shard's gate from its regional record's persisted
         ``federation`` field (the --resume path). The fence token is
         re-read from the LIVE parent — a resume is a new attachment, not
         a replay of the dead shard's token — but the digest must match:
         a parent that was aborted and recreated for a different plan
-        must refuse the stale regional record."""
+        must refuse the stale regional record.
+
+        When the parent plane is DARK (transport error) and the record
+        carries the escrow ledger, the gate resumes degraded from the
+        persisted ledger instead of refusing: a mid-blackout SIGKILL
+        must not wedge its successor. The first successful sync
+        re-validates the adopted token against the live parent."""
         store = ParentStore(
             api,
             namespace=str(fed.get("parent_namespace") or "") or None,
             name=str(fed.get("parent_name") or PARENT_LEASE_NAME),
         )
-        gate = cls(store, region=str(fed["region"]), metrics=metrics)
-        parent = store.load()
+        gate = cls(
+            store, region=str(fed["region"]), metrics=metrics,
+            offline_grace_s=offline_grace_s, clock=clock,
+        )
+        try:
+            parent = store.load()
+        except KubeApiError as e:
+            if not is_outage_error(e) or "escrow" not in fed:
+                raise
+            gate.generation = int(fed.get("generation") or 1)
+            gate.digest = str(fed.get("digest") or "") or None
+            gate.regions_total = max(int(fed.get("regions") or 1), 1)
+            gate.escrow_balance = (
+                int(fed["escrow"]) if fed.get("escrow") is not None else None
+            )
+            gate.acked_spend = {str(n) for n in fed.get("acked_spend") or []}
+            gate.charged = {str(n) for n in fed.get("charged") or []}
+            gate.region_budget = (
+                int(fed["region_budget"])
+                if fed.get("region_budget") is not None else None
+            )
+            gate.offline.note_failure()
+            log.warning(
+                "region %s: parent plane dark at resume; continuing "
+                "degraded on persisted escrow (balance=%s, pending "
+                "reconciliation=%d)", gate.region, gate.escrow_balance,
+                len(gate.charged - gate.acked_spend),
+            )
+            return gate
         if parent is None:
             raise rollout_state.RolloutFenced(
                 "regional record is federated but the parent record is "
@@ -494,6 +863,12 @@ class FederationGate:
         if self.metrics is not None:
             self.metrics.record_federation_sync(outcome)
 
+    @property
+    def degraded(self) -> bool:
+        """Whether this shard has declared parent-plane degraded mode
+        (dark past the offline grace)."""
+        return self._was_engaged
+
     def sync(
         self,
         spend,
@@ -506,83 +881,220 @@ class FederationGate:
         """One wave-boundary exchange with the parent.
 
         Pushes this region's budget spend (union-merged — exactly-once
-        under CAS races), status and progress; returns
-        ``{"spend": [global union], "halted": bool, "reason": ...}``.
-        Raises ``RolloutFenced`` when the parent generation has advanced
-        past this shard's token (force-abort) or the parent is aborted —
-        the wedged-shard self-fence."""
+        under CAS races), status and progress, re-reserves the escrow
+        slice; returns ``{"spend": [global union], "halted": bool,
+        "reason": ...}``. Raises ``RolloutFenced`` when the parent
+        generation has advanced past this shard's token (force-abort),
+        the parent is aborted, or the plan digest changed under it —
+        the wedged-shard self-fence.
+
+        TRANSPORT-level failures (the parent plane is dark) do not
+        raise: the shard answers itself from the escrow ledger — keep
+        rolling while dark spend stays within the escrowed slice, halt
+        ``escrow-exhausted`` the moment it would exceed it. The next
+        successful sync reconciles dark spend exactly-once (set union)
+        and returns unused escrow."""
         if self.generation is None:
             raise rollout_state.RolloutFenced(
                 "federation gate used before attach()"
             )
         regional_spend = sorted(set(spend))
+        # Dark spend still pending reconciliation: everything the local
+        # record charged since the last acknowledged global union.
+        # Between syncs the local record only grows by LOCAL charges
+        # (sibling spend arrives exclusively through the fold-down), so
+        # this difference is exactly this region's attribution delta.
+        pending = set(regional_spend) - self.acked_spend
+        terminal = status in (PARENT_COMPLETE, PARENT_HALTED)
+        regional_halt: dict = {"reason": None}
 
         def _merge(rec: ParentRecord) -> ParentRecord:
-            if rec.generation > self.generation:
-                self._count("fenced")
-                if self.metrics is not None:
-                    self.metrics.record_federation_fence("parent-generation")
-                raise rollout_state.RolloutFenced(
-                    f"region {self.region}: parent generation "
-                    f"{rec.generation} > attached {self.generation} "
-                    "(force-aborted; this shard is fenced)"
-                )
-            if rec.status == PARENT_ABORTED:
-                self._count("fenced")
-                if self.metrics is not None:
-                    self.metrics.record_federation_fence("parent-aborted")
-                raise rollout_state.RolloutFenced(
-                    f"region {self.region}: federated rollout aborted "
-                    f"({rec.halted_reason or 'no reason recorded'})"
-                )
+            regional_halt["reason"] = None
+            self._guard(rec)
             rec.charge_budget(regional_spend)
+            track = rec.failure_budget is not None
+            charged = None
+            if track:
+                charged = sorted(
+                    rec.region_charged(self.region) | self.charged | pending
+                )
             rec.note_region(
                 self.region, status, done, total,
                 generation=lease_generation,
+                charged=charged,
+                synced_at=self.wall(),
             )
-            if status == PARENT_HALTED and rec.status == PARENT_IN_PROGRESS:
-                rec.status = PARENT_HALTED
-                rec.halted_reason = halted_reason or (
-                    f"region {self.region} halted"
+            rb = rec.region_budgets.get(self.region)
+            if (
+                rb is not None and charged is not None
+                and len(charged) > rb and status != PARENT_HALTED
+            ):
+                regional_halt["reason"] = (
+                    f"region {self.region}: {REGION_BUDGET_REASON} "
+                    f"({len(charged)} > {rb})"
                 )
+            target = self._escrow_target(
+                rec, set(charged or []),
+                terminal=terminal or regional_halt["reason"] is not None,
+            )
+            if target is not None:
+                rec.escrow[self.region] = target
+            if status == PARENT_HALTED and rec.status == PARENT_IN_PROGRESS:
+                if halted_reason and any(
+                    r in halted_reason for r in _REGIONAL_ONLY_HALTS
+                ):
+                    # Regional-only halts (this region's escrow or
+                    # heterogeneous cap ran dry) stop THIS shard without
+                    # halting the federation: siblings' budgets are
+                    # untouched, so they keep rolling.
+                    pass
+                else:
+                    rec.status = PARENT_HALTED
+                    rec.halted_reason = halted_reason or (
+                        f"region {self.region} halted"
+                    )
             elif rec.all_complete and rec.status == PARENT_IN_PROGRESS:
                 rec.status = PARENT_COMPLETE
             return rec
 
-        parent = self.store.update(_merge)
+        try:
+            parent = self.store.update(_merge)
+        except KubeApiError as e:
+            if not is_outage_error(e):
+                raise
+            return self._offline_view(regional_spend, pending, status)
+        reconnected = self.offline.note_success()
+        self._was_engaged = False
+        self.acked_spend = set(parent.budget_spend)
+        if parent.failure_budget is not None:
+            self.charged = parent.region_charged(self.region)
+            self.escrow_balance = parent.escrow.get(self.region, 0)
+        else:
+            self.escrow_balance = None
         self._count("ok")
         if self.metrics is not None:
             self.metrics.set_federation_budget_spent(
                 len(parent.budget_spend)
             )
+            self.metrics.set_federation_offline_seconds(0.0)
+            if self.escrow_balance is not None:
+                self.metrics.set_federation_escrow(self.escrow_balance, 0)
         halted = parent.status == PARENT_HALTED and status != PARENT_HALTED
+        reason = parent.halted_reason if halted else None
+        if regional_halt["reason"] and status != PARENT_HALTED:
+            halted = True
+            reason = regional_halt["reason"]
         return {
             "spend": list(parent.budget_spend),
             "halted": halted,
-            "reason": parent.halted_reason if halted else None,
+            "reason": reason,
             "parent_status": parent.status,
+            "offline": False,
+            "degraded": False,
+            "offline_edge": False,
+            "reconnected": reconnected,
+            "escrow": self.escrow_balance,
+        }
+
+    def _offline_view(
+        self, regional_spend: list[str], pending: set[str], status: str
+    ) -> dict:
+        """The shard's self-answered sync while the parent plane is
+        dark: local union only, halt verdict strictly from the escrow
+        ledger. ``offline_edge`` flips True exactly once per outage, the
+        first sync past the grace — the caller's cue to journal
+        parent-offline and cross the parent-offline crash point."""
+        self.offline.note_failure()
+        engaged = self.offline.engaged
+        edge = engaged and not self._was_engaged
+        if edge:
+            self._was_engaged = True
+        self._count("offline")
+        if self.metrics is not None:
+            self.metrics.set_federation_offline_seconds(
+                self.offline.offline_seconds
+            )
+            if self.escrow_balance is not None:
+                self.metrics.set_federation_escrow(
+                    self.escrow_balance, len(pending)
+                )
+        halted = False
+        reason = None
+        terminal = status in (PARENT_COMPLETE, PARENT_HALTED)
+        if (
+            not terminal
+            and self.escrow_balance is not None
+            and len(pending) > self.escrow_balance
+        ):
+            # The regional remainder of a heterogeneous cap IS the
+            # escrow slice, so this one comparison covers both ledgers.
+            halted = True
+            reason = ESCROW_EXHAUSTED_REASON
+        if pending:
+            self.charged = self.charged | pending
+        return {
+            "spend": sorted(self.acked_spend | set(regional_spend)),
+            "halted": halted,
+            "reason": reason,
+            "parent_status": PARENT_OFFLINE,
+            "offline": True,
+            "degraded": engaged,
+            "offline_seconds": round(self.offline.offline_seconds, 3),
+            "offline_edge": edge,
+            "reconnected": False,
+            "escrow": self.escrow_balance,
+            "escrow_pending": len(pending),
         }
 
 
-def describe_parent(parent: ParentRecord | None) -> str:
+def describe_parent(
+    parent: ParentRecord | None, wall=time.time,
+    offline_grace_s: float | None = None,
+) -> str:
     """One operator-readable block for ``tpu-cc-ctl status`` /
-    ``rollout --regions`` output."""
+    ``rollout --regions`` output: global ledger, then per region its
+    progress, escrow balance/heterogeneous cap, and last-sync age (a
+    region silent past the offline grace is flagged STALE — the
+    parent-side view of a possibly-degraded shard). The age is display
+    only; fencing never reads it."""
     if parent is None:
         return "federation: no parent record"
+    grace = (
+        offline_grace_s if offline_grace_s is not None
+        else federation_offline_grace_s()
+    )
+    escrowed = sum(parent.escrow.values())
     lines = [
         f"federation: mode={parent.mode} status={parent.status} "
         f"gen={parent.generation} digest={parent.digest} "
         f"budget_spend={len(parent.budget_spend)}"
         + (f"/{parent.failure_budget}" if parent.failure_budget is not None
            else "")
+        + (f" escrowed={escrowed}" if parent.escrow else "")
     ]
     for name in sorted(parent.regions):
         r = parent.regions[name]
-        lines.append(
+        line = (
             f"  region {name}: {r.get('status')} "
             f"{r.get('done')}/{r.get('total')} group(s)"
             + (f" gen={r.get('generation')}" if r.get("generation") else "")
         )
+        if name in parent.region_budgets:
+            line += (
+                f" budget={len(parent.region_charged(name))}"
+                f"/{parent.region_budgets[name]}"
+            )
+        if name in parent.escrow:
+            line += f" escrow={parent.escrow[name]}"
+        synced_at = r.get("synced_at")
+        if synced_at is not None:
+            age = max(0.0, wall() - float(synced_at))
+            line += f" synced {age:.0f}s ago"
+            if grace > 0 and age >= grace and r.get("status") not in (
+                PARENT_COMPLETE, PARENT_HALTED,
+            ):
+                line += " (STALE — parent plane dark or shard dead?)"
+        lines.append(line)
     if parent.halted_reason:
         lines.append(f"  halted: {parent.halted_reason}")
     return "\n".join(lines)

@@ -200,6 +200,13 @@ CRASH_POINTS = (
     # budget propagation — the successor's --resume re-attaches to the
     # parent and the set-union spend merge keeps the charge exactly-once.
     "federation-boundary",
+    # Fired at the boundary sync where the shard FIRST recognizes the
+    # parent plane as offline (grace elapsed, degraded mode entered): a
+    # kill here models a regional orchestrator dying mid-blackout — the
+    # successor's --resume must re-enter degraded mode from the
+    # checkpointed escrow ledger without any parent round trip
+    # (federation.py FederationGate.from_record_dict dark path).
+    "parent-offline",
 )
 
 
@@ -834,15 +841,48 @@ class RollingReconfigurator:
             spend, status=status, done=done, total=total,
             halted_reason=halted_reason, lease_generation=self.generation,
         )
-        if record is not None and view["spend"]:
-            with self._record_lock:
+        with self._record_lock:
+            if record is not None and view["spend"]:
                 record.charge_budget(view["spend"])
+            if record is not None and record.federation is not None:
+                # Keep the checkpointed escrow ledger current: a SIGKILL
+                # after this boundary must resume with the balance/acked
+                # spend AS OF this sync, not as of attach (dark resume
+                # charges strictly against this snapshot).
+                record.federation = self.federation.to_record_dict()
         self._fl(
             flight_mod.EVENT_FEDERATION_SYNC,
             region=self.federation.region, wave=wave, window=window,
             status=status, spend=len(view["spend"]),
             parent_status=view["parent_status"],
         )
+        if view.get("offline_edge"):
+            # First boundary past the offline grace: the shard is now
+            # autonomous, charging against its escrow slice alone.
+            self._fl(
+                flight_mod.EVENT_PARENT_OFFLINE,
+                region=self.federation.region, wave=wave, window=window,
+                offline_seconds=round(view.get("offline_seconds") or 0.0, 3),
+                escrow=view.get("escrow"),
+            )
+            log.warning(
+                "region %s: parent plane offline past grace — degraded "
+                "mode, escrow balance %s",
+                self.federation.region, view.get("escrow"),
+            )
+            if boundary:
+                self._crash_point("parent-offline")
+        if view.get("reconnected"):
+            self._fl(
+                flight_mod.EVENT_PARENT_RECONNECT,
+                region=self.federation.region, wave=wave, window=window,
+                escrow=view.get("escrow"),
+            )
+            log.info(
+                "region %s: parent plane reconnected — dark spend "
+                "reconciled, escrow balance %s",
+                self.federation.region, view.get("escrow"),
+            )
         if view["halted"]:
             log.error(
                 "region %s: federation halt (%s) — stopping this shard",

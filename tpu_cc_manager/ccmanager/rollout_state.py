@@ -51,6 +51,7 @@ from tpu_cc_manager import labels as labels_mod
 from tpu_cc_manager.kubeclient.api import KubeApi, KubeApiError, WatchEvent
 from tpu_cc_manager.utils import metrics as metrics_mod
 from tpu_cc_manager.utils import locks as locks_mod
+from tpu_cc_manager.utils import retry as retry_mod
 
 log = logging.getLogger(__name__)
 
@@ -99,8 +100,17 @@ RECORD_HALTED = "halted"
 #: refused loudly by older parsers. A single-region federation is just a
 #: plain rollout and serializes <= v4, so it round-trips through the
 #: legacy resume path.
-RECORD_VERSION = 5
+#: 6: the ``federation`` dict gains the budget-escrow ledger (``escrow``
+#: balance, ``acked_spend``, ``charged`` — parent-plane partition
+#: tolerance), written ONLY when the federation has a failure budget to
+#: escrow. A v5 binary resuming an escrow-bearing slice would drop the
+#: ledger and keep charging while the parent plane is dark with no
+#: bound at all — the precise overspend the escrow exists to prevent —
+#: so v6 is refused loudly by escrow-unaware parsers; budgetless
+#: federated slices stay v5.
+RECORD_VERSION = 6
 #: What records WITHOUT the newer optional fields write (compat floors).
+RECORD_VERSION_NO_ESCROW = 5
 RECORD_VERSION_NO_FEDERATION = 4
 RECORD_VERSION_NO_SLO = 3
 RECORD_VERSION_NO_SURGE = 2
@@ -192,8 +202,13 @@ class RolloutRecord:
         federation = self.federation if (
             self.federation and int(self.federation.get("regions") or 0) > 1
         ) else None
-        if federation:
+        if federation and "escrow" in federation:
+            # The shard holds an escrow ledger (parent-plane partition
+            # tolerance): an escrow-unaware resume would keep charging
+            # unbounded while the parent is dark, so refuse downgrade.
             version = RECORD_VERSION
+        elif federation:
+            version = RECORD_VERSION_NO_ESCROW
         elif self.slo_gate:
             version = RECORD_VERSION_NO_FEDERATION
         elif self.surge:
@@ -388,12 +403,23 @@ class RolloutLease:
         metrics: metrics_mod.MetricsRegistry | None = None,
         wall=time.time,
         clock=time.monotonic,
+        max_clock_skew_s: float = 0.0,
     ) -> None:
         self.api = api
         self.holder = holder
         self.namespace = namespace or lease_namespace()
         self.name = name
         self.duration_s = max(0.001, duration_s)
+        # Cross-region skew tolerance. When > 0, a wall-clock "expired"
+        # verdict against another holder is never trusted directly —
+        # their renewTime was stamped by THEIR wall clock, and a skew of
+        # ±max_clock_skew_s can fabricate expiry on a healthy holder.
+        # Instead acquire() treats renewTime as an opaque token and
+        # observes it over one lease duration of LOCAL monotonic time:
+        # an alive holder must advance it in that window regardless of
+        # what either wall clock reads. 0 keeps the legacy wall-only
+        # verdict (single-cluster, one wall clock).
+        self.max_clock_skew_s = max(0.0, max_clock_skew_s)
         self.metrics = metrics if metrics is not None else metrics_mod.REGISTRY
         self.wall = wall
         self.clock = clock
@@ -464,8 +490,24 @@ class RolloutLease:
         spec = lease.get("spec") or {}
         prev_holder = spec.get("holderIdentity")
         expired, age = self._expired(spec)
-        if prev_holder and prev_holder != self.holder and not expired:
-            raise LeaseHeld(prev_holder, age)
+        if prev_holder and prev_holder != self.holder:
+            # A stamp more than 1 s in OUR future can only come from a
+            # skewed remote wall clock; wall math would keep a dead
+            # holder "live" until our clock catches up, so it is as
+            # suspect as an expired one.
+            future_stamp = age is not None and age < -1.0
+            if self.max_clock_skew_s > 0 and (expired or future_stamp):
+                # The wall clocks disagree about this holder (expired,
+                # or stamped from the future) — but their stamp came
+                # from a different region's clock, so neither verdict
+                # is trustworthy. Confirm skew-free before fencing:
+                # watch renewTime as an opaque token for one lease
+                # duration of LOCAL monotonic time. An alive holder
+                # must advance it; a dead one cannot.
+                lease = self._observe_holder(lease, prev_holder)
+                spec = lease.get("spec") or {}
+            elif not expired:
+                raise LeaseHeld(prev_holder, age)
         record = record_of_lease(lease)
         transitions = int(spec.get("leaseTransitions") or 0) + 1
         updated = copy.deepcopy(lease)
@@ -491,6 +533,42 @@ class RolloutLease:
         )
         self.metrics.record_lease_transition()
         return record
+
+    def _observe_holder(self, lease: dict, prev_holder: str) -> dict:
+        """Skew-free liveness check on another holder: poll the lease for
+        one lease duration of LOCAL monotonic time, treating renewTime +
+        leaseTransitions purely as an opaque change-token. Any change
+        (renewal, or a third party's takeover) proves a live writer →
+        :class:`LeaseHeld`; a token frozen for a full duration proves the
+        holder dead on ITS OWN terms (it must renew within its advertised
+        duration or self-fence) → return the last-seen lease so the
+        caller takes over. No wall clock is consulted."""
+        spec = lease.get("spec") or {}
+        token = (spec.get("renewTime"), spec.get("leaseTransitions"))
+        deadline = self.clock() + self.duration_s
+        poll = max(0.05, min(1.0, self.duration_s / 5.0))
+        while True:
+            remaining = deadline - self.clock()
+            if remaining <= 0:
+                return lease
+            retry_mod.wait(min(poll, remaining))
+            try:
+                lease = self.api.get_lease(self.namespace, self.name)
+            except KubeApiError as e:
+                if e.status == 404:
+                    # Holder (or an aborter) deleted it; acquire() has
+                    # already passed the 404 branch, so surface as a
+                    # held-then-released race for the caller to retry.
+                    raise LeaseHeld(
+                        f"{prev_holder!r} (lease deleted mid-observation)"
+                    ) from e
+                raise
+            spec = lease.get("spec") or {}
+            now_token = (spec.get("renewTime"), spec.get("leaseTransitions"))
+            if now_token != token:
+                raise LeaseHeld(
+                    spec.get("holderIdentity") or prev_holder,
+                )
 
     def _adopt(self, lease: dict, generation: int) -> None:  # cclint: requires(_lock)
         self._lease = lease

@@ -58,9 +58,11 @@ def build_parser() -> argparse.ArgumentParser:
         "adopts the persisted record's mode)",
     )
     r.add_argument(
-        "--max-unavailable", type=int, default=None,
+        "--max-unavailable", type=str, default=None,
         help="concurrent group budget (default 1; a resumed rollout "
-        "inherits the record's value unless this flag is passed)",
+        "inherits the record's value unless this flag is passed). With "
+        "--regions, accepts per-region overrides — '2,r2=3' caps r2 at "
+        "3 with every other region at 2",
     )
     r.add_argument("--node-timeout", type=float, default=600.0)
     r.add_argument("--continue-on-failure", action="store_true")
@@ -70,11 +72,14 @@ def build_parser() -> argparse.ArgumentParser:
         "desired mode (the failed group is left for the operator)",
     )
     r.add_argument(
-        "--failure-budget", type=int, default=None,
+        "--failure-budget", type=str, default=None,
         help="pool failure budget: halt (and refuse to start) when MORE "
         "than this many nodes are quarantined or already failed this "
         "rollout (pre-crash failures persist in the record) — a "
-        "fleet-level circuit breaker (default: no budget)",
+        "fleet-level circuit breaker (default: no budget). With "
+        "--regions, accepts heterogeneous per-region budgets — "
+        "'r1=2,r2=5' (every region must be named; the global budget is "
+        "their sum, and a region halts alone at its own cap)",
     )
     r.add_argument(
         "--wave-shards", type=int, default=None,
@@ -224,7 +229,11 @@ def build_parser() -> argparse.ArgumentParser:
         "orchestrator shard per region, each with its own rollout "
         "lease and its own regional slice of ONE federated record; "
         "--failure-budget and --max-unavailable are GLOBAL (spent "
-        "across all regions via the CAS-fenced parent record). "
+        "across all regions via the CAS-fenced parent record) unless "
+        "given per-region overrides (see their help). "
+        "'r1=ctx1,r2=ctx2' drives each region through a named "
+        "kubeconfig context — a real multi-cluster federation, with "
+        "the parent record on the default cluster. "
         "--resume resumes every region's slice; --abort force-aborts "
         "the whole federation (live shards self-fence on their next "
         "parent sync)",
@@ -398,6 +407,84 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_regions(spec: str) -> tuple[list[str], dict[str, str]]:
+    """``--regions`` syntax: ``r1,r2`` (shards over one cluster, region-
+    sliced selectors) or ``r1=ctx1,r2=ctx2`` (one kubeconfig context per
+    region — a real multi-cluster federation). All-or-nothing on the
+    contexts: half a federation silently sharing the local cluster is
+    exactly the mixup the explicit form exists to prevent."""
+    regions: list[str] = []
+    contexts: dict[str, str] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        region, sep, ctx = entry.partition("=")
+        region = region.strip()
+        if not region:
+            raise ValueError(f"--regions: bad entry {entry!r}")
+        regions.append(region)
+        if sep:
+            if not ctx.strip():
+                raise ValueError(
+                    f"--regions: empty kubeconfig context for {region!r}"
+                )
+            contexts[region] = ctx.strip()
+    if len(regions) != len(set(regions)):
+        raise ValueError("--regions: duplicate region names")
+    if contexts and len(contexts) != len(regions):
+        missing = sorted(set(regions) - set(contexts))
+        raise ValueError(
+            "--regions: kubeconfig contexts must be given for EVERY "
+            f"region or none (missing: {', '.join(missing)})"
+        )
+    return regions, contexts
+
+
+def _parse_per_region_int(
+    spec: str | None, flag: str, regions: list[str],
+) -> tuple[int | None, dict[str, int]]:
+    """Per-region integer flag syntax (``--failure-budget``,
+    ``--max-unavailable`` under ``--regions``): a bare ``N`` is the
+    default for every region, ``r=N`` overrides one. Returns
+    ``(default, per_region)``; unknown region names are refused."""
+    if spec is None:
+        return None, {}
+    default: int | None = None
+    per: dict[str, int] = {}
+    for entry in str(spec).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        region, sep, value = entry.partition("=")
+        if not sep:
+            if default is not None:
+                raise ValueError(f"{flag}: more than one bare value")
+            default = int(entry)
+            continue
+        region = region.strip()
+        if region not in regions:
+            raise ValueError(
+                f"{flag}: unknown region {region!r} (not in --regions)"
+            )
+        if region in per:
+            raise ValueError(f"{flag}: duplicate region {region!r}")
+        per[region] = int(value)
+    return default, per
+
+
+def _plain_int_flag(value, flag: str) -> int | None:
+    """Non-federated rollouts take these flags as plain integers; the
+    per-region ``r=N`` syntax only means something under ``--regions``."""
+    if value is None or isinstance(value, int):
+        return value
+    if "=" in str(value) or "," in str(value):
+        raise ValueError(
+            f"{flag}: per-region syntax ({value!r}) requires --regions"
+        )
+    return int(value)
+
+
 def _abort_rollout(api, namespace: str | None, force: bool = False) -> int:
     """Release the rollout lease and discard its record. Safe against the
     pool: desired labels already written stay written and the node agents
@@ -454,6 +541,14 @@ def cmd_rollout(api, args) -> int:
         # held lease behind that blocks the corrected retry for a whole
         # lease duration.
         raise ValueError(f"invalid CC mode {mode!r} (valid: {VALID_MODES})")
+    # Same pre-lease discipline for the flag syntax: the per-region
+    # ``r=N`` form is only valid under --regions (handled above).
+    args.failure_budget = _plain_int_flag(
+        getattr(args, "failure_budget", None), "--failure-budget"
+    )
+    args.max_unavailable = _plain_int_flag(
+        getattr(args, "max_unavailable", None), "--max-unavailable"
+    )
     resume_requested = getattr(args, "resume", False)
     if resume_requested and getattr(args, "no_lease", False):
         # Contradictory: resume reads the record checkpointed in the
@@ -817,6 +912,78 @@ def cmd_rollout(api, args) -> int:
     return 0 if result.ok else 1
 
 
+def _abort_federated(
+    api, store, regions, region_apis, lease_namespace,
+    federation_mod, rollout_state,
+) -> int:
+    """``rollout --regions ... --abort``: discard the parent record (live
+    shards self-fence at their next sync) and force-release every
+    regional lease. Partition-hardened on purpose: a corrupt parent is
+    entombed, not a traceback, and a transport error against the parent
+    plane must NOT strand the regional leases — they are released
+    regardless, each on its own cluster when per-region contexts are in
+    play."""
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    known_regions = set(regions)
+    aborted = None
+    unreadable = False
+    parent_error: Exception | None = None
+    try:
+        parent = store.load()
+    except federation_mod.ParentUnreadable as e:
+        log.warning(
+            "--abort --regions: parent record unreadable (%s); "
+            "discarding it", e,
+        )
+        parent = None
+        unreadable = True
+    except KubeApiError as e:
+        parent = None
+        parent_error = e
+    if parent is not None:
+        known_regions |= set(parent.regions)
+    if parent is None and not unreadable and parent_error is None:
+        log.error("--abort --regions: no federated parent record")
+        return 1
+    if parent_error is None:
+        try:
+            aborted = store.abort()
+        except KubeApiError as e:
+            parent_error = e
+    released: list[str] = []
+    for region in sorted(known_regions):
+        try:
+            rollout_state.release_lease(
+                region_apis.get(region, api),
+                lease_namespace or rollout_state.lease_namespace(),
+                name=federation_mod.regional_lease_name(region),
+            )
+            released.append(region)
+        except KubeApiError as e:
+            log.warning(
+                "--abort --regions: could not release the %s regional "
+                "lease (%s); it expires on its own after the lease "
+                "duration", region, e,
+            )
+    if parent_error is not None:
+        log.error(
+            "--abort --regions: the parent plane is unreachable (%s). "
+            "Regional leases released: %s. Re-run --abort once the "
+            "parent apiserver is back so live shards fence at their "
+            "next sync", parent_error, ", ".join(released) or "none",
+        )
+        return 1
+    if aborted is None:
+        log.error("--abort --regions: abort did not complete")
+        return 1
+    log.warning(
+        "federated rollout aborted (generation now %d); every live "
+        "shard is fenced at its next parent sync", aborted.generation,
+    )
+    return 0
+
+
 def _rollout_federated(api, args) -> int:
     """``rollout --regions r1,r2,...``: one regional orchestrator shard
     per region (own lease, own flight file, own regional slice of the
@@ -835,42 +1002,61 @@ def _rollout_federated(api, args) -> int:
     from tpu_cc_manager.labels import canonical_mode
     from tpu_cc_manager.obs import flight as flight_mod
 
-    regions = [r.strip() for r in args.regions.split(",") if r.strip()]
-    if len(regions) != len(set(regions)):
-        raise ValueError("--regions: duplicate region names")
+    regions, region_contexts = _parse_regions(args.regions)
     if getattr(args, "no_lease", False):
         raise ValueError(
             "--regions cannot run --no-lease: the federation IS the "
             "fencing hierarchy"
         )
     lease_namespace = getattr(args, "lease_namespace", None)
+    # Per-region kubeconfig contexts: each shard drives ITS cluster while
+    # the parent record stays on the default one — the coordination plane
+    # and the data planes are different apiservers, which is exactly the
+    # partition SCALE_r04 drills.
+    region_apis: dict[str, object] = {}
+    if region_contexts:
+        from tpu_cc_manager.kubeclient.rest import ClusterConfig, RestKube
+
+        for region, ctx in region_contexts.items():
+            region_apis[region] = RestKube(
+                ClusterConfig.load(args.kubeconfig, context=ctx)
+            )
     store = federation_mod.ParentStore(api, namespace=lease_namespace)
     if getattr(args, "abort_rollout", False):
-        parent = store.load()
-        if parent is None:
-            log.error("--abort --regions: no federated parent record")
-            return 1
-        aborted = store.abort()
-        # Live shards self-fence on their next parent sync (the abort
-        # bumped the generation); their regional leases/records are
-        # force-released so a fresh federation can start immediately.
-        for region in sorted(set(regions) | set(parent.regions)):
-            rollout_state.release_lease(
-                api,
-                lease_namespace or rollout_state.lease_namespace(),
-                name=federation_mod.regional_lease_name(region),
-            )
-        log.warning(
-            "federated rollout aborted (generation now %d); every live "
-            "shard is fenced at its next parent sync", aborted.generation,
+        return _abort_federated(
+            api, store, regions, region_apis, lease_namespace,
+            federation_mod, rollout_state,
         )
-        return 0
     mode = canonical_mode(args.mode) if getattr(args, "mode", None) else None
     if mode is not None and mode not in VALID_MODES:
         raise ValueError(f"invalid CC mode {mode!r} (valid: {VALID_MODES})")
     resume_requested = getattr(args, "resume", False)
-    failure_budget = getattr(args, "failure_budget", None)
-    max_unavailable = getattr(args, "max_unavailable", None)
+    fb_default, region_budgets = _parse_per_region_int(
+        getattr(args, "failure_budget", None), "--failure-budget", regions
+    )
+    if region_budgets and fb_default is not None:
+        # '3,r2=5' is ambiguous — is the global budget 3, or the sum?
+        # Heterogeneous budgets name every region; the global is their
+        # sum by construction.
+        raise ValueError(
+            "--failure-budget: cannot mix a bare global value with "
+            "per-region budgets"
+        )
+    if region_budgets and set(region_budgets) != set(regions):
+        missing = sorted(set(regions) - set(region_budgets))
+        raise ValueError(
+            "--failure-budget: per-region budgets must name EVERY "
+            f"region (missing: {', '.join(missing)})"
+        )
+    failure_budget = (
+        sum(region_budgets.values()) if region_budgets else fb_default
+    )
+    mu_default, region_max_unavailable = _parse_per_region_int(
+        getattr(args, "max_unavailable", None), "--max-unavailable", regions
+    )
+    max_unavailable = mu_default
+    flags_budget_given = getattr(args, "failure_budget", None) is not None
+    flags_mu_given = getattr(args, "max_unavailable", None) is not None
     if resume_requested:
         existing = store.load()
         if existing is None:
@@ -879,10 +1065,12 @@ def _rollout_federated(api, args) -> int:
         # The parent carries the dead federation's settings; explicit
         # flags still win (same inheritance rule as a regional resume).
         mode = mode or existing.mode
-        if failure_budget is None:
+        if not flags_budget_given:
             failure_budget = existing.failure_budget
-        if max_unavailable is None:
+            region_budgets = dict(existing.region_budgets)
+        if not flags_mu_given:
             max_unavailable = existing.max_unavailable
+            region_max_unavailable = dict(existing.region_max_unavailable)
     if mode is None:
         raise ValueError("--mode is required (unless --resume)")
     if max_unavailable is None:
@@ -892,6 +1080,8 @@ def _rollout_federated(api, args) -> int:
             mode, args.selector, regions,
             max_unavailable=max_unavailable,
             failure_budget=failure_budget,
+            region_budgets=region_budgets or None,
+            region_max_unavailable=region_max_unavailable or None,
         ),
         resume=resume_requested,
     )
@@ -900,11 +1090,16 @@ def _rollout_federated(api, args) -> int:
     flight_files: dict[str, str] = {}
 
     def run_region(region: str) -> None:
-        regional_selector = federation_mod.regional_selector(
-            args.selector, region
+        rapi = region_apis.get(region, api)
+        # With a per-region cluster the WHOLE pool there belongs to the
+        # region — slicing by the topology label would select nothing on
+        # clusters that don't stamp it.
+        regional_selector = (
+            args.selector if region in region_apis
+            else federation_mod.regional_selector(args.selector, region)
         )
         lease = rollout_state.RolloutLease(
-            api,
+            rapi,
             holder=f"{_socket.gethostname()}-{_os.getpid()}-{region}",
             namespace=lease_namespace,
             name=federation_mod.regional_lease_name(region),
@@ -938,7 +1133,15 @@ def _rollout_federated(api, args) -> int:
                 return
             resume_record = record
         gate = federation_mod.FederationGate(store, region)
-        gate.attach(parent)
+        try:
+            gate.attach(parent)
+        except rollout_state.RolloutFenced as e:
+            log.error(
+                "region %s: parent refused the attachment (%s)", region, e,
+            )
+            lease.release()
+            results[region] = None
+            return
         flight = None
         if not getattr(args, "no_flight", False):
             flight = flight_mod.FlightRecorder(
@@ -956,12 +1159,18 @@ def _rollout_federated(api, args) -> int:
         result = None
         try:
             roller = RollingReconfigurator(
-                api,
+                rapi,
                 regional_selector,
-                max_unavailable=max_unavailable,
+                max_unavailable=region_max_unavailable.get(
+                    region, max_unavailable
+                ),
                 node_timeout_s=args.node_timeout,
                 continue_on_failure=args.continue_on_failure,
                 rollback_on_failure=args.rollback_on_failure,
+                # The GLOBAL budget: a region's own cap (region_budgets)
+                # is enforced by the gate at every parent sync, so one
+                # blown region halts alone while the federation's total
+                # spend still stops everyone.
                 failure_budget=failure_budget,
                 lease=lease,
                 resume_record=resume_record,
@@ -1260,14 +1469,25 @@ def cmd_status(api, args) -> int:
     if rollout_line:
         print(rollout_line)
     # Federated rollouts: when a parent record exists, show the global
-    # view (per-region status, global budget spend) above the node
-    # table — the first thing to read when one region looks stuck.
+    # view (per-region status + escrow balances, global budget spend,
+    # last-sync staleness) above the node table — the first thing to
+    # read when one region looks stuck or the parent plane was dark.
     try:
         from tpu_cc_manager.ccmanager import federation as federation_mod
 
-        parent = federation_mod.ParentStore(
-            api, namespace=getattr(args, "lease_namespace", None)
-        ).load()
+        try:
+            parent = federation_mod.ParentStore(
+                api, namespace=getattr(args, "lease_namespace", None)
+            ).load()
+        except federation_mod.ParentUnreadable as e:
+            # A corrupt parent must read as an actionable line, not a
+            # traceback or a silently missing block.
+            print(
+                "FEDERATION parent record UNREADABLE "
+                f"({e}); `tpu-cc-ctl rollout --regions ... --abort` "
+                "discards it"
+            )
+            parent = None
         if parent is not None:
             print(federation_mod.describe_parent(parent))
     except Exception as e:  # noqa: BLE001 - status stays best-effort

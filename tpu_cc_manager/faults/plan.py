@@ -303,6 +303,24 @@ class FaultPlan:
         )
         backend.set_preempted(True)
 
+    def seed_blackout_window(self) -> int:
+        """Open ONE total-outage window unconditionally, its length in
+        API calls drawn from the derived blackout stream (acceptance
+        drills — SCALE_r04's parent-plane blackout — need the scenario,
+        not the odds; the LENGTH stays a pure function of the seed so
+        the drill replays exactly). Recorded in the injected schedule
+        like a drawn window. Returns the window length armed."""
+        span = self._blackout_rng.randint(
+            self.blackout_min_calls, max(self.blackout_min_calls,
+                                         self.blackout_max_calls)
+        )
+        self._seq += 1
+        self.begin_blackout(calls=span)
+        self.injected.append(
+            Fault(kind=BLACKOUT_KIND, op="seeded-window", seq=self._seq)
+        )
+        return span
+
     def seed_terminal_backend_fault(self, backend, ops: tuple[str, ...]) -> str:
         """Arm one TERMINAL device fault (``times=-1``: never clears) on an
         op drawn from the seeded stream — the chaos mode that drives the

@@ -69,8 +69,13 @@ class ClusterConfig:
         )
 
     @classmethod
-    def from_kubeconfig(cls, path: str | None = None) -> "ClusterConfig":
-        """Parse the current-context of a kubeconfig file.
+    def from_kubeconfig(
+        cls, path: str | None = None, context: str | None = None,
+    ) -> "ClusterConfig":
+        """Parse one context of a kubeconfig file — ``context`` names it,
+        None means the file's current-context. Per-region federation
+        shards (``--regions r1=ctx1,...``) select their cluster this way
+        from a single shared kubeconfig.
 
         Supports token, client-certificate(-data)/client-key(-data), and
         insecure-skip-tls-verify — the auth shapes kind and GKE emit.
@@ -87,7 +92,7 @@ class ClusterConfig:
                     return item.get(section.rstrip("s")) or {}
             raise KubeApiError(None, f"kubeconfig: {section} entry {name!r} not found")
 
-        ctx_name = cfg.get("current-context")
+        ctx_name = context or cfg.get("current-context")
         if not ctx_name:
             raise KubeApiError(None, "kubeconfig: no current-context")
         ctx = by_name("contexts", ctx_name)
@@ -117,8 +122,21 @@ class ClusterConfig:
         )
 
     @classmethod
-    def load(cls, kubeconfig: str | None = None) -> "ClusterConfig":
-        """In-cluster first, kubeconfig fallback (reference main.py:129-140)."""
+    def load(
+        cls, kubeconfig: str | None = None, context: str | None = None,
+    ) -> "ClusterConfig":
+        """In-cluster first, kubeconfig fallback (reference main.py:129-140).
+        A named ``context`` skips the in-cluster probe outright: asking
+        for a specific cluster and silently getting the local one is
+        exactly the cross-region mixup per-region contexts exist to
+        prevent."""
+        if context:
+            cfg = cls.from_kubeconfig(kubeconfig, context=context)
+            log.info(
+                "using kubeconfig at %s (context %s)",
+                kubeconfig or "<default>", context,
+            )
+            return cfg
         try:
             cfg = cls.in_cluster()
             log.info("using in-cluster kubernetes configuration")

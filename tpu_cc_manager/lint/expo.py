@@ -256,7 +256,11 @@ def _seeded_registry_text() -> str:
     registry.record_federation_sync("ok")
     registry.record_federation_sync('odd"outcome\nhere')
     registry.record_federation_fence("parent-generation")
+    registry.record_federation_fence('odd"reason\nhere')
     registry.set_federation_budget_spent(7)
+    # Parent-plane partition tolerance (escrowed degraded mode).
+    registry.set_federation_offline_seconds(12.5)
+    registry.set_federation_escrow(3, 1)
     # Apiserver-outage autonomy families (ccmanager/intent_journal.py).
     registry.set_apiserver_connected(False)
     registry.set_offline_seconds(93.5)

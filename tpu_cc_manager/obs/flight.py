@@ -83,6 +83,16 @@ EVENT_SPARE_PRESTAGED = "spare-prestaged"
 #: stitched cross-region timeline uses these to show WHEN each region
 #: learned of a sibling's budget charges or a global halt.
 EVENT_FEDERATION_SYNC = "federation-sync"
+#: Parent-plane partition tolerance (ccmanager/federation.py escrow):
+#: journaled once per outage edge. ``parent-offline`` fires when a
+#: shard's boundary syncs have hit transport errors past
+#: CC_FEDERATION_OFFLINE_GRACE_S and it enters degraded mode (waves now
+#: charge strictly against the local escrow); ``parent-reconnect`` fires
+#: when the next sync lands and the dark spend reconciles exactly-once
+#: into the parent. The stitched timeline uses the pair to bracket how
+#: long each region ran autonomously.
+EVENT_PARENT_OFFLINE = "parent-offline"
+EVENT_PARENT_RECONNECT = "parent-reconnect"
 
 #: Node-terminal events: the exactly-once reconstruction keys on these
 #: (a node converges/fails/retires once per rollout, crash+resume

@@ -213,6 +213,12 @@ class MetricsRegistry:
         self._federation_sync_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
         self._federation_fence_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
         self._federation_budget_spent: int | None = None  # cclint: guarded-by(_lock)
+        # Parent-plane partition tolerance: how long the current parent
+        # blackout has lasted (0 when connected), this shard's escrowed
+        # budget slice, and how much dark spend is pending reconciliation.
+        self._federation_offline_seconds: float | None = None  # cclint: guarded-by(_lock)
+        self._federation_escrow_reserved: int | None = None  # cclint: guarded-by(_lock)
+        self._federation_escrow_spent: int | None = None  # cclint: guarded-by(_lock)
         # Apiserver-outage autonomy (ccmanager/intent_journal.py): live
         # connectivity, how long the current outage has lasted, intent-
         # journal replays by outcome, and deferred label patches.
@@ -396,6 +402,21 @@ class MetricsRegistry:
         parent record."""
         with self._lock:
             self._federation_budget_spent = max(0, int(count))
+
+    def set_federation_offline_seconds(self, seconds: float) -> None:
+        """Record how long the current PARENT-plane blackout has lasted
+        for this regional shard (0 when the last parent sync landed)."""
+        with self._lock:
+            self._federation_offline_seconds = max(0.0, float(seconds))
+
+    def set_federation_escrow(self, reserved: int, spent: int) -> None:
+        """Record this shard's escrow ledger: the budget slice reserved
+        on the parent for autonomous degraded-mode spending, and how
+        many dark charges are pending reconciliation against it (0 once
+        a reconnect sync union-merges them into the global ledger)."""
+        with self._lock:
+            self._federation_escrow_reserved = max(0, int(reserved))
+            self._federation_escrow_spent = max(0, int(spent))
 
     def set_apiserver_connected(self, connected: bool) -> None:
         """Record whether the last apiserver interaction succeeded (the
@@ -709,6 +730,9 @@ class MetricsRegistry:
             federation_syncs = dict(self._federation_sync_totals)
             federation_fences = dict(self._federation_fence_totals)
             federation_budget_spent = self._federation_budget_spent
+            federation_offline_seconds = self._federation_offline_seconds
+            federation_escrow_reserved = self._federation_escrow_reserved
+            federation_escrow_spent = self._federation_escrow_spent
             apiserver_connected = self._apiserver_connected
             offline_seconds = self._offline_seconds
             journal_replays = dict(self._journal_replay_totals)
@@ -885,6 +909,40 @@ class MetricsRegistry:
             lines.append("# TYPE tpu_cc_federation_budget_spent gauge")
             lines.append(
                 "tpu_cc_federation_budget_spent %d" % federation_budget_spent
+            )
+        if federation_offline_seconds is not None:
+            lines.append(
+                "# HELP tpu_cc_federation_offline_seconds How long the "
+                "current PARENT-plane blackout has lasted for this "
+                "regional shard (0 when the last parent sync landed; "
+                "degraded mode engages past CC_FEDERATION_OFFLINE_GRACE_S)."
+            )
+            lines.append("# TYPE tpu_cc_federation_offline_seconds gauge")
+            lines.append(
+                "tpu_cc_federation_offline_seconds %.3f"
+                % federation_offline_seconds
+            )
+        if federation_escrow_reserved is not None:
+            lines.append(
+                "# HELP tpu_cc_federation_escrow_reserved This shard's "
+                "escrowed slice of the global failure budget — what it "
+                "may charge autonomously while the parent plane is dark."
+            )
+            lines.append("# TYPE tpu_cc_federation_escrow_reserved gauge")
+            lines.append(
+                "tpu_cc_federation_escrow_reserved %d"
+                % federation_escrow_reserved
+            )
+        if federation_escrow_spent is not None:
+            lines.append(
+                "# HELP tpu_cc_federation_escrow_spent Dark charges "
+                "pending reconciliation against the escrowed slice (0 "
+                "once a reconnect sync union-merges them into the global "
+                "ledger)."
+            )
+            lines.append("# TYPE tpu_cc_federation_escrow_spent gauge")
+            lines.append(
+                "tpu_cc_federation_escrow_spent %d" % federation_escrow_spent
             )
         if apiserver_connected is not None:
             lines.append(
