@@ -39,8 +39,11 @@
 # regional-rollout leg (FEDERATION_SUMMARY: seeded mid-rollout regional
 # orchestrator kill + successor resume, then a regional apiserver
 # blackout that stalls only its own region — parent record completes
-# with exactly-once budget accounting) so the evidence ladder can cite
-# them.
+# with exactly-once budget accounting), and the continuous-prestage
+# crash leg (PRESTAGE_SUMMARY: a seeded SIGKILL lands mid-prestage of
+# wave N+1 while wave N drains; successors resume BOTH waves, the
+# capacity ledger balances to zero with no double-charge, no node lost
+# or double-bounced) so the evidence ladder can cite them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -73,7 +76,11 @@ mkdir -p "$(dirname "$OUT")" artifacts
 # test_federation.py carries the federated regional-rollout leg (seeded
 # regional kill + resume, regional apiserver blackout, exactly-once
 # shared budget) — FEDERATION_SUMMARY lines.
-PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py tests/test_flight.py tests/test_obs_fleet.py tests/test_federation.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
+# test_prestage_ledger.py carries the continuous-prestage crash leg
+# (seeded orchestrator SIGKILL mid-prestage of wave N+1 while wave N
+# drains; dual-wave resume, ledger balanced, no double-charge) —
+# PRESTAGE_SUMMARY lines.
+PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py tests/test_flight.py tests/test_obs_fleet.py tests/test_federation.py tests/test_prestage_ledger.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
 if [ "$TERMINAL" = "0" ]; then
   PYTEST_ARGS+=(--deselect \
     "tests/test_chaos.py::test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts")
@@ -105,7 +112,8 @@ for i in $(seq 0 $((ITERS - 1))); do
   obs=$(grep -ao "OBS_SUMMARY.*" "$log" | tail -1 | sed "s/^OBS_SUMMARY //; s/'/ /g; s/\"/ /g")
   fleet=$(grep -ao "FLEET_SUMMARY.*" "$log" | tail -1 | sed "s/^FLEET_SUMMARY //; s/'/ /g; s/\"/ /g")
   federation=$(grep -ao "FEDERATION_SUMMARY.*" "$log" | tail -1 | sed "s/^FEDERATION_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"handoff\": \"${handoff}\", \"obs\": \"${obs}\", \"fleet\": \"${fleet}\", \"federation\": \"${federation}\"}")
+  prestage=$(grep -ao "PRESTAGE_SUMMARY.*" "$log" | tail -1 | sed "s/^PRESTAGE_SUMMARY //; s/'/ /g; s/\"/ /g")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"handoff\": \"${handoff}\", \"obs\": \"${obs}\", \"fleet\": \"${fleet}\", \"federation\": \"${federation}\", \"prestage\": \"${prestage}\"}")
 done
 
 {

@@ -50,6 +50,7 @@ ARTIFACTS=(
   SERVE_r02.json
   SERVE_r03.json
   BENCH_r08.json
+  BENCH_r09.json
   artifacts/smoke_cache_r06.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
@@ -317,6 +318,28 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s BENCH_r08.json ] && mv BENCH_r08.json artifacts/BENCH_r08.failed.json
     echo ">>> spare-prestage bench FAILED; stopping ladder (summary in artifacts/BENCH_r08.failed.json)"
+    finish
+  }
+fi
+
+# Whole-fleet zero-bounce evidence (ROADMAP item, BENCH_r09): a 10-node
+# rolling flip under open-loop traffic at 80 % of the knee with
+# CONTINUOUS prestage under the crash-journaled capacity ledger —
+# every node's effective flip wall <= its drain+readmit bar, zero
+# prestage-attributable SLO pauses, zero lost requests, a no-prestage
+# control leg whose walls exceed the bar, and a seeded mid-prestage
+# orchestrator SIGKILL resumed with the ledger balancing to zero and
+# no double-charge. CPU-only, single point, same skip/park discipline.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("BENCH_r09.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> BENCH_r09.json already captured (ok:true); skipping"
+else
+  echo "=== stage: serve-bench --prestage (fleet zero-bounce, no tunnel) ==="
+  python3 hack/serve_bench.py --prestage --nodes 10 \
+      --partial artifacts/serve_prestage_sweep_partial.jsonl \
+      --out BENCH_r09.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s BENCH_r09.json ] && mv BENCH_r09.json artifacts/BENCH_r09.failed.json
+    echo ">>> fleet-prestage bench FAILED; stopping ladder (summary in artifacts/BENCH_r09.failed.json; partial sweep rows kept for resume)"
     finish
   }
 fi

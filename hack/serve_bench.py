@@ -8,6 +8,20 @@ rolling CC flip runs mid-traffic (tpu_cc_manager/serve/). The line
 reports p50/p99 latency and error rate DURING the rollout vs steady
 state, and the headline claim: ``requests_lost_per_node_bounced`` == 0.
 
+**--prestage (BENCH_r09)**: the whole-fleet zero-bounce artifact.
+Finds the knee with the SERVE_r02 sweep machinery, then runs a 10-node
+rolling flip under open-loop traffic at ``--knee-frac`` (80 %) of it
+with CONTINUOUS prestage on: the orchestrator's capacity ledger
+CAS-reserves knee-slack headroom (``headroom_gate_from_source`` reading
+the harness's live ``tpu_cc_serve_offered_rps``) and prestages wave
+N+1 while wave N flips. Gates: every node's effective flip wall (its
+window-close seconds) ≤ that node's drain+readmit bar, zero
+prestage-attributable SLO pauses, zero lost requests — plus a control
+leg (same pool, no prestage) whose walls must exceed the bar, and a
+crash leg where a seeded SIGKILL lands mid-prestage of wave N+1
+(FaultPlan ``seed_prestage_kill``) and the successor resumes BOTH
+waves with the ledger balancing to zero and no node double-charged.
+
 **--sweep (SERVE_r02)**: the open-loop overload artifact. A resumable
 rate sweep (seeded Poisson arrivals, per-request deadlines, admission
 control) finds the KNEE — the last rate where goodput tracks offered
@@ -199,6 +213,302 @@ def run_handoff(args, executor_factory, calibration) -> dict:
     }
 
 
+def _flight_node_walls(flight_path: str) -> dict[str, float]:
+    """Per-node EFFECTIVE flip wall from the rollout flight timeline:
+    each node is assigned the wave-0 window its desired-patch landed in,
+    and charged that window's close seconds. With continuous prestage a
+    held node's window closes as fast as the convergence poll — the
+    reset/boot cost was paid off-wave — which is exactly the number the
+    BENCH_r09 bar compares against drain+readmit."""
+    window_s: dict[int, float] = {}
+    node_window: dict[str, int] = {}
+    if not os.path.exists(flight_path):
+        return {}
+    with open(flight_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("wave") != 0:
+                continue
+            if e.get("event") == "node-desired-patch":
+                node_window[e["node"]] = e.get("window")
+            elif e.get("event") == "window-close":
+                window_s[e.get("window")] = float(e.get("seconds") or 0.0)
+    return {
+        node: window_s[w]
+        for node, w in node_window.items() if w in window_s
+    }
+
+
+def _drain_readmit_bar(metrics_text: str) -> float | None:
+    """One node's drain+readmit bar from its agent registry: the mean
+    drain phase latency plus the mean readmit phase latency (parsed
+    from the ``tpu_cc_phase_seconds`` histogram's _sum/_count series).
+    None until the agent has run both phases."""
+    import re
+
+    bar = 0.0
+    for phase in ("drain", "readmit"):
+        total = count = 0.0
+        for kind in ("sum", "count"):
+            pat = (
+                r"tpu_cc_phase_seconds_%s\{(?=[^}]*phase=\"%s\")[^}]*\}"
+                r"\s+([0-9.eE+-]+)" % (kind, phase)
+            )
+            acc = sum(float(m) for m in re.findall(pat, metrics_text))
+            if kind == "sum":
+                total = acc
+            else:
+                count = acc
+        if count <= 0:
+            return None
+        bar += total / count
+    return bar
+
+
+def _prestage_flip(
+    args, executor_factory, knee, deadline_s, prestage: bool
+) -> dict:
+    """One BENCH_r09 traffic leg: args.nodes real agents with nonzero
+    reset/boot latencies (so a full flip visibly costs more than
+    drain+readmit), open-loop Poisson at ``--knee-frac`` of the knee,
+    rolling flip mid-traffic — with continuous prestage on (the
+    measured leg) or off (the control leg that proves the bar bites)."""
+    from tpu_cc_manager.ccmanager import rolling as rolling_mod
+    from tpu_cc_manager.serve import ServeHarness
+    from tpu_cc_manager.serve.driver import PoissonSchedule
+
+    rate = knee["rate_rps"] * args.knee_frac
+    tmp = tempfile.mkdtemp(prefix="tpu-cc-serve-prestage-")
+    harness = ServeHarness(
+        n_nodes=args.nodes,
+        tmp_dir=tmp,
+        executor_factory=executor_factory,
+        reset_latency_s=args.reset_s,
+        boot_latency_s=args.boot_s,
+        driver_kwargs={
+            "schedule": PoissonSchedule(rate, seed=args.seed + 2),
+            "deadline_s": deadline_s,
+            "initial_batch": knee["batch"],
+            "min_batch": knee["batch"],
+            "max_batch": knee["batch"],
+        },
+        slo_windows_s=(2.0, 30.0),
+        slo_error_budget=0.05,
+    )
+    harness.build()
+    try:
+        roller_kwargs = None
+        if prestage:
+            # The REAL remote-gate path, fed in-process: the gate
+            # scrapes tpu_cc_serve_offered_rps off the harness registry
+            # and converts the slack under the knee into whole nodes.
+            gate = rolling_mod.headroom_gate_from_source(
+                "inproc://serve-harness", knee["rate_rps"], args.nodes,
+                fetch=lambda _url: harness.metrics.render_prometheus(),
+            )
+            roller_kwargs = {
+                "continuous_prestage": True,
+                "headroom_gate": gate,
+                "prestage_timeout_s": 30.0,
+            }
+        report = harness.run(
+            traffic_s=args.traffic_s,
+            rollout_mode=args.mode,
+            max_unavailable=args.max_unavailable,
+            slo_max_burn_rate=2.0,
+            slo_window_s=2.0,
+            slo_max_pause_s=30.0,
+            roller_kwargs=roller_kwargs,
+        )
+        walls = _flight_node_walls(os.path.join(tmp, "flight.jsonl"))
+        bars = {
+            mgr.node_name: _drain_readmit_bar(
+                mgr.metrics.render_prometheus()
+            )
+            for mgr in harness.agents
+        }
+        report["offered_rps"] = round(rate, 1)
+        report["node_walls_s"] = {n: round(w, 3) for n, w in walls.items()}
+        report["node_bars_s"] = {
+            n: (round(b, 3) if b is not None else None)
+            for n, b in bars.items()
+        }
+        report["prestage_totals"] = harness.metrics.prestage_totals()
+        report["fleet_rollup"] = _fleet_rollup(harness.metrics)
+        return report
+    finally:
+        harness.shutdown()
+
+
+def _prestage_crash_leg(args, executor_factory) -> dict:
+    """The BENCH_r09 crash leg: a seeded orchestrator SIGKILL lands at
+    a prestage crash point — mid-prestage of wave N+1 while wave N
+    drains — under a REAL short-TTL lease, and however many successors
+    it takes resume BOTH waves from the checkpointed record. No
+    traffic (the ledger/resume claims are record semantics, measured
+    here without paying another open-loop leg); reserve/arm points
+    only, since prestage-invalidate never fires in clean weather."""
+    import time as time_mod
+
+    from tpu_cc_manager.ccmanager import rollout_state
+    from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+    from tpu_cc_manager.faults.plan import FaultPlan, OrchestratorKilled
+    from tpu_cc_manager.serve import ServeHarness
+    from tpu_cc_manager.serve.harness import NS, POOL_SELECTOR
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    harness = ServeHarness(
+        n_nodes=args.crash_nodes,
+        tmp_dir=tempfile.mkdtemp(prefix="tpu-cc-serve-crash-"),
+        executor_factory=executor_factory,
+        reset_latency_s=0.05,
+        boot_latency_s=0.05,
+    )
+    harness.build()
+    plan = FaultPlan(seed=args.seed, rate=0.0, kill_rate=0.0)
+    target = plan.seed_prestage_kill(
+        points=("prestage-reserved", "prestage-armed"),
+    )
+    metrics = MetricsRegistry()
+    result = None
+    ledger = None
+    try:
+        for attempt in range(8):
+            lease = rollout_state.RolloutLease(
+                harness.kube, holder=f"bench-orch-{attempt}", namespace=NS,
+                duration_s=2.0, metrics=metrics,
+            )
+            record = lease.acquire()
+            roller = RollingReconfigurator(
+                harness.kube, POOL_SELECTOR,
+                max_unavailable=2,
+                node_timeout_s=30.0,
+                poll_interval_s=0.02,
+                lease=lease,
+                resume_record=(
+                    record
+                    if record is not None
+                    and record.status == rollout_state.RECORD_IN_PROGRESS
+                    else None
+                ),
+                crash_hook=plan.decide_orchestrator_kill,
+                metrics=metrics,
+                continuous_prestage=True,
+                prestage_timeout_s=10.0,
+                headroom_gate=lambda: args.crash_nodes,
+            )
+            try:
+                result = roller.rollout(args.mode)
+                ledger = roller._ledger
+                lease.release(clear_record=result.ok)
+                break
+            except OrchestratorKilled:
+                # SIGKILL semantics: no cleanup, lease NOT released —
+                # the successor waits out the real-clock TTL.
+                time_mod.sleep(2.2)
+    finally:
+        harness.shutdown()
+    kills = [f for f in plan.injected if f.kind == "orch-kill"]
+    return {
+        "nodes": args.crash_nodes,
+        "kill_point_armed": target,
+        "kills": len(kills),
+        "kill_landed_at": kills[0].op if kills else None,
+        "resumes": metrics.rollout_totals()["resumes"],
+        "rollout_ok": bool(result is not None and result.ok),
+        "ledger_charges": ledger.charges_total() if ledger else None,
+        "ledger_releases": ledger.releases_total() if ledger else None,
+        "ledger_balanced": bool(ledger is not None and ledger.balanced()),
+        "ledger_open_entries": len(ledger.entries) if ledger else None,
+        "double_charged": ledger.double_charged() if ledger else None,
+        "ok": bool(
+            result is not None and result.ok
+            and kills
+            and kills[0].op == target
+            and metrics.rollout_totals()["resumes"] == len(kills)
+            and ledger is not None
+            and ledger.balanced()
+            and not ledger.entries
+            and ledger.double_charged() == []
+        ),
+    }
+
+
+def run_prestage(args, executor_factory, calibration) -> dict:
+    """BENCH_r09: whole-fleet zero-bounce under the capacity ledger.
+    Knee sweep → prestage leg at 80 % of knee (every node's effective
+    flip wall ≤ its drain+readmit bar, zero prestage SLO pauses, zero
+    lost requests) → control leg (no prestage: walls MUST exceed the
+    bar, proving the bar bites) → seeded mid-prestage crash leg."""
+    sweep = run_sweep(args, executor_factory, calibration, flip=False)
+    knee = sweep.get("knee")
+    deadline_s = args.deadline_ms / 1e3
+    flip = control = None
+    walls_ok = False
+    control_exceeds = None
+    if knee is not None:
+        flip = _prestage_flip(
+            args, executor_factory, knee, deadline_s, prestage=True,
+        )
+        control = _prestage_flip(
+            args, executor_factory, knee, deadline_s, prestage=False,
+        )
+        # Every node's effective wall ≤ its own drain+readmit bar
+        # (+0.25 s of convergence-poll/scheduler noise).
+        walls_ok = bool(flip["node_walls_s"]) and all(
+            flip["node_bars_s"].get(n) is not None
+            and w <= flip["node_bars_s"][n] + 0.25
+            for n, w in flip["node_walls_s"].items()
+        )
+        # The control leg pays reset+boot inside the window: its walls
+        # exceeding the SAME bar is what makes walls_ok non-trivial.
+        control_exceeds = sum(
+            1 for n, w in control["node_walls_s"].items()
+            if control["node_bars_s"].get(n) is not None
+            and w > control["node_bars_s"][n] + 0.25
+        )
+    crash = _prestage_crash_leg(args, executor_factory)
+    pt = (flip or {}).get("prestage_totals") or {}
+    return {
+        "metric": "zero_bounce_fleet_prestage",
+        "nodes": args.nodes,
+        "knee_frac": args.knee_frac,
+        "deadline_ms": args.deadline_ms,
+        "seed": args.seed,
+        "knee": knee,
+        "prestage_flip": flip,
+        "control_flip": control,
+        "walls_ok": walls_ok,
+        "control_walls_exceeding_bar": control_exceeds,
+        "crash_leg": crash,
+        "calibration": calibration,
+        "ok": bool(
+            knee is not None
+            and sweep["ok"]
+            and flip is not None
+            and flip["rollout_ok"]
+            and flip["requests_lost"] == 0
+            and flip["conserved"]
+            and flip["nodes_bounced"] == args.nodes
+            # Every node rode the prestage path (held == pool size) and
+            # SLO burn never paused a top-up at 80 % of knee.
+            and pt.get("held", 0) == args.nodes
+            and pt.get("paused", 0) == 0
+            and flip["rollout_slo_pauses"] == 0
+            and walls_ok
+            and control is not None
+            and (control_exceeds or 0) > 0
+            and crash["ok"]
+        ),
+    }
+
+
 def run_sweep(args, executor_factory, calibration, flip: bool = True) -> dict:
     from tpu_cc_manager.serve import sweep as sweep_mod
 
@@ -311,6 +621,23 @@ def main(argv: list[str] | None = None) -> int:
                         "then flip at it twice — control vs in-flight "
                         "handoff to peers — and gate on the handoff "
                         "flip's during/steady p99 ratio")
+    parser.add_argument("--prestage", action="store_true",
+                        help="whole-fleet zero-bounce artifact (BENCH_r09): "
+                        "find the knee, flip the pool under open-loop "
+                        "traffic at --knee-frac of it with continuous "
+                        "prestage under the capacity ledger, run a "
+                        "no-prestage control leg, and a seeded "
+                        "mid-prestage orchestrator-SIGKILL crash leg")
+    parser.add_argument("--knee-frac", type=float, default=0.8,
+                        help="--prestage offered load as a fraction of "
+                        "the knee (the ISSUE bar: 80%%)")
+    parser.add_argument("--reset-s", type=float, default=0.3,
+                        help="--prestage simulated device reset latency: "
+                        "the cost prestage moves off the flip window")
+    parser.add_argument("--boot-s", type=float, default=0.2,
+                        help="--prestage simulated runtime boot latency")
+    parser.add_argument("--crash-nodes", type=int, default=6,
+                        help="--prestage crash-leg pool size")
     parser.add_argument("--ratio-bar", type=float, default=1.3,
                         help="--handoff ok-gate: during-rollout p99 must "
                         "stay within this multiple of steady-state p99")
@@ -351,6 +678,17 @@ def main(argv: list[str] | None = None) -> int:
         executor_factory = (
             lambda: SimulatedExecutor.from_smoke_result(smoke)
         )
+
+    if args.prestage:
+        if not args.sweep:
+            args.sweep = "200,400,800,1600,3200,6400"
+        result = run_prestage(args, executor_factory, calibration)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return 0 if result["ok"] else 1
 
     if args.handoff:
         if not args.sweep:

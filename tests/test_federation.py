@@ -482,7 +482,11 @@ def test_escrow_unaware_orchestrator_refuses_v6_record(monkeypatch):
         escrow=2, acked_spend=[], charged=["r1-node-9"],
     )
     data = record.to_json()
-    assert json.loads(data)["version"] == rollout_state.RECORD_VERSION
+    # Versioning is demand-driven: escrow demands exactly v6 (a touched
+    # capacity ledger would demand v7, but there is none here).
+    assert json.loads(data)["version"] == (
+        rollout_state.RECORD_VERSION_NO_LEDGER
+    )
     monkeypatch.setattr(
         rollout_state, "RECORD_VERSION",
         rollout_state.RECORD_VERSION_NO_ESCROW,

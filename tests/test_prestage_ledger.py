@@ -1,0 +1,821 @@
+"""Crash-safe continuous prestage under the capacity ledger (ISSUE 19).
+
+The tentpole contract, held to in tier-1:
+
+- The **CapacityLedger** (rollout_state.py, record format v7) conserves
+  headroom charges: every reserve is refused past the limit or on an
+  existing entry (the no-double-charge proof), every release settles
+  exactly one charge, and ``balanced()`` holds across any interleaving
+  (property-tested below via the hypothesis shim).
+- **Continuous prestage** (rolling.py): the window loop tops up wave
+  N+1's prestage while window N flips, bounded by
+  ``min(headroom_gate(), max_unavailable)``; held nodes flip zero-bounce
+  in ~drain+readmit; a prestage-path failure degrades that node to the
+  full flip path and the rollout presses on; sustained SLO burn pauses
+  prestage — never the wave.
+- **Resume** adopts checkpointed entries as-is (no re-surge, no second
+  ledger charge) and invalidates entries whose plan digest drifted — a
+  stale prestaged node re-flips, never converges against an old plan.
+
+The chaos-marked soak (``-m chaos -s``) kills the orchestrator
+mid-prestage of wave N+1 while wave N drains (FaultPlan's seeded
+``seed_prestage_kill``) and prints the PRESTAGE_SUMMARY line
+hack/chaos_soak.sh scrapes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from _hypothesis_compat import given, settings, st
+from tpu_cc_manager.ccmanager import rollout_state
+from tpu_cc_manager.ccmanager.rolling import (
+    RollingReconfigurator,
+    headroom_gate_from_source,
+)
+from tpu_cc_manager.faults.plan import FaultPlan, OrchestratorKilled
+from tpu_cc_manager.kubeclient.api import node_annotations, node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import (
+    CC_MODE_LABEL,
+    CC_MODE_STATE_LABEL,
+    PRESTAGE_ANNOTATION,
+    PRESTAGED_ANNOTATION,
+)
+from tpu_cc_manager.obs import flight as flight_mod
+from tpu_cc_manager.obs import slo as slo_mod
+from tpu_cc_manager.serve import sweep as sweep_mod
+from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+POOL = "pool=tpu"
+NS = "tpu-operator"
+
+
+def add_pool(fake, n=4, slice_map=None):
+    for i in range(n):
+        labels = {"pool": "tpu"}
+        if slice_map and i in slice_map:
+            labels["cloud.google.com/tpu-slice-id"] = slice_map[i]
+        fake.add_node(f"node-{i}", labels)
+
+
+def prestage_agent_simulator(
+    fake, counts=None, prestaged=None, obey_prestage=True,
+):
+    """Emulate prestage-capable per-node agents: a PRESTAGE annotation
+    runs the flip ahead of the wave (state label to the mode, PRESTAGED
+    status record published, ``prestaged`` counted); the wave's desired
+    write then converges instantly with NO reconcile — ``counts`` only
+    grows on the full flip path, so it is the double-bounce detector
+    AND the zero-bounce proof."""
+    in_flight = set()
+
+    def reactor(name, node):
+        ann = node_annotations(node)
+        labels = node_labels(node)
+        want = ann.get(PRESTAGE_ANNOTATION)
+        state = labels.get(CC_MODE_STATE_LABEL)
+        if obey_prestage and want and state != want and name not in in_flight:
+            in_flight.add(name)
+            if prestaged is not None:
+                prestaged[name] = prestaged.get(name, 0) + 1
+
+            def hold():
+                # State label first, record second: re-entrant reactor
+                # invocations from these patches see state == want and
+                # do nothing.
+                fake.set_node_label(name, CC_MODE_STATE_LABEL, want)
+                fake.patch_node_annotations(name, {
+                    PRESTAGED_ANNOTATION: json.dumps({
+                        "mode": want, "prior": state or "off",
+                        "seconds": 0.01, "ts": 0,
+                    }),
+                })
+                in_flight.discard(name)
+                # Re-evaluate: a write that landed while this transition
+                # was in flight was skipped by the in_flight guard.
+                reactor(name, fake.get_node(name))
+
+            t = threading.Timer(0.03, hold)
+            t.daemon = True
+            t.start()
+            return
+        rec_raw = ann.get(PRESTAGED_ANNOTATION)
+        if rec_raw and not want and name not in in_flight:
+            # The arm was deleted (abort / invalidation): the agent
+            # breaks its hold and reverts to the desired mode (or its
+            # pre-prestage prior), clearing the stale status record —
+            # the node re-flips via the full path.
+            try:
+                prior = json.loads(rec_raw).get("prior") or "off"
+            except ValueError:
+                prior = "off"
+            target = labels.get(CC_MODE_LABEL) or prior
+            if node_labels(fake.get_node(name)).get(
+                CC_MODE_STATE_LABEL
+            ) != target:
+                in_flight.add(name)
+
+                def revert():
+                    fake.set_node_label(name, CC_MODE_STATE_LABEL, target)
+                    fake.patch_node_annotations(
+                        name, {PRESTAGED_ANNOTATION: None}
+                    )
+                    in_flight.discard(name)
+                    reactor(name, fake.get_node(name))
+
+                t = threading.Timer(0.03, revert)
+                t.daemon = True
+                t.start()
+                return
+        desired = labels.get(CC_MODE_LABEL)
+        if desired and state != desired and name not in in_flight:
+            in_flight.add(name)
+            if counts is not None:
+                counts[name] = counts.get(name, 0) + 1
+
+            def fire():
+                fake.set_node_label(name, CC_MODE_STATE_LABEL, desired)
+                in_flight.discard(name)
+                reactor(name, fake.get_node(name))
+
+            t = threading.Timer(0.03, fire)
+            t.daemon = True
+            t.start()
+
+    fake.add_patch_reactor(reactor)
+    # FakeKube only fires patch reactors on LABEL patches; the PRESTAGE
+    # arm is an annotation patch, so the simulated agent also watches
+    # those.
+    real_ann = fake.patch_node_annotations
+
+    def patched_ann(name, annotations):
+        node = real_ann(name, annotations)
+        reactor(name, node)
+        return node
+
+    fake.patch_node_annotations = patched_ann
+
+
+def make_roller(fake, **kw):
+    kw.setdefault("node_timeout_s", 5)
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("continuous_prestage", True)
+    kw.setdefault("prestage_timeout_s", 1.0)
+    return RollingReconfigurator(fake, POOL, **kw)
+
+
+class Clock:
+    """Injectable wall/monotonic clock for deterministic lease expiry."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def make_lease(fake, holder, clk, duration_s=30.0, metrics=None):
+    return rollout_state.RolloutLease(
+        fake, holder=holder, namespace=NS, duration_s=duration_s,
+        metrics=metrics or MetricsRegistry(), wall=clk, clock=clk,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CapacityLedger conservation (unit + property)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_reserve_mark_release_conserves_charges():
+    led = rollout_state.CapacityLedger()
+    assert led.reserve("n0", "g0", "d0", 1, limit=2)
+    assert led.reserve("n1", "g1", "d1", 1, limit=2)
+    # At the limit: a third reservation is refused, nothing charged.
+    assert not led.reserve("n2", "g2", "d2", 1, limit=2)
+    assert "n2" not in led.charged
+    # Re-reserving an existing entry IS the double charge the ledger
+    # exists to prevent: refused, charge count untouched.
+    assert not led.reserve("n0", "g0", "d0", 2, limit=99)
+    assert led.charged["n0"] == 1
+    assert led.in_transition() == 2
+    # Held entries serve again: they free transition headroom, so the
+    # next reservation fits — this is what pipelines wave N+1.
+    led.mark("n0", rollout_state.LEDGER_HELD)
+    assert led.in_transition() == 1
+    assert led.reserve("n2", "g2", "d2", 1, limit=2)
+    assert led.balanced()
+    # Release settles exactly one charge; releasing an absent node is
+    # an idempotent no-op (crash between release and checkpoint).
+    assert led.release("n0")
+    assert not led.release("n0")
+    assert led.released["n0"] == 1
+    for n in ("n1", "n2"):
+        assert led.release(n)
+    assert led.balanced() and not led.entries
+    assert led.charges_total() == 3 == led.releases_total()
+    assert led.double_charged() == []
+
+
+def test_ledger_round_trips_through_record_v7():
+    led = rollout_state.CapacityLedger()
+    led.reserve("n0", "g0", "d0", 3, limit=1)
+    led.mark("n0", rollout_state.LEDGER_ARMED)
+    led.release("n0")
+    led.reserve("n1", "g1", "d1", 3, limit=1)
+    rec = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=3,
+        groups=[("g1", ("n1",))], ledger=led,
+    )
+    raw = rec.to_json()
+    obj = json.loads(raw)
+    # A touched ledger forces format v7 — the loud-refusal boundary for
+    # older binaries (they reject versions above their own).
+    assert obj["version"] == rollout_state.RECORD_VERSION == 7
+    back = rollout_state.RolloutRecord.from_json(raw)
+    assert back.ledger is not None
+    assert back.ledger.entry("n1")["state"] == rollout_state.LEDGER_RESERVED
+    assert back.ledger.entry("n1")["gid"] == "g1"
+    assert back.ledger.charged == {"n0": 1, "n1": 1}
+    assert back.ledger.released == {"n0": 1}
+    assert back.ledger.balanced()
+    # No ledger (or an untouched one) keeps the downgrade-compatible
+    # pre-v7 format: a non-prestaging rollout never locks out older
+    # binaries.
+    plain = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=3, groups=[("g1", ("n1",))],
+        ledger=rollout_state.CapacityLedger(),
+    )
+    pobj = json.loads(plain.to_json())
+    assert pobj.get("version", 1) < rollout_state.RECORD_VERSION
+    assert "ledger" not in pobj
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    ops=st.lists(
+        st.tuples(
+            st.sampled_from(["reserve", "hold", "release", "kill-resume"]),
+            st.integers(min_value=0, max_value=5),
+        ),
+        max_size=40,
+    ),
+    limit=st.integers(min_value=0, max_value=3),
+)
+def test_ledger_invariants_under_interleaved_ops(ops, limit):
+    """The acceptance property: across ANY interleaving of
+    reserve/hold/release — including a kill+resume, modeled as a
+    serialize/deserialize round trip mid-sequence — the in-transition
+    count never exceeds the limit (concurrent prestages can never
+    violate ``max_unavailable``), the ledger stays balanced, and no
+    node is ever double-charged without an intervening release."""
+    led = rollout_state.CapacityLedger()
+    live_charges: dict[str, int] = {}
+    for op, i in ops:
+        node = f"n{i}"
+        if op == "reserve":
+            before = led.in_transition()
+            ok = led.reserve(node, f"g{i}", f"d{i}", 1, limit=limit)
+            if ok:
+                live_charges[node] = live_charges.get(node, 0) + 1
+                assert before < limit
+            else:
+                assert node in led.entries or before >= limit
+        elif op == "hold":
+            led.mark(node, rollout_state.LEDGER_HELD)
+        elif op == "release":
+            led.release(node)
+        else:  # kill-resume: only the checkpointed state survives
+            led = rollout_state.CapacityLedger.from_dict(led.to_dict())
+        assert led.in_transition() <= max(limit, 0)
+        assert led.balanced()
+        # A node's lifetime charges can only exceed one via an
+        # intervening release (a legitimate re-reservation) — never a
+        # straight double charge.
+        for n, c in led.charged.items():
+            assert c - led.released.get(n, 0) <= 1
+
+
+def test_ledger_invariants_random_interleavings_seeded():
+    """The same conservation property as the hypothesis test above, as
+    a seeded plain-random fuzz so the invariant is exercised even on
+    images without hypothesis (the shim skips the property test
+    visibly there)."""
+    import random
+
+    rng = random.Random(20260807)
+    for _trial in range(200):
+        limit = rng.randrange(0, 4)
+        led = rollout_state.CapacityLedger()
+        for _ in range(30):
+            op = rng.choice(["reserve", "hold", "release", "kill-resume"])
+            node = f"n{rng.randrange(6)}"
+            if op == "reserve":
+                before = led.in_transition()
+                if led.reserve(node, "g", "d", 1, limit=limit):
+                    assert before < limit
+            elif op == "hold":
+                led.mark(node, rollout_state.LEDGER_HELD)
+            elif op == "release":
+                led.release(node)
+            else:
+                led = rollout_state.CapacityLedger.from_dict(led.to_dict())
+            assert led.in_transition() <= limit
+            assert led.balanced()
+            for n, c in led.charged.items():
+                assert c - led.released.get(n, 0) <= 1
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    knee=st.floats(min_value=1.0, max_value=1e5),
+    offered=st.floats(min_value=0.0, max_value=2e5),
+    n=st.integers(min_value=1, max_value=64),
+    reserve=st.integers(min_value=0, max_value=4),
+)
+def test_knee_slack_reservation_never_exceeds_slack(knee, offered, n, reserve):
+    """Reserved headroom never exceeds knee slack, and the allowance
+    always leaves the configured reserve (>=1 node in BENCH_r09's
+    shape) un-spendable."""
+    slack = sweep_mod.knee_slack_nodes(knee, offered, n)
+    allow = sweep_mod.prestage_allowance(knee, offered, n, reserve)
+    assert 0 <= allow <= slack <= n or (slack >= 0 and offered < knee)
+    assert allow <= max(0, slack - reserve) or reserve == 0
+    # Whole nodes only, and slack * per-node capacity fits under the
+    # knee minus the offered load (never oversubscribes).
+    assert slack * (knee / n) <= max(0.0, knee - offered) + 1e-6
+
+
+def test_prestage_allowance_caps_at_max_unavailable_and_fails_closed():
+    fake = FakeKube()
+    add_pool(fake, 2)
+    roller = make_roller(fake, max_unavailable=2, headroom_gate=lambda: 99)
+    assert roller._prestage_allowance() == 2
+    roller.headroom_gate = lambda: 1
+    assert roller._prestage_allowance() == 1
+    roller.headroom_gate = lambda: -3
+    assert roller._prestage_allowance() == 0
+    # A gate that RAISES reads zero slack — fail-CLOSED (the mirror of
+    # the SLO gate's fail-open): prestage must never consume headroom
+    # it cannot prove exists. The wave is never paused by this.
+    def broken():
+        raise OSError("scrape endpoint died")
+
+    roller.headroom_gate = broken
+    assert roller._prestage_allowance() == 0
+    roller.headroom_gate = None
+    assert roller._prestage_allowance() == 2
+
+
+def test_headroom_gate_from_source_scrapes_offered_rps():
+    text = (
+        "tpu_cc_serve_goodput_rps 790.0\n"
+        "tpu_cc_serve_offered_rps 800.0\n"
+    )
+    assert slo_mod.parse_serve_offered_rps(text) == 800.0
+    assert slo_mod.parse_serve_offered_rps("nothing here") is None
+    gate = headroom_gate_from_source(
+        "http://pool:9100/metrics", knee_rps=1000.0, n_nodes=10,
+        fetch=lambda url: text,
+    )
+    # 200 rps of slack at 100 rps/node = 2 whole nodes.
+    assert gate() == 2
+    # No offered gauge exported: zero slack, not an invented number.
+    empty_gate = headroom_gate_from_source(
+        "http://pool:9100/metrics", knee_rps=1000.0, n_nodes=10,
+        fetch=lambda url: "",
+    )
+    assert empty_gate() == 0
+
+    # A dead endpoint RAISES — _prestage_allowance turns that into
+    # zero slack (fail-closed), asserted above.
+    def dead(url):
+        raise OSError("connection refused")
+
+    dead_gate = headroom_gate_from_source(
+        "http://pool:9100/metrics", knee_rps=1000.0, n_nodes=10, fetch=dead,
+    )
+    with pytest.raises(OSError):
+        dead_gate()
+
+
+# ---------------------------------------------------------------------------
+# Continuous prestage end-to-end (fake pool, prestage-capable agents)
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_prestage_pipelines_zero_bounce_windows(tmp_path):
+    """The tentpole happy path: with prestage-capable agents every
+    window's nodes are reserved, armed and HELD before their flip
+    window opens — so every flip converges zero-bounce (the full-path
+    reconcile counter never moves), the ledger balances to zero, and
+    the flight journal carries the whole reserve→arm→hold→release
+    lifecycle."""
+    fake = FakeKube()
+    add_pool(fake, 4, slice_map={0: "s1", 1: "s1"})
+    counts: dict = {}
+    prestaged: dict = {}
+    prestage_agent_simulator(fake, counts=counts, prestaged=prestaged)
+    fpath = str(tmp_path / "flight.jsonl")
+    metrics = MetricsRegistry()
+    roller = make_roller(
+        fake, max_unavailable=2, headroom_gate=lambda: 8,
+        flight=flight_mod.FlightRecorder(fpath), metrics=metrics,
+    )
+    result = roller.rollout("on")
+    assert result.ok, result.summary()
+    # Zero full-path reconciles anywhere: every node flipped via its
+    # held prestage.
+    assert counts == {}, f"full-path reconciles on {counts}"
+    assert all(prestaged.get(f"node-{i}") == 1 for i in range(4)), prestaged
+    led = roller._ledger
+    assert led is not None and led.balanced() and not led.entries
+    assert led.charges_total() == 4 == led.releases_total()
+    assert led.double_charged() == []
+    events, torn = flight_mod.read_events(fpath)
+    assert torn == 0
+    rec = flight_mod.reconstruct(events)
+    pre = rec["prestage"]
+    assert pre is not None
+    assert sorted(pre["reserved"]) == [f"node-{i}" for i in range(4)]
+    assert sorted(pre["held"]) == [f"node-{i}" for i in range(4)]
+    assert pre["released"] == {"converged": 4}
+    assert pre["invalidated"] == [] and pre["paused"] == 0
+    # The metric families exported (the cclint triangle's runtime leg).
+    text = metrics.render_prometheus()
+    assert "tpu_cc_prestage_reserved 0" in text
+    assert 'tpu_cc_prestage_total{outcome="held"} 4' in text
+    assert 'tpu_cc_prestage_total{outcome="converged"} 4' in text
+
+
+def test_prestage_timeout_degrades_to_full_flip_and_presses_on(tmp_path):
+    """Graceful degradation: agents that never honor the PRESTAGE
+    annotation (older binaries, CC_PRESTAGE=0) cost each window only
+    the bounded finalize await — the entry is invalidated as degraded,
+    the node takes the PR-10 full flip path, and the rollout still
+    converges every node exactly once. A prestage-path failure never
+    halts."""
+    fake = FakeKube()
+    add_pool(fake, 3)
+    counts: dict = {}
+    prestage_agent_simulator(fake, counts=counts, obey_prestage=False)
+    fpath = str(tmp_path / "flight.jsonl")
+    metrics = MetricsRegistry()
+    roller = make_roller(
+        fake, max_unavailable=1, prestage_timeout_s=0.2,
+        flight=flight_mod.FlightRecorder(fpath), metrics=metrics,
+    )
+    result = roller.rollout("on")
+    assert result.ok, result.summary()
+    assert all(counts.get(f"node-{i}") == 1 for i in range(3)), counts
+    led = roller._ledger
+    assert led.balanced() and not led.entries
+    totals = metrics.prestage_totals()
+    assert totals.get("degraded", 0) == 3
+    assert totals.get("held", 0) == 0
+    rec = flight_mod.reconstruct(flight_mod.read_events(fpath)[0])
+    assert sorted(rec["prestage"]["invalidated"]) == [
+        f"node-{i}" for i in range(3)
+    ]
+    # The arm annotations were aborted, not left to re-engage later.
+    for i in range(3):
+        assert PRESTAGE_ANNOTATION not in node_annotations(
+            fake.get_node(f"node-{i}")
+        )
+
+
+def test_slo_burn_pauses_prestage_never_the_wave(tmp_path):
+    """Sustained SLO burn pauses prestage top-up — and ONLY that: the
+    wave keeps flipping (no slo-paused window pause), the paused
+    boundary is journaled and counted, and once the burn clears the
+    top-up resumes."""
+    fake = FakeKube()
+    add_pool(fake, 3)
+    counts: dict = {}
+    prestaged: dict = {}
+    prestage_agent_simulator(fake, counts=counts, prestaged=prestaged)
+    calls = {"n": 0}
+
+    def gate() -> bool:
+        # Call 1 is window 0's wave-gate poll (healthy); call 2 is the
+        # maintenance pass's burn check (burning: prestage pauses while
+        # the wave proceeds); later calls are healthy again.
+        calls["n"] += 1
+        return calls["n"] == 2
+
+    fpath = str(tmp_path / "flight.jsonl")
+    metrics = MetricsRegistry()
+    roller = make_roller(
+        fake, max_unavailable=1, slo_gate=gate,
+        flight=flight_mod.FlightRecorder(fpath), metrics=metrics,
+    )
+    result = roller.rollout("on")
+    assert result.ok, result.summary()
+    names = [e["event"] for e in flight_mod.read_events(fpath)[0]]
+    assert "prestage-paused" in names
+    assert "slo-paused" not in names, "the WAVE must never pause for this"
+    assert names.count("window-open") == 3
+    assert metrics.prestage_totals().get("paused", 0) == 1
+    # Window 0 flipped full-path under the paused top-up; the burn
+    # cleared and later windows prestaged again.
+    assert counts.get("node-0") == 1
+    assert prestaged.get("node-1") == 1 and prestaged.get("node-2") == 1
+    rec = flight_mod.reconstruct(flight_mod.read_events(fpath)[0])
+    assert rec["prestage"]["paused"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Crash + resume: adopt-as-is, no second charge, digest invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_resume_adopts_armed_entry_without_second_charge(tmp_path):
+    """Satellite 1 (the re-pick hazard): SIGKILL between prestage-armed
+    and the flip; the successor adopts the held node AS-IS — no
+    re-surge, no second ledger charge (``reserve()`` refusing an
+    existing entry is the proof), mirroring the prestaged-spare resume
+    rule — and the adopted node still flips zero-bounce."""
+    fake = FakeKube()
+    add_pool(fake, 4, slice_map={0: "s1", 1: "s1"})
+    counts: dict = {}
+    prestaged: dict = {}
+    prestage_agent_simulator(fake, counts=counts, prestaged=prestaged)
+    metrics = MetricsRegistry()
+    clk = Clock()
+    lease_a = make_lease(fake, "orch-a", clk, metrics=metrics)
+    lease_a.acquire()
+    armed_once = {"fired": False}
+
+    def kill_after_first_arm(point):
+        if point == "prestage-armed" and not armed_once["fired"]:
+            armed_once["fired"] = True
+            raise OrchestratorKilled(point, 0)
+
+    roller_a = make_roller(
+        fake, lease=lease_a, max_unavailable=1, headroom_gate=lambda: 4,
+        crash_hook=kill_after_first_arm,
+    )
+    with pytest.raises(OrchestratorKilled):
+        roller_a.rollout("on")
+    clk.advance(31)
+    lease_b = make_lease(fake, "orch-b", clk, metrics=metrics)
+    record = lease_b.acquire()
+    assert record is not None and record.ledger is not None
+    armed = [
+        n for n in record.ledger.entries
+        if record.ledger.entry(n)["state"] == rollout_state.LEDGER_ARMED
+    ]
+    assert armed, "the kill landed after a durable armed checkpoint"
+    roller_b = make_roller(
+        fake, lease=lease_b, resume_record=record, metrics=metrics,
+        max_unavailable=1, headroom_gate=lambda: 4,
+    )
+    result = roller_b.rollout(record.mode)
+    assert result.ok and result.resumed
+    led = roller_b._ledger
+    assert led.balanced() and not led.entries
+    assert led.double_charged() == []
+    for n in armed:
+        # Adopted as-is: exactly ONE lifetime charge, one prestage run,
+        # zero full-path reconciles.
+        assert led.charged[n] == 1
+        assert prestaged.get(n) == 1
+        assert counts.get(n, 0) == 0
+    for i in range(4):
+        assert node_labels(fake.get_node(f"node-{i}"))[
+            CC_MODE_STATE_LABEL
+        ] == "on"
+
+
+def test_resume_invalidates_digest_drift_and_releases_exactly_once():
+    """Fence/plan-digest invalidation on resume: a checkpointed entry
+    whose digest no longer matches the live plan is invalidated and
+    released exactly once — the node's hold is aborted and it re-flips
+    via the full path, never converging against the old plan."""
+    fake = FakeKube()
+    add_pool(fake, 2)
+    counts: dict = {}
+    prestage_agent_simulator(fake, counts=counts)
+    # The dead orchestrator armed node-0 under a plan that has since
+    # drifted (digest mismatch); the agent pre-staged and holds.
+    fake.patch_node_annotations("node-0", {PRESTAGE_ANNOTATION: "on"})
+    from tpu_cc_manager.utils import retry as retry_mod
+    assert retry_mod.poll_until(
+        lambda: node_annotations(fake.get_node("node-0")).get(
+            PRESTAGED_ANNOTATION
+        ) is not None,
+        5.0, 0.02,
+    )
+    led = rollout_state.CapacityLedger()
+    led.reserve("node-0", "node/node-0", "stale-digest", 1, limit=1)
+    led.mark("node-0", rollout_state.LEDGER_ARMED)
+    record = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[(f"node/node-{i}", (f"node-{i}",)) for i in range(2)],
+        ledger=led,
+    )
+    metrics = MetricsRegistry()
+    roller = make_roller(
+        fake, resume_record=record, metrics=metrics,
+        max_unavailable=1, headroom_gate=lambda: 0,  # no re-reserve noise
+    )
+    result = roller.rollout("on")
+    assert result.ok and result.resumed
+    assert led.released.get("node-0") == 1
+    assert led.balanced() and not led.entries
+    assert metrics.prestage_totals().get("invalidated", 0) == 1
+    # The stale arm was aborted, and the node re-flipped the FULL path.
+    assert PRESTAGE_ANNOTATION not in node_annotations(
+        fake.get_node("node-0")
+    )
+    assert counts.get("node-0") == 1
+
+
+def test_no_prestage_resume_drains_the_ledger():
+    """The --no-prestage degraded-mode escape: resuming a ledgered
+    record with continuous prestage OFF releases every checkpointed
+    entry (aborted), balances the ledger, and every node takes the
+    full flip path."""
+    fake = FakeKube()
+    add_pool(fake, 2)
+    counts: dict = {}
+    prestage_agent_simulator(fake, counts=counts)
+    fake.patch_node_annotations("node-0", {PRESTAGE_ANNOTATION: "on"})
+    from tpu_cc_manager.utils import retry as retry_mod
+    assert retry_mod.poll_until(
+        lambda: node_annotations(fake.get_node("node-0")).get(
+            PRESTAGED_ANNOTATION
+        ) is not None,
+        5.0, 0.02,
+    )
+    led = rollout_state.CapacityLedger()
+    gid = "node/node-0"
+    digest = rollout_state.plan_digest("on", gid, ("node-0",))
+    led.reserve("node-0", gid, digest, 1, limit=1)
+    led.mark("node-0", rollout_state.LEDGER_ARMED)
+    record = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[(f"node/node-{i}", (f"node-{i}",)) for i in range(2)],
+        ledger=led,
+    )
+    metrics = MetricsRegistry()
+    roller = make_roller(
+        fake, resume_record=record, metrics=metrics,
+        continuous_prestage=False, max_unavailable=1,
+    )
+    result = roller.rollout("on")
+    assert result.ok
+    assert led.balanced() and not led.entries
+    assert metrics.prestage_totals().get("aborted", 0) == 1
+    assert counts.get("node-0") == 1 and counts.get("node-1") == 1
+
+
+def test_ctl_status_prints_prestage_ledger_block(fake_kube, capsys):
+    """The degraded-mode runbook's first read: `ctl status` on a
+    ledgered in-progress record prints the PRESTAGE block with
+    per-state counts and the charge/release balance."""
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    fake_kube.add_node("node-0", {"pool": "tpu"})
+    clk = Clock()
+    lease = make_lease(fake_kube, "orch-a", clk)
+    lease.acquire()
+    led = rollout_state.CapacityLedger()
+    led.reserve("node-0", "node/node-0", "d0", 1, limit=2)
+    led.mark("node-0", rollout_state.LEDGER_ARMED)
+    led.reserve("node-9", "node/node-9", "d9", 1, limit=2)
+    led.mark("node-9", rollout_state.LEDGER_HELD)
+    record = rollout_state.RolloutRecord(
+        mode="on", selector=POOL, generation=1,
+        groups=[("node/node-0", ("node-0",))], ledger=led,
+    )
+    lease.checkpoint(record)
+    args = argparse.Namespace(selector=POOL, lease_namespace=NS)
+    assert ctl.cmd_status(fake_kube, args) == 0
+    out = capsys.readouterr().out
+    assert "PRESTAGE ledger: 0 reserved, 1 armed, 1 held" in out
+    assert "charges=2 releases=0 (balanced)" in out
+
+
+def test_ctl_prestage_flag_validation(fake_kube):
+    import argparse
+
+    from tpu_cc_manager import ctl
+
+    fake_kube.add_node("node-0", {"pool": "tpu"})
+
+    def ns(**kw):
+        base = dict(
+            selector=POOL, mode="on", max_unavailable=1, node_timeout=5.0,
+            continue_on_failure=False, rollback_on_failure=False,
+            failure_budget=None, resume=False, abort_rollout=False,
+            no_lease=True, lease_duration=30.0, lease_namespace=NS,
+        )
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    with pytest.raises(ValueError, match="contradictory"):
+        ctl.cmd_rollout(
+            fake_kube, ns(prestage_continuous=True, no_prestage=True)
+        )
+    with pytest.raises(ValueError, match="--prestage-continuous"):
+        ctl.cmd_rollout(fake_kube, ns(prestage_knee_rps=1000.0))
+    with pytest.raises(ValueError, match="--slo-source"):
+        ctl.cmd_rollout(
+            fake_kube,
+            ns(prestage_continuous=True, prestage_knee_rps=1000.0),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos: kill mid-prestage of wave N+1 while wave N drains
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_seeded_kill_mid_prestage_of_next_wave():
+    """The BENCH_r09 crash-leg shape in the soak: FaultPlan's seeded
+    ``seed_prestage_kill`` SIGKILLs the orchestrator at one of the
+    prestage crash points — mid-prestage of wave N+1 while wave N
+    drains — and across however many successors it takes, BOTH waves
+    resume, the capacity ledger balances to zero with no node
+    double-charged, and no node is lost or double-bounced. Prints the
+    PRESTAGE_SUMMARY line hack/chaos_soak.sh scrapes."""
+    fake = FakeKube()
+    add_pool(fake, 6, slice_map={0: "s1", 1: "s1"})
+    counts: dict = {}
+    prestaged: dict = {}
+    prestage_agent_simulator(fake, counts=counts, prestaged=prestaged)
+    metrics = MetricsRegistry()
+    # Soak-seeded (CC_CHAOS_SEED) like the other chaos legs. Reserve/arm
+    # points only: prestage-invalidate never fires in clean weather (no
+    # digest drift, no timeout), so arming it would make the kill a
+    # seed-dependent no-op — that point's coverage lives in the
+    # exhaustive kill-at-every-crash-point test (test_rollout_resume).
+    plan = FaultPlan.from_env(default_seed=20260807, rate=0.0, kill_rate=0.0)
+    target = plan.seed_prestage_kill(
+        points=("prestage-reserved", "prestage-armed"),
+    )
+    assert target in ("prestage-reserved", "prestage-armed")
+
+    result = None
+    last_led = None
+    clk = Clock()
+    for attempt in range(8):
+        lease = make_lease(fake, f"orch-{attempt}", clk, metrics=metrics)
+        record = lease.acquire()
+        roller = make_roller(
+            fake, lease=lease,
+            resume_record=(
+                record
+                if record is not None
+                and record.status == rollout_state.RECORD_IN_PROGRESS
+                else None
+            ),
+            metrics=metrics, max_unavailable=2, headroom_gate=lambda: 6,
+            crash_hook=plan.decide_orchestrator_kill,
+        )
+        try:
+            result = roller.rollout("on")
+            last_led = roller._ledger
+            lease.release(clear_record=result.ok)
+            break
+        except OrchestratorKilled:
+            clk.advance(31)
+    assert result is not None and result.ok
+    kills = [f for f in plan.injected if f.kind == "orch-kill"]
+    assert kills and kills[0].op == target, (
+        "the seeded prestage kill must land at the drawn crash point"
+    )
+    for i in range(6):
+        name = f"node-{i}"
+        assert node_labels(fake.get_node(name))[CC_MODE_STATE_LABEL] == "on"
+        assert counts.get(name, 0) + (1 if prestaged.get(name) else 0) >= 1, (
+            f"{name} was lost"
+        )
+        assert counts.get(name, 0) <= 1, f"{name} double-bounced"
+    assert last_led is not None
+    assert last_led.balanced() and not last_led.entries
+    assert last_led.double_charged() == []
+    assert metrics.rollout_totals()["resumes"] == len(kills)
+    print("PRESTAGE_SUMMARY " + json.dumps({
+        "kills": len(kills),
+        "kill_point": target,
+        "charges": last_led.charges_total(),
+        "releases": last_led.releases_total(),
+        "double_charged": last_led.double_charged(),
+        "held": sum(1 for n, c in prestaged.items() if c),
+        "full_path_flips": sum(counts.values()),
+        "nodes": 6,
+        "resumes": metrics.rollout_totals()["resumes"],
+        "balanced": last_led.balanced(),
+    }))

@@ -44,6 +44,9 @@ ROLLING_CRASH_POINTS = [
     "spare-prestaged",
     "federation-boundary",
     "parent-offline",
+    "prestage-reserved",
+    "prestage-armed",
+    "prestage-invalidate",
 ]
 
 
@@ -396,9 +399,16 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
     # the slo-paused crash point too (pause at the first boundary,
     # recover on the next poll) — a kill landing INSIDE the pause is the
     # "orchestrator dies while latency-paused" scenario.
+    # continuous_prestage carries the run through the capacity-ledger
+    # crash points too (prestage-reserved / prestage-armed /
+    # prestage-invalidate): the ledger tops up ahead of the wave, the
+    # simulated agents never publish a PRESTAGED record for the armed
+    # node, and the short prestage timeout degrades it back to the full
+    # flip path — so every node still bounces exactly once.
     roller_a = make_roller(
         fake, lease=lease_a, crash_hook=killer, slo_gate=one_breach_gate(),
         surge=1, prestage=True, federation=fed_a,
+        continuous_prestage=True, prestage_timeout_s=0.25,
     )
     killed = False
     try:
@@ -428,6 +438,7 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
             # What ctl does on resume: surge inherited from the record
             # (a resume never re-surges; stale taints are reclaimed).
             surge=record.surge, prestage=True, federation=fed_b,
+            continuous_prestage=True, prestage_timeout_s=0.25,
         )
         result = roller_b.rollout(record.mode)
         assert result.resumed is True
@@ -452,7 +463,7 @@ def test_successor_converges_after_kill_at_every_crash_point():
     )
     points_seen: set = set()
     exhausted = False
-    for kill_at in range(32):
+    for kill_at in range(48):
         killed, counts, result, fake = _run_crash_resume(
             kill_at, points_seen=points_seen
         )

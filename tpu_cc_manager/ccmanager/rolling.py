@@ -207,6 +207,23 @@ CRASH_POINTS = (
     # checkpointed escrow ledger without any parent round trip
     # (federation.py FederationGate.from_record_dict dark path).
     "parent-offline",
+    # Fired after a continuous-prestage ledger reservation is durably
+    # checkpointed (record v7) but BEFORE the node is armed: a kill here
+    # leaves a charged-but-unarmed entry — the successor adopts it
+    # (reserve() refuses a second charge) and re-arms it in place.
+    "prestage-reserved",
+    # Fired after the PRESTAGE annotation landed and the ledger entry
+    # was marked armed + checkpointed: a kill here models the dual-wave
+    # hazard — wave N+1 is mid-prestage while wave N drains — and the
+    # successor must adopt the armed node AS-IS (no re-surge, no second
+    # ledger charge), mirroring the spare rule at the surge resume.
+    "prestage-armed",
+    # Fired the moment a prestaged entry is found stale at its flip
+    # window (plan digest mismatch, agent never held, or the hold
+    # expired): a kill here leaves the entry charged — the successor
+    # re-validates and releases it exactly once, and the node re-flips
+    # via the full path (never converges against an old plan).
+    "prestage-invalidate",
 )
 
 
@@ -329,6 +346,39 @@ def metrics_gate(config: SloGateConfig, fetch=None):
 
     return gate
 
+
+def headroom_gate_from_source(
+    source: str, knee_rps: float, n_nodes: int, fetch=None
+):
+    """Build a continuous-prestage headroom gate that scrapes a serving
+    pool's ``/metrics`` for the ``tpu_cc_serve_offered_rps`` gauge and
+    converts the slack under ``knee_rps`` into whole nodes
+    (:func:`~tpu_cc_manager.serve.sweep.knee_slack_nodes`) — the remote
+    form ``tpu-cc-ctl rollout --prestage-knee-rps`` uses. Deliberately
+    the mirror image of :func:`metrics_gate`: a failed scrape RAISES so
+    ``_prestage_allowance`` reads zero slack (fail-closed) — prestage
+    must never consume headroom it cannot prove exists, while the wave
+    itself keeps rolling."""
+    from tpu_cc_manager.obs import slo as slo_mod
+    from tpu_cc_manager.serve import sweep as sweep_mod
+
+    if fetch is None:
+        def fetch(url: str) -> str:  # pragma: no cover - trivial I/O
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.read().decode("utf-8", "replace")
+
+    def gate() -> int:
+        text = fetch(source)
+        offered = slo_mod.parse_serve_offered_rps(text)
+        if offered is None:
+            return 0  # no gauge exported: no evidence of slack
+        return sweep_mod.knee_slack_nodes(knee_rps, offered, n_nodes)
+
+    return gate
+
+
 #: Terminal await-state for a node whose Node OBJECT vanished mid-window
 #: (cluster-autoscaler scale-down, spot reclaim). The informer delivers
 #: the DELETED event (or the fallback GET answers 404), and the await
@@ -417,6 +467,8 @@ class RollingReconfigurator:
         surge: int = 0,
         prestage: bool = False,
         prestage_timeout_s: float | None = None,
+        continuous_prestage: bool = False,
+        headroom_gate=None,
         adopt_new_nodes: bool = True,
         flight: "flight_mod.FlightRecorder | None" = None,
         slo_gate=None,
@@ -527,6 +579,31 @@ class RollingReconfigurator:
             prestage_timeout_s if prestage_timeout_s is not None
             else node_timeout_s
         )
+        # Whole-fleet zero-bounce (ROADMAP item 2): with
+        # ``continuous_prestage`` on, the single-shard window loop
+        # prestages the REGULAR nodes of upcoming windows (wave N+1)
+        # while window N flips, under a crash-journaled capacity ledger
+        # (rollout_state.CapacityLedger, record v7). Every prestage
+        # CAS-reserves one node of transition headroom, bounded by
+        # ``headroom_gate`` — a zero-arg callable returning how many
+        # nodes of slack the offered load leaves under the serving knee
+        # (serve.sweep.knee_slack_nodes). No gate = max_unavailable;
+        # a gate that RAISES reads as zero slack (fail-CLOSED — the
+        # opposite of the SLO gate, because prestage is an optimization
+        # and must never consume headroom it cannot prove exists).
+        # Prestage transitions are additionally capped at
+        # max_unavailable so concurrent prestages can never violate the
+        # rollout's own disruption bound. Sharded waves
+        # (wave_shards > 1) roll without continuous prestage: the
+        # ledger is a single-writer structure and the sharded suite
+        # asserts no cross-shard coupling.
+        self.continuous_prestage = bool(continuous_prestage)
+        self.headroom_gate = headroom_gate
+        # The live ledger: aliased to record.ledger when a record exists
+        # (so every checkpoint persists it), or an in-memory ledger for
+        # lease-less embedded callers (ServeHarness) — same invariants,
+        # no crash durability to need them.
+        self._ledger: "rollout_state.CapacityLedger | None" = None
         if self.surge > 0 and rollback_on_failure:
             # A surge halt would have to revert tainted spares (and the
             # halt path would silently skip the rollback otherwise) —
@@ -1180,6 +1257,49 @@ class RollingReconfigurator:
             else:
                 todo.append((gid, names))
         groups = todo
+        if self.continuous_prestage and self.wave_shards <= 1:
+            # The capacity ledger rides the record (v7) when one exists
+            # so every checkpoint persists it; lease-less callers get an
+            # in-memory ledger with the same invariants.
+            if record is not None:
+                if record.ledger is None:
+                    record.ledger = rollout_state.CapacityLedger()
+                self._ledger = record.ledger
+            else:
+                self._ledger = rollout_state.CapacityLedger()
+            if resumed and self._ledger.entries:
+                self._prestage_adopt(mode, groups, record)
+        elif self.continuous_prestage:
+            log.warning(
+                "continuous prestage is single-shard only (the ledger "
+                "is a single-writer structure); wave_shards=%d rolls "
+                "without it", self.wave_shards,
+            )
+        if (
+            not (self.continuous_prestage and self.wave_shards <= 1)
+            and record is not None
+            and record.ledger is not None
+            and record.ledger.entries
+        ):
+            # Degraded mode (--no-prestage on a ledgered record): every
+            # checkpointed entry is released and its agent's hold
+            # aborted — the ledger balances, every node takes the full
+            # flip path, and the drained ledger is persisted with the
+            # next checkpoint.
+            log.warning(
+                "prestage disabled on a ledgered record: releasing %d "
+                "entr(ies); every node takes the full flip path",
+                len(record.ledger.entries),
+            )
+            with self._record_lock:
+                for name in list(record.ledger.entries):
+                    self._prestage_clear_arm(name)
+                    record.ledger.release(name)
+                    self.metrics.record_prestage("aborted")
+                    self._fl(
+                        flight_mod.EVENT_PRESTAGE_RELEASED, node=name,
+                        outcome="aborted", resumed=True,
+                    )
         # Pre-rollout desired mode per node, for rollback_on_failure: read
         # from the pool listing already in hand — the rollout itself only
         # rewrites CC_MODE_LABEL on nodes it is about to await, so the
@@ -1365,6 +1485,12 @@ class RollingReconfigurator:
                     surged=surged,
                     max_unavailable_observed=self._max_inflight_observed,
                 )
+            # Continuous prestage maintenance: runs BEFORE the window
+            # timer starts, so prestage awaits never count against the
+            # measured per-window disruption wall — the whole point is
+            # that the flip window itself then closes in ~drain+readmit.
+            if self._ledger is not None:
+                self._prestage_maintain(mode, groups, i, record, window_id)
             self._crash_point("window-start")
             started = time.monotonic()
             self._note_window_inflight(len(window))
@@ -1403,6 +1529,15 @@ class RollingReconfigurator:
                 if not gres.ok:
                     ok = False
                     window_failed.append(gid)
+                # A held prestage that just converged (or failed) gives
+                # its headroom back: released exactly once, under the
+                # record lock, BEFORE the "awaited" checkpoint persists
+                # the balanced ledger.
+                if self._ledger is not None:
+                    self._prestage_release_group(
+                        names, outcome="converged" if gres.ok else "failed",
+                        window=window_id,
+                    )
             self._note_window_inflight(-len(window))
             window_seconds.append(time.monotonic() - started)
             self._fl(
@@ -1469,6 +1604,24 @@ class RollingReconfigurator:
                 mode, record, results, window_seconds, known_nodes
             )
             ok = ok and adopt_ok
+        if self._ledger is not None and self._ledger.entries:
+            # Terminal drain: a COMPLETE record must carry a balanced
+            # ledger (every charge released). Anything still entried
+            # here was reserved for a group that never flipped (plan
+            # shrank under us) — release it as aborted; the halt paths
+            # above deliberately KEEP their entries for --resume to
+            # adopt.
+            with self._record_lock:
+                for name in list(self._ledger.entries):
+                    self._ledger.release(name)
+                    self.metrics.record_prestage("aborted")
+                    self._fl(
+                        flight_mod.EVENT_PRESTAGE_RELEASED, node=name,
+                        outcome="aborted",
+                    )
+                self.metrics.set_prestage_reserved(
+                    self._ledger.in_transition()
+                )
         self._checkpoint(
             record,
             status=(
@@ -1821,6 +1974,354 @@ class RollingReconfigurator:
             "seconds": round(time.monotonic() - t0, 3),
             "ok": len(prestaged) == len(names),
         }
+
+    # -- continuous prestage (whole-fleet zero-bounce) ---------------------
+
+    def _prestage_allowance(self) -> int:
+        """How many nodes may be in prestage transition right now: the
+        headroom gate's knee slack (whole nodes the offered load leaves
+        free under the serving knee — serve.sweep.knee_slack_nodes),
+        capped at ``max_unavailable`` so concurrent prestages can never
+        violate the rollout's own disruption bound. No gate =
+        max_unavailable. A gate that RAISES reads ZERO slack
+        (fail-closed, the mirror image of the SLO gate's fail-open):
+        prestage is an optimization, and it must never consume headroom
+        it cannot prove exists — the wave rolls on unpaced either way."""
+        if self.headroom_gate is None:
+            return self.max_unavailable
+        try:
+            slack = int(self.headroom_gate())
+        except Exception as e:  # noqa: BLE001 - fail-closed by design
+            log.warning(
+                "prestage headroom gate failed (%s); reading ZERO slack "
+                "(prestage pauses; the wave is never paused by this)", e,
+            )
+            return 0
+        return max(0, min(slack, self.max_unavailable))
+
+    def _prestage_adopt(self, mode, groups, record) -> None:
+        """Resume-time ledger adoption — the dual-wave resume. Every
+        checkpointed entry is re-validated against the CURRENT plan: a
+        matching plan digest is adopted AS-IS and re-stamped with this
+        run's fence generation (no re-reserve — ``reserve()`` refusing
+        an existing node IS the no-double-charge proof), while a
+        vanished group or a digest mismatch is invalidated and released
+        exactly once, aborting the agent's hold so the node re-flips
+        via the full path rather than converging against an old plan.
+        Mirrors the surge resume rule: a kill between prestage-armed
+        and the flip adopts the held node, never re-drives it."""
+        ledger = self._ledger
+        plan = {gid: names for gid, names in groups}
+        digests = {
+            gid: rollout_state.plan_digest(mode, gid, names)
+            for gid, names in plan.items()
+        }
+        adopted: list[str] = []
+        dropped: list[str] = []
+        with self._record_lock:
+            for name in list(ledger.entries):
+                entry = ledger.entry(name)
+                gid = str(entry.get("gid"))
+                names = plan.get(gid)
+                if names is None or name not in names:
+                    # The group left the remaining plan: it either
+                    # converged before the crash (the charge settles as
+                    # converged) or was quarantined out from under its
+                    # prestage (invalidated; abort the hold).
+                    done = (record.done.get(gid) or {}) if record else {}
+                    outcome = (
+                        "converged" if done.get("ok") else "invalidated"
+                    )
+                    if outcome == "invalidated":
+                        self._prestage_clear_arm(name)
+                    ledger.release(name)
+                    self.metrics.record_prestage(outcome)
+                    self._fl(
+                        flight_mod.EVENT_PRESTAGE_RELEASED, node=name,
+                        outcome=outcome, resumed=True,
+                    )
+                    dropped.append(name)
+                elif entry.get("digest") != digests[gid]:
+                    self._prestage_clear_arm(name)
+                    ledger.release(name)
+                    self.metrics.record_prestage("invalidated")
+                    self._fl(
+                        flight_mod.EVENT_PRESTAGE_INVALIDATED, node=name,
+                        outcome="invalidated", resumed=True,
+                    )
+                    dropped.append(name)
+                else:
+                    ledger.mark(
+                        name, entry.get("state"),
+                        generation=self.generation,
+                    )
+                    adopted.append(name)
+        if adopted or dropped:
+            log.warning(
+                "resume: capacity ledger adopted %d prestage entr%s "
+                "as-is (%s) and released %d stale one(s) (%s)",
+                len(adopted), "y" if len(adopted) == 1 else "ies",
+                sorted(adopted), len(dropped), sorted(dropped),
+            )
+
+    def _prestage_maintain(self, mode, groups, i, record, window_id) -> None:
+        """One maintenance pass per wave boundary, run BEFORE the window
+        timer starts (prestage awaits never count against the measured
+        disruption wall): (1) sustained SLO burn pauses prestage — and
+        ONLY prestage; the wave itself is paced by ``_slo_gate_wait``;
+        (2) top-up — reserve + arm upcoming groups in plan order,
+        current window first, while the allowance holds; (3) finalize
+        the current window's entries — adopt the agents' held records
+        or invalidate and fall back to the full flip path; (4) a second
+        top-up fills the transition slots the finalize freed, which is
+        what makes wave N+1 prestage WHILE window N flips."""
+        window = groups[i : i + self.max_unavailable]
+        paused = self._slo_breached()
+        allowance = self._prestage_allowance()
+        self.metrics.set_prestage_headroom_nodes(allowance)
+        if paused:
+            log.warning(
+                "SLO burn at window %s boundary: pausing prestage "
+                "top-up (the wave itself is paced separately)",
+                window_id,
+            )
+            self.metrics.record_prestage("paused")
+            self._fl(
+                flight_mod.EVENT_PRESTAGE_PAUSED, window=window_id,
+                reason="slo-burn",
+            )
+        else:
+            self._prestage_topup(
+                mode, groups, i, record, allowance, window_id
+            )
+        self._prestage_finalize(mode, window, record, window_id)
+        if not paused:
+            self._prestage_topup(
+                mode, groups, i + self.max_unavailable, record,
+                allowance, window_id,
+            )
+        self.metrics.set_prestage_reserved(self._ledger.in_transition())
+
+    def _prestage_topup(
+        self, mode, groups, start, record, allowance, window_id
+    ) -> None:
+        """Reserve + arm groups from ``groups[start:]`` in plan order
+        while transition headroom remains. A slice flips as one unit,
+        so a group reserves ALL its nodes or none (too-big groups are
+        skipped, not split — the scan keeps looking for one that
+        fits). Groups already in the ledger only get stranded
+        reserved-not-armed entries re-armed (the prestage-reserved
+        crash resume)."""
+        ledger = self._ledger
+        for j in range(start, len(groups)):
+            gid, names = groups[j]
+            entered = [n for n in names if ledger.entry(n) is not None]
+            if entered:
+                stranded = [
+                    n for n in entered
+                    if (ledger.entry(n) or {}).get("state")
+                    == rollout_state.LEDGER_RESERVED
+                ]
+                if stranded:
+                    self._prestage_arm(
+                        mode, gid, stranded, record, window_id
+                    )
+                continue
+            free = allowance - ledger.in_transition()
+            if free <= 0:
+                break
+            if len(names) > free:
+                continue
+            digest = rollout_state.plan_digest(mode, gid, names)
+            with self._record_lock:
+                for name in names:
+                    ledger.reserve(
+                        name, gid, digest, self.generation or 0,
+                        limit=allowance,
+                    )
+            for name in names:
+                self.metrics.record_prestage("reserved")
+                self._fl(
+                    flight_mod.EVENT_PRESTAGE_RESERVED, node=name,
+                    group=gid, window=window_id, digest=digest,
+                )
+            # The reservation is durable BEFORE the node is touched: a
+            # kill at the point below leaves a charged entry the
+            # successor adopts, never a second charge.
+            self._checkpoint(record)
+            self._crash_point("prestage-reserved")
+            self._prestage_arm(mode, gid, names, record, window_id)
+
+    def _prestage_arm(self, mode, gid, names, record, window_id) -> None:
+        """Arm the PRESTAGE annotation on regular nodes — NO surge
+        taint: the node keeps serving, and the drain inside the agent's
+        journaled flip hands its in-flight requests to peers (the PR-14
+        handoff path), which is exactly the capacity the ledger
+        reserved. A vanished node (404) releases its charge as degraded
+        — its window retires it."""
+        ledger = self._ledger
+        armed: list[str] = []
+        for name in names:
+            try:
+                self.retry_policy.call(
+                    lambda name=name: self.api.patch_node_annotations(
+                        name, {labels_mod.PRESTAGE_ANNOTATION: mode}
+                    ),
+                    op="rollout.prestage_arm",
+                    classify=classify_kube_error,
+                )
+                armed.append(name)
+            except KubeApiError as e:
+                if e.status != 404:
+                    raise
+                log.warning(
+                    "node %s vanished before its prestage arm "
+                    "(autoscaler scale-down); releasing its ledger "
+                    "charge", name,
+                )
+                with self._record_lock:
+                    ledger.release(name)
+                self.metrics.record_prestage("degraded")
+                self._fl(
+                    flight_mod.EVENT_PRESTAGE_RELEASED, node=name,
+                    outcome="degraded", window=window_id,
+                )
+        if not armed:
+            return
+        with self._record_lock:
+            for name in armed:
+                ledger.mark(
+                    name, rollout_state.LEDGER_ARMED,
+                    generation=self.generation,
+                )
+        for name in armed:
+            self.metrics.record_prestage("armed")
+            self._fl(
+                flight_mod.EVENT_PRESTAGE_ARMED, node=name, group=gid,
+                window=window_id,
+            )
+        self._checkpoint(record)
+        self._crash_point("prestage-armed")
+
+    def _prestage_finalize(self, mode, window, record, window_id) -> None:
+        """The current window's entries meet their flip window: adopt
+        the agents' held records (entry → held; the node flips in
+        ~drain+readmit and its transition headroom is freed — held
+        entries cost nothing, which is what lets the next top-up start
+        wave N+1), or invalidate. Digest drift and never-held timeouts
+        both downgrade the node to the PR-10 full flip path and the
+        rollout presses on — a prestage-path failure never halts."""
+        ledger = self._ledger
+        pending: list[str] = []
+        for gid, names in window:
+            digest = rollout_state.plan_digest(mode, gid, names)
+            for name in names:
+                entry = ledger.entry(name)
+                if entry is None:
+                    continue
+                if entry.get("digest") != digest:
+                    # The plan advanced under the entry: a stale
+                    # prestage must re-flip, never converge against an
+                    # old plan.
+                    self._prestage_invalidate(
+                        name, record, window_id, outcome="invalidated"
+                    )
+                elif entry.get("state") != rollout_state.LEDGER_HELD:
+                    pending.append(name)
+        if not pending:
+            return
+        held: set[str] = set()
+
+        def scan() -> bool:
+            nodes = {
+                n["metadata"]["name"]: n for n in self._list_pool()
+            }
+            for name in pending:
+                if name in held:
+                    continue
+                node = nodes.get(name)
+                if node is not None and (
+                    self._prestaged_record_of(node, mode) is not None
+                ):
+                    held.add(name)
+            return len(held) == len(pending)
+
+        retry_mod.poll_until(
+            scan, self.prestage_timeout_s, self.poll_interval_s
+        )
+        with self._record_lock:
+            for name in held:
+                ledger.mark(name, rollout_state.LEDGER_HELD)
+        for name in held:
+            self.metrics.record_prestage("held")
+            self._fl(
+                flight_mod.EVENT_PRESTAGE_HELD, node=name,
+                window=window_id,
+            )
+        for name in pending:
+            if name not in held:
+                self._prestage_invalidate(
+                    name, record, window_id, outcome="degraded"
+                )
+        if held:
+            self._checkpoint(record)
+
+    def _prestage_invalidate(
+        self, name, record, window_id, outcome
+    ) -> None:
+        """Exactly-once invalidation: the crash point fires FIRST (a
+        kill here leaves the charged entry for the successor to
+        re-validate and release — never a lost or doubled charge), then
+        the agent's hold is aborted, the charge released, and the
+        balanced ledger checkpointed."""
+        self._crash_point("prestage-invalidate")
+        log.warning(
+            "prestage of %s invalidated (%s); the node re-flips via "
+            "the full path", name, outcome,
+        )
+        self._prestage_clear_arm(name)
+        with self._record_lock:
+            self._ledger.release(name)
+        self.metrics.record_prestage(outcome)
+        self._fl(
+            flight_mod.EVENT_PRESTAGE_INVALIDATED, node=name,
+            window=window_id, outcome=outcome,
+        )
+        self._checkpoint(record)
+
+    def _prestage_release_group(self, names, outcome, window) -> None:
+        """Release the entries of a just-awaited window group (held
+        prestages settle as converged). Idempotent: release() answers
+        False for absent nodes, so only real releases are journaled."""
+        with self._record_lock:
+            released = [n for n in names if self._ledger.release(n)]
+        for name in released:
+            self.metrics.record_prestage(outcome)
+            self._fl(
+                flight_mod.EVENT_PRESTAGE_RELEASED, node=name,
+                outcome=outcome, window=window,
+            )
+        if released:
+            self.metrics.set_prestage_reserved(
+                self._ledger.in_transition()
+            )
+
+    def _prestage_clear_arm(self, name: str) -> None:
+        """Best-effort abort of a node's prestage hold: deleting the
+        PRESTAGE annotation makes the agent revert its held flip
+        (manager.py watches the request vanish). A vanished node needs
+        no abort."""
+        try:
+            self.retry_policy.call(
+                lambda: self.api.patch_node_annotations(
+                    name, {labels_mod.PRESTAGE_ANNOTATION: None}
+                ),
+                op="rollout.prestage_clear",
+                classify=classify_kube_error,
+            )
+        except KubeApiError as e:
+            if e.status != 404:
+                raise
 
     # -- autoscaler scale-up adoption -------------------------------------
 

@@ -39,6 +39,7 @@ rollout generation last drove each node (``tpu-cc-ctl status``).
 from __future__ import annotations
 
 import copy
+import hashlib
 import json
 import logging
 import os
@@ -108,12 +109,174 @@ RECORD_HALTED = "halted"
 #: bound at all — the precise overspend the escrow exists to prevent —
 #: so v6 is refused loudly by escrow-unaware parsers; budgetless
 #: federated slices stay v5.
-RECORD_VERSION = 6
+#: 7: adds ``ledger`` (the continuous-prestage capacity ledger): every
+#: in-flight headroom reservation for a wave-N+1 prestage, plus the
+#: per-node charge/release counters that prove exactly-once accounting
+#: across a crash. Written ONLY when the ledger has ever been touched. A
+#: ledger-unaware binary resuming a v7 record would silently drop the
+#: reservations: armed prestages would neither converge against their
+#: plan digest nor release their headroom — the successor could stack
+#: fresh prestages on top of invisible old ones and spend the knee slack
+#: the SLO gate is protecting — so v7 is refused loudly by older
+#: parsers. Rollouts that never prestage keep writing <= v6.
+RECORD_VERSION = 7
 #: What records WITHOUT the newer optional fields write (compat floors).
+RECORD_VERSION_NO_LEDGER = 6
 RECORD_VERSION_NO_ESCROW = 5
 RECORD_VERSION_NO_FEDERATION = 4
 RECORD_VERSION_NO_SLO = 3
 RECORD_VERSION_NO_SURGE = 2
+
+#: Capacity-ledger entry states. ``reserved``: headroom charged, the
+#: arm annotation not yet (durably) written. ``armed``: the PRESTAGE
+#: annotation is on the node; its agent is (or will be) running the
+#: full journaled flip + warmup — the node is in transition and
+#: consumes headroom. ``held``: the agent published a valid prestaged
+#: record and re-admitted — the node serves again (at the target mode,
+#: holding), so it no longer consumes transition headroom; the entry
+#: stays until the node's flip window converges it (release) or the
+#: plan moves past it (invalidate).
+LEDGER_RESERVED = "reserved"
+LEDGER_ARMED = "armed"
+LEDGER_HELD = "held"
+_LEDGER_STATES = (LEDGER_RESERVED, LEDGER_ARMED, LEDGER_HELD)
+
+
+def plan_digest(mode: str, gid: str, names) -> str:
+    """Short content digest of one group's flip plan (target mode +
+    group identity + membership). A ledger entry is only adoptable while
+    the digest it was reserved under still matches the live plan — a
+    stale prestaged node must re-flip, never converge against an old
+    plan (rolling.py continuous prestage)."""
+    basis = "|".join([str(mode), str(gid)] + sorted(str(n) for n in names))
+    return hashlib.sha256(basis.encode("utf-8")).hexdigest()[:12]
+
+
+@dataclass
+class CapacityLedger:
+    """Journaled headroom reservations for continuous prestage (record
+    format v7). One entry per prestaging node; ``charged``/``released``
+    are per-node lifetime counters, persisted so "balances to zero, no
+    double charge" is provable across a crash: the ledger is balanced
+    iff total charges minus total releases equals the live entry count,
+    and a node was never double-charged iff its charge count stayed at
+    one. All mutation happens under the orchestrator's record lock
+    (rolling.py brackets every mutation + checkpoint)."""
+
+    entries: dict[str, dict] = field(default_factory=dict)
+    charged: dict[str, int] = field(default_factory=dict)
+    released: dict[str, int] = field(default_factory=dict)
+
+    def entry(self, node: str) -> dict | None:
+        return self.entries.get(node)
+
+    def in_transition(self) -> int:
+        """Entries currently consuming headroom (reserved/armed — the
+        node is mid-prestage). Held entries serve again and count 0."""
+        return sum(
+            1 for e in self.entries.values()
+            if e.get("state") != LEDGER_HELD
+        )
+
+    def active(self) -> int:
+        return len(self.entries)
+
+    def reserve(
+        self, node: str, gid: str, digest: str, generation: int,
+        limit: int,
+    ) -> bool:
+        """CAS-reserve one node of headroom. Refused (False, nothing
+        charged) when the node already holds an entry — re-reserving is
+        the double charge the ledger exists to prevent; a resume adopts
+        the existing entry instead — or when the reservation would push
+        the in-transition count past ``limit``. The caller checkpoints
+        the record after a successful reserve: the durable write IS the
+        reservation."""
+        if node in self.entries:
+            return False
+        if self.in_transition() >= max(0, int(limit)):
+            return False
+        self.entries[node] = {
+            "gid": str(gid),
+            "digest": str(digest),
+            "generation": int(generation),
+            "state": LEDGER_RESERVED,
+        }
+        self.charged[node] = self.charged.get(node, 0) + 1
+        return True
+
+    def mark(self, node: str, state: str, generation: int | None = None) -> None:
+        """Advance an entry's state (reserved -> armed -> held). A
+        resume re-stamps the fence generation it adopted the entry
+        under."""
+        assert state in _LEDGER_STATES, state
+        e = self.entries.get(node)
+        if e is None:
+            return
+        e["state"] = state
+        if generation is not None:
+            e["generation"] = int(generation)
+
+    def release(self, node: str) -> bool:
+        """Drop an entry (converged / invalidated / aborted / degraded)
+        and count the release. Releasing an absent node is a no-op
+        (False) so the counters can never drift from the entry map — a
+        crash between an in-memory release and its checkpoint re-runs
+        the release idempotently on resume."""
+        if self.entries.pop(node, None) is None:
+            return False
+        self.released[node] = self.released.get(node, 0) + 1
+        return True
+
+    def charges_total(self) -> int:
+        return sum(self.charged.values())
+
+    def releases_total(self) -> int:
+        return sum(self.released.values())
+
+    def balanced(self) -> bool:
+        """The conservation invariant: every charge is either still an
+        entry or exactly one release. Zero entries + balanced means the
+        ledger balances to zero."""
+        return (
+            self.charges_total() - self.releases_total()
+            == len(self.entries)
+        )
+
+    def double_charged(self) -> list[str]:
+        """Nodes charged more than once over the rollout's lifetime —
+        must stay empty across any kill/resume interleaving."""
+        return sorted(n for n, c in self.charged.items() if c > 1)
+
+    def to_dict(self) -> dict:
+        return {
+            "entries": {n: dict(e) for n, e in sorted(self.entries.items())},
+            "charged": dict(sorted(self.charged.items())),
+            "released": dict(sorted(self.released.items())),
+        }
+
+    @classmethod
+    def from_dict(cls, obj: dict) -> "CapacityLedger":
+        return cls(
+            entries={
+                str(n): dict(e)
+                for n, e in (obj.get("entries") or {}).items()
+            },
+            charged={
+                str(n): int(c)
+                for n, c in (obj.get("charged") or {}).items()
+            },
+            released={
+                str(n): int(c)
+                for n, c in (obj.get("released") or {}).items()
+            },
+        )
+
+    def touched(self) -> bool:
+        """Whether this ledger has ever recorded anything — an untouched
+        ledger is dropped from the serialized record so non-prestaging
+        rollouts keep their downgrade-compatible <= v6 format."""
+        return bool(self.entries or self.charged)
 
 
 def lease_namespace() -> str:
@@ -180,6 +343,12 @@ class RolloutRecord:
     # crash + --resume reconnects the successor to the parent's global
     # budget instead of silently resuming one region unfenced.
     federation: dict | None = None
+    # Continuous-prestage capacity ledger (format v7, written only once
+    # touched): in-flight wave-N+1 headroom reservations plus the
+    # per-node charge/release counters. A successor adopts armed
+    # entries as-is (no re-surge, no second charge) and invalidates
+    # entries whose plan digest no longer matches.
+    ledger: CapacityLedger | None = None
 
     def charge_budget(self, nodes) -> None:
         self.budget_spend = sorted(set(self.budget_spend) | set(nodes))
@@ -202,11 +371,20 @@ class RolloutRecord:
         federation = self.federation if (
             self.federation and int(self.federation.get("regions") or 0) > 1
         ) else None
-        if federation and "escrow" in federation:
+        ledger = (
+            self.ledger if self.ledger is not None and self.ledger.touched()
+            else None
+        )
+        if ledger is not None:
+            # The rollout prestaged: a ledger-unaware resume would drop
+            # the reservations and stack fresh prestages on invisible
+            # old ones, so refuse downgrade.
+            version = RECORD_VERSION
+        elif federation and "escrow" in federation:
             # The shard holds an escrow ledger (parent-plane partition
             # tolerance): an escrow-unaware resume would keep charging
             # unbounded while the parent is dark, so refuse downgrade.
-            version = RECORD_VERSION
+            version = RECORD_VERSION_NO_LEDGER
         elif federation:
             version = RECORD_VERSION_NO_ESCROW
         elif self.slo_gate:
@@ -232,6 +410,8 @@ class RolloutRecord:
         }
         if federation:
             body["federation"] = federation
+        if ledger is not None:
+            body["ledger"] = ledger.to_dict()
         return json.dumps(body, sort_keys=True, separators=(",", ":"))
 
     @classmethod
@@ -273,6 +453,10 @@ class RolloutRecord:
                 federation=(
                     dict(obj["federation"])
                     if isinstance(obj.get("federation"), dict) else None
+                ),
+                ledger=(
+                    CapacityLedger.from_dict(obj["ledger"])
+                    if isinstance(obj.get("ledger"), dict) else None
                 ),
             )
         except RolloutFenced:

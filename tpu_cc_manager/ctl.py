@@ -126,6 +126,34 @@ def build_parser() -> argparse.ArgumentParser:
         "falling back to the full flip (default: --node-timeout)",
     )
     r.add_argument(
+        "--prestage-continuous", action="store_true",
+        help="whole-fleet zero-bounce: prestage upcoming REGULAR "
+        "windows (wave N+1 arms while wave N flips) under a "
+        "crash-journaled capacity ledger in the record (v7 — older "
+        "binaries refuse it loudly). Concurrency is bounded by "
+        "min(--prestage-knee-rps slack, max-unavailable); SLO burn "
+        "pauses prestage (never the wave); a prestage failure "
+        "downgrades that node to the full flip path. A resume of a "
+        "ledgered record re-enables this automatically unless "
+        "--no-prestage",
+    )
+    r.add_argument(
+        "--no-prestage", action="store_true",
+        help="degraded-mode escape hatch: disable continuous prestage "
+        "even when resuming a record that carries a capacity ledger "
+        "(its entries are invalidated and released on adoption; every "
+        "node takes the full flip path — see docs/operations.md)",
+    )
+    r.add_argument(
+        "--prestage-knee-rps", type=float, default=None,
+        help="the serving pool's measured knee (hack/serve_bench.py "
+        "--sweep): with --slo-source, the continuous-prestage headroom "
+        "gate scrapes tpu_cc_serve_offered_rps and allows prestage "
+        "only while offered load leaves whole nodes of slack under "
+        "this knee (no knee or no source: allowance defaults to "
+        "max-unavailable)",
+    )
+    r.add_argument(
         "--no-adopt", action="store_true",
         help="do NOT adopt nodes created mid-rollout (autoscaler "
         "scale-up) into a trailing wave; by default new selector-matching "
@@ -796,6 +824,62 @@ def cmd_rollout(api, args) -> int:
                 "--slo-source (or --abort to discard the record)"
             )
         slo_gate = metrics_gate(slo_config)
+    # Continuous prestage (record v7 capacity ledger): the explicit
+    # flag, or inherited on --resume from a record that carries a
+    # ledger — a ledgered rollout must stay ledgered across a crash
+    # (its checkpointed entries need adoption), unless the operator
+    # degrades it deliberately with --no-prestage.
+    continuous_prestage = getattr(args, "prestage_continuous", False)
+    if getattr(args, "no_prestage", False):
+        if continuous_prestage:
+            if lease is not None:
+                lease.release()
+            raise ValueError(
+                "--prestage-continuous and --no-prestage are "
+                "contradictory"
+            )
+        if (
+            resume_record is not None
+            and resume_record.ledger is not None
+            and resume_record.ledger.entries
+        ):
+            log.warning(
+                "resume: --no-prestage on a ledgered record — its %d "
+                "prestage entr(ies) will be released and every node "
+                "takes the full flip path",
+                len(resume_record.ledger.entries),
+            )
+    elif (
+        not continuous_prestage
+        and resume_record is not None
+        and resume_record.ledger is not None
+    ):
+        continuous_prestage = True
+        log.warning(
+            "resume: the record carries a capacity ledger (%d live "
+            "entr(ies)); re-enabling continuous prestage "
+            "(--no-prestage to degrade)",
+            len(resume_record.ledger.entries),
+        )
+    prestage_knee_rps = getattr(args, "prestage_knee_rps", None)
+    if prestage_knee_rps and not continuous_prestage:
+        if lease is not None:
+            lease.release()
+        raise ValueError(
+            "--prestage-knee-rps needs --prestage-continuous (or a "
+            "--resume of a ledgered record)"
+        )
+    if (
+        continuous_prestage and prestage_knee_rps
+        and (slo_config is None or not slo_config.source)
+    ):
+        if lease is not None:
+            lease.release()
+        raise ValueError(
+            "--prestage-knee-rps needs --slo-source (the serving "
+            "pool's /metrics URL the headroom gate scrapes for "
+            "tpu_cc_serve_offered_rps)"
+        )
     if mode is None:
         if lease is not None:
             lease.release()
@@ -857,6 +941,20 @@ def cmd_rollout(api, args) -> int:
                     "falls back to O(pool) polling listings"
                 )
                 informer = None
+        headroom_gate = None
+        if continuous_prestage and prestage_knee_rps:
+            # Whole-node slack under the measured knee, judged from the
+            # pool's live offered-rate gauge. The node count is the
+            # live selector population (a gate call is one scrape; the
+            # count is re-read so autoscaling doesn't skew the slack).
+            from tpu_cc_manager.ccmanager.rolling import (
+                headroom_gate_from_source,
+            )
+
+            n_nodes = max(1, len(api.list_nodes(args.selector)))
+            headroom_gate = headroom_gate_from_source(
+                slo_config.source, prestage_knee_rps, n_nodes,
+            )
         roller = RollingReconfigurator(
             api,
             args.selector,
@@ -872,6 +970,8 @@ def cmd_rollout(api, args) -> int:
             surge=surge,
             prestage=getattr(args, "prestage", False),
             prestage_timeout_s=getattr(args, "prestage_timeout", None),
+            continuous_prestage=continuous_prestage,
+            headroom_gate=headroom_gate,
             adopt_new_nodes=not getattr(args, "no_adopt", False),
             flight=flight,
             slo_gate=slo_gate,
@@ -1450,6 +1550,46 @@ def _rollout_status_line(api, namespace: str | None = None) -> str | None:
     return rollout_state.describe_lease(lease)
 
 
+def _prestage_status_line(api, namespace: str | None = None) -> str | None:
+    """The capacity-ledger block for a ledgered record: per-state entry
+    counts plus the charge/release balance — the first read of the
+    continuous-prestage degraded-mode runbook (docs/operations.md
+    "Continuous prestage & the capacity ledger")."""
+    from tpu_cc_manager.ccmanager import rollout_state
+    from tpu_cc_manager.kubeclient.api import KubeApiError
+
+    try:
+        lease = api.get_lease(
+            namespace or rollout_state.lease_namespace(),
+            rollout_state.LEASE_NAME,
+        )
+        record = rollout_state.record_of_lease(lease)
+    except (KubeApiError, rollout_state.RolloutFenced):
+        return None
+    if (
+        record is None
+        or record.ledger is None
+        or not record.ledger.touched()
+    ):
+        return None
+    led = record.ledger
+    by_state: dict[str, int] = {}
+    for e in led.entries.values():
+        s = str(e.get("state"))
+        by_state[s] = by_state.get(s, 0) + 1
+    line = (
+        "PRESTAGE ledger: "
+        f"{by_state.get(rollout_state.LEDGER_RESERVED, 0)} reserved, "
+        f"{by_state.get(rollout_state.LEDGER_ARMED, 0)} armed, "
+        f"{by_state.get(rollout_state.LEDGER_HELD, 0)} held; "
+        f"charges={led.charges_total()} releases={led.releases_total()} "
+        f"({'balanced' if led.balanced() else 'UNBALANCED'})"
+    )
+    if not led.balanced():
+        line += " — resume with --no-prestage to drain"
+    return line
+
+
 def cmd_status(api, args) -> int:
     from tpu_cc_manager import labels as labels_mod
     from tpu_cc_manager.ccmanager import remediation as remediation_mod
@@ -1468,6 +1608,11 @@ def cmd_status(api, args) -> int:
     )
     if rollout_line:
         print(rollout_line)
+        prestage_line = _prestage_status_line(
+            api, getattr(args, "lease_namespace", None)
+        )
+        if prestage_line:
+            print(prestage_line)
     # Federated rollouts: when a parent record exists, show the global
     # view (per-region status + escrow balances, global budget spend,
     # last-sync staleness) above the node table — the first thing to

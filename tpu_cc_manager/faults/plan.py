@@ -118,6 +118,10 @@ class FaultPlan:
         self._forced_blackout = False
         self.blackout_windows = 0
         self.blackout_refusals = 0
+        # One-shot forced kill point (seed_prestage_kill): the next
+        # decide_orchestrator_kill at exactly this point raises,
+        # regardless of kill_rate.
+        self._forced_kill_point: str | None = None
 
     @classmethod
     def from_env(cls, default_seed: int = 20260803, **kwargs) -> "FaultPlan":
@@ -235,6 +239,15 @@ class FaultPlan:
         self._seq += 1
         roll = self.rng.random()
         kills = sum(1 for f in self.injected if f.kind == "orch-kill")
+        if self._forced_kill_point == point:
+            # Seeded scenario kill (seed_prestage_kill): one-shot, fires
+            # at exactly the armed point, bypasses kill_rate but is
+            # recorded like a drawn kill. The rng already advanced
+            # above, so arming never reshuffles the drawn schedule.
+            self._forced_kill_point = None
+            fault = Fault(kind="orch-kill", op=point, seq=self._seq)
+            self.injected.append(fault)
+            raise OrchestratorKilled(point, self._seq)
         if roll >= self.kill_rate or self.exhausted or (
             self.max_kills is not None and kills >= self.max_kills
         ):
@@ -302,6 +315,23 @@ class FaultPlan:
             Fault(kind="preemption", op="preemption-notice", seq=self._seq)
         )
         backend.set_preempted(True)
+
+    def seed_prestage_kill(self, points: tuple[str, ...] = (
+        "prestage-reserved", "prestage-armed", "prestage-invalidate",
+    )) -> str:
+        """Arm ONE orchestrator kill at a continuous-prestage crash
+        point, the point drawn from the seeded main stream (the
+        chaos_soak dual-wave leg needs the scenario — a SIGKILL landing
+        mid-prestage of wave N+1 while wave N drains — not the odds;
+        WHICH prestage point stays a pure function of the seed so a
+        soak failure replays exactly). The armed point fires through
+        :meth:`decide_orchestrator_kill`'s normal path via a one-shot
+        force, recorded in the injected schedule like a drawn kill.
+        Returns the point armed."""
+        self._seq += 1
+        point = points[self.rng.randrange(len(points))]
+        self._forced_kill_point = point
+        return point
 
     def seed_blackout_window(self) -> int:
         """Open ONE total-outage window unconditionally, its length in

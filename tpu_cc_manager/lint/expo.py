@@ -312,6 +312,18 @@ def _seeded_registry_text() -> str:
     registry.set_serve_hbm_bw_util("serve-node-0", 0.73)
     registry.set_serve_hbm_bw_util('odd"node\nname', 0.99)
     registry.set_prestage_in_progress(True)
+    # Continuous-prestage ledger families (ccmanager/rolling.py
+    # continuous_prestage, record v7), awkward outcome value included.
+    registry.set_prestage_reserved(2)
+    registry.set_prestage_headroom_nodes(1)
+    registry.record_prestage("reserved")
+    registry.record_prestage("armed")
+    registry.record_prestage("held")
+    registry.record_prestage("converged")
+    registry.record_prestage("invalidated")
+    registry.record_prestage("degraded")
+    registry.record_prestage("paused")
+    registry.record_prestage('odd"outcome\nhere')
     return registry.render_prometheus()
 
 

@@ -93,6 +93,22 @@ EVENT_FEDERATION_SYNC = "federation-sync"
 #: long each region ran autonomously.
 EVENT_PARENT_OFFLINE = "parent-offline"
 EVENT_PARENT_RECONNECT = "parent-reconnect"
+#: Continuous prestage (ccmanager/rolling.py continuous_prestage, record
+#: v7): the capacity-ledger lifecycle of one REGULAR node prestaged
+#: ahead of its flip window. ``reserved`` journals the headroom charge
+#: (durable before the node is touched), ``armed`` the PRESTAGE request
+#: landing, ``held`` the agent's completed hidden flip adopted at the
+#: window, ``invalidated`` a stale/never-held entry downgraded to the
+#: full flip path, ``released`` the charge settling (outcome rides
+#: along: converged/degraded/aborted), and ``paused`` a maintenance
+#: pass that skipped its top-up on SLO burn — prestage pauses, the
+#: wave never does.
+EVENT_PRESTAGE_RESERVED = "prestage-reserved"
+EVENT_PRESTAGE_ARMED = "prestage-armed"
+EVENT_PRESTAGE_HELD = "prestage-held"
+EVENT_PRESTAGE_INVALIDATED = "prestage-invalidated"
+EVENT_PRESTAGE_RELEASED = "prestage-released"
+EVENT_PRESTAGE_PAUSED = "prestage-paused"
 
 #: Node-terminal events: the exactly-once reconstruction keys on these
 #: (a node converges/fails/retires once per rollout, crash+resume
@@ -288,6 +304,10 @@ def reconstruct(events: list[dict]) -> dict:
     adopted: list[str] = []
     surged: list[str] = []
     prestaged: list[str] = []
+    prestage: dict = {
+        "reserved": [], "armed": [], "held": [], "invalidated": [],
+        "released": {}, "paused": 0,
+    }
     for e in events:
         ev = e.get("event")
         gen = e.get("gen")
@@ -305,6 +325,21 @@ def reconstruct(events: list[dict]) -> dict:
             surged.extend(e.get("nodes") or [])
         elif ev == EVENT_SPARE_PRESTAGED:
             prestaged.append(e.get("node"))
+        elif ev == EVENT_PRESTAGE_RESERVED:
+            prestage["reserved"].append(e.get("node"))
+        elif ev == EVENT_PRESTAGE_ARMED:
+            prestage["armed"].append(e.get("node"))
+        elif ev == EVENT_PRESTAGE_HELD:
+            prestage["held"].append(e.get("node"))
+        elif ev == EVENT_PRESTAGE_INVALIDATED:
+            prestage["invalidated"].append(e.get("node"))
+        elif ev == EVENT_PRESTAGE_RELEASED:
+            outcome = e.get("outcome") or "released"
+            prestage["released"][outcome] = (
+                prestage["released"].get(outcome, 0) + 1
+            )
+        elif ev == EVENT_PRESTAGE_PAUSED:
+            prestage["paused"] += 1
         elif ev == EVENT_NODE_ADOPTED:
             adopted.append(e.get("node"))
         elif ev in (EVENT_WINDOW_OPEN, EVENT_WINDOW_CLOSE):
@@ -370,6 +405,14 @@ def reconstruct(events: list[dict]) -> dict:
         "adopted": sorted(n for n in adopted if n),
         "surged": sorted(set(surged)),
         "prestaged": sorted({n for n in prestaged if n}),
+        # Continuous-prestage ledger accounting, crash-spanning: a
+        # resumed rollout's adoption re-journals nothing, so reserved −
+        # (invalidated + released) should read the live in-transition
+        # count and a COMPLETE timeline balances to zero.
+        "prestage": prestage if (
+            prestage["reserved"] or prestage["paused"]
+            or prestage["released"]
+        ) else None,
         "halts": halts,
         "slo_pauses": slo_pauses,
         "duplicate_node_events": duplicates,

@@ -268,6 +268,26 @@ def parse_serve_slo_text(text: str) -> dict[float, dict[str, float]]:
     return out
 
 
+def parse_serve_offered_rps(text: str) -> float | None:
+    """Parse the ``tpu_cc_serve_offered_rps`` gauge (no labels, unlike
+    the windowed SLO gauges) out of an exposition scrape — the input
+    the continuous-prestage headroom gate converts into knee slack
+    (rolling.headroom_gate_from_source). None when the pool exports no
+    offered-rate gauge: no evidence of slack."""
+    import re
+
+    m = re.search(
+        r"^tpu_cc_serve_offered_rps\s+([0-9.eE+-]+)\s*$",
+        text, re.MULTILINE,
+    )
+    if m is None:
+        return None
+    try:
+        return float(m.group(1))
+    except ValueError:
+        return None
+
+
 def breached_from_metrics_text(
     text: str,
     max_burn_rate: float = 1.0,

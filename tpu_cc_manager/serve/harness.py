@@ -220,6 +220,7 @@ class ServeHarness:
         slo_p99_target_ms: float | None = None,
         slo_window_s: float | None = None,
         slo_max_pause_s: float = 60.0,
+        roller_kwargs: dict | None = None,
     ) -> dict:
         """Sustain traffic for ``traffic_s`` (plus however long the flip
         needs), run the rolling CC flip after ``warmup_frac`` of it, and
@@ -283,6 +284,9 @@ class ServeHarness:
                     metrics=self.metrics,
                     slo_gate=slo_gate,
                     slo_config=slo_config,
+                    # Extra orchestrator knobs (BENCH_r09 passes
+                    # continuous_prestage + headroom_gate here).
+                    **(roller_kwargs or {}),
                 )
                 t_roll_0 = time.monotonic()
                 result = roller.rollout(rollout_mode)

@@ -154,6 +154,39 @@ def find_knee(
     return knee
 
 
+def knee_slack_nodes(
+    knee_rps: float, offered_rps: float, n_nodes: int
+) -> int:
+    """How many whole nodes of capacity the offered load leaves free
+    under the knee. The knee is the fleet's proven serving capacity, so
+    one node is worth ``knee_rps / n_nodes`` of it; the slack is the
+    unused capacity expressed in those units, floored (a fractional
+    node cannot absorb a whole node's traffic during its prestage).
+    Pure and fail-closed: nonsensical inputs (no nodes, no knee,
+    offered at/above knee) yield 0."""
+    if n_nodes <= 0 or knee_rps <= 0:
+        return 0
+    per_node = knee_rps / n_nodes
+    slack = (knee_rps - max(0.0, offered_rps)) / per_node
+    return max(0, int(slack))
+
+
+def prestage_allowance(
+    knee_rps: float,
+    offered_rps: float,
+    n_nodes: int,
+    reserve_nodes: int = 1,
+) -> int:
+    """The capacity ledger's concurrency budget: how many nodes may be
+    in prestage transition at once. The ISSUE-19 rule is "prestage only
+    while offered load leaves >= 1 node of slack" — so the allowance is
+    the knee slack MINUS a reserved node kept free for the wave itself
+    (the draining window's traffic has to land somewhere). At 80 % of
+    knee on 10 nodes: slack 2, allowance 1."""
+    slack = knee_slack_nodes(knee_rps, offered_rps, n_nodes)
+    return max(0, slack - max(0, int(reserve_nodes)))
+
+
 def goodput_holds_past_knee(
     rows: list[dict], knee: dict, hold_frac: float = DEFAULT_HOLD_FRAC
 ) -> bool:

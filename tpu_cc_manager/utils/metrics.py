@@ -280,6 +280,15 @@ class MetricsRegistry:
         # of the rollout wave) took.
         self._serve_handoff_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
         self._spare_prestage_seconds: float | None = None  # cclint: guarded-by(_lock)
+        # Continuous prestage (ccmanager/rolling.py capacity ledger,
+        # record v7): how many regular nodes are in prestage transition
+        # right now (reserved or armed — held costs nothing), the
+        # allowance the headroom gate last granted, and lifecycle
+        # outcomes (reserved/armed/held/converged/invalidated/degraded/
+        # paused/aborted/failed) as a labeled counter.
+        self._prestage_reserved: int | None = None  # cclint: guarded-by(_lock)
+        self._prestage_headroom_nodes: int | None = None  # cclint: guarded-by(_lock)
+        self._prestage_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -617,6 +626,38 @@ class MetricsRegistry:
         with self._lock:
             self._rollout_slo_pauses_total += 1
 
+    def set_prestage_reserved(self, count: int) -> None:
+        """Gauge: capacity-ledger entries currently in prestage
+        TRANSITION (reserved or armed — a held entry's node is serving
+        at the target mode and costs no headroom), maintained by the
+        rolling orchestrator's continuous-prestage pass."""
+        with self._lock:
+            self._prestage_reserved = max(0, int(count))
+
+    def set_prestage_headroom_nodes(self, count: int) -> None:
+        """Gauge: the prestage allowance the headroom gate last granted
+        — whole nodes of slack under the serving knee, capped at
+        max_unavailable (serve.sweep.knee_slack_nodes). Zero while the
+        gate fails closed or offered load fills the knee."""
+        with self._lock:
+            self._prestage_headroom_nodes = max(0, int(count))
+
+    def record_prestage(self, outcome: str) -> None:
+        """Count one continuous-prestage lifecycle step by outcome:
+        ``reserved``/``armed``/``held`` (the happy path), ``converged``
+        (charge settled at the flip window), ``invalidated`` (stale
+        plan digest), ``degraded`` (prestage-path failure downgraded
+        the node to the full flip), ``paused`` (SLO burn skipped a
+        top-up) and ``aborted``/``failed`` (terminal drains)."""
+        with self._lock:
+            self._prestage_totals[outcome] = (
+                self._prestage_totals.get(outcome, 0) + 1
+            )
+
+    def prestage_totals(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._prestage_totals)
+
     def set_serve_goodput(self, rps: float) -> None:
         """Completed-requests-per-second over the SLO window."""
         with self._lock:
@@ -758,6 +799,9 @@ class MetricsRegistry:
             serve_slo = dict(self._serve_slo)
             serve_handoffs = dict(self._serve_handoff_totals)
             spare_prestage_seconds = self._spare_prestage_seconds
+            prestage_reserved = self._prestage_reserved
+            prestage_headroom = self._prestage_headroom_nodes
+            prestage_totals = dict(self._prestage_totals)
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -1208,6 +1252,39 @@ class MetricsRegistry:
             lines.append(
                 "tpu_cc_rollout_slo_pauses_total %d" % rollout_slo_pauses
             )
+        if prestage_reserved is not None:
+            lines.append(
+                "# HELP tpu_cc_prestage_reserved Capacity-ledger entries "
+                "currently in prestage transition (reserved or armed; a "
+                "held entry's node serves at target mode and costs no "
+                "headroom) — ccmanager/rolling.py continuous prestage."
+            )
+            lines.append("# TYPE tpu_cc_prestage_reserved gauge")
+            lines.append("tpu_cc_prestage_reserved %d" % prestage_reserved)
+        if prestage_headroom is not None:
+            lines.append(
+                "# HELP tpu_cc_prestage_headroom_nodes Prestage allowance "
+                "the headroom gate last granted: whole nodes of slack "
+                "under the serving knee, capped at max_unavailable "
+                "(serve/sweep.py knee_slack_nodes)."
+            )
+            lines.append("# TYPE tpu_cc_prestage_headroom_nodes gauge")
+            lines.append(
+                "tpu_cc_prestage_headroom_nodes %d" % prestage_headroom
+            )
+        if prestage_totals:
+            lines.append(
+                "# HELP tpu_cc_prestage_total Continuous-prestage "
+                "lifecycle steps by outcome (reserved/armed/held/"
+                "converged/invalidated/degraded/paused/aborted) — the "
+                "ledger balances when charges equal releases."
+            )
+            lines.append("# TYPE tpu_cc_prestage_total counter")
+            for outcome in sorted(prestage_totals):
+                lines.append(
+                    "tpu_cc_prestage_total%s %d"
+                    % (_labels(outcome=outcome), prestage_totals[outcome])
+                )
         if serve_goodput is not None:
             lines.append(
                 "# HELP tpu_cc_serve_goodput_rps Completed requests per "
