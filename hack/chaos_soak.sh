@@ -43,7 +43,12 @@
 # crash leg (PRESTAGE_SUMMARY: a seeded SIGKILL lands mid-prestage of
 # wave N+1 while wave N drains; successors resume BOTH waves, the
 # capacity ledger balances to zero with no double-charge, no node lost
-# or double-bounced) so the evidence ladder can cite them.
+# or double-bounced), and the gray-failure brownout leg (GRAY_SUMMARY:
+# a mid-run brownout slows one node without failing anything; the
+# peer-relative vetter detects it, the ladder escalates
+# runtime-restart -> quarantine reason=fail-slow with zero lost
+# requests, and the cleared verdict + probation lift it) so the
+# evidence ladder can cite them.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -80,7 +85,10 @@ mkdir -p "$(dirname "$OUT")" artifacts
 # (seeded orchestrator SIGKILL mid-prestage of wave N+1 while wave N
 # drains; dual-wave resume, ledger balanced, no double-charge) —
 # PRESTAGE_SUMMARY lines.
-PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py tests/test_flight.py tests/test_obs_fleet.py tests/test_federation.py tests/test_prestage_ledger.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
+# test_failslow.py carries the gray-failure brownout leg (peer-relative
+# detection -> de-weight -> restart -> quarantine -> probation lift,
+# zero lost requests) — GRAY_SUMMARY lines.
+PYTEST_ARGS=(tests/test_chaos.py tests/test_preemption.py tests/test_serve.py tests/test_flight.py tests/test_obs_fleet.py tests/test_federation.py tests/test_prestage_ledger.py tests/test_failslow.py -q -m chaos -p no:cacheprovider -p no:randomly -s)
 if [ "$TERMINAL" = "0" ]; then
   PYTEST_ARGS+=(--deselect \
     "tests/test_chaos.py::test_terminal_fault_escalates_full_ladder_to_quarantine_and_lifts")
@@ -113,7 +121,8 @@ for i in $(seq 0 $((ITERS - 1))); do
   fleet=$(grep -ao "FLEET_SUMMARY.*" "$log" | tail -1 | sed "s/^FLEET_SUMMARY //; s/'/ /g; s/\"/ /g")
   federation=$(grep -ao "FEDERATION_SUMMARY.*" "$log" | tail -1 | sed "s/^FEDERATION_SUMMARY //; s/'/ /g; s/\"/ /g")
   prestage=$(grep -ao "PRESTAGE_SUMMARY.*" "$log" | tail -1 | sed "s/^PRESTAGE_SUMMARY //; s/'/ /g; s/\"/ /g")
-  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"handoff\": \"${handoff}\", \"obs\": \"${obs}\", \"fleet\": \"${fleet}\", \"federation\": \"${federation}\", \"prestage\": \"${prestage}\"}")
+  gray=$(grep -ao "GRAY_SUMMARY.*" "$log" | tail -1 | sed "s/^GRAY_SUMMARY //; s/'/ /g; s/\"/ /g")
+  results+=("{\"seed\": $seed, \"ok\": $ok, \"summary\": \"${summary}\", \"remediation\": \"${remediation}\", \"offline\": \"${offline}\", \"preemption\": \"${preemption}\", \"serve\": \"${serve}\", \"serve_overload\": \"${serve_overload}\", \"handoff\": \"${handoff}\", \"obs\": \"${obs}\", \"fleet\": \"${fleet}\", \"federation\": \"${federation}\", \"prestage\": \"${prestage}\", \"gray\": \"${gray}\"}")
 done
 
 {

@@ -51,6 +51,7 @@ ARTIFACTS=(
   SERVE_r03.json
   BENCH_r08.json
   BENCH_r09.json
+  artifacts/GRAY_r01.json
   artifacts/smoke_cache_r06.json
   artifacts/pallas_sweep_r05.jsonl
   artifacts/smoke_llama1b_tpu_r05.json
@@ -340,6 +341,29 @@ else
       2>>artifacts/evidence_r5.stderr.log || {
     [ -s BENCH_r09.json ] && mv BENCH_r09.json artifacts/BENCH_r09.failed.json
     echo ">>> fleet-prestage bench FAILED; stopping ladder (summary in artifacts/BENCH_r09.failed.json; partial sweep rows kept for resume)"
+    finish
+  }
+fi
+
+# Fail-slow containment evidence (GRAY_r01): a seeded brownout slows
+# one node 4x without failing anything; the detector leg must hold
+# during-brownout p99 within 1.3x of healthy steady state with zero
+# lost requests, quarantine the node within <=2 vetting windows
+# (reason=fail-slow) and restore it after the brownout clears, while
+# the detector-off control leg shows >=2x p99 degradation — plus a
+# SIGKILL crash leg at the failslow-vetted journal point proving
+# exactly-once containment. CPU-only, single point, same skip/park
+# discipline as the other serve stages.
+if python3 -c 'import json,sys; sys.exit(0 if json.load(open("artifacts/GRAY_r01.json")).get("ok") is True else 1)' 2>/dev/null; then
+  echo ">>> artifacts/GRAY_r01.json already captured (ok:true); skipping"
+else
+  echo "=== stage: serve-bench --brownout (fail-slow containment, no tunnel) ==="
+  python3 hack/serve_bench.py --brownout --nodes 6 --knee-frac 0.6 \
+      --rate-s 1.5 --out artifacts/GRAY_r01.json \
+      2>>artifacts/evidence_r5.stderr.log || {
+    [ -s artifacts/GRAY_r01.json ] && \
+      mv artifacts/GRAY_r01.json artifacts/GRAY_r01.failed.json
+    echo ">>> fail-slow bench FAILED; stopping ladder (summary in artifacts/GRAY_r01.failed.json)"
     finish
   }
 fi

@@ -22,6 +22,20 @@ crash leg where a seeded SIGKILL lands mid-prestage of wave N+1
 (FaultPlan ``seed_prestage_kill``) and the successor resumes BOTH
 waves with the ledger balancing to zero and no node double-charged.
 
+**--brownout (GRAY_r01)**: the fail-slow containment artifact. One
+seeded node (FaultPlan ``seed_brownout``) degrades to a fraction of its
+token rate MID-FLIP while its watchdog stays green — the gray failure.
+Two traffic legs at ``--knee-frac`` of the knee: detector-on (the
+peer-relative vetter de-weights the suspect within one vetting window
+and the remediation ladder escalates runtime-restart -> quarantine
+``reason=fail-slow``) and a detector-off control. Gates: detector-on
+during-brownout p99 within ``--gray-ratio-bar`` (1.3x) of healthy
+steady while the control exceeds 2x, zero lost requests, quarantine
+within <=2 vetting windows of onset, probation lift restores the node
+after recovery — plus a crash leg where a seeded SIGKILL lands at the
+``failslow-vetted`` crash point mid-vetting and the successor resumes
+the journaled verdict to the SAME single quarantine, ledger balanced.
+
 **--sweep (SERVE_r02)**: the open-loop overload artifact. A resumable
 rate sweep (seeded Poisson arrivals, per-request deadlines, admission
 control) finds the KNEE — the last rate where goodput tracks offered
@@ -509,6 +523,345 @@ def run_prestage(args, executor_factory, calibration) -> dict:
     }
 
 
+def _brownout_flip(
+    args, executor_factory, knee, detector: bool
+) -> dict:
+    """One GRAY_r01 traffic leg: open-loop Poisson at ``--knee-frac``
+    of the knee, a rolling flip mid-traffic, and ONE seeded node
+    browning out (token rate cut by the plan's factor) right as the
+    flip begins — with the peer-relative fail-slow vetter armed
+    (``detector=True``) or off (the control leg that proves the bar
+    bites). The request deadline is stretched by the brownout factor
+    on BOTH legs: a tight deadline would shed the gray node's requests
+    at admission, turning fail-slow into fail-stop — the easy case
+    this artifact exists to NOT measure."""
+    import threading
+    import time as time_mod
+
+    from tpu_cc_manager.ccmanager import remediation as remediation_mod
+    from tpu_cc_manager.faults.plan import FaultPlan
+    from tpu_cc_manager.kubeclient.api import node_labels
+    from tpu_cc_manager.serve import ServeHarness
+    from tpu_cc_manager.serve.driver import PoissonSchedule
+    from tpu_cc_manager.utils import retry as retry_mod
+
+    plan = FaultPlan(seed=args.seed, rate=0.0)
+    victim = f"serve-node-{plan.seed_brownout(args.nodes)}"
+    factor = plan.brownout_token_rate_factor
+    window_s = args.vet_window_s
+    rate = knee["rate_rps"] * args.knee_frac
+    warmup_frac = 0.25
+    harness = ServeHarness(
+        n_nodes=args.nodes,
+        tmp_dir=tempfile.mkdtemp(prefix="tpu-cc-serve-gray-"),
+        executor_factory=executor_factory,
+        failslow=detector,
+        failslow_kwargs={
+            "window_s": window_s,
+            "threshold": 2.0,
+            # min_windows=1 + re-concluding verdicts: verdict #1 lands
+            # at the first window close after onset (runtime restart),
+            # verdict #2 one window later (quarantine) — the <=2-window
+            # containment bound by construction.
+            "min_windows": 1,
+            "min_peers": 3,
+            "min_samples": 3,
+            "clear_windows": 2,
+        },
+        failslow_probation_s=2 * window_s,
+        driver_kwargs={
+            "schedule": PoissonSchedule(rate, seed=args.seed + 3),
+            "deadline_s": (args.deadline_ms / 1e3) * factor,
+            "initial_batch": knee["batch"],
+            # min_batch=1 is what the suspect trickle de-weights down
+            # to (driver._dispatch_round) — pinning it at the knee
+            # batch would turn de-weighting off.
+            "min_batch": 1,
+            "max_batch": knee["batch"],
+        },
+        slo_windows_s=(2.0, 30.0),
+        slo_error_budget=0.05,
+    )
+    harness.build()
+    events: dict[str, float] = {}
+
+    def drive() -> None:
+        # Onset rides the same warmup fraction run() sleeps before the
+        # flip, so the brownout begins as the rollout does.
+        retry_mod.wait(args.traffic_s * warmup_frac, None)
+        harness.set_brownout(victim, factor)
+        events["onset"] = time_mod.monotonic()
+        deadline = events["onset"] + args.brownout_s
+        while time_mod.monotonic() < deadline:
+            labels = node_labels(harness.kube.get_node(victim))
+            if (
+                labels.get(remediation_mod.QUARANTINED_LABEL)
+                and "quarantined" not in events
+            ):
+                events["quarantined"] = time_mod.monotonic()
+            time_mod.sleep(0.02)
+        harness.set_brownout(victim, 1.0)
+        plan.clear_brownout()
+        events["cleared"] = time_mod.monotonic()
+
+    t = threading.Thread(target=drive, daemon=True, name="gray-drive")
+    t.start()
+    try:
+        report = harness.run(
+            traffic_s=args.traffic_s,
+            rollout_mode=args.mode,
+            warmup_frac=warmup_frac,
+            max_unavailable=args.max_unavailable,
+            roller_kwargs={
+                # Straggler-proof waves: a browned-out node mid-flip is
+                # cut at the peer-relative wall, not the absolute node
+                # timeout.
+                "straggler_factor": 4.0,
+                "straggler_floor_s": 2.0,
+            },
+        )
+        t.join(timeout=args.brownout_s + args.traffic_s)
+        restored = None
+        if detector:
+            ladder = harness.ladders[victim]
+            # Probation lift: the vet loop keeps running after the
+            # traffic stops — recovered peer stats clear the verdict,
+            # healthy probes accrue, the quarantine lifts.
+            restored = retry_mod.poll_until(
+                lambda: (
+                    not ladder.quarantined
+                    and not node_labels(
+                        harness.kube.get_node(victim)
+                    ).get(remediation_mod.QUARANTINED_LABEL)
+                ),
+                20.0, 0.1,
+            )
+        # Custom buckets off the SAME completion log: "healthy steady"
+        # is everything before onset; "during brownout" starts two
+        # vetting windows in (the containment bound this artifact
+        # separately asserts) and runs to the seeded clear.
+        healthy = harness.driver.report(
+            rollout_window=(0.0, events["onset"])
+        )["latency_during_rollout"]
+        brown = harness.driver.report(
+            rollout_window=(
+                events["onset"] + 2 * window_s, events["cleared"],
+            )
+        )["latency_during_rollout"]
+        detection_windows = (
+            round((events["quarantined"] - events["onset"]) / window_s, 2)
+            if "quarantined" in events else None
+        )
+        ratio = (
+            round(brown["p99_ms"] / healthy["p99_ms"], 3)
+            if brown.get("p99_ms") and healthy.get("p99_ms") else None
+        )
+        report["victim"] = victim
+        report["brownout_factor"] = factor
+        report["vet_window_s"] = window_s
+        report["healthy_steady"] = healthy
+        report["during_brownout"] = brown
+        report["brownout_p99_ratio"] = ratio
+        report["detection_windows"] = detection_windows
+        report["quarantined"] = "quarantined" in events
+        report["restored"] = restored
+        if detector:
+            report["verdicts"] = harness.failslow_vetter.concluded()[:8]
+            totals = harness.metrics.failslow_totals()
+            report["failslow_verdict_totals"] = {
+                f"{node}/{verdict}": count
+                for (node, verdict), count in totals["verdicts"].items()
+            }
+        return report
+    finally:
+        harness.shutdown()
+
+
+def _gray_crash_leg(args, executor_factory) -> dict:
+    """The GRAY_r01 crash leg: a scripted vetter concludes two
+    confirmed fail-slow verdicts for one node, a seeded SIGKILL lands
+    at the ``failslow-vetted`` crash point — AFTER the verdicts are
+    journaled in the record, BEFORE containment acts — and the
+    successor resumes the journal to the SAME single quarantine
+    (restart once, quarantine once, no double-escalation), with the
+    continuous-prestage capacity ledger balancing to zero around it.
+    No traffic: the journal/resume claims are record semantics."""
+    import time as time_mod
+
+    from tpu_cc_manager.ccmanager import rollout_state
+    from tpu_cc_manager.ccmanager.remediation import (
+        STEP_QUARANTINE,
+        STEP_RUNTIME_RESTART,
+        RemediationLadder,
+    )
+    from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+    from tpu_cc_manager.faults.plan import FaultPlan, OrchestratorKilled
+    from tpu_cc_manager.serve import ServeHarness
+    from tpu_cc_manager.serve.harness import NS, POOL_SELECTOR
+    from tpu_cc_manager.utils.metrics import MetricsRegistry
+
+    harness = ServeHarness(
+        n_nodes=args.crash_nodes,
+        tmp_dir=tempfile.mkdtemp(prefix="tpu-cc-serve-grayk-"),
+        executor_factory=executor_factory,
+    )
+    harness.build()
+    plan = FaultPlan(seed=args.seed, rate=0.0, kill_rate=0.0)
+    victim = f"serve-node-{plan.seed_brownout(args.crash_nodes)}"
+    target = plan.seed_prestage_kill(points=("failslow-vetted",))
+
+    class ScriptedVetter:
+        """Concludes two confirmed verdicts for the victim — the
+        escalation pair — on a fixed script; non-draining like the
+        real one, so every successor re-reads the same list."""
+
+        def concluded(self):
+            return [
+                {"id": 1, "node": victim, "verdict": "confirmed",
+                 "deviation": 4.0},
+                {"id": 2, "node": victim, "verdict": "confirmed",
+                 "deviation": 4.0},
+            ]
+
+        def suspects(self):
+            return {victim}
+
+    metrics = MetricsRegistry()
+    acts: list[str] = []
+
+    def failslow_act(node: str, entry: dict) -> None:
+        # A fresh ladder per act = a fresh successor process: the
+        # exactly-once proof must come from the RECORD journal plus the
+        # annotation-persisted ladder state, not in-memory dedup.
+        ladder = RemediationLadder(harness.kube, node, metrics=metrics)
+        acts.append(ladder.note_failslow(entry.get("deviation")))
+
+    result = None
+    ledger = None
+    try:
+        for attempt in range(8):
+            lease = rollout_state.RolloutLease(
+                harness.kube, holder=f"gray-orch-{attempt}", namespace=NS,
+                duration_s=2.0, metrics=metrics,
+            )
+            record = lease.acquire()
+            roller = RollingReconfigurator(
+                harness.kube, POOL_SELECTOR,
+                max_unavailable=2,
+                node_timeout_s=30.0,
+                poll_interval_s=0.02,
+                lease=lease,
+                resume_record=(
+                    record
+                    if record is not None
+                    and record.status == rollout_state.RECORD_IN_PROGRESS
+                    else None
+                ),
+                crash_hook=plan.decide_orchestrator_kill,
+                metrics=metrics,
+                continuous_prestage=True,
+                prestage_timeout_s=10.0,
+                headroom_gate=lambda: args.crash_nodes,
+                failslow_vetter=ScriptedVetter(),
+                failslow_act=failslow_act,
+            )
+            try:
+                result = roller.rollout(args.mode)
+                ledger = roller._ledger
+                lease.release(clear_record=result.ok)
+                break
+            except OrchestratorKilled:
+                time_mod.sleep(2.2)
+    finally:
+        harness.shutdown()
+    kills = [f for f in plan.injected if f.kind == "orch-kill"]
+    final = RemediationLadder(harness.kube, victim, metrics=metrics)
+    return {
+        "nodes": args.crash_nodes,
+        "victim": victim,
+        "kill_point_armed": target,
+        "kills": len(kills),
+        "kill_landed_at": kills[0].op if kills else None,
+        "acts": acts,
+        "quarantined": final.quarantined,
+        "quarantine_reason": final.last_reason,
+        "ledger_balanced": bool(ledger is not None and ledger.balanced()),
+        "ledger_open_entries": len(ledger.entries) if ledger else None,
+        "ok": bool(
+            result is not None and result.ok
+            and kills
+            and kills[0].op == target
+            # Exactly-once containment across the SIGKILL: one restart,
+            # one quarantine, nothing doubled.
+            and acts == [STEP_RUNTIME_RESTART, STEP_QUARANTINE]
+            and final.quarantined
+            and final.last_reason == "fail-slow"
+            and ledger is not None
+            and ledger.balanced()
+            and not ledger.entries
+        ),
+    }
+
+
+def run_brownout(args, executor_factory, calibration) -> dict:
+    """GRAY_r01: fail-slow detection & containment. Knee sweep →
+    detector-on brownout flip (containment holds the tail) →
+    detector-off control (the tail blows out, proving the bar bites) →
+    seeded ``failslow-vetted`` SIGKILL crash leg."""
+    sweep = run_sweep(args, executor_factory, calibration, flip=False)
+    knee = sweep.get("knee")
+    detect = control = None
+    if knee is not None:
+        detect = _brownout_flip(args, executor_factory, knee, detector=True)
+        control = _brownout_flip(
+            args, executor_factory, knee, detector=False,
+        )
+    crash = _gray_crash_leg(args, executor_factory)
+    d_ratio = (detect or {}).get("brownout_p99_ratio")
+    c_ratio = (control or {}).get("brownout_p99_ratio")
+    dw = (detect or {}).get("detection_windows")
+    return {
+        "metric": "failslow_containment_brownout",
+        "nodes": args.nodes,
+        "knee_frac": args.knee_frac,
+        "vet_window_s": args.vet_window_s,
+        "brownout_s": args.brownout_s,
+        "seed": args.seed,
+        "knee": knee,
+        "detector_flip": detect,
+        "control_flip": control,
+        "detector_p99_ratio": d_ratio,
+        "control_p99_ratio": c_ratio,
+        "gray_ratio_bar": args.gray_ratio_bar,
+        "detection_windows": dw,
+        "crash_leg": crash,
+        "calibration": calibration,
+        "ok": bool(
+            knee is not None
+            and sweep["ok"]
+            and detect is not None
+            and detect["rollout_ok"]
+            and detect["requests_lost"] == 0
+            and detect["conserved"]
+            and detect["quarantined"]
+            and detect["restored"]
+            # Containment bound: quarantine within <=2 vetting windows
+            # of onset (+ half a window of vet-loop phase alignment).
+            and dw is not None
+            and dw <= 2.5
+            and d_ratio is not None
+            and d_ratio <= args.gray_ratio_bar
+            # The control leg must HURT, or the detector leg's clean
+            # tail proves nothing.
+            and control is not None
+            and control["requests_lost"] == 0
+            and c_ratio is not None
+            and c_ratio >= 2.0
+            and crash["ok"]
+        ),
+    }
+
+
 def run_sweep(args, executor_factory, calibration, flip: bool = True) -> dict:
     from tpu_cc_manager.serve import sweep as sweep_mod
 
@@ -628,6 +981,24 @@ def main(argv: list[str] | None = None) -> int:
                         "prestage under the capacity ledger, run a "
                         "no-prestage control leg, and a seeded "
                         "mid-prestage orchestrator-SIGKILL crash leg")
+    parser.add_argument("--brownout", action="store_true",
+                        help="fail-slow containment artifact (GRAY_r01): "
+                        "find the knee, brown out ONE seeded node during "
+                        "a rolling flip at load with the peer-relative "
+                        "vetter on, run a detector-off control leg that "
+                        "must blow the tail, and a seeded SIGKILL at the "
+                        "failslow-vetted crash point")
+    parser.add_argument("--vet-window-s", type=float, default=0.75,
+                        help="--brownout fail-slow vetting window (the "
+                        "<=2-window containment bar is in these units)")
+    parser.add_argument("--brownout-s", type=float, default=4.0,
+                        help="--brownout seconds the victim stays browned "
+                        "out before the seeded recovery")
+    parser.add_argument("--gray-ratio-bar", type=float, default=1.3,
+                        help="--brownout ok-gate: detector-on "
+                        "during-brownout p99 must stay within this "
+                        "multiple of healthy-steady p99 (control must "
+                        "exceed 2x)")
     parser.add_argument("--knee-frac", type=float, default=0.8,
                         help="--prestage offered load as a fraction of "
                         "the knee (the ISSUE bar: 80%%)")
@@ -678,6 +1049,17 @@ def main(argv: list[str] | None = None) -> int:
         executor_factory = (
             lambda: SimulatedExecutor.from_smoke_result(smoke)
         )
+
+    if args.brownout:
+        if not args.sweep:
+            args.sweep = "200,400,800,1600,3200,6400"
+        result = run_brownout(args, executor_factory, calibration)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return 0 if result["ok"] else 1
 
     if args.prestage:
         if not args.sweep:

@@ -1,0 +1,639 @@
+"""Peer-relative fail-slow vetting (obs/failslow.py) and its
+containment surfaces: the false-positive bound under healthy jitter,
+the detection-latency bound, the remediation ladder's non-probe
+escalation, the serve driver's suspect de-weighting, the rolling
+orchestrator's journaled exactly-once acting + straggler wall, and the
+fleet gateway's slow-vs-dead scrape distinction."""
+
+import random
+
+import pytest
+
+from tpu_cc_manager.ccmanager.remediation import (
+    STEP_QUARANTINE,
+    STEP_RUNTIME_RESTART,
+    RemediationLadder,
+)
+from tpu_cc_manager.kubeclient.api import node_labels
+from tpu_cc_manager.kubeclient.fake import FakeKube
+from tpu_cc_manager.labels import FAILSLOW_SUSPECT_LABEL
+from tpu_cc_manager.obs.failslow import (
+    VERDICT_CLEARED,
+    VERDICT_CONFIRMED,
+    FailslowVetter,
+    publish_suspect_labels,
+)
+
+NODES = [f"n{i}" for i in range(6)]
+
+
+def feed_window(vetter, latencies_by_node, samples=4):
+    for node, lat in latencies_by_node.items():
+        for _ in range(samples):
+            vetter.observe(node, lat)
+
+
+# ---------------------------------------------------------------------------
+# The false-positive bound (the ISSUE's seeded property test)
+# ---------------------------------------------------------------------------
+
+
+def test_healthy_fleet_under_20pct_jitter_is_never_suspected():
+    """The documented FP bound: with threshold 2.0, +/-20 % latency
+    jitter on a homogeneous fleet caps the peer ratio at 1.2/0.8 = 1.5
+    — strictly inside the threshold — so across 200 seeded trials of
+    8 windows each, NO node may ever collect a strike, let alone a
+    verdict. This is the property that makes fail-slow containment safe
+    to leave on: jitter alone can never quarantine a healthy node."""
+    for trial in range(200):
+        rng = random.Random(31_000 + trial)
+        vetter = FailslowVetter(
+            window_s=1.0, threshold=2.0, min_windows=2, min_peers=3,
+            min_samples=3,
+        )
+        base = 0.02 + rng.random() * 0.2  # fleet-wide latency level
+        for _ in range(8):
+            for node in NODES:
+                for _ in range(5):
+                    jitter = 0.8 + rng.random() * 0.4  # +/-20 %
+                    vetter.observe(node, base * jitter)
+            vetter.vet()
+        assert vetter.concluded() == [], f"trial {trial} concluded"
+        assert vetter.suspects() == set(), f"trial {trial} suspected"
+
+
+def test_detection_within_min_windows_of_onset():
+    """Detection-latency bound: a node going 3x deviant is confirmed on
+    exactly the ``min_windows``-th window after onset (default 2) —
+    one strike window of hysteresis, then the verdict. No faster (one
+    bad window is weather), no slower (the bound ctl/ops quote)."""
+    vetter = FailslowVetter(min_windows=2, min_peers=3, min_samples=3)
+    healthy = {n: 0.05 for n in NODES}
+    feed_window(vetter, healthy)
+    assert vetter.vet() == []
+    # Onset: n0 triples. Window 1 after onset -> strike, suspect.
+    feed_window(vetter, {**healthy, "n0": 0.15})
+    assert vetter.vet() == []
+    assert vetter.suspects() == {"n0"}
+    # Window 2 after onset -> confirmed: latency <= 2 windows.
+    feed_window(vetter, {**healthy, "n0": 0.15})
+    verdicts = vetter.vet()
+    assert [v["verdict"] for v in verdicts] == [VERDICT_CONFIRMED]
+    assert verdicts[0]["node"] == "n0"
+    assert verdicts[0]["deviation"] == pytest.approx(3.0, abs=0.01)
+
+
+def test_reconcluding_verdicts_get_fresh_monotonic_ids():
+    """A still-deviant confirmed node re-concludes every window under a
+    NEW id — the consumer's escalation edge (verdict 1 restart,
+    verdict 2 quarantine) and the dedup key for journaled acting."""
+    vetter = FailslowVetter(min_windows=1, min_peers=3, min_samples=3)
+    healthy = {n: 0.05 for n in NODES}
+    for _ in range(3):
+        feed_window(vetter, {**healthy, "n0": 0.2})
+        vetter.vet()
+    ids = [v["id"] for v in vetter.concluded()]
+    assert ids == [1, 2, 3]
+    assert all(v["verdict"] == VERDICT_CONFIRMED for v in vetter.concluded())
+    # Non-draining: reading twice sees the same list.
+    assert [v["id"] for v in vetter.concluded()] == ids
+
+
+def test_clear_requires_consecutive_recovered_windows():
+    """Flapping is not recovery: one recovered window followed by one
+    deviant window resets the clear streak; only ``clear_windows``
+    CONSECUTIVE recovered windows conclude a cleared verdict (and drop
+    the node from the suspect set)."""
+    vetter = FailslowVetter(
+        min_windows=1, clear_windows=2, min_peers=3, min_samples=3,
+    )
+    healthy = {n: 0.05 for n in NODES}
+    feed_window(vetter, {**healthy, "n0": 0.2})
+    vetter.vet()
+    assert vetter.confirmed() == {"n0"}
+    # Recovered... then deviant again: streak resets, still confirmed.
+    feed_window(vetter, healthy)
+    vetter.vet()
+    feed_window(vetter, {**healthy, "n0": 0.2})
+    vetter.vet()
+    feed_window(vetter, healthy)
+    vetter.vet()
+    assert vetter.confirmed() == {"n0"}
+    # Second consecutive recovered window -> cleared.
+    feed_window(vetter, healthy)
+    verdicts = vetter.vet()
+    assert [v["verdict"] for v in verdicts] == [VERDICT_CLEARED]
+    assert vetter.confirmed() == set()
+    assert vetter.suspects() == set()
+
+
+def test_abstains_below_min_peers_and_strikes_hold():
+    """No fleet, no verdict: below min_peers participating nodes the
+    window abstains — strikes neither advance nor reset — so a partial
+    outage cannot push a half-struck node over the line."""
+    vetter = FailslowVetter(min_windows=2, min_peers=3, min_samples=3)
+    healthy = {n: 0.05 for n in NODES}
+    feed_window(vetter, {**healthy, "n0": 0.2})
+    assert vetter.vet() == []
+    assert vetter.suspects() == {"n0"}
+    # Only 2 nodes produce samples: abstain, strike count holds.
+    feed_window(vetter, {"n0": 0.2, "n1": 0.05})
+    assert vetter.vet() == []
+    assert vetter.suspects() == {"n0"}
+    # Fleet back: the held strike plus this one confirm.
+    feed_window(vetter, {**healthy, "n0": 0.2})
+    assert [v["verdict"] for v in vetter.vet()] == [VERDICT_CONFIRMED]
+
+
+def test_ingest_exposition_deltas_cumulative_families():
+    """The scrape-fed path: cumulative sum/count deltas become window
+    samples (first call only primes), so a FleetGateway rollup can feed
+    the vetter without per-request hooks."""
+    vetter = FailslowVetter(min_windows=1, min_peers=3, min_samples=1)
+
+    def expo(sums, counts):
+        lines = []
+        for n in sums:
+            lines.append(
+                'tpu_cc_serve_request_seconds_sum{node="%s"} %s' % (n, sums[n])
+            )
+            lines.append(
+                'tpu_cc_serve_request_seconds_count{node="%s"} %s'
+                % (n, counts[n])
+            )
+        return "\n".join(lines) + "\n"
+
+    nodes = ["a", "b", "c", "d"]
+    assert vetter.ingest_exposition(
+        expo({n: 0.0 for n in nodes}, {n: 0 for n in nodes})
+    ) == 0  # priming read contributes nothing
+    # Interval means: a/b/c at 50 ms, d at 300 ms.
+    sums = {"a": 0.5, "b": 0.5, "c": 0.5, "d": 3.0}
+    counts = {n: 10 for n in nodes}
+    assert vetter.ingest_exposition(expo(sums, counts)) == 4
+    verdicts = vetter.vet()
+    assert [v["node"] for v in verdicts] == ["d"]
+    assert verdicts[0]["verdict"] == VERDICT_CONFIRMED
+
+
+def test_publish_suspect_labels_sets_and_clears():
+    fake = FakeKube()
+    fake.add_node("n0", {})
+    publish_suspect_labels(fake, added=["n0"], removed=[])
+    assert node_labels(fake.get_node("n0"))[FAILSLOW_SUSPECT_LABEL] == "true"
+    publish_suspect_labels(fake, added=[], removed=["n0"])
+    assert FAILSLOW_SUSPECT_LABEL not in node_labels(fake.get_node("n0"))
+
+
+# ---------------------------------------------------------------------------
+# Remediation ladder: the non-probe fail-slow rungs
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_failslow_escalates_restart_then_quarantine():
+    """Confirmed verdict 1 -> runtime restart (the cheapest action that
+    un-wedges a degraded runtime); a re-concluded verdict after that ->
+    quarantine with reason=fail-slow. The watchdog was green the whole
+    time — this path never consumed a probe failure."""
+    fake = FakeKube()
+    fake.add_node("gray-0", {})
+    ladder = RemediationLadder(fake, "gray-0")
+    assert ladder.note_failslow(3.4) == STEP_RUNTIME_RESTART
+    assert not ladder.quarantined
+    assert ladder.note_failslow(3.2) == STEP_QUARANTINE
+    assert ladder.quarantined
+    assert ladder.last_reason == "fail-slow"
+    # Already contained: further verdicts are no-ops, not re-taints.
+    assert ladder.note_failslow(3.1) == STEP_QUARANTINE
+
+
+def test_ladder_failslow_state_survives_agent_restart():
+    """The escalation counter persists in the node annotation: a FRESH
+    ladder (agent restart, or the rolling orchestrator's successor
+    acting a journaled verdict) resumes at the next rung instead of
+    restarting the runtime forever — the cross-process half of
+    exactly-once containment."""
+    fake = FakeKube()
+    fake.add_node("gray-1", {})
+    assert RemediationLadder(fake, "gray-1").note_failslow(3.0) == (
+        STEP_RUNTIME_RESTART
+    )
+    successor = RemediationLadder(fake, "gray-1")
+    assert successor.note_failslow(3.0) == STEP_QUARANTINE
+    assert successor.last_reason == "fail-slow"
+
+
+def test_ladder_failslow_recovered_resets_escalation():
+    """A cleared verdict before quarantine forgets the escalation (the
+    restart fixed it): the NEXT confirmed verdict starts at the cheap
+    rung again. A quarantined node is NOT released here — that goes
+    through probation, same as every quarantine."""
+    fake = FakeKube()
+    fake.add_node("gray-2", {})
+    ladder = RemediationLadder(fake, "gray-2")
+    ladder.note_failslow(2.5)
+    ladder.note_failslow_recovered()
+    assert ladder.note_failslow(2.5) == STEP_RUNTIME_RESTART
+
+
+# ---------------------------------------------------------------------------
+# Serve driver: suspect de-weighting
+# ---------------------------------------------------------------------------
+
+
+class StubServer:
+    def __init__(self) -> None:
+        self.got: list = []
+
+    def accepting(self) -> bool:
+        return True
+
+    def submit(self, batch, front: bool = False) -> bool:
+        self.got.extend(batch)
+        return True
+
+
+def _drain_rounds(driver, rounds=8):
+    for _ in range(rounds):
+        driver._dispatch_round(top_up=False)
+
+
+def test_driver_caps_suspects_at_min_batch_in_flight():
+    """A suspect node is capped at min_batch IN FLIGHT (its trickle is
+    bounded by its own service rate): with nothing completing, repeated
+    dispatch rounds give it exactly min_batch requests while healthy
+    peers fill their full pipes."""
+    from tpu_cc_manager.serve.driver import Request, TrafficDriver
+
+    servers = {"h0": StubServer(), "h1": StubServer(), "gray": StubServer()}
+    driver = TrafficDriver(
+        servers, initial_batch=4, min_batch=1, max_batch=4, pipe_depth=1,
+    )
+    driver.set_suspects({"gray"})
+    with driver._lock:
+        driver._pending = [Request(req_id=i, decode_tokens=1, submitted_at=0.0) for i in range(32)]
+    _drain_rounds(driver)
+    assert len(servers["gray"].got) == 1, "suspect trickle must be min_batch"
+    assert len(servers["h0"].got) == 4
+    assert len(servers["h1"].got) == 4
+
+
+def test_driver_suspect_trickle_survives_fleet_headroom():
+    """The starvation regression: suspects draw their one-in-flight
+    trickle FIRST, so a fleet with spare capacity (healthy nodes could
+    absorb everything) still feeds the suspect the samples vetting
+    needs to ever clear it."""
+    from tpu_cc_manager.serve.driver import Request, TrafficDriver
+
+    servers = {"h0": StubServer(), "gray": StubServer()}
+    driver = TrafficDriver(
+        servers, initial_batch=8, min_batch=1, max_batch=8, pipe_depth=2,
+    )
+    driver.set_suspects({"gray"})
+    # Fewer pending than the healthy node's pipe: without
+    # suspect-first ordering, h0 would drink the whole queue.
+    with driver._lock:
+        driver._pending = [Request(req_id=i, decode_tokens=1, submitted_at=0.0) for i in range(4)]
+    _drain_rounds(driver)
+    assert len(servers["gray"].got) == 1
+    assert len(servers["h0"].got) == 3
+
+
+def test_driver_deweight_disabled_when_all_accepting_are_suspect():
+    """De-weighting the WHOLE pool would just shed it: when every
+    accepting node is suspect, the cap is ignored and dispatch proceeds
+    at full batch."""
+    from tpu_cc_manager.serve.driver import Request, TrafficDriver
+
+    servers = {"g0": StubServer(), "g1": StubServer()}
+    driver = TrafficDriver(
+        servers, initial_batch=4, min_batch=1, max_batch=4, pipe_depth=1,
+    )
+    driver.set_suspects({"g0", "g1"})
+    with driver._lock:
+        driver._pending = [Request(req_id=i, decode_tokens=1, submitted_at=0.0) for i in range(8)]
+    _drain_rounds(driver)
+    assert len(servers["g0"].got) == 4
+    assert len(servers["g1"].got) == 4
+
+
+# ---------------------------------------------------------------------------
+# Rolling orchestrator: journaled acting, group skip, straggler wall
+# ---------------------------------------------------------------------------
+
+POOL = "pool=tpu"
+
+
+def _add_pool(fake, n=4):
+    for i in range(n):
+        fake.add_node(f"node-{i}", {"pool": "tpu"})
+
+
+def _agent_simulator(fake):
+    import threading
+
+    from tpu_cc_manager.labels import CC_MODE_LABEL, CC_MODE_STATE_LABEL
+
+    def reactor(name, node):
+        desired = node_labels(node).get(CC_MODE_LABEL)
+        state = node_labels(node).get(CC_MODE_STATE_LABEL)
+        if desired and state != desired:
+            t = threading.Timer(
+                0.05,
+                lambda: fake.set_node_label(
+                    name, CC_MODE_STATE_LABEL, desired
+                ),
+            )
+            t.daemon = True
+            t.start()
+
+    fake.add_patch_reactor(reactor)
+
+
+class ScriptedVetter:
+    """Concludes a fixed verdict list; non-draining like the real one."""
+
+    def __init__(self, verdicts, suspects=frozenset()):
+        self._verdicts = list(verdicts)
+        self._suspects = set(suspects)
+
+    def concluded(self):
+        return [dict(v) for v in self._verdicts]
+
+    def suspects(self):
+        return set(self._suspects)
+
+
+def test_rolling_acts_confirmed_verdict_and_skips_its_group():
+    """A confirmed verdict flowing through the rollout: journaled in
+    the record path, acted through failslow_act exactly once, the
+    victim's group skipped (never bounced — its members are already
+    being contained) and its disruption budget charged."""
+    from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+    from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
+
+    fake = FakeKube()
+    _add_pool(fake, 4)
+    _agent_simulator(fake)
+    acts: list[tuple] = []
+    roller = RollingReconfigurator(
+        fake, POOL, node_timeout_s=5, poll_interval_s=0.02,
+        failslow_vetter=ScriptedVetter(
+            [{"id": 1, "node": "node-3", "verdict": "confirmed",
+              "deviation": 3.5}],
+            suspects={"node-3"},
+        ),
+        failslow_act=lambda node, e: acts.append((node, e["id"], e["verdict"])),
+    )
+    result = roller.rollout("on")
+    assert result.ok
+    assert acts == [("node-3", "1", "confirmed")]
+    labels = node_labels(fake.get_node("node-3"))
+    assert labels.get(CC_MODE_STATE_LABEL) != "on", (
+        "confirmed fail-slow group must be skipped, not bounced"
+    )
+    for i in range(3):
+        assert node_labels(
+            fake.get_node(f"node-{i}")
+        )[CC_MODE_STATE_LABEL] == "on"
+
+
+def test_rolling_cleared_verdict_acts_without_skipping():
+    """A cleared verdict is acted (the consumer lifts its escalation)
+    but never charges budget or skips the node's group."""
+    from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+    from tpu_cc_manager.labels import CC_MODE_STATE_LABEL
+
+    fake = FakeKube()
+    _add_pool(fake, 3)
+    _agent_simulator(fake)
+    acts: list[str] = []
+    roller = RollingReconfigurator(
+        fake, POOL, node_timeout_s=5, poll_interval_s=0.02,
+        failslow_vetter=ScriptedVetter(
+            [{"id": 1, "node": "node-0", "verdict": "cleared",
+              "deviation": 0.9}],
+        ),
+        failslow_act=lambda node, e: acts.append(e["verdict"]),
+    )
+    result = roller.rollout("on")
+    assert result.ok
+    assert acts == ["cleared"]
+    for i in range(3):
+        assert node_labels(
+            fake.get_node(f"node-{i}")
+        )[CC_MODE_STATE_LABEL] == "on"
+
+
+def test_straggler_wall_is_peer_relative():
+    """The wall is max(floor, factor * median(peer convergence)) once
+    enough history exists — and absent (None) below min_peers samples
+    or when the factor is unset, so early waves fall back to the
+    absolute node timeout."""
+    from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+
+    fake = FakeKube()
+    _add_pool(fake, 2)
+    roller = RollingReconfigurator(
+        fake, POOL, node_timeout_s=5, poll_interval_s=0.02,
+        straggler_factor=3.0, straggler_min_peers=3,
+        straggler_floor_s=0.1,
+    )
+    assert roller._straggler_wall() is None  # no history yet
+    for s in (0.2, 0.4, 0.2):
+        roller._note_converge_seconds(s)
+    assert roller._straggler_wall() == pytest.approx(0.6)  # 3.0 * 0.2
+    # The floor wins over a tiny median.
+    fast = RollingReconfigurator(
+        fake, POOL, node_timeout_s=5, poll_interval_s=0.02,
+        straggler_factor=2.0, straggler_min_peers=2,
+        straggler_floor_s=1.0,
+    )
+    for s in (0.01, 0.01):
+        fast._note_converge_seconds(s)
+    assert fast._straggler_wall() == pytest.approx(1.0)
+    # Unset factor: the feature is off.
+    plain = RollingReconfigurator(
+        fake, POOL, node_timeout_s=5, poll_interval_s=0.02,
+    )
+    plain._note_converge_seconds(0.2)
+    assert plain._straggler_wall() is None
+
+
+def test_straggler_factor_must_exceed_one():
+    from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
+
+    fake = FakeKube()
+    _add_pool(fake, 2)
+    with pytest.raises(ValueError):
+        RollingReconfigurator(
+            fake, POOL, node_timeout_s=5, poll_interval_s=0.02,
+            straggler_factor=0.9,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Fleet gateway: slow-vs-dead scrape distinction
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def test_gateway_distinguishes_slow_from_dead():
+    """A scrape that SUCCEEDS but overruns slow_scrape_s is a gray
+    signal, not an outage: the node stays in the rollups (the vetter
+    needs its samples) but loses has_headroom, and /fleetz reports it
+    under slow_nodes — apart from dead/stale."""
+    from tpu_cc_manager.obs import fleet as fleet_mod
+
+    clk = FakeClock()
+    body = (
+        '# HELP tpu_cc_serve_request_seconds request latency\n'
+        '# TYPE tpu_cc_serve_request_seconds histogram\n'
+        'tpu_cc_serve_request_seconds_bucket{node="x",le="+Inf"} 3\n'
+        'tpu_cc_serve_request_seconds_sum{node="x"} 0.3\n'
+        'tpu_cc_serve_request_seconds_count{node="x"} 3\n'
+    )
+
+    def fast_fetch(path):
+        return body if path == "/metrics" else "{}"
+
+    def slow_fetch(path):
+        clk.t += 0.9  # each hop drags; total scrape >> slow_scrape_s
+        return body if path == "/metrics" else "{}"
+
+    def dead_fetch(path):
+        raise OSError("connection refused")
+
+    gateway = fleet_mod.FleetGateway(
+        targets={
+            "fast-0": fast_fetch, "slow-0": slow_fetch, "dead-0": dead_fetch,
+        },
+        scrape_deadline_s=2.0, slow_scrape_s=1.0, clock=clk, workers=1,
+        stale_after_sweeps=1,
+    )
+    fleetz = gateway.scrape_once()
+    nodes = fleetz["nodes"]
+    assert nodes["fast-0"]["scrape_slow"] is False
+    assert nodes["fast-0"]["stale"] is False
+    assert nodes["slow-0"]["scrape_slow"] is True
+    assert nodes["slow-0"]["stale"] is False
+    assert nodes["slow-0"]["has_headroom"] is False, (
+        "slow capacity is phantom: the prestage pacer must not spend it"
+    )
+    assert nodes["dead-0"]["stale"] is True
+    assert fleetz["fleet"]["slow_nodes"] == ["slow-0"]
+    assert fleetz["fleet"]["stale_nodes"] == ["dead-0"]
+    text = gateway.metrics_text()
+    assert "tpu_cc_fleet_nodes_slow 1" in text
+    # Slow != dead in the rollups: the slow node's histogram is merged.
+    assert 'tpu_cc_serve_request_seconds_count{node="x"} 6' in text
+
+
+# ---------------------------------------------------------------------------
+# Chaos leg (hack/chaos_soak.sh scrapes the GRAY_SUMMARY line)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_brownout_detected_contained_and_lifted_under_traffic(
+    tmp_path, monkeypatch,
+):
+    """The short-soak gray-failure loop, end to end under live traffic
+    (the long, calibrated form is `hack/serve_bench.py --brownout` ->
+    GRAY_r01.json): a mid-run brownout slows one node 6x without
+    failing anything; the peer-relative vetter must detect it, the vet
+    loop must escalate runtime-restart -> quarantine(reason=fail-slow),
+    the fleet must lose ZERO requests, and once the brownout clears the
+    cleared verdict + probation must lift the quarantine — full cycle,
+    one test."""
+    import json
+    import threading
+    import time as time_mod
+
+    from tpu_cc_manager.labels import QUARANTINED_LABEL
+    from tpu_cc_manager.serve.harness import ServeHarness
+    from tpu_cc_manager.utils import locks as locks_rt
+
+    locks_rt.GRAPH.reset()
+    monkeypatch.setenv("CC_LOCKCHECK", "1")
+    harness = ServeHarness(
+        n_nodes=4, tmp_dir=str(tmp_path), checkpoint_full_s=0.02,
+        failslow=True,
+        failslow_kwargs={
+            "window_s": 0.4, "threshold": 2.0, "min_windows": 1,
+            "min_peers": 3, "min_samples": 3, "clear_windows": 2,
+        },
+        failslow_probation_s=0.8,
+    )
+    harness.build()
+    victim = "serve-node-1"
+    marks: dict = {}
+
+    def chaos():
+        time_mod.sleep(1.2)
+        harness.set_brownout(victim, 6.0)
+        marks["onset"] = time_mod.monotonic()
+        deadline = marks["onset"] + 3.5
+        while time_mod.monotonic() < deadline:
+            if QUARANTINED_LABEL in node_labels(harness.kube.get_node(victim)):
+                marks["quarantined"] = time_mod.monotonic()
+                break
+            time_mod.sleep(0.02)
+        harness.set_brownout(victim, 1.0)
+        marks["cleared"] = time_mod.monotonic()
+
+    thread = threading.Thread(target=chaos, daemon=True)
+    thread.start()
+    try:
+        report = harness.run(traffic_s=7.0, rollout_mode=None)
+        thread.join(timeout=10)
+        # The vet loop is still pacing windows: give the cleared
+        # verdict + probation a bounded tail to lift the quarantine.
+        ladder = harness.ladders[victim]
+        deadline = time_mod.monotonic() + 10.0
+        while time_mod.monotonic() < deadline:
+            if not ladder.quarantined and QUARANTINED_LABEL not in (
+                node_labels(harness.kube.get_node(victim))
+            ):
+                break
+            time_mod.sleep(0.05)
+    finally:
+        harness.shutdown()
+    detection_s = (
+        round(marks["quarantined"] - marks["onset"], 3)
+        if "quarantined" in marks else None
+    )
+    verdicts = {
+        f"{n}/{v}": c
+        for (n, v), c in harness.metrics.failslow_totals()["verdicts"].items()
+    }
+    print("GRAY_SUMMARY " + json.dumps({
+        "requests_issued": report["requests_issued"],
+        "requests_completed": report["requests_completed"],
+        "requests_lost": report["requests_lost"],
+        "victim": victim,
+        "detection_s": detection_s,
+        "quarantined": "quarantined" in marks,
+        "restored": not harness.ladders[victim].quarantined,
+        "verdicts": verdicts,
+    }))
+    assert report["requests_lost"] == 0, report
+    assert "quarantined" in marks, (
+        f"brownout never contained; deviation="
+        f"{harness.failslow_vetter.deviation(victim)}"
+    )
+    assert detection_s is not None and detection_s <= 3.5
+    assert harness.ladders[victim].last_reason == "fail-slow"
+    assert not harness.ladders[victim].quarantined, (
+        "cleared brownout must lift the quarantine via probation"
+    )
+    assert QUARANTINED_LABEL not in node_labels(harness.kube.get_node(victim))
+    assert verdicts.get(f"{victim}/confirmed", 0) >= 2
+    assert verdicts.get(f"{victim}/cleared", 0) >= 1

@@ -63,6 +63,15 @@ def test_live_registry_render_passes_lint():
     registry.set_serve_hbm_bw_util("serve-node-0", 0.73)
     registry.set_serve_hbm_bw_util('odd"node', 1.7)
     registry.set_prestage_in_progress(True)
+    # Fail-slow vetting families (obs/failslow.py), hostile node and
+    # verdict labels included.
+    registry.set_failslow_suspect("serve-node-0", True)
+    registry.set_failslow_suspect('odd"node\nname', False)
+    registry.set_failslow_deviation("serve-node-0", 3.4142)
+    registry.record_failslow_verdict("serve-node-0", "confirmed")
+    registry.record_failslow_verdict("serve-node-0", "confirmed")
+    registry.record_failslow_verdict("serve-node-0", "cleared")
+    registry.record_failslow_verdict('odd"node', 'odd"verdict')
     problems = check_metrics_lint.lint(registry.render_prometheus())
     assert problems == [], problems
     text = registry.render_prometheus()
@@ -99,6 +108,17 @@ def test_live_registry_render_passes_lint():
     assert 'tpu_cc_hbm_bw_util{node="serve-node-0"} 0.730000' in text
     assert 'tpu_cc_hbm_bw_util{node="odd\\"node"} 1' in text  # clamped
     assert "tpu_cc_prestage_in_progress 1" in text
+    assert 'tpu_cc_failslow_suspect{node="serve-node-0"} 1' in text
+    assert 'tpu_cc_failslow_suspect{node="odd\\"node\\nname"} 0' in text
+    assert 'tpu_cc_failslow_deviation{node="serve-node-0"} 3.414' in text
+    assert (
+        'tpu_cc_failslow_verdicts_total{node="serve-node-0",verdict="confirmed"} 2'
+        in text
+    )
+    assert (
+        'tpu_cc_failslow_verdicts_total{node="serve-node-0",verdict="cleared"} 1'
+        in text
+    )
 
 
 def test_fleet_merged_exposition_passes_lint():

@@ -237,8 +237,12 @@ def test_ledger_round_trips_through_record_v7():
     raw = rec.to_json()
     obj = json.loads(raw)
     # A touched ledger forces format v7 — the loud-refusal boundary for
-    # older binaries (they reject versions above their own).
-    assert obj["version"] == rollout_state.RECORD_VERSION == 7
+    # older binaries (they reject versions above their own). Demand-driven
+    # versioning keeps it AT 7 even as RECORD_VERSION advances for other
+    # features (v8 = fail-slow verdicts): a ledger-only record must not
+    # lock out v7 binaries.
+    assert obj["version"] == 7
+    assert rollout_state.RECORD_VERSION >= 7
     back = rollout_state.RolloutRecord.from_json(raw)
     assert back.ledger is not None
     assert back.ledger.entry("n1")["state"] == rollout_state.LEDGER_RESERVED

@@ -47,7 +47,27 @@ ROLLING_CRASH_POINTS = [
     "prestage-reserved",
     "prestage-armed",
     "prestage-invalidate",
+    "failslow-vetted",
 ]
+
+
+class OneClearVetter:
+    """Duck-typed fail-slow vetter (the orchestrator only polls
+    concluded()/suspects()) that concludes ONE benign "cleared" verdict
+    — enough to open the failslow-vetted crash point on the first
+    window without quarantining anything, so the exhaustive kill loop
+    reaches the point while every node still converges exactly once.
+    Non-draining like the real one: the successor re-reads the same
+    list and must dedup via the record journal, not this stub."""
+
+    def concluded(self):
+        return [
+            {"id": 1, "node": "node-0", "verdict": "cleared",
+             "deviation": 0.97},
+        ]
+
+    def suspects(self):
+        return set()
 
 
 class ParentBlackoutKube:
@@ -405,10 +425,19 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
     # simulated agents never publish a PRESTAGED record for the armed
     # node, and the short prestage timeout degrades it back to the full
     # flip path — so every node still bounces exactly once.
+    # Every run carries the one-clear stub vetter so the kill loop
+    # reaches the failslow-vetted crash point too — a kill landing
+    # between the journaled verdict and its act is the "orchestrator
+    # dies mid-vetting" scenario, and the successor must resume the
+    # SAME verdict from the record without double-acting it.
+    vetter = OneClearVetter()
+    acts: list[str] = []
     roller_a = make_roller(
         fake, lease=lease_a, crash_hook=killer, slo_gate=one_breach_gate(),
         surge=1, prestage=True, federation=fed_a,
         continuous_prestage=True, prestage_timeout_s=0.25,
+        failslow_vetter=vetter,
+        failslow_act=lambda node, entry: acts.append(str(entry.get("id"))),
     )
     killed = False
     try:
@@ -439,11 +468,20 @@ def _run_crash_resume(kill_at: int, points_seen: set | None = None):
             # (a resume never re-surges; stale taints are reclaimed).
             surge=record.surge, prestage=True, federation=fed_b,
             continuous_prestage=True, prestage_timeout_s=0.25,
+            failslow_vetter=vetter,
+            failslow_act=lambda node, entry: acts.append(
+                str(entry.get("id"))
+            ),
         )
         result = roller_b.rollout(record.mode)
         assert result.resumed is True
         assert result.generation == 2
         assert metrics.rollout_totals()["resumes"] == 1
+    # Exactly-once acting across the kill: the stub's single verdict is
+    # journaled in the record and acted ONCE, whether the kill landed
+    # before, at, or after failslow-vetted (the non-draining stub keeps
+    # offering id 1 to the successor; the journal must dedup it).
+    assert acts == ["1"], f"verdict 1 acted {len(acts)} times: {acts}"
     return killed, counts, result, fake
 
 

@@ -161,6 +161,14 @@ class RemediationLadder:
         self.step = STEP_RETRY  # cclint: guarded-by(_lock)
         self.quarantined = False  # cclint: guarded-by(_lock)
         self.last_reason = ""  # cclint: guarded-by(_lock)
+        # Confirmed fail-slow verdicts acted on (obs/failslow.py feed):
+        # a NON-probe signal ladder — verdict 1 restarts the runtime,
+        # verdict 2 quarantines reason=fail-slow. Persisted with the
+        # rest of the ladder so an agent restart mid-escalation cannot
+        # reset a gray node back to the cheap rung. Cleared when the
+        # peer-relative stats recover (note_failslow_recovered) or on
+        # unquarantine.
+        self.failslow_signals = 0  # cclint: guarded-by(_lock)
         # Probation: monotonic timestamp of the first healthy probe of the
         # current healthy streak while quarantined; None = not in a streak.
         # In-memory only — an agent restart restarts probation, which errs
@@ -203,6 +211,7 @@ class RemediationLadder:
             self.step = step if step in STEPS else STEP_RETRY
             self.quarantined = bool(state.get("quarantined", False))
             self.last_reason = str(state.get("reason", ""))
+            self.failslow_signals = int(state.get("failslow", 0))
         except (ValueError, TypeError) as e:
             log.warning("remediation: corrupt ladder annotation (%s); reset", e)
             return
@@ -217,7 +226,10 @@ class RemediationLadder:
         """Best-effort write-through of the ladder state; a lost write costs
         at most one rung of progress after a crash-restart."""
         value: str | None
-        if not self.failures and not self.quarantined:
+        if (
+            not self.failures and not self.quarantined
+            and not self.failslow_signals
+        ):
             value = None  # clean state: drop the annotation entirely
         else:
             value = json.dumps({
@@ -225,6 +237,7 @@ class RemediationLadder:
                 "step": self.step,
                 "quarantined": self.quarantined,
                 "reason": self.last_reason,
+                "failslow": self.failslow_signals,
                 "ts": int(time.time()),
             }, sort_keys=True)
         try:
@@ -323,6 +336,70 @@ class RemediationLadder:
             )
         self._persist()
         return step
+
+    def note_failslow(self, deviation: float | None = None) -> str:
+        """One CONFIRMED peer-relative fail-slow verdict
+        (obs/failslow.py): the gray-failure entry into the ladder.
+        Unlike note_failure this is a non-probe signal — the watchdog
+        is green throughout, nothing ever errored — so it enters at the
+        hardware rungs directly: the first confirmed verdict restarts
+        the TPU runtime (the cheapest action that un-wedges a degraded
+        runtime), a re-concluded verdict after that quarantines with
+        ``reason=fail-slow`` (probation plus recovered peer-relative
+        stats lift it). Returns the rung that ran."""
+        with self._lock:
+            self._ensure_loaded()
+            if self.quarantined:
+                return STEP_QUARANTINE  # already contained
+            self.failslow_signals += 1
+            self.last_reason = "fail-slow"
+            if self.failslow_signals == 1:
+                outcome = "ok"
+                try:
+                    if self.backend is not None:
+                        self._runtime_restart()
+                    else:
+                        outcome = "skipped"
+                except (TpuError, KubeApiError) as e:
+                    outcome = "failed"
+                    log.error(
+                        "remediation: fail-slow runtime restart failed on "
+                        "%s: %s", self.node_name, e,
+                    )
+                self.metrics.record_remediation_step(
+                    STEP_RUNTIME_RESTART, outcome
+                )
+                log.warning(
+                    "remediation: fail-slow verdict %d on %s "
+                    "(deviation=%s) -> %s (%s)",
+                    self.failslow_signals, self.node_name,
+                    f"{deviation:.2f}x" if deviation else "n/a",
+                    STEP_RUNTIME_RESTART, outcome,
+                )
+                self._persist()
+                return STEP_RUNTIME_RESTART
+            self._quarantine_locked(reason="fail-slow", manual=False)
+            return STEP_QUARANTINE
+
+    def note_failslow_recovered(self) -> None:
+        """The vetter CLEARED the node before the ladder reached
+        quarantine (peer-relative stats recovered — e.g. the runtime
+        restart fixed it): forget the escalation so the next confirmed
+        verdict, if any, starts from the cheap rung again. A
+        quarantined node is NOT released here — that goes through
+        probation (note_probe) or the operator, same as every other
+        quarantine."""
+        with self._lock:
+            self._ensure_loaded()
+            if self.quarantined or not self.failslow_signals:
+                return
+            log.info(
+                "remediation: fail-slow suspicion cleared on %s after %d "
+                "verdict(s); escalation reset", self.node_name,
+                self.failslow_signals,
+            )
+            self.failslow_signals = 0
+            self._persist()
 
     def _journal_hardware_intent(self, op: str) -> str | None:
         """Journal-before-reset: a KIND_REMEDIATION intent fsync'd BEFORE
@@ -462,6 +539,7 @@ class RemediationLadder:
         self._healthy_since = None
         self.failures = 0
         self.step = STEP_RETRY
+        self.failslow_signals = 0
         self.metrics.set_quarantined(False)
         if was:
             log.warning(
@@ -550,6 +628,8 @@ class RemediationLadder:
         with self._lock:
             if self.quarantined:
                 return "quarantined"
+            if self.failslow_signals:
+                return f"fail-slow({self.failslow_signals})"
             if self.failures:
                 return f"{self.step}({self.failures})"
             return ""

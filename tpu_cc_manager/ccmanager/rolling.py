@@ -32,6 +32,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import statistics
 import threading
 import time
 
@@ -224,6 +225,14 @@ CRASH_POINTS = (
     # re-validates and releases it exactly once, and the node re-flips
     # via the full path (never converges against an old plan).
     "prestage-invalidate",
+    # Fired after a fail-slow verdict is journaled in the record
+    # (durably checkpointed) but BEFORE the containment action runs: a
+    # kill here models the orchestrator dying mid-vetting — the
+    # successor resumes the verdict FROM the record (entries marked
+    # acted are skipped, unacted ones re-acted through the idempotent
+    # ladder), so one confirmed verdict can never quarantine twice.
+    # Fires only at boundaries where a new or unacted verdict exists.
+    "failslow-vetted",
 )
 
 
@@ -388,6 +397,15 @@ def headroom_gate_from_source(
 #: pool failure budget.
 STATE_NODE_DELETED = "deleted"
 
+#: Await-map state for a node abandoned at the peer-relative straggler
+#: wall: its peers in this rollout converged, this node is still
+#: converging beyond ``straggler_factor`` times the peer median, so the
+#: await returns WITHOUT it instead of stretching the window to the full
+#: node_timeout_s. Charged to the failure budget like a failure (the
+#: node did not reach the mode), distinct in the states map so the
+#: timeline and the record tell a straggler from a hard failure.
+STATE_STRAGGLER = "straggler"
+
 
 def partition_waves(
     groups: list[tuple[str, tuple[str, ...]]],
@@ -474,6 +492,11 @@ class RollingReconfigurator:
         slo_gate=None,
         slo_config: "SloGateConfig | None" = None,
         federation=None,
+        failslow_vetter=None,
+        failslow_act=None,
+        straggler_factor: float | None = None,
+        straggler_min_peers: int = 3,
+        straggler_floor_s: float = 1.0,
     ) -> None:
         # Crash safety: with a lease, every write goes through the fence
         # (a lost lease refuses further patches) and progress is
@@ -668,6 +691,45 @@ class RollingReconfigurator:
         # lost, parent generation advanced, parent aborted) raises
         # RolloutFenced instead of writing another byte.
         self.federation = federation
+        # Fail-slow containment (obs/failslow.py): ``failslow_vetter``
+        # is polled at every window boundary — its ``concluded()``
+        # verdicts are JOURNALED in the record (v8) and checkpointed
+        # behind the "failslow-vetted" crash point BEFORE
+        # ``failslow_act(node, entry)`` (typically
+        # RemediationLadder.note_failslow via the harness) runs, so a
+        # SIGKILL mid-containment resumes to the same single
+        # quarantine. Its ``suspects()`` feed the continuous-prestage
+        # headroom exclusion, and window groups whose every member is
+        # CONFIRMED fail-slow are skipped like quarantined ones. Both
+        # default to None: no vetter, no behavior change (the crash
+        # point never fires without a journaled verdict).
+        self.failslow_vetter = failslow_vetter
+        self.failslow_act = failslow_act
+        # Straggler-proof waves: when ``straggler_factor`` is set, an
+        # await whose remaining nodes have been converging longer than
+        # ``max(straggler_floor_s, factor * median(peer convergence))``
+        # abandons them as STATE_STRAGGLER (budget-charged, window
+        # wall released) instead of stretching to node_timeout_s. The
+        # peer stats are this rollout's own cross-window convergence
+        # history; below ``straggler_min_peers`` samples there is no
+        # peer evidence and the wall stays node_timeout_s.
+        self.straggler_factor = (
+            float(straggler_factor) if straggler_factor else None
+        )
+        if self.straggler_factor is not None and self.straggler_factor <= 1.0:
+            raise ValueError("straggler_factor must be > 1.0")
+        self.straggler_min_peers = max(1, int(straggler_min_peers))
+        self.straggler_floor_s = max(0.0, float(straggler_floor_s))
+        # Per-node convergence walls this rollout observed (bounded;
+        # guarded by _inflight_lock — awaits append from wave threads).
+        self._converge_history: list[float] = []
+        # Nodes with a journaled CONFIRMED verdict (acted or about to
+        # be) / currently-suspect nodes, refreshed at each vet pass.
+        self._failslow_confirmed: set[str] = set()
+        self._failslow_suspects: set[str] = set()
+        # Lease-less fallback journal (embedded callers without a
+        # record): same shape as record.failslow, no crash durability.
+        self._failslow_journal: dict[str, dict] = {}
 
     def _fl(self, event: str, **fields) -> None:
         """One flight-recorder event (no-op without a recorder)."""
@@ -840,6 +902,113 @@ class RollingReconfigurator:
             )
             with self._crash_lock:
                 self.crash_hook(point)
+
+    def _failslow_journal_of(self, record) -> dict[str, dict]:
+        """The fail-slow verdict journal: lease-backed (record.failslow,
+        checkpointed with every other rollout mutation) when a record
+        exists, else the in-memory fallback for lease-less rollouts."""
+        if record is not None:
+            return record.failslow
+        return self._failslow_journal
+
+    def _failslow_vet(self, record, window_id: int) -> None:
+        """One fail-slow pass at a window boundary: poll the vetter's
+        concluded verdicts, JOURNAL any new ones into the record, then
+        act every unacted entry exactly once through ``failslow_act``.
+
+        Exactly-once shape (same contract as hardware intents): the
+        verdict is checkpointed BEFORE containment runs, the
+        ``failslow-vetted`` crash point fires between the journal write
+        and the act, and the act is only marked done (and re-
+        checkpointed) after it returns. A kill anywhere in between
+        leaves an unacted journal entry the successor re-drives; the
+        remediation ladder underneath is idempotent, so a replayed act
+        cannot double-quarantine. An act that raises stays unacted and
+        is retried at the next boundary. The vetter itself is
+        fail-open: a raising vetter skips the pass, never halts the
+        rollout."""
+        journal = self._failslow_journal_of(record)
+        concluded: list[dict] = []
+        if self.failslow_vetter is not None:
+            try:
+                concluded = self.failslow_vetter.concluded()
+                self._failslow_suspects = set(self.failslow_vetter.suspects())
+            except Exception:
+                log.warning(
+                    "fail-slow vetter raised; skipping this vetting pass",
+                    exc_info=True,
+                )
+                concluded = []
+        with self._record_lock:
+            new_keys: list[str] = []
+            for entry in concluded:
+                key = str(entry.get("id"))
+                if key in journal:
+                    continue
+                journal[key] = {
+                    "node": str(entry.get("node")),
+                    "verdict": str(entry.get("verdict")),
+                    "deviation": entry.get("deviation"),
+                    "acted": False,
+                }
+                new_keys.append(key)
+            unacted = [
+                k for k, e in sorted(
+                    journal.items(),
+                    key=lambda kv: (len(kv[0]), kv[0]),  # numeric id order
+                )
+                if not e.get("acted")
+            ]
+        if not new_keys and not unacted:
+            return
+        # Journal-then-act: the verdicts are durable before any
+        # containment runs, so a SIGKILL at the crash point resumes
+        # them from the record instead of losing or replaying them.
+        self._checkpoint(record)
+        for key in new_keys:
+            e = journal[key]
+            self._fl(
+                flight_mod.EVENT_FAILSLOW_VERDICT, verdict_id=key,
+                node=e["node"], verdict=e["verdict"],
+                deviation=e["deviation"], window=window_id,
+            )
+        self._crash_point("failslow-vetted")
+        acted_any = False
+        for key in unacted:
+            e = journal[key]
+            node = e.get("node")
+            confirmed = e.get("verdict") == "confirmed"
+            if confirmed:
+                with self._record_lock:
+                    self._failslow_confirmed.add(node)
+                    if record is not None:
+                        record.charge_budget([node])
+            else:
+                with self._record_lock:
+                    self._failslow_confirmed.discard(node)
+            try:
+                if self.failslow_act is not None:
+                    # The journal key IS the verdict id: handing it to
+                    # the act lets an idempotent consumer dedup a
+                    # replayed act after a mid-act SIGKILL.
+                    self.failslow_act(node, {**e, "id": key})
+            except Exception:
+                log.error(
+                    "fail-slow containment for %s (verdict %s) failed; "
+                    "left unacted for retry at the next window boundary",
+                    node, key, exc_info=True,
+                )
+                continue
+            if confirmed:
+                self._fl(
+                    flight_mod.EVENT_BUDGET_CHARGE, nodes=[node],
+                    reason="fail-slow", window=window_id,
+                )
+            with self._record_lock:
+                e["acted"] = True
+            acted_any = True
+        if acted_any:
+            self._checkpoint(record)
 
     def _list_pool(self) -> list[dict]:
         """The current pool view: the informer cache when present (zero
@@ -1095,6 +1264,19 @@ class RollingReconfigurator:
                 # parent generation token) so the slice a successor
                 # resumes from fences against the live parent.
                 record.federation = self.federation.to_record_dict()
+            if record.failslow:
+                # Rehydrate the confirmed set from the journal in id
+                # order (a later cleared verdict lifts an earlier
+                # confirmed one); unacted entries are re-driven by the
+                # first _failslow_vet pass, not here.
+                for _k, e in sorted(
+                    record.failslow.items(),
+                    key=lambda kv: (len(kv[0]), kv[0]),
+                ):
+                    if e.get("verdict") == "confirmed":
+                        self._failslow_confirmed.add(e.get("node"))
+                    else:
+                        self._failslow_confirmed.discard(e.get("node"))
         elif self.lease is not None:
             record = rollout_state.RolloutRecord(
                 mode=mode, selector=self.selector,
@@ -1485,6 +1667,40 @@ class RollingReconfigurator:
                     surged=surged,
                     max_unavailable_observed=self._max_inflight_observed,
                 )
+            # Fail-slow vetting at the window boundary: journal any new
+            # peer-relative verdicts, then act them (restart -> quarantine
+            # ladder) behind the failslow-vetted crash point. Runs before
+            # the window timer so containment never counts against the
+            # measured disruption wall.
+            if self.failslow_vetter is not None or (
+                record is not None and record.failslow
+            ):
+                self._failslow_vet(record, window_id)
+            if self._failslow_confirmed:
+                # A group whose EVERY member holds a confirmed fail-slow
+                # verdict is already quarantined (or being quarantined) by
+                # the ladder — flipping it would just burn the window wall
+                # on a node we intend to drain. Partially-confirmed
+                # multi-host groups still flip whole: slice atomicity wins
+                # over skipping.
+                kept = []
+                for gid, names in window:
+                    if names and all(
+                        n in self._failslow_confirmed for n in names
+                    ):
+                        log.warning(
+                            "skipping group %s: all members confirmed "
+                            "fail-slow (%s)", gid, sorted(names),
+                        )
+                        self._fl(
+                            flight_mod.EVENT_QUARANTINE_SKIP,
+                            nodes=list(names), group=gid, why="fail-slow",
+                        )
+                        continue
+                    kept.append((gid, names))
+                window = kept
+                if not window:
+                    continue
             # Continuous prestage maintenance: runs BEFORE the window
             # timer starts, so prestage awaits never count against the
             # measured per-window disruption wall — the whole point is
@@ -1988,16 +2204,25 @@ class RollingReconfigurator:
         prestage is an optimization, and it must never consume headroom
         it cannot prove exists — the wave rolls on unpaced either way."""
         if self.headroom_gate is None:
-            return self.max_unavailable
-        try:
-            slack = int(self.headroom_gate())
-        except Exception as e:  # noqa: BLE001 - fail-closed by design
-            log.warning(
-                "prestage headroom gate failed (%s); reading ZERO slack "
-                "(prestage pauses; the wave is never paused by this)", e,
-            )
-            return 0
-        return max(0, min(slack, self.max_unavailable))
+            allowance = self.max_unavailable
+        else:
+            try:
+                slack = int(self.headroom_gate())
+            except Exception as e:  # noqa: BLE001 - fail-closed by design
+                log.warning(
+                    "prestage headroom gate failed (%s); reading ZERO slack "
+                    "(prestage pauses; the wave is never paused by this)", e,
+                )
+                return 0
+            allowance = max(0, min(slack, self.max_unavailable))
+        # A fail-slow suspect's capacity is phantom headroom: it still
+        # answers probes, but its effective token rate is a fraction of
+        # what the knee model assumes. Deduct suspects from the slack so
+        # prestage never spends headroom a gray node only pretends to
+        # supply.
+        if self._failslow_suspects:
+            allowance = max(0, allowance - len(self._failslow_suspects))
+        return allowance
 
     def _prestage_adopt(self, mode, groups, record) -> None:
         """Resume-time ledger adoption — the dual-wave resume. Every
@@ -2126,6 +2351,14 @@ class RollingReconfigurator:
                     self._prestage_arm(
                         mode, gid, stranded, record, window_id
                     )
+                continue
+            if self._failslow_suspects and any(
+                n in self._failslow_suspects for n in names
+            ):
+                # A suspect group is never prestaged: its drain handoff
+                # would route in-flight work through a node already
+                # serving at a fraction of its rate, and a confirmed
+                # verdict is about to skip the group anyway.
                 continue
             free = allowance - ledger.in_transition()
             if free <= 0:
@@ -2879,6 +3112,30 @@ class RollingReconfigurator:
             raise
         return node_labels(node).get(CC_MODE_STATE_LABEL)
 
+    def _note_converge_seconds(self, seconds: float) -> None:
+        """Append one node's convergence wall to the peer history the
+        straggler wall is computed from (bounded; oldest evicted)."""
+        with self._inflight_lock:
+            self._converge_history.append(seconds)
+            if len(self._converge_history) > 64:
+                del self._converge_history[0]
+
+    def _straggler_wall(self) -> float | None:
+        """The peer-relative straggler deadline for the CURRENT window,
+        or None while disarmed. Armed only once enough peers converged
+        this rollout (min_peers) — the first window of a cold rollout
+        has no peer baseline and must run on the absolute node timeout
+        alone. The wall is median(peer walls) x factor, floored so a
+        fast homogeneous fleet (medians near zero) cannot turn routine
+        scheduling jitter into skips."""
+        if self.straggler_factor is None:
+            return None
+        with self._inflight_lock:
+            if len(self._converge_history) < self.straggler_min_peers:
+                return None
+            med = statistics.median(self._converge_history)
+        return max(self.straggler_floor_s, self.straggler_factor * med)
+
     def _await_group(
         self, gid: str, names: tuple[str, ...], mode: str, started: float
     ) -> GroupResult:
@@ -2936,6 +3193,9 @@ class RollingReconfigurator:
                 if state == mode:
                     states[name] = state
                     pending.discard(name)
+                    self._note_converge_seconds(
+                        time.monotonic() - started
+                    )
                 elif state == STATE_NODE_DELETED:
                     # The Node object is gone (autoscaler scale-down):
                     # resolve the slot immediately — it is not a CC
@@ -2949,6 +3209,32 @@ class RollingReconfigurator:
                 elif state == STATE_FAILED and name not in stale_failed:
                     states[name] = state
                     pending.discard(name)
+            # Peer-relative straggler wall: a node still pending long
+            # after its peers' median convergence wall is a gray node,
+            # not a slow one — cut it loose NOW (charged to the failure
+            # budget like a failure, distinct state for forensics)
+            # instead of letting one brownout chip hold the whole
+            # disruption window open to the absolute node timeout.
+            if pending:
+                wall = self._straggler_wall()
+                if wall is not None and time.monotonic() - started > wall:
+                    for name in sorted(pending):
+                        log.error(
+                            "node %s exceeded the straggler wall "
+                            "(%.1fs = %.1fx peer median) in group %s; "
+                            "skipping it (budget-charged)",
+                            name, wall, self.straggler_factor, gid,
+                        )
+                        states[name] = STATE_STRAGGLER
+                        self._fl(
+                            flight_mod.EVENT_STRAGGLER_SKIPPED,
+                            node=name, group=gid,
+                            wall_s=round(wall, 3),
+                            waited_s=round(
+                                time.monotonic() - started, 3
+                            ),
+                        )
+                    pending.clear()
             return not pending
 
         remaining = max(0.0, started + self.node_timeout_s - time.monotonic())

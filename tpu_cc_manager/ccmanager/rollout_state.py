@@ -119,8 +119,20 @@ RECORD_HALTED = "halted"
 #: fresh prestages on top of invisible old ones and spend the knee slack
 #: the SLO gate is protecting — so v7 is refused loudly by older
 #: parsers. Rollouts that never prestage keep writing <= v6.
-RECORD_VERSION = 7
+#: 8: adds ``failslow`` (journaled fail-slow verdicts): one entry per
+#: concluded peer-relative verdict (keyed by the vetter's monotonic id)
+#: with the node, the verdict, and whether the orchestrator has ACTED
+#: on it yet — journaled behind the ``failslow-vetted`` crash point
+#: BEFORE acting, so a SIGKILL mid-containment resumes to the same
+#: single quarantine instead of re-deriving (or double-acting) the
+#: verdict. Written ONLY when a verdict has been journaled. A
+#: failslow-unaware binary resuming a v8 record would drop the acted
+#: markers and re-run the ladder from scratch — the double-quarantine
+#: the journal exists to prevent — so v8 is refused loudly by older
+#: parsers. Rollouts that never concluded a verdict keep writing <= v7.
+RECORD_VERSION = 8
 #: What records WITHOUT the newer optional fields write (compat floors).
+RECORD_VERSION_NO_FAILSLOW = 7
 RECORD_VERSION_NO_LEDGER = 6
 RECORD_VERSION_NO_ESCROW = 5
 RECORD_VERSION_NO_FEDERATION = 4
@@ -349,6 +361,14 @@ class RolloutRecord:
     # entries as-is (no re-surge, no second charge) and invalidates
     # entries whose plan digest no longer matches.
     ledger: CapacityLedger | None = None
+    # Journaled fail-slow verdicts (format v8, written only when one
+    # exists): vetter verdict id (str) -> {"node", "verdict",
+    # "deviation", "acted"}. Journal-then-act: an entry lands here (and
+    # is checkpointed) BEFORE the remediation ladder runs, behind the
+    # failslow-vetted crash point, so a successor acts each verdict
+    # exactly once — already-acted entries are skipped, unacted ones
+    # retried (the ladder's actions are idempotent).
+    failslow: dict[str, dict] = field(default_factory=dict)
 
     def charge_budget(self, nodes) -> None:
         self.budget_spend = sorted(set(self.budget_spend) | set(nodes))
@@ -375,11 +395,16 @@ class RolloutRecord:
             self.ledger if self.ledger is not None and self.ledger.touched()
             else None
         )
-        if ledger is not None:
+        if self.failslow:
+            # A verdict is journaled: a failslow-unaware resume would
+            # drop the acted markers and double-act the ladder, so
+            # refuse downgrade.
+            version = RECORD_VERSION
+        elif ledger is not None:
             # The rollout prestaged: a ledger-unaware resume would drop
             # the reservations and stack fresh prestages on invisible
             # old ones, so refuse downgrade.
-            version = RECORD_VERSION
+            version = RECORD_VERSION_NO_FAILSLOW
         elif federation and "escrow" in federation:
             # The shard holds an escrow ledger (parent-plane partition
             # tolerance): an escrow-unaware resume would keep charging
@@ -412,6 +437,8 @@ class RolloutRecord:
             body["federation"] = federation
         if ledger is not None:
             body["ledger"] = ledger.to_dict()
+        if self.failslow:
+            body["failslow"] = self.failslow
         return json.dumps(body, sort_keys=True, separators=(",", ":"))
 
     @classmethod
@@ -457,6 +484,10 @@ class RolloutRecord:
                 ledger=(
                     CapacityLedger.from_dict(obj["ledger"])
                     if isinstance(obj.get("ledger"), dict) else None
+                ),
+                failslow=(
+                    {str(k): dict(v) for k, v in obj["failslow"].items()}
+                    if isinstance(obj.get("failslow"), dict) else {}
                 ),
             )
         except RolloutFenced:

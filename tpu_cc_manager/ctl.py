@@ -1639,7 +1639,7 @@ def cmd_status(api, args) -> int:
         log.debug("federated parent record unreadable: %s", e)
     rows = [
         f"{'NODE':<24} {'SLICE':<20} {'DESIRED':<10} {'STATE':<10} "
-        f"{'READY':<6} {'TRACE':<17} NOTE"
+        f"{'READY':<6} {'SUSPECT':<8} {'TRACE':<17} NOTE"
     ]
     for node in api.list_nodes(args.selector):
         labels = node_labels(node)
@@ -1701,12 +1701,22 @@ def cmd_status(api, args) -> int:
             notes.append(
                 f"drain:requested({len(subs) - pending}/{len(subs)} acked)"
             )
+        # Fail-slow SUSPECT: published by the vetter (obs/failslow.py
+        # publish_suspect_labels) while a node's peer-relative latency
+        # deviates — green probes, gray service. Telemetry only; the
+        # verdict journal in the rollout record is what acts.
+        suspect = (
+            "slow"
+            if labels.get(labels_mod.FAILSLOW_SUSPECT_LABEL)
+            else "-"
+        )
         rows.append(
             f"{node['metadata']['name']:<24} "
             f"{labels.get(SLICE_ID_LABEL, '-'):<20} "
             f"{labels.get(CC_MODE_LABEL, '-'):<10} "
             f"{labels.get(CC_MODE_STATE_LABEL, '-'):<10} "
             f"{labels.get(CC_READY_STATE_LABEL, '-'):<6} "
+            f"{suspect:<8} "
             f"{trace:<17} "
             f"{' '.join(notes) or '-'}"
         )

@@ -49,6 +49,15 @@ class FaultyKubeClient(KubeApi):
     # ---- fault application ----------------------------------------------
 
     def _maybe_fault(self, op: str) -> None:
+        # Brownout weather first (gray failure: the call SUCCEEDS, just
+        # late — intermittently, from the plan's derived brownout
+        # stream). Checked before the main-stream decision so a slow
+        # call can still also draw a fault; neither perturbs the other's
+        # schedule.
+        brown = self.plan.decide_brownout_slow(op)
+        if brown > 0:
+            log.info("chaos: brownout slows %s by %.3fs", op, brown)
+            self.sleep(brown)
         fault = self.plan.decide(op)
         if fault is None:
             return

@@ -104,6 +104,26 @@ class FaultPlan:
     # fast-drain path (drain/evict.py) must.
     preemption_rate: float = 0.0
     preemption_deadline_s: float = 30.0
+    # Per-verb slow latency overrides: when the drawn kind is ``slow``,
+    # the delay for ``op`` comes from here (falling back to the global
+    # ``slow_s``). Consulted with ZERO extra rng draws, so arming
+    # per-verb weather composes with existing chaos seeds without
+    # reshuffling the schedule other modes draw from the main stream.
+    slow_s_by_op: dict[str, float] = field(default_factory=dict)
+    # Brownout mode (gray failure, Huang HotOS'17): a SEEDED node fails
+    # SLOW, not stop — its executor token rate degrades by
+    # ``brownout_token_rate_factor``, its per-chip reset/boot walls
+    # inflate by ``brownout_reset_factor``, and its kube ops/probes go
+    # intermittently slow (``brownout_kube_slow_rate`` of calls sleep
+    # ``brownout_kube_slow_s``) while still SUCCEEDING — the watchdog
+    # stays green by construction. Per-call slowness draws from a
+    # DERIVED stream so arming a brownout never reshuffles the per-call
+    # fault schedule, and the intermittent delays are weather, not
+    # budget: they do not count against ``max_faults``.
+    brownout_token_rate_factor: float = 4.0
+    brownout_reset_factor: float = 3.0
+    brownout_kube_slow_rate: float = 0.35
+    brownout_kube_slow_s: float = 0.2
     rng: random.Random = field(init=False, repr=False)
     injected: list[Fault] = field(init=False, repr=False)
     _seq: int = field(init=False, repr=False)
@@ -122,6 +142,10 @@ class FaultPlan:
         # decide_orchestrator_kill at exactly this point raises,
         # regardless of kill_rate.
         self._forced_kill_point: str | None = None
+        # Derived, not the main stream (see brownout_* above).
+        self._brownout_rng = random.Random((self.seed << 2) ^ 0xB70B0)
+        self._brownout: int | None = None
+        self.brownout_slow_ops = 0
 
     @classmethod
     def from_env(cls, default_seed: int = 20260803, **kwargs) -> "FaultPlan":
@@ -156,7 +180,10 @@ class FaultPlan:
                 else None
             ),
             retry_after_s=self.retry_after_s if kind == "http-429" else None,
-            slow_s=self.slow_s if kind == "slow" else None,
+            slow_s=(
+                self.slow_s_by_op.get(op, self.slow_s)
+                if kind == "slow" else None
+            ),
         )
         self.injected.append(fault)
         return fault
@@ -350,6 +377,63 @@ class FaultPlan:
             Fault(kind=BLACKOUT_KIND, op="seeded-window", seq=self._seq)
         )
         return span
+
+    # ---- brownout (gray-failure) mode -----------------------------------
+
+    @property
+    def brownout_active(self) -> bool:
+        return self._brownout is not None
+
+    @property
+    def brownout_node(self) -> int | None:
+        """Index of the node currently browning out, or None."""
+        return self._brownout
+
+    def seed_brownout(self, nodes: int = 1) -> int:
+        """Arm a brownout on ONE node unconditionally, the victim's
+        index drawn uniformly from ``nodes`` via the seeded main stream
+        (the GRAY_r01 drill needs the scenario — a gray node the
+        watchdog can't see — not the odds; WHICH node stays a pure
+        function of the seed so a soak failure replays exactly).
+        Recorded in the injected schedule like a drawn fault but NOT
+        counted against ``max_faults`` — a brownout is weather the
+        detector must see through, not budget the soak spends. The
+        caller applies the factors to that node's executor/backend
+        (serve/server.py ``set_brownout``, tpudev/fake.py
+        ``set_brownout``) and routes its kube client's per-call
+        slowness through :meth:`decide_brownout_slow`. Returns the
+        victim index."""
+        self._seq += 1
+        idx = self.rng.randrange(max(1, nodes))
+        self._brownout = idx
+        self.injected.append(
+            Fault(kind="brownout", op=f"node-{idx}", seq=self._seq)
+        )
+        return idx
+
+    def clear_brownout(self) -> None:
+        """Model the gray hardware recovering (the probation-lift leg):
+        per-call slowness stops; the caller clears the executor/backend
+        factors it applied at seed time."""
+        self._brownout = None
+
+    def decide_brownout_slow(self, op: str) -> float:
+        """One intermittent-slowness decision for a kube call on the
+        browning-out node: returns seconds to sleep (0.0 = this call is
+        fast). The call still SUCCEEDS either way — brownout never
+        errors, that is the point. Draws from the derived brownout
+        stream on every call while armed so the schedule stays a pure
+        function of (seed, call sequence) and never perturbs the main
+        stream; decisions are not appended to ``injected`` (weather,
+        not budget)."""
+        if self._brownout is None:
+            return 0.0
+        roll = self._brownout_rng.random()
+        jitter = self._brownout_rng.random()
+        if roll >= self.brownout_kube_slow_rate:
+            return 0.0
+        self.brownout_slow_ops += 1
+        return self.brownout_kube_slow_s * (0.5 + jitter)
 
     def seed_terminal_backend_fault(self, backend, ops: tuple[str, ...]) -> str:
         """Arm one TERMINAL device fault (``times=-1``: never clears) on an

@@ -106,6 +106,13 @@ SLICE_COMMIT_GEN_LABEL = "cloud.google.com/tpu-cc.slice.commit-gen"
 # Remediation-ladder persistence (ccmanager/remediation.py).
 REMEDIATION_ANNOTATION = "cloud.google.com/tpu-cc.remediation"
 
+# Fail-slow vetting (obs/failslow.py): "true" while peer-relative
+# outlier vetting suspects the node of a gray failure — operator
+# telemetry for the `ctl status` SUSPECT column, never control flow
+# (the rollout record's journaled verdicts are authoritative for
+# acting). Cleared when the peer-relative stats recover.
+FAILSLOW_SUSPECT_LABEL = "cloud.google.com/tpu-cc.failslow.suspect"
+
 # Crash-safe rollouts (ccmanager/rollout_state.py): the checkpointed
 # record on the Lease, and the generation stamp on rolled nodes.
 ROLLOUT_RECORD_ANNOTATION = "cloud.google.com/tpu-cc.rollout-record"

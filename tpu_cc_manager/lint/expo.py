@@ -324,6 +324,15 @@ def _seeded_registry_text() -> str:
     registry.record_prestage("degraded")
     registry.record_prestage("paused")
     registry.record_prestage('odd"outcome\nhere')
+    # Fail-slow vetting families (obs/failslow.py peer-relative
+    # gray-failure detection), hostile node/verdict labels included.
+    registry.set_failslow_suspect("serve-node-0", True)
+    registry.set_failslow_suspect('odd"node\nname', False)
+    registry.set_failslow_deviation("serve-node-0", 3.4142)
+    registry.set_failslow_deviation('odd"node\nname', 0.98)
+    registry.record_failslow_verdict("serve-node-0", "confirmed")
+    registry.record_failslow_verdict("serve-node-0", "cleared")
+    registry.record_failslow_verdict('odd"node\nname', 'odd"verdict')
     return registry.render_prometheus()
 
 

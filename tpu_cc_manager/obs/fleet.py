@@ -454,10 +454,21 @@ class FleetGateway:
         workers: int = 8,
         hbm_ceiling: float = 0.9,
         max_queue_depth: int = 16,
+        slow_scrape_s: float | None = None,
         clock=time.monotonic,
     ) -> None:
         self.interval_s = float(interval_s)
         self.scrape_deadline_s = float(scrape_deadline_s)
+        # A scrape that SUCCEEDS but takes this long is a gray signal:
+        # the agent is alive (not dead, not stale) yet something on the
+        # node is dragging — surfaced as scrape_slow in /fleetz and
+        # excluded from the headroom ledger, but NOT from the rollups
+        # (its telemetry is real; a fail-slow vetter needs it).
+        self.slow_scrape_s = (
+            float(slow_scrape_s)
+            if slow_scrape_s is not None
+            else self.scrape_deadline_s / 2.0
+        )
         self.stale_after_sweeps = max(1, int(stale_after_sweeps))
         self.workers = max(1, int(workers))
         self.hbm_ceiling = float(hbm_ceiling)
@@ -532,6 +543,8 @@ class FleetGateway:
                 "rolloutz": rolloutz if isinstance(rolloutz, dict) else {},
             }
 
+        clock = self.clock if callable(self.clock) else time.monotonic
+        t0 = clock()
         try:
             got = policy.call(
                 fetch_all, op=f"fleet.scrape.{name}",
@@ -539,6 +552,7 @@ class FleetGateway:
             )
         except Exception as e:  # noqa: BLE001 - a dead agent is data, not a crash
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        scrape_seconds = clock() - t0
         snapshot_ts = got["statusz"].get("snapshot_ts")
         prev_ts = prev.get("snapshot_ts") if prev else None
         if (
@@ -567,6 +581,11 @@ class FleetGateway:
             "agent_version": got["statusz"].get("agent_version"),
             "rollout_recent": got["rolloutz"].get("recent") or [],
             "rollout_torn": got["rolloutz"].get("torn_lines") or 0,
+            # Slow-but-successful is a DISTINCT verdict from dead: the
+            # agent answered (telemetry stays in the rollups) but took
+            # long enough that the node itself is suspect.
+            "scrape_seconds": round(scrape_seconds, 4),
+            "slow": scrape_seconds >= self.slow_scrape_s,
         }
 
     def scrape_once(self) -> dict:
@@ -643,6 +662,8 @@ class FleetGateway:
                 "agent_version": scrape.get("agent_version"),
                 "snapshot_ts": scrape.get("snapshot_ts"),
                 "age_sweeps": self._sweep - scrape.get("last_ok_sweep", 0),
+                "scrape_slow": bool(scrape.get("slow")),
+                "scrape_seconds": scrape.get("scrape_seconds"),
             }
             if text is not None and not stale:
                 live[name] = text
@@ -654,12 +675,22 @@ class FleetGateway:
                     fastest = burns[min(burns)]
                     entry["slo_burn"] = fastest.get("burn_rate")
                     entry["slo_p99_s"] = fastest.get("p99_s")
+                if entry["scrape_slow"]:
+                    # Slow != dead: the telemetry stays in the rollups
+                    # (the fail-slow vetter needs the suspect's own
+                    # samples), but its capacity is phantom — the
+                    # prestage pacer must not spend it.
+                    entry["has_headroom"] = False
             else:
                 entry["has_headroom"] = False
             ledger[name] = entry
         merged = merge_expositions(live)
         p99 = fleet_p99(shards)
         n_stale = sum(1 for e in ledger.values() if e["stale"])
+        n_slow = sum(
+            1 for e in ledger.values()
+            if e.get("scrape_slow") and not e["stale"]
+        )
         n_headroom = sum(
             1 for e in ledger.values() if e.get("has_headroom")
         )
@@ -675,6 +706,13 @@ class FleetGateway:
             "from the rollups.",
             "# TYPE tpu_cc_fleet_nodes_stale gauge",
             "tpu_cc_fleet_nodes_stale %d" % n_stale,
+            "# HELP tpu_cc_fleet_nodes_slow Targets whose scrape "
+            "SUCCEEDED but ran past slow_scrape_s — alive-but-dragging "
+            "gray signal: kept in the rollups, excluded from the "
+            "headroom ledger, surfaced per node as scrape_slow in "
+            "/fleetz.",
+            "# TYPE tpu_cc_fleet_nodes_slow gauge",
+            "tpu_cc_fleet_nodes_slow %d" % n_slow,
             "# HELP tpu_cc_fleet_headroom_nodes The capacity ledger: "
             "nodes with serving headroom (fresh scrape, not quarantined"
             "/offline/prestaging, hbm_bw_util under the ceiling, queue "
@@ -745,6 +783,13 @@ class FleetGateway:
             errors = self._scrape_errors_total
             sweep_seconds = self._last_sweep_seconds
         stale = sorted(n for n, e in ledger.items() if e["stale"])
+        # Slow-but-successful is reported apart from dead/stale: a gray
+        # node's telemetry is still live (rollups keep it) but its
+        # capacity is not trusted — operators need to see which is which.
+        slow = sorted(
+            n for n, e in ledger.items()
+            if e.get("scrape_slow") and not e["stale"]
+        )
         burns = [
             e["slo_burn"] for e in ledger.values()
             if e.get("slo_burn") is not None
@@ -759,6 +804,8 @@ class FleetGateway:
                 "nodes": len(ledger),
                 "stale": len(stale),
                 "stale_nodes": stale,
+                "slow": len(slow),
+                "slow_nodes": slow,
                 "headroom_nodes": sum(
                     1 for e in ledger.values() if e.get("has_headroom")
                 ),

@@ -109,6 +109,17 @@ EVENT_PRESTAGE_HELD = "prestage-held"
 EVENT_PRESTAGE_INVALIDATED = "prestage-invalidated"
 EVENT_PRESTAGE_RELEASED = "prestage-released"
 EVENT_PRESTAGE_PAUSED = "prestage-paused"
+#: Fail-slow containment (obs/failslow.py + ccmanager/rolling.py):
+#: ``failslow-verdict`` journals one concluded peer-relative verdict
+#: (node, verdict, deviation ride along) at the boundary where the
+#: orchestrator recorded it — BEFORE acting, behind the
+#: ``failslow-vetted`` crash point, so a successor resumes the same
+#: verdict instead of re-deriving it. ``straggler-skipped`` fires when
+#: an await gives up on nodes converging beyond the peer-relative
+#: straggler wall: charged to the failure budget and skipped, instead
+#: of stretching every window to node_timeout_s.
+EVENT_FAILSLOW_VERDICT = "failslow-verdict"
+EVENT_STRAGGLER_SKIPPED = "straggler-skipped"
 
 #: Node-terminal events: the exactly-once reconstruction keys on these
 #: (a node converges/fails/retires once per rollout, crash+resume

@@ -175,8 +175,23 @@ class TrafficDriver:
         self._next_arrival_t: float | None = None  # cclint: guarded-by(_lock)
         self._open_loop_t0: float | None = None  # cclint: guarded-by(_lock)
         self._traffic_stopped_t: float | None = None  # cclint: guarded-by(_lock)
+        # Fail-slow de-weighting (obs/failslow.py): nodes under
+        # peer-relative suspicion are capped at min_batch IN FLIGHT —
+        # their trickle is bounded by their own service rate, not a
+        # share of the offered load — which holds the tail while the
+        # verdict is still out yet keeps vetting fed so recovery stays
+        # observable. Ignored when EVERY accepting node is suspect:
+        # de-weighting the whole pool would just shed it.
+        self._suspects: frozenset[str] = frozenset()  # cclint: guarded-by(_lock)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def set_suspects(self, names) -> None:
+        """Replace the fail-slow suspect set the dispatcher de-weights
+        (the vetting loop publishes :meth:`FailslowVetter.suspects`
+        here each window)."""
+        with self._lock:
+            self._suspects = frozenset(names)
 
     # -- server callbacks --------------------------------------------------
 
@@ -438,12 +453,31 @@ class TrafficDriver:
         flow."""
         if self.deadline_s is not None:
             self._shed_expired_pending(self.clock())
-        for name, server in self.servers.items():
+        with self._lock:
+            suspects = self._suspects
+        accepting = [n for n, s in self.servers.items() if s.accepting()]
+        if suspects and accepting and all(n in suspects for n in accepting):
+            suspects = frozenset()
+        # Suspects draw their (one-in-flight) trickle first: with fleet
+        # headroom the healthy nodes would otherwise drain the pending
+        # queue every round and starve the suspect of the very samples
+        # vetting needs to clear it. The CAP is the de-weight — a
+        # suspect can never hold more than min_batch requests — so
+        # going first costs the tail at most min_batch slow slots.
+        ordered = sorted(
+            self.servers.items(), key=lambda kv: kv[0] not in suspects
+        )
+        for name, server in ordered:
             if not server.accepting():
                 continue
             with self._lock:
-                bsz = self._batch[name]
-                if self._outstanding[name] >= self.pipe_depth * bsz:
+                if name in suspects:
+                    bsz = self.min_batch
+                    cap = self.min_batch
+                else:
+                    bsz = self._batch[name]
+                    cap = self.pipe_depth * bsz
+                if self._outstanding[name] >= cap:
                     continue
                 if top_up:
                     now = self.clock()

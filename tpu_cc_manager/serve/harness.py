@@ -27,6 +27,7 @@ import time
 
 from tpu_cc_manager.ccmanager.manager import CCManager
 from tpu_cc_manager.ccmanager.metrics_server import start_metrics_server
+from tpu_cc_manager.ccmanager.remediation import RemediationLadder
 from tpu_cc_manager.ccmanager.rolling import RollingReconfigurator
 from tpu_cc_manager.drain.sim import add_drainable_node
 from tpu_cc_manager.kubeclient.api import node_labels
@@ -36,6 +37,7 @@ from tpu_cc_manager.labels import (
     MODE_OFF,
     SLICE_ID_LABEL,
 )
+from tpu_cc_manager.obs import failslow as failslow_mod
 from tpu_cc_manager.obs.flight import FlightRecorder
 from tpu_cc_manager.obs.journal import Journal
 from tpu_cc_manager.obs.slo import SloEvaluator
@@ -84,6 +86,9 @@ class ServeHarness:
         slo_windows_s: tuple[float, ...] = (5.0, 30.0),
         slo_error_budget: float = 1e-3,
         handoff: bool = False,
+        failslow: bool = False,
+        failslow_kwargs: dict | None = None,
+        failslow_probation_s: float = 2.0,
     ) -> None:
         self.n_nodes = n_nodes
         self.nodes = [f"serve-node-{i}" for i in range(n_nodes)]
@@ -123,6 +128,24 @@ class ServeHarness:
         self.driver: TrafficDriver | None = None
         self._agent_threads: list[threading.Thread] = []
         self._agent_stop = threading.Event()
+        # Fail-slow plane (GRAY_r01): the peer-relative vetter judging
+        # every completion, one remediation ladder per node for
+        # containment (runtime-restart -> quarantine reason=fail-slow),
+        # and a vet-loop thread pacing the windows and acting verdicts
+        # at window cadence. A concurrent rollout journals the same
+        # verdicts into its record (crash-resume) and replays them
+        # through the same callable — both paths funnel through
+        # _failslow_act, whose per-id dedup keeps one verdict from ever
+        # escalating twice.
+        self.failslow = failslow
+        self.failslow_kwargs = failslow_kwargs or {}
+        self.failslow_probation_s = failslow_probation_s
+        self.failslow_vetter: failslow_mod.FailslowVetter | None = None
+        self.ladders: dict[str, RemediationLadder] = {}
+        self._failslow_acted: set[str] = set()
+        self._suspects_published: set[str] = set()
+        self._vet_stop = threading.Event()
+        self._vet_thread: threading.Thread | None = None
 
     # -- pool construction -------------------------------------------------
 
@@ -168,10 +191,24 @@ class ServeHarness:
         # Forwarding closures break the server↔driver construction cycle
         # (nothing fires before run() starts the servers, by which time
         # the driver exists).
+        if self.failslow:
+            kwargs = dict(self.failslow_kwargs)
+            kwargs.setdefault("metrics", self.metrics)
+            self.failslow_vetter = failslow_mod.FailslowVetter.from_env(
+                **kwargs
+            )
+            self.ladders = {
+                name: RemediationLadder(
+                    self.kube, name, backend=self.backends[name],
+                    probation_s=self.failslow_probation_s,
+                    metrics=self.metrics,
+                )
+                for name in self.nodes
+            }
         self.servers = {
             name: NodeServer(
                 self.kube, name,
-                on_complete=lambda n, r, u: self.driver.on_complete(n, r, u),
+                on_complete=lambda n, r, u: self._on_complete(n, r, u),
                 on_requeue=lambda n, rs: self.driver.on_requeue(n, rs),
                 on_shed=lambda n, rs: self.driver.on_shed(n, rs),
                 on_handoff=(
@@ -205,6 +242,131 @@ class ServeHarness:
             return True
 
         return retry_mod.poll_until(settled, timeout_s, 0.05)
+
+    # -- fail-slow plane ---------------------------------------------------
+
+    def _on_complete(self, node, req, util) -> None:
+        """Driver completion callback, teed into the fail-slow vetter:
+        every finished request's SERVICE time (dispatch to completion)
+        is one peer-relative sample for the node that served it. NOT
+        end-to-end latency: the driver's pending queue is shared, so
+        under overload its wait inflates every node's arrival-to-done
+        latency together and the peer ratio compresses toward 1 —
+        exactly when a browned-out node is eating the fleet's headroom.
+        Service time stays a property of the node alone."""
+        self.driver.on_complete(node, req, util)
+        if (
+            self.failslow_vetter is not None
+            and req.completed_at is not None
+        ):
+            t0 = (
+                req.started_at
+                if req.started_at is not None else req.submitted_at
+            )
+            self.failslow_vetter.observe(
+                node, max(0.0, req.completed_at - t0)
+            )
+
+    def _failslow_act(self, node: str, entry: dict) -> None:
+        """Containment for ONE fail-slow verdict — the callable the
+        rolling orchestrator invokes behind its ``failslow-vetted``
+        crash point, and the vet loop invokes between rollouts.
+        Idempotent per verdict id (the rolling journal may replay an
+        act after a mid-act SIGKILL): a replayed id is a no-op, so a
+        node can never be double-escalated for one verdict."""
+        key = str(entry.get("id", ""))
+        if key and key in self._failslow_acted:
+            return
+        ladder = self.ladders.get(node)
+        if ladder is None:
+            return
+        if entry.get("verdict") == failslow_mod.VERDICT_CONFIRMED:
+            step = ladder.note_failslow(entry.get("deviation"))
+            log.warning(
+                "fail-slow containment: node %s verdict %s "
+                "(deviation %.2fx) -> %s",
+                node, key or "?", float(entry.get("deviation") or 0.0),
+                step,
+            )
+        else:
+            ladder.note_failslow_recovered()
+            log.info(
+                "fail-slow cleared: node %s verdict %s (peer-relative "
+                "stats recovered)", node, key or "?",
+            )
+        if key:
+            self._failslow_acted.add(key)
+
+    def _vet_once(self) -> None:
+        """One vetting window: judge, publish the suspect set to the
+        driver (de-weighting) and the node labels (ctl status SUSPECT
+        column), then — only while no rollout owns the journal — act
+        any verdicts the orchestrator has not already acted."""
+        vetter = self.failslow_vetter
+        vetter.vet()
+        suspects = vetter.suspects()
+        if self.driver is not None:
+            self.driver.set_suspects(suspects)
+        added = suspects - self._suspects_published
+        removed = self._suspects_published - suspects
+        if added or removed:
+            failslow_mod.publish_suspect_labels(
+                self.kube, sorted(added), sorted(removed)
+            )
+            self._suspects_published = set(suspects)
+        # Containment latency is the vet loop's job: verdicts are acted
+        # HERE, at window cadence, not deferred to the next rollout
+        # window boundary. The rolling orchestrator journals the same
+        # verdicts into its record (crash-resume) and replays them
+        # through this same callable — the per-id dedup makes whichever
+        # path runs second a no-op, so the two consumers can never
+        # double-escalate one verdict.
+        for entry in vetter.concluded():
+            self._failslow_act(str(entry.get("node")), entry)
+        # Probation feed: a quarantined node that is no longer suspect
+        # accrues healthy probes, so the lift (reason=fail-slow release)
+        # happens on recovery without a separate watchdog in the
+        # harness.
+        for name, ladder in self.ladders.items():
+            if ladder.quarantined and name not in suspects:
+                ladder.note_probe(True)
+
+    def _vet_loop(self) -> None:
+        while not self._vet_stop.wait(self.failslow_vetter.window_s):
+            try:
+                self._vet_once()
+            except Exception:  # noqa: BLE001 - vetting never kills traffic
+                log.warning(
+                    "fail-slow vet pass failed; continuing", exc_info=True
+                )
+
+    def _start_vetting(self) -> None:
+        if self.failslow_vetter is None or self._vet_thread is not None:
+            return
+        self._vet_stop.clear()
+        self._vet_thread = threading.Thread(
+            target=self._vet_loop, daemon=True, name="failslow-vet",
+        )
+        self._vet_thread.start()
+
+    def _stop_vetting(self) -> None:
+        if self._vet_thread is None:
+            return
+        self._vet_stop.set()
+        self._vet_thread.join(timeout=10)
+        self._vet_thread = None
+
+    def set_brownout(self, node: str, token_rate_factor: float) -> None:
+        """Degrade (or restore, factor 1.0) one node's executor token
+        rate AND its fake TPU latency walls — the seeded gray-failure
+        injection: the node keeps completing requests and passing
+        probes, just slower."""
+        server = self.servers.get(node)
+        if server is not None and hasattr(server.executor, "set_brownout"):
+            server.executor.set_brownout(token_rate_factor)
+        backend = self.backends.get(node)
+        if backend is not None:
+            backend.set_brownout(token_rate_factor)
 
     # -- run ---------------------------------------------------------------
 
@@ -240,6 +402,7 @@ class ServeHarness:
         for server in self.servers.values():
             server.start()
         self.driver.start()
+        self._start_vetting()
         result = None
         t_roll_0 = t_roll_1 = None
         try:
@@ -274,6 +437,14 @@ class ServeHarness:
                             p99_target_s=target_s,
                         )
 
+                extra = dict(roller_kwargs or {})
+                if self.failslow_vetter is not None:
+                    # The orchestrator owns verdict acting during the
+                    # flip: journaled in the record, acted behind the
+                    # failslow-vetted crash point — _failslow_act's
+                    # per-id dedup keeps a replay harmless.
+                    extra.setdefault("failslow_vetter", self.failslow_vetter)
+                    extra.setdefault("failslow_act", self._failslow_act)
                 roller = RollingReconfigurator(
                     self.kube, POOL_SELECTOR,
                     max_unavailable=max_unavailable,
@@ -286,7 +457,7 @@ class ServeHarness:
                     slo_config=slo_config,
                     # Extra orchestrator knobs (BENCH_r09 passes
                     # continuous_prestage + headroom_gate here).
-                    **(roller_kwargs or {}),
+                    **extra,
                 )
                 t_roll_0 = time.monotonic()
                 result = roller.rollout(rollout_mode)
@@ -345,6 +516,7 @@ class ServeHarness:
         return f"{host}:{port}"
 
     def shutdown(self) -> None:
+        self._stop_vetting()
         for server in self.servers.values():
             server.stop()
         self._agent_stop.set()

@@ -133,6 +133,28 @@ class SimulatedExecutor:
         self.per_token_s = per_token_s
         self.weight_frac = weight_frac
         self.kv_frac = kv_frac
+        # Brownout (gray failure, faults/plan.py seed_brownout): > 1
+        # while the node's token rate is degraded. Scales base_s AND
+        # per_token_s in place so estimate_s, the admission math that
+        # reads per_token_s, and the execute charge all slow down
+        # together — the node stays honest about its own degradation,
+        # it just IS slower.
+        self.brownout_factor = 1.0
+
+    def set_brownout(self, factor: float) -> None:
+        """Arm (factor > 1) or clear (factor = 1) a degraded token
+        rate: every dispatch and decode step runs ``factor`` times
+        slower while the executor keeps succeeding — the seeded gray
+        failure the fail-slow detector must catch peer-relatively,
+        because nothing on this node ever errors."""
+        f = max(1.0, float(factor))
+        if self.brownout_factor != 1.0:
+            # Restore the nominal rate before re-scaling.
+            self.per_token_s /= self.brownout_factor
+            self.base_s /= self.brownout_factor
+        self.per_token_s *= f
+        self.base_s *= f
+        self.brownout_factor = f
 
     @classmethod
     def from_smoke_result(cls, smoke: dict) -> "SimulatedExecutor":

@@ -101,6 +101,11 @@ class FakeTpuBackend(TpuCcBackend):
         # None -> CC_RESET_PARALLELISM (default 4); only per-chip reset
         # latencies fan out — a scalar keeps the legacy single sleep.
         self.reset_parallelism_override = reset_parallelism_override
+        # Brownout (gray failure, faults/plan.py seed_brownout): every
+        # reset/boot wall is multiplied by this factor while > 1 — the
+        # node fails SLOW, not stop, and probe_runtime_health stays
+        # healthy by construction (that is what makes it gray).
+        self.brownout_factor = 1.0
         self._boot_done_at: dict[int, float] = {}
         # Fault injection: map op name -> remaining failure count (-1 = always).
         self.fail: dict[str, int] = {}
@@ -160,14 +165,24 @@ class FakeTpuBackend(TpuCcBackend):
                 ("clear_staged", tuple(c.index for c in chips))
             )
 
+    def set_brownout(self, factor: float) -> None:
+        """Arm (factor > 1) or clear (factor = 1) a brownout: inflate
+        every reset/boot wall while leaving health probes green — the
+        seeded gray-failure scenario the fail-slow detector exists
+        for."""
+        self.brownout_factor = max(1.0, float(factor))
+
     def _latency_for(self, spec: float | list[float], index: int) -> float:
         """Per-chip latency from a scalar-or-list spec (lists are
-        index-aligned; a short list repeats its last value)."""
+        index-aligned; a short list repeats its last value), scaled by
+        the brownout factor while one is armed."""
         if isinstance(spec, (list, tuple)):
             if not spec:
                 return 0.0
-            return float(spec[index] if index < len(spec) else spec[-1])
-        return float(spec)
+            base = float(spec[index] if index < len(spec) else spec[-1])
+        else:
+            base = float(spec)
+        return base * self.brownout_factor
 
     def _reset_one_chip(self, chip: TpuChip) -> None:
         """One chip's share of a per-chip reset: its own fault point, its
@@ -210,8 +225,9 @@ class FakeTpuBackend(TpuCcBackend):
             )
             self._finish_reset(chips)
             return
-        if self.reset_latency_s:
-            time.sleep(self.reset_latency_s)
+        scalar_wall = self._latency_for(self.reset_latency_s, 0)
+        if scalar_wall:
+            time.sleep(scalar_wall)
         with self._lock:
             now = time.monotonic()
             for chip in chips:

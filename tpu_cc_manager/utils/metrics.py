@@ -289,6 +289,15 @@ class MetricsRegistry:
         self._prestage_reserved: int | None = None  # cclint: guarded-by(_lock)
         self._prestage_headroom_nodes: int | None = None  # cclint: guarded-by(_lock)
         self._prestage_totals: dict[str, int] = {}  # cclint: guarded-by(_lock)
+        # Fail-slow vetting (tpu_cc_failslow_* families; obs/failslow.py):
+        # per-node suspicion flag and last peer-relative deviation ratio
+        # (node window median / fleet median — 1.0 is "moving with the
+        # fleet"), plus concluded verdicts (confirmed/cleared) as a
+        # labeled counter. The gray-failure readout: a node can be deep
+        # in suspicion here while every watchdog probe stays green.
+        self._failslow_suspect: dict[str, bool] = {}  # cclint: guarded-by(_lock)
+        self._failslow_deviation: dict[str, float] = {}  # cclint: guarded-by(_lock)
+        self._failslow_verdict_totals: dict[tuple[str, str], int] = {}  # cclint: guarded-by(_lock)
 
     def start(self, mode: str) -> ReconcileMetrics:
         m = ReconcileMetrics(mode=mode, registry=self)
@@ -672,6 +681,40 @@ class MetricsRegistry:
         with self._lock:
             self._serve_slo[float(window_s)] = (p99_s, burn_rate)
 
+    def set_failslow_suspect(self, node: str, suspect: bool) -> None:
+        """Whether peer-relative fail-slow vetting (obs/failslow.py)
+        currently suspects this node of a gray failure (>= 1 strike or
+        confirmed). 0/1 gauge per node."""
+        with self._lock:
+            self._failslow_suspect[node] = bool(suspect)
+
+    def set_failslow_deviation(self, node: str, deviation: float) -> None:
+        """Last vetting window's peer-relative deviation ratio for this
+        node (window median / fleet median-of-medians): 1.0 moves with
+        the fleet, the confirm threshold defaults to 2.0."""
+        with self._lock:
+            self._failslow_deviation[node] = max(0.0, float(deviation))
+
+    def record_failslow_verdict(self, node: str, verdict: str) -> None:
+        """Count one concluded fail-slow verdict for a node:
+        ``confirmed`` (sustained deviation beyond the threshold for
+        min_windows consecutive windows — feeds the remediation ladder)
+        or ``cleared`` (recovered below the clear threshold for
+        clear_windows consecutive windows — suspicion lifted)."""
+        with self._lock:
+            key = (node, verdict)
+            self._failslow_verdict_totals[key] = (
+                self._failslow_verdict_totals.get(key, 0) + 1
+            )
+
+    def failslow_totals(self) -> dict:
+        with self._lock:
+            return {
+                "suspects": dict(self._failslow_suspect),
+                "deviation": dict(self._failslow_deviation),
+                "verdicts": dict(self._failslow_verdict_totals),
+            }
+
     def serve_totals(self) -> dict:
         with self._lock:
             return {
@@ -802,6 +845,9 @@ class MetricsRegistry:
             prestage_reserved = self._prestage_reserved
             prestage_headroom = self._prestage_headroom_nodes
             prestage_totals = dict(self._prestage_totals)
+            failslow_suspect = dict(self._failslow_suspect)
+            failslow_deviation = dict(self._failslow_deviation)
+            failslow_verdicts = dict(self._failslow_verdict_totals)
         for result in ("ok", "failed", "noop"):
             lines.append(
                 "tpu_cc_reconciles_total%s %d"
@@ -1317,6 +1363,46 @@ class MetricsRegistry:
                 lines.append(
                     "tpu_cc_serve_error_budget_burn%s %.6f"
                     % (_labels(window=_bucket_le(w)), burn)
+                )
+        if failslow_suspect:
+            lines.append(
+                "# HELP tpu_cc_failslow_suspect Whether peer-relative "
+                "fail-slow vetting currently suspects this node of a "
+                "gray failure (obs/failslow.py; 1 = >= 1 strike or "
+                "confirmed — the watchdog probe can be green "
+                "throughout)."
+            )
+            lines.append("# TYPE tpu_cc_failslow_suspect gauge")
+            for node in sorted(failslow_suspect):
+                lines.append(
+                    "tpu_cc_failslow_suspect%s %d"
+                    % (_labels(node=node), 1 if failslow_suspect[node] else 0)
+                )
+        if failslow_deviation:
+            lines.append(
+                "# HELP tpu_cc_failslow_deviation Last vetting window's "
+                "peer-relative deviation ratio per node (window median "
+                "/ fleet median-of-medians; 1.0 = moving with the "
+                "fleet, confirm threshold defaults to 2.0)."
+            )
+            lines.append("# TYPE tpu_cc_failslow_deviation gauge")
+            for node in sorted(failslow_deviation):
+                lines.append(
+                    "tpu_cc_failslow_deviation%s %.4f"
+                    % (_labels(node=node), failslow_deviation[node])
+                )
+        if failslow_verdicts:
+            lines.append(
+                "# HELP tpu_cc_failslow_verdicts_total Concluded "
+                "fail-slow verdicts by node and verdict (confirmed = "
+                "sustained deviation, feeds the remediation ladder; "
+                "cleared = recovered below the clear threshold)."
+            )
+            lines.append("# TYPE tpu_cc_failslow_verdicts_total counter")
+            for (node, verdict), count in sorted(failslow_verdicts.items()):
+                lines.append(
+                    "tpu_cc_failslow_verdicts_total%s %d"
+                    % (_labels(node=node, verdict=verdict), count)
                 )
         # The cumulative per-phase sums/counts are served exclusively as
         # the histogram's _sum/_count series below — separate
